@@ -1,0 +1,16 @@
+"""mqtt_tpu — a TPU-native MQTT broker framework.
+
+A brand-new, embeddable, MQTT v5 / v3.1.1 compliant broker with the
+capability surface of the reference Go broker (xyzj/mqtt-server, Mochi-MQTT
+v2.7.9): QoS 0-2, sessions and takeover, retained messages, shared
+subscriptions, topic aliases, wills, expiry, a stackable hook system,
+TCP/WebSocket/Unix/$SYS listeners, file config, auth ledger, and storage
+hooks.
+
+The host data plane (codec, sessions, hooks) is Python/asyncio; the
+performance-critical wildcard topic matcher runs as a batched JAX/Pallas
+NFA-over-CSR kernel on TPU (``mqtt_tpu.ops``), sharded across device meshes
+via ``mqtt_tpu.parallel``.
+"""
+
+__version__ = "0.1.0"

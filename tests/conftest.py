@@ -1,0 +1,14 @@
+"""Test configuration.
+
+Forces JAX onto the host CPU platform with 8 virtual devices BEFORE any test
+imports jax, so multi-chip sharding tests (mqtt_tpu.parallel) compile and run
+without TPU hardware. Benchmarks (bench.py) run outside pytest and use the
+real device.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

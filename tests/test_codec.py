@@ -1,0 +1,124 @@
+"""Primitive codec conformance: mirrors the reference's codec tests
+(packets/codec_test.go) — offsets, errors, varint bounds, UTF-8 rules."""
+
+import pytest
+
+from mqtt_tpu.packets import codec
+from mqtt_tpu.packets.codes import (
+    ERR_MALFORMED_INVALID_UTF8,
+    ERR_MALFORMED_OFFSET_BOOL_OUT_OF_RANGE,
+    ERR_MALFORMED_OFFSET_BYTE_OUT_OF_RANGE,
+    ERR_MALFORMED_OFFSET_BYTES_OUT_OF_RANGE,
+    ERR_MALFORMED_OFFSET_UINT_OUT_OF_RANGE,
+    ERR_MALFORMED_VARIABLE_BYTE_INTEGER,
+)
+
+
+class TestUint:
+    def test_decode_uint16(self):
+        assert codec.decode_uint16(b"\x00\x7b\xff", 0) == (123, 2)
+        assert codec.decode_uint16(b"\xff\x01\xc8", 1) == (456, 3)
+
+    def test_decode_uint16_underflow(self):
+        with pytest.raises(type(ERR_MALFORMED_OFFSET_UINT_OUT_OF_RANGE)) as e:
+            codec.decode_uint16(b"\x01", 0)
+        assert e.value == ERR_MALFORMED_OFFSET_UINT_OUT_OF_RANGE
+
+    def test_decode_uint32(self):
+        assert codec.decode_uint32(b"\x00\x00\x00\x7b", 0) == (123, 4)
+        assert codec.decode_uint32(b"\x00\x00\x01\xc8\x27", 0) == (456, 4)
+
+    def test_decode_uint32_underflow(self):
+        with pytest.raises(Exception) as e:
+            codec.decode_uint32(b"\x01\x02\x03", 0)
+        assert e.value == ERR_MALFORMED_OFFSET_UINT_OUT_OF_RANGE
+
+    def test_roundtrip(self):
+        assert codec.encode_uint16(123) == b"\x00\x7b"
+        assert codec.encode_uint32(70000) == b"\x00\x01\x11\x70"
+
+
+class TestStringsBytes:
+    def test_decode_string(self):
+        assert codec.decode_string(b"\x00\x03\x61\x2f\x62", 0) == ("a/b", 5)
+
+    def test_decode_string_invalid_utf8(self):
+        with pytest.raises(Exception) as e:
+            codec.decode_string(b"\x00\x02\xff\xfe", 0)
+        assert e.value == ERR_MALFORMED_INVALID_UTF8
+
+    def test_decode_string_rejects_nul(self):
+        # [MQTT-1.5.4-2]
+        with pytest.raises(Exception) as e:
+            codec.decode_string(b"\x00\x03a\x00b", 0)
+        assert e.value == ERR_MALFORMED_INVALID_UTF8
+
+    def test_decode_bytes(self):
+        assert codec.decode_bytes(b"\x00\x02\xde\xad\xbe", 0) == (b"\xde\xad", 4)
+
+    def test_decode_bytes_overflow(self):
+        with pytest.raises(Exception) as e:
+            codec.decode_bytes(b"\x00\x05\x01", 0)
+        assert e.value == ERR_MALFORMED_OFFSET_BYTES_OUT_OF_RANGE
+
+    def test_decode_byte(self):
+        assert codec.decode_byte(b"\x07", 0) == (7, 1)
+        with pytest.raises(Exception) as e:
+            codec.decode_byte(b"", 0)
+        assert e.value == ERR_MALFORMED_OFFSET_BYTE_OUT_OF_RANGE
+
+    def test_decode_byte_bool(self):
+        assert codec.decode_byte_bool(b"\x01", 0) == (True, 1)
+        assert codec.decode_byte_bool(b"\x00", 0) == (False, 1)
+        with pytest.raises(Exception) as e:
+            codec.decode_byte_bool(b"", 0)
+        assert e.value == ERR_MALFORMED_OFFSET_BOOL_OUT_OF_RANGE
+
+    def test_encode_string(self):
+        assert codec.encode_string("a/b") == b"\x00\x03a/b"
+        assert codec.encode_string("") == b"\x00\x00"
+
+    def test_encode_bytes(self):
+        assert codec.encode_bytes(b"\x01\x02") == b"\x00\x02\x01\x02"
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "value,encoded",
+        [
+            (0, b"\x00"),
+            (127, b"\x7f"),
+            (128, b"\x80\x01"),
+            (16383, b"\xff\x7f"),
+            (16384, b"\x80\x80\x01"),
+            (2097151, b"\xff\xff\x7f"),
+            (2097152, b"\x80\x80\x80\x01"),
+            (268435455, b"\xff\xff\xff\x7f"),
+        ],
+    )
+    def test_roundtrip(self, value, encoded):
+        out = bytearray()
+        codec.encode_length(out, value)
+        assert bytes(out) == encoded
+        assert codec.decode_length(encoded, 0) == (value, len(encoded))
+
+    def test_decode_overflow(self):
+        with pytest.raises(Exception) as e:
+            codec.decode_length(b"\xff\xff\xff\xff\x7f", 0)
+        assert e.value == ERR_MALFORMED_VARIABLE_BYTE_INTEGER
+
+    def test_decode_truncated(self):
+        with pytest.raises(Exception) as e:
+            codec.decode_length(b"\x80", 0)
+        assert e.value == ERR_MALFORMED_VARIABLE_BYTE_INTEGER
+
+
+class TestValidUtf8:
+    def test_valid(self):
+        assert codec.valid_utf8(b"hello")
+        assert codec.valid_utf8("héllo".encode())
+        assert codec.valid_utf8(b"")
+
+    def test_invalid(self):
+        assert not codec.valid_utf8(b"\xff\xfe")
+        assert not codec.valid_utf8(b"a\x00b")
