@@ -9,7 +9,6 @@ state (inflight, retained, wills) store packets uniformly.
 
 from __future__ import annotations
 
-import threading
 from dataclasses import dataclass, field
 
 from . import fixedheader as fh
@@ -84,6 +83,7 @@ from .codes import (
     ERR_WILDCARD_SUBSCRIPTIONS_NOT_SUPPORTED,
     Code,
 )
+from ..utils import LockedMap
 from .fixedheader import FixedHeader
 from .properties import Mods, Properties
 
@@ -769,30 +769,6 @@ def _wrap(inner: Code, outer: Code) -> Code:
     return outer.wrap(inner)
 
 
-class PacketStore:
+class PacketStore(LockedMap[str, Packet]):
     """Concurrency-safe id-keyed packet map used for the retained-message
     store and delayed wills (reference packets.go:66-117)."""
-
-    def __init__(self) -> None:
-        self._lock = threading.RLock()
-        self._internal: dict[str, Packet] = {}
-
-    def add(self, id_: str, val: Packet) -> None:
-        with self._lock:
-            self._internal[id_] = val
-
-    def get(self, id_: str) -> Packet | None:
-        with self._lock:
-            return self._internal.get(id_)
-
-    def get_all(self) -> dict[str, Packet]:
-        with self._lock:
-            return dict(self._internal)
-
-    def delete(self, id_: str) -> None:
-        with self._lock:
-            self._internal.pop(id_, None)
-
-    def __len__(self) -> int:
-        with self._lock:
-            return len(self._internal)

@@ -25,7 +25,7 @@ from .codec import (
     encode_uint16,
     encode_uint32,
 )
-from .codes import ERR_PROTOCOL_VIOLATION_UNSUPPORTED_PROPERTY, Code
+from .codes import ERR_PROTOCOL_VIOLATION_UNSUPPORTED_PROPERTY
 
 PROP_PAYLOAD_FORMAT = 1
 PROP_MESSAGE_EXPIRY_INTERVAL = 2
@@ -338,10 +338,8 @@ class Properties:
         while offset < end:
             k, offset = decode_byte(buf, offset)
             if pkt not in VALID_PACKET_PROPERTIES.get(k, ()):
-                raise Code(
-                    ERR_PROTOCOL_VIOLATION_UNSUPPORTED_PROPERTY.code,
-                    f"property type {k} not valid for packet type {pkt}: "
-                    + ERR_PROTOCOL_VIOLATION_UNSUPPORTED_PROPERTY.reason,
+                raise ERR_PROTOCOL_VIOLATION_UNSUPPORTED_PROPERTY.wrap(
+                    f"property type {k} not valid for packet type {pkt}"
                 )
             if k == PROP_PAYLOAD_FORMAT:
                 self.payload_format, offset = decode_byte(buf, offset)

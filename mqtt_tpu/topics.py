@@ -1,0 +1,476 @@
+"""Topic trie: subscriptions, shared subscriptions, inline subscriptions,
+retained messages, wildcard match walks, and topic aliases.
+
+Behavioral parity with reference ``topics.go`` — this host implementation is
+the bit-identical oracle (and fallback path) for the device matcher in
+``mqtt_tpu.ops``. The corner cases that define "bit-identical":
+
+- ``zen/#`` matches ``zen`` (spec 4.7.1.2), via the child-``#`` gather at the
+  terminal level (topics.go:612-616).
+- ``a/b`` must NOT match ``a/b/c`` (no prefix inheritance).
+- ``$``-prefixed topics are not matched by TOP-LEVEL ``+``/``#`` filters
+  [MQTT-4.7.1-1/2]; the check is on the subscription's original filter string
+  (topics.go:637).
+- Empty levels are real levels: ``/a/`` is ``["", "a", ""]``.
+- ``#`` is gathered at every walk level; ``+`` forks the frontier.
+- Shared subscriptions (``$SHARE/<group>/<filter>``) root their subtree at
+  depth 2 (topics.go:407-411).
+
+Quirk replicated on purpose (topics.go:615): in the terminal child-``#``
+branch, the reference gathers the *parent* particle's inline subscriptions
+again instead of the wild child's — so an inline subscription on ``a/#``
+does not match topic ``a``.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .packets import Packet, PacketStore, Subscription
+from .utils import LockedMap
+
+SHARE_PREFIX = "$SHARE"  # prefix indicating a shared-subscription filter
+SYS_PREFIX = "$SYS"  # prefix indicating a system info topic
+
+
+def isolate_particle(filter: str, d: int) -> tuple[str, bool]:
+    """Extract the topic level at depth ``d`` and whether more levels follow.
+
+    Depths past the last level clamp to the last level (reference
+    topics.go:679-698) — the retained-message ``#`` walk relies on this.
+    """
+    parts = filter.split("/")
+    if d >= len(parts):
+        return parts[-1], False
+    return parts[d], d < len(parts) - 1
+
+
+def is_shared_filter(filter: str) -> bool:
+    prefix, _ = isolate_particle(filter, 0)
+    return prefix.upper() == SHARE_PREFIX
+
+
+def is_valid_filter(filter: str, for_publish: bool = False) -> bool:
+    """Validate a topic filter (or topic name when ``for_publish``);
+    reference topics.go:707-745."""
+    if not for_publish and len(filter) == 0:
+        return False  # [MQTT-4.7.3-1]
+    if for_publish:
+        # 4.7.2: the server prevents clients using $SYS topic names to
+        # exchange messages with other clients.
+        if len(filter) >= len(SYS_PREFIX) and filter[: len(SYS_PREFIX)].upper() == SYS_PREFIX:
+            return False
+        if "+" in filter or "#" in filter:
+            return False  # [MQTT-3.3.2-2]
+    wildhash = filter.find("#")
+    if wildhash >= 0 and wildhash != len(filter) - 1:
+        return False  # [MQTT-4.7.1-2]
+    prefix, has_next = isolate_particle(filter, 0)
+    if prefix.upper() == SHARE_PREFIX:
+        if not has_next:
+            return False  # [MQTT-4.8.2-1]
+        group, has_next = isolate_particle(filter, 1)
+        if not has_next:
+            return False  # [MQTT-4.8.2-1]
+        if "+" in group or "#" in group:
+            return False  # [MQTT-4.8.2-2]
+    return True
+
+
+# -- topic aliases ---------------------------------------------------------
+
+
+class InboundTopicAliases:
+    """Aliases received from the client (topics.go:43-64)."""
+
+    def __init__(self, maximum: int) -> None:
+        self.maximum = maximum
+        self.internal: dict[int, str] = {}
+        self._lock = threading.Lock()
+
+    def set(self, id_: int, topic: str) -> str:
+        with self._lock:
+            if self.maximum == 0:
+                return topic
+            if topic == "" and id_ in self.internal:
+                return self.internal[id_]
+            self.internal[id_] = topic
+            return topic
+
+
+class OutboundTopicAliases:
+    """Aliases assigned by the broker for messages to the client; ids are
+    cursor-allocated 1..maximum (topics.go:67-105)."""
+
+    def __init__(self, maximum: int) -> None:
+        self.maximum = maximum
+        self.internal: dict[str, int] = {}
+        self.cursor = 0
+        self._lock = threading.Lock()
+
+    def set(self, topic: str) -> tuple[int, bool]:
+        """Returns ``(alias, already_existed)``; ``(0, False)`` when aliases
+        are disabled or exhausted."""
+        with self._lock:
+            if self.maximum == 0:
+                return 0, False
+            if topic in self.internal:
+                return self.internal[topic], True
+            if self.cursor + 1 > self.maximum:
+                return 0, False
+            self.cursor += 1
+            self.internal[topic] = self.cursor
+            return self.cursor, False
+
+
+class TopicAliases:
+    """Inbound and outbound alias registries for one client (topics.go:21)."""
+
+    def __init__(self, topic_alias_maximum: int) -> None:
+        self.inbound = InboundTopicAliases(topic_alias_maximum)
+        self.outbound = OutboundTopicAliases(topic_alias_maximum)
+
+
+# -- subscription containers -----------------------------------------------
+
+
+class Subscriptions(LockedMap[str, Subscription]):
+    """A map of subscriptions, keyed by client id (trie state) or by filter
+    (client state) (topics.go:249-301)."""
+
+
+class SharedSubscriptions:
+    """Shared subscriptions for one filter: group -> client id -> sub
+    (topics.go:109-187)."""
+
+    def __init__(self) -> None:
+        self.internal: dict[str, dict[str, Subscription]] = {}
+        self._lock = threading.RLock()
+
+    def add(self, group: str, id_: str, val: Subscription) -> None:
+        with self._lock:
+            self.internal.setdefault(group, {})[id_] = val
+
+    def delete(self, group: str, id_: str) -> None:
+        with self._lock:
+            subs = self.internal.get(group)
+            if subs is None:
+                return
+            subs.pop(id_, None)
+            if not subs:
+                del self.internal[group]
+
+    def get(self, group: str, id_: str) -> Optional[Subscription]:
+        with self._lock:
+            return self.internal.get(group, {}).get(id_)
+
+    def get_all(self) -> dict[str, dict[str, Subscription]]:
+        with self._lock:
+            return {group: dict(subs) for group, subs in self.internal.items()}
+
+    def group_len(self) -> int:
+        with self._lock:
+            return len(self.internal)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return sum(len(subs) for subs in self.internal.values())
+
+
+# Signature of an inline (in-process) subscription callback: receives the
+# local client, the matched subscription, and the publish packet.
+InlineSubFn = Callable[["object", Subscription, Packet], None]
+
+
+@dataclass
+class InlineSubscription(Subscription):
+    """An in-process subscription: a Subscription plus a handler callback,
+    keyed on the subscription identifier (topics.go:306-309)."""
+
+    handler: InlineSubFn | None = None
+
+
+class InlineSubscriptions(LockedMap[int, "InlineSubscription"]):
+    """Inline subscriptions for one particle, keyed on identifier
+    (topics.go:195-246)."""
+
+    def add_inline(self, val: "InlineSubscription") -> None:
+        self.add(val.identifier, val)
+
+
+# Aggregated subscriptions for one client, keyed on filter.
+ClientSubscriptions = dict
+
+
+class Subscribers:
+    """The result set of a subscriber scan (topics.go:312-347)."""
+
+    def __init__(self) -> None:
+        self.shared: dict[str, dict[str, Subscription]] = {}
+        self.shared_selected: dict[str, Subscription] = {}
+        self.subscriptions: dict[str, Subscription] = {}
+        self.inline_subscriptions: dict[int, InlineSubscription] = {}
+
+    def select_shared(self) -> None:
+        """Pick one subscriber per shared group. The reference picks the
+        first map-iteration entry (nondeterministic in Go, insertion-ordered
+        here); selection stays host-side and pluggable via the
+        on_select_subscribers hook."""
+        self.shared_selected = {}
+        for subs in self.shared.values():
+            for client, sub in subs.items():
+                cls = self.shared_selected.get(client, sub)
+                self.shared_selected[client] = cls.merge(sub)
+                break
+
+    def merge_shared_selected(self) -> None:
+        """Fold selected shared subscribers into the non-shared set so no
+        client receives duplicates (topics.go:338-347)."""
+        for client, sub in self.shared_selected.items():
+            cls = self.subscriptions.get(client, sub)
+            self.subscriptions[client] = cls.merge(sub)
+
+
+# -- the trie --------------------------------------------------------------
+
+
+class _Particle:
+    """One trie node (reference 'particle', topics.go:748-769)."""
+
+    __slots__ = (
+        "key",
+        "parent",
+        "particles",
+        "subscriptions",
+        "shared",
+        "inline_subscriptions",
+        "retain_path",
+    )
+
+    def __init__(self, key: str, parent: "_Particle | None") -> None:
+        self.key = key
+        self.parent = parent
+        self.particles: dict[str, _Particle] = {}
+        self.subscriptions = Subscriptions()
+        self.shared = SharedSubscriptions()
+        self.inline_subscriptions = InlineSubscriptions()
+        self.retain_path = ""
+
+
+class TopicsIndex:
+    """A trie of topic filters with subscriber scan and retained-message
+    walks (reference TopicsIndex, topics.go:350+)."""
+
+    def __init__(self) -> None:
+        self.retained = PacketStore()
+        self.root = _Particle("", None)
+        self._lock = threading.RLock()
+
+    # -- mutation ----------------------------------------------------------
+
+    def subscribe(self, client: str, subscription: Subscription) -> bool:
+        """Add a subscription; returns True if it was new (topics.go:401-419).
+        ``$SHARE/<group>/<filter>`` roots the subtree at depth 2."""
+        with self._lock:
+            prefix, _ = isolate_particle(subscription.filter, 0)
+            if prefix.upper() == SHARE_PREFIX:
+                group, _ = isolate_particle(subscription.filter, 1)
+                n = self._set(subscription.filter, 2)
+                existed = n.shared.get(group, client) is not None
+                n.shared.add(group, client, subscription)
+            else:
+                n = self._set(subscription.filter, 0)
+                existed = n.subscriptions.get(client) is not None
+                n.subscriptions.add(client, subscription)
+            return not existed
+
+    def unsubscribe(self, filter: str, client: str) -> bool:
+        """Remove a client's subscription; returns True if it existed
+        (topics.go:423-448)."""
+        with self._lock:
+            d = 0
+            prefix, _ = isolate_particle(filter, 0)
+            share_sub = prefix.upper() == SHARE_PREFIX
+            if share_sub:
+                d = 2
+            particle = self._seek(filter, d)
+            if particle is None:
+                return False
+            if share_sub:
+                group, _ = isolate_particle(filter, 1)
+                particle.shared.delete(group, client)
+            else:
+                particle.subscriptions.delete(client)
+            self._trim(particle)
+            return True
+
+    def inline_subscribe(self, subscription: InlineSubscription) -> bool:
+        """Add an in-process subscription keyed on its identifier; returns
+        True if new (topics.go:368-378)."""
+        with self._lock:
+            n = self._set(subscription.filter, 0)
+            existed = n.inline_subscriptions.get(subscription.identifier) is not None
+            n.inline_subscriptions.add_inline(subscription)
+            return not existed
+
+    def inline_unsubscribe(self, id_: int, filter: str) -> bool:
+        with self._lock:
+            particle = self._seek(filter, 0)
+            if particle is None:
+                return False
+            particle.inline_subscriptions.delete(id_)
+            if len(particle.inline_subscriptions) == 0:
+                self._trim(particle)
+            return True
+
+    def retain_message(self, pk: Packet) -> int:
+        """Store/clear the retained message for a topic. Returns 1 when a
+        message was retained, -1 when an existing one was cleared, 0 for a
+        clear with nothing to clear (topics.go:453-476)."""
+        with self._lock:
+            n = self._set(pk.topic_name, 0)
+            if pk.payload:
+                n.retain_path = pk.topic_name
+                self.retained.add(pk.topic_name, pk)
+                return 1
+            out = 0
+            pke = self.retained.get(pk.topic_name)
+            if pke is not None and pke.payload and pke.fixed_header.retain:
+                out = -1
+            n.retain_path = ""
+            self.retained.delete(pk.topic_name)  # [MQTT-3.3.1-6] [MQTT-3.3.1-7]
+            self._trim(n)
+            return out
+
+    def _set(self, topic: str, d: int) -> _Particle:
+        """Create (or find) the particle at a topic address (topics.go:479)."""
+        parts = topic.split("/")
+        n = self.root
+        for key in parts[d:] if d < len(parts) else [parts[-1]]:
+            p = n.particles.get(key)
+            if p is None:
+                p = _Particle(key, n)
+                n.particles[key] = p
+            n = p
+        return n
+
+    def _seek(self, filter: str, d: int) -> _Particle | None:
+        parts = filter.split("/")
+        n = self.root
+        for key in parts[d:] if d < len(parts) else [parts[-1]]:
+            n = n.particles.get(key)
+            if n is None:
+                return None
+        return n
+
+    def _trim(self, n: _Particle) -> None:
+        """Prune empty particles up the parent chain (topics.go:516-522)."""
+        while (
+            n.parent is not None
+            and n.retain_path == ""
+            and len(n.particles) + len(n.subscriptions) + len(n.shared) + len(n.inline_subscriptions) == 0
+        ):
+            key = n.key
+            n = n.parent
+            n.particles.pop(key, None)
+
+    # -- scans -------------------------------------------------------------
+
+    def subscribers(self, topic: str) -> Subscribers:
+        """All clients subscribed to filters matching ``topic`` — THE hot
+        walk the TPU matcher accelerates (topics.go:583-628). Iterative
+        frontier walk (explicit stack) so deep topics cannot overflow the
+        interpreter's recursion limit."""
+        subs = Subscribers()
+        if len(topic) == 0:
+            return subs
+        parts = topic.split("/")
+        last = len(parts) - 1
+        stack: list[tuple[_Particle, int]] = [(self.root, 0)]
+        while stack:
+            n, d = stack.pop()
+            key = parts[d] if d < len(parts) else parts[-1]
+            has_next = d < last
+            for part_key in (key, "+"):
+                particle = n.particles.get(part_key)
+                if particle is not None:  # [MQTT-3.3.2-3]
+                    if has_next:
+                        stack.append((particle, d + 1))
+                    else:
+                        self._gather_subscriptions(topic, particle, subs)
+                        self._gather_shared(particle, subs)
+                        self._gather_inline(particle, subs)
+                        wild = particle.particles.get("#")
+                        if wild is not None and part_key != "+":
+                            # filter/# matches filter itself, per spec 4.7.1.2
+                            self._gather_subscriptions(topic, wild, subs)
+                            self._gather_shared(wild, subs)
+                            # reference quirk (topics.go:615): gathers the
+                            # parent particle's inline subs, not the wild
+                            # child's
+                            self._gather_inline(particle, subs)
+            particle = n.particles.get("#")
+            if particle is not None:
+                self._gather_subscriptions(topic, particle, subs)
+                self._gather_shared(particle, subs)
+                self._gather_inline(particle, subs)
+        return subs
+
+    def _gather_subscriptions(self, topic: str, particle: _Particle, subs: Subscribers) -> None:
+        """Merge a particle's subscriptions into the result set, excluding
+        top-level-wildcard filters for $-topics [MQTT-4.7.1-1/2]
+        (topics.go:631-648)."""
+        for client, sub in particle.subscriptions.get_all().items():
+            if sub.filter and topic[0] == "$" and sub.filter[0] in "+#":
+                continue
+            cls = subs.subscriptions.get(client, sub)
+            subs.subscriptions[client] = cls.merge(sub)
+
+    def _gather_shared(self, particle: _Particle, subs: Subscribers) -> None:
+        for shares in particle.shared.get_all().values():
+            for client, sub in shares.items():
+                subs.shared.setdefault(sub.filter, {})[client] = sub
+
+    def _gather_inline(self, particle: _Particle, subs: Subscribers) -> None:
+        subs.inline_subscriptions.update(particle.inline_subscriptions.get_all())
+
+    def messages(self, filter: str) -> list[Packet]:
+        """All retained messages matching ``filter`` (topics.go:525-579).
+        Iterative walk — see :meth:`subscribers`."""
+        pks: list[Packet] = []
+        if len(filter) == 0 or len(self.retained) == 0:
+            return pks
+        if "#" not in filter and "+" not in filter:
+            pk = self.retained.get(filter)
+            if pk is not None:
+                pks.append(pk)
+            return pks
+        parts = filter.split("/")
+        last = len(parts) - 1
+        stack: list[tuple[_Particle, int]] = [(self.root, 0)]
+        while stack:
+            n, d = stack.pop()
+            key = parts[d] if d < len(parts) else parts[-1]
+            has_next = d < last
+            if key in ("+", "#"):
+                for adjacent in list(n.particles.values()):
+                    if d == 0 and adjacent.key == SYS_PREFIX:
+                        continue
+                    if not has_next and adjacent.retain_path:
+                        pk = self.retained.get(adjacent.retain_path)
+                        if pk is not None:
+                            pks.append(pk)
+                    if has_next or key == "#":
+                        stack.append((adjacent, d + 1))
+            else:
+                particle = n.particles.get(key)
+                if particle is not None:
+                    if has_next:
+                        stack.append((particle, d + 1))
+                    elif particle.retain_path:
+                        pk = self.retained.get(particle.retain_path)
+                        if pk is not None:
+                            pks.append(pk)
+        return pks

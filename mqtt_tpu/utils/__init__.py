@@ -1,0 +1,5 @@
+"""Shared host-side utilities."""
+
+from .locked import LockedMap
+
+__all__ = ["LockedMap"]

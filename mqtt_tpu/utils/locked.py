@@ -1,0 +1,42 @@
+"""A lock-guarded map shared by the broker's concurrent registries.
+
+The reference wraps every shared map in a small mutex-guarded struct
+(e.g. topics.go:249-301, packets/packets.go:66-117); this is the one Python
+equivalent they all reuse.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, Hashable, Optional, TypeVar
+
+K = TypeVar("K", bound=Hashable)
+V = TypeVar("V")
+
+
+class LockedMap(Generic[K, V]):
+    """RLock-protected dict with copy-on-iterate semantics."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self.internal: dict[K, V] = {}
+
+    def add(self, key: K, val: V) -> None:
+        with self._lock:
+            self.internal[key] = val
+
+    def get(self, key: K) -> Optional[V]:
+        with self._lock:
+            return self.internal.get(key)
+
+    def get_all(self) -> dict[K, V]:
+        with self._lock:
+            return dict(self.internal)
+
+    def delete(self, key: K) -> None:
+        with self._lock:
+            self.internal.pop(key, None)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.internal)

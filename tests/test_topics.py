@@ -1,0 +1,426 @@
+"""Topic trie conformance — ports of the reference's oracle tables
+(topics_test.go): the wildcard match matrix (TestSubscribersFind :590), the
+multi-client merge scan (TestScanSubscribers :490), the inheritance bug-check
+(:530), shared-group selection (:539-588), retained-message patterns (:640),
+isolate_particle empty-level semantics (:452), and filter validity (:755).
+
+These tables are the bit-identical oracle for the TPU matcher.
+"""
+
+import pytest
+
+from mqtt_tpu.packets import FixedHeader, Packet, Subscription, PUBLISH
+from mqtt_tpu.topics import (
+    SHARE_PREFIX,
+    InlineSubscription,
+    SharedSubscriptions,
+    Subscribers,
+    TopicAliases,
+    TopicsIndex,
+    is_shared_filter,
+    is_valid_filter,
+    isolate_particle,
+)
+
+
+class TestIsolateParticle:
+    def test_basic(self):
+        assert isolate_particle("path/to/my/mqtt", 0) == ("path", True)
+        assert isolate_particle("path/to/my/mqtt", 1) == ("to", True)
+        assert isolate_particle("path/to/my/mqtt", 2) == ("my", True)
+        assert isolate_particle("path/to/my/mqtt", 3) == ("mqtt", False)
+
+    def test_empty_levels(self):
+        assert isolate_particle("/path/", 0) == ("", True)
+        assert isolate_particle("/path/", 1) == ("path", True)
+        assert isolate_particle("/path/", 2) == ("", False)
+
+    def test_wildcards(self):
+        assert isolate_particle("a/b/c/+/+", 3) == ("+", True)
+        assert isolate_particle("a/b/c/+/+", 4) == ("+", False)
+
+    def test_clamps_past_end(self):
+        assert isolate_particle("a/b", 5) == ("b", False)
+
+
+class TestSubscribe:
+    def test_new_and_existing(self):
+        index = TopicsIndex()
+        assert index.subscribe("cl1", Subscription(filter="a/b/c", qos=1))
+        assert not index.subscribe("cl1", Subscription(filter="a/b/c", qos=2))
+        assert index.subscribe("cl2", Subscription(filter="a/b/c"))
+
+    def test_shared(self):
+        index = TopicsIndex()
+        assert index.subscribe("cl1", Subscription(filter=SHARE_PREFIX + "/grp/a/b"))
+        assert not index.subscribe("cl1", Subscription(filter=SHARE_PREFIX + "/grp/a/b"))
+        assert index.subscribe("cl2", Subscription(filter=SHARE_PREFIX + "/grp/a/b"))
+        subs = index.subscribers("a/b")
+        assert len(subs.shared) == 1
+
+    def test_unsubscribe(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(filter="a/b/c"))
+        index.subscribe("cl2", Subscription(filter="a/b/c"))
+        assert index.unsubscribe("a/b/c", "cl1")
+        assert not index.unsubscribe("d/e/f", "cl1")
+        subs = index.subscribers("a/b/c")
+        assert list(subs.subscriptions) == ["cl2"]
+
+    def test_unsubscribe_no_cascade(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(filter="a/b/c/d"))
+        index.subscribe("cl1", Subscription(filter="a/b"))
+        assert index.unsubscribe("a/b/c/d", "cl1")
+        subs = index.subscribers("a/b")
+        assert len(subs.subscriptions) == 1
+
+    def test_unsubscribe_shared(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(filter=SHARE_PREFIX + "/grp/a/b"))
+        assert index.unsubscribe(SHARE_PREFIX + "/grp/a/b", "cl1")
+        assert len(index.subscribers("a/b").shared) == 0
+
+
+class TestRetainMessage:
+    def _pk(self, topic, payload=b"hello"):
+        return Packet(
+            fixed_header=FixedHeader(type=PUBLISH, retain=True),
+            topic_name=topic,
+            payload=payload,
+        )
+
+    def test_add_clear(self):
+        index = TopicsIndex()
+        assert index.retain_message(self._pk("a/b/c")) == 1
+        assert index.retain_message(self._pk("a/b/c")) == 1  # replace
+        assert index.retain_message(self._pk("a/b/c", b"")) == -1  # clear
+        assert index.retain_message(self._pk("a/b/c", b"")) == 0  # no-op clear
+        assert len(index.retained) == 0
+
+
+class TestScanSubscribers:
+    def _index(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(qos=1, filter="a/b/c", identifier=22))
+        index.subscribe("cl1", Subscription(qos=1, filter="a/b/c/d/e/f"))
+        index.subscribe("cl1", Subscription(qos=2, filter="a/b/c/d/+/f"))
+        index.subscribe("cl2", Subscription(qos=0, filter="a/#"))
+        index.subscribe("cl2", Subscription(qos=1, filter="a/b/c"))
+        index.subscribe("cl2", Subscription(qos=2, filter="a/b/+", identifier=77))
+        index.subscribe("cl2", Subscription(qos=2, filter="d/e/f", identifier=7237))
+        index.subscribe("cl2", Subscription(qos=2, filter="$SYS/uptime", identifier=3))
+        index.subscribe("cl3", Subscription(qos=1, filter="+/b", identifier=234))
+        index.subscribe("cl4", Subscription(qos=0, filter="#", identifier=5))
+        index.subscribe("cl2", Subscription(qos=0, filter="$SYS/test", identifier=2))
+        return index
+
+    def test_multi_client_merge(self):
+        subs = self._index().subscribers("a/b/c")
+        assert set(subs.subscriptions) == {"cl1", "cl2", "cl4"}
+        assert subs.subscriptions["cl1"].qos == 1
+        assert subs.subscriptions["cl2"].qos == 2
+        assert subs.subscriptions["cl4"].qos == 0
+        assert subs.subscriptions["cl1"].identifiers["a/b/c"] == 22
+        # Go map zero-value semantics: absent-or-zero both read as 0
+        assert subs.subscriptions["cl2"].identifiers.get("a/#", 0) == 0
+        assert subs.subscriptions["cl2"].identifiers["a/b/+"] == 77
+        assert subs.subscriptions["cl2"].identifiers.get("a/b/c", 0) == 0
+        assert subs.subscriptions["cl4"].identifiers["#"] == 5
+
+    def test_hash_only(self):
+        subs = self._index().subscribers("d/e/f/g")
+        assert set(subs.subscriptions) == {"cl4"}
+        assert subs.subscriptions["cl4"].qos == 0
+        assert subs.subscriptions["cl4"].identifiers["#"] == 5
+
+    def test_empty_topic(self):
+        assert len(self._index().subscribers("").subscriptions) == 0
+
+    def test_topic_inheritance_bug(self):
+        # a/b must NOT match a/b/c (topics_test.go:530)
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(qos=0, filter="a/b/c"))
+        index.subscribe("cl2", Subscription(qos=0, filter="a/b"))
+        subs = index.subscribers("a/b/c")
+        assert len(subs.subscriptions) == 1
+
+
+class TestSharedScan:
+    def test_groups_matched(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(qos=1, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=111))
+        index.subscribe("cl2", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=112))
+        index.subscribe("cl3", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp2/a/b/c", identifier=113))
+        index.subscribe("cl2", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/+", identifier=10))
+        index.subscribe("cl3", Subscription(qos=1, filter=SHARE_PREFIX + "/tmp/a/b/+", identifier=200))
+        index.subscribe("cl4", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/+", identifier=201))
+        index.subscribe("cl5", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/c/#"))
+        subs = index.subscribers("a/b/c")
+        assert len(subs.shared) == 4
+
+    def test_select_shared(self):
+        index = TopicsIndex()
+        index.subscribe("cl1", Subscription(qos=1, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=110))
+        index.subscribe("cl1b", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=111))
+        index.subscribe("cl2", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=112))
+        index.subscribe("cl3", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp2/a/b/c", identifier=113))
+        subs = index.subscribers("a/b/c")
+        assert len(subs.shared) == 2
+        assert SHARE_PREFIX + "/tmp/a/b/c" in subs.shared
+        assert SHARE_PREFIX + "/tmp2/a/b/c" in subs.shared
+        assert len(subs.shared[SHARE_PREFIX + "/tmp/a/b/c"]) == 3
+        assert len(subs.shared[SHARE_PREFIX + "/tmp2/a/b/c"]) == 1
+        subs.select_shared()
+        assert len(subs.shared_selected) == 2
+
+    def test_merge_shared_selected(self):
+        s = Subscribers()
+        s.shared_selected = {
+            "cl1": Subscription(qos=1, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=110),
+            "cl2": Subscription(qos=1, filter=SHARE_PREFIX + "/tmp2/a/b/c", identifier=111),
+        }
+        s.subscriptions = {
+            "cl2": Subscription(qos=1, filter="a/b/c", identifier=112),
+        }
+        s.merge_shared_selected()
+        assert set(s.subscriptions) == {"cl1", "cl2"}
+        assert s.subscriptions["cl2"].identifiers == {
+            SHARE_PREFIX + "/tmp2/a/b/c": 111,
+            "a/b/c": 112,
+        }
+
+
+# the wildcard match matrix from topics_test.go:590-627
+FIND_MATRIX = [
+    ("a", "a", True),
+    ("a/", "a", False),
+    ("a/", "a/", True),
+    ("/a", "/a", True),
+    ("path/to/my/mqtt", "path/to/my/mqtt", True),
+    ("path/to/+/mqtt", "path/to/my/mqtt", True),
+    ("+/to/+/mqtt", "path/to/my/mqtt", True),
+    ("#", "path/to/my/mqtt", True),
+    ("+/+/+/+", "path/to/my/mqtt", True),
+    ("+/+/+/#", "path/to/my/mqtt", True),
+    ("zen/#", "zen", True),  # as per 4.7.1.2
+    ("trailing-end/#", "trailing-end/", True),
+    ("+/prefixed", "/prefixed", True),
+    ("+/+/#", "path/to/my/mqtt", True),
+    ("path/to/", "path/to/my/mqtt", False),
+    ("#/stuff", "path/to/my/mqtt", False),
+    ("#", "$SYS/info", False),
+    ("$SYS/#", "$SYS/info", True),
+    ("+/info", "$SYS/info", False),
+]
+
+
+@pytest.mark.parametrize("filter_,topic,matched", FIND_MATRIX, ids=[f"{f}~{t}" for f, t, _ in FIND_MATRIX])
+def test_subscribers_find(filter_, topic, matched):
+    index = TopicsIndex()
+    index.subscribe("cl1", Subscription(filter=filter_))
+    subs = index.subscribers(topic)
+    assert (len(subs.subscriptions) == 1) == matched
+
+
+# the retained-message pattern matrix from topics_test.go:640-686
+RETAINED_TOPICS = [
+    "$SYS/uptime",
+    "$SYS/info",
+    "a/b/c/d",
+    "a/b/c/e",
+    "a/b/d/f",
+    "q/w/e/r/t/y",
+    "q/x/e/r/t/o",
+    "asdf",
+]
+
+MESSAGES_MATRIX = [
+    ("a/b/c/d", 1),
+    ("$SYS/+", 2),
+    ("$SYS/#", 2),
+    ("#", 6),
+    ("a/b/c/+", 2),
+    ("a/+/c/+", 2),
+    ("+/+/+/d", 1),
+    ("q/w/e/#", 1),
+    ("+/+/+/+", 3),
+    ("q/#", 2),
+    ("asdf", 1),
+    ("", 0),
+]
+
+
+@pytest.mark.parametrize("filter_,expected", MESSAGES_MATRIX, ids=[f or "(empty)" for f, _ in MESSAGES_MATRIX])
+def test_messages_pattern(filter_, expected):
+    index = TopicsIndex()
+    for topic in RETAINED_TOPICS:
+        index.retain_message(
+            Packet(
+                fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                topic_name=topic,
+                payload=b"hello",
+            )
+        )
+    assert len(index.messages(filter_)) == expected
+
+
+class TestIsValidFilter:
+    def test_subscribe_filters(self):
+        assert is_valid_filter("a/b/c", False)
+        assert is_valid_filter("a/b//c", False)
+        assert is_valid_filter("$SYS", False)
+        assert is_valid_filter("$SYS/info", False)
+        assert is_valid_filter("$sys/info", False)
+        assert is_valid_filter("+/info", False)
+        assert is_valid_filter("#", False)
+        assert not is_valid_filter("", False)  # [MQTT-4.7.3-1]
+        assert not is_valid_filter("a/#/c", False)  # [MQTT-4.7.1-2]
+        assert not is_valid_filter("#/", False)
+        assert not is_valid_filter(SHARE_PREFIX, False)  # [MQTT-4.8.2-1]
+        assert not is_valid_filter(SHARE_PREFIX + "/grp", False)
+        assert not is_valid_filter(SHARE_PREFIX + "/gr+p/a", False)  # [MQTT-4.8.2-2]
+        assert is_valid_filter(SHARE_PREFIX + "/grp/a/b", False)
+        assert is_valid_filter("$share/grp/a/b", False)  # case-insensitive prefix
+
+    def test_publish_topics(self):
+        assert is_valid_filter("a/b/c", True)
+        assert not is_valid_filter("$SYS/info", True)  # 4.7.2 unpublishable
+        assert not is_valid_filter("$sys/info", True)
+        assert not is_valid_filter("a/+/c", True)  # [MQTT-3.3.2-2]
+        assert not is_valid_filter("a/#", True)
+        assert is_valid_filter("", True)  # alias may supply the topic
+
+
+class TestIsSharedFilter:
+    def test(self):
+        assert is_shared_filter(SHARE_PREFIX + "/grp/a")
+        assert is_shared_filter("$share/grp/a")
+        assert not is_shared_filter("a/b/c")
+
+
+class TestTopicAliases:
+    def test_inbound(self):
+        a = TopicAliases(5).inbound
+        assert a.set(1, "a/b") == "a/b"
+        assert a.set(1, "") == "a/b"  # empty topic resolves existing alias
+        assert a.set(1, "c/d") == "c/d"
+
+    def test_inbound_max_zero(self):
+        a = TopicAliases(0).inbound
+        assert a.set(1, "a/b") == "a/b"
+        assert a.internal == {}
+
+    def test_outbound(self):
+        a = TopicAliases(2).outbound
+        assert a.set("a/b") == (1, False)
+        assert a.set("a/b") == (1, True)
+        assert a.set("c/d") == (2, False)
+        assert a.set("e/f") == (0, False)  # exhausted
+
+    def test_outbound_max_zero(self):
+        a = TopicAliases(0).outbound
+        assert a.set("a/b") == (0, False)
+
+
+class TestInlineSubscriptions:
+    def test_subscribe_match_unsubscribe(self):
+        calls = []
+
+        def handler(cl, sub, pk):
+            calls.append((sub.filter, pk.topic_name))
+
+        index = TopicsIndex()
+        assert index.inline_subscribe(InlineSubscription(filter="a/+", identifier=1, handler=handler))
+        assert not index.inline_subscribe(InlineSubscription(filter="a/+", identifier=1, handler=handler))
+        subs = index.subscribers("a/b")
+        assert len(subs.inline_subscriptions) == 1
+        assert index.inline_unsubscribe(1, "a/+")
+        assert not index.inline_unsubscribe(9, "x/y")
+        assert len(index.subscribers("a/b").inline_subscriptions) == 0
+
+    def test_inline_hash_quirk(self):
+        # reference quirk (topics.go:615): an inline sub on a/# does NOT
+        # match topic "a" via the terminal child-# branch
+        index = TopicsIndex()
+        index.inline_subscribe(InlineSubscription(filter="a/#", identifier=1, handler=lambda *a: None))
+        assert len(index.subscribers("a").inline_subscriptions) == 0
+        assert len(index.subscribers("a/b").inline_subscriptions) == 1
+
+
+class TestSharedContainers:
+    def test_shared_subscriptions(self):
+        s = SharedSubscriptions()
+        s.add("grp", "cl1", Subscription(filter="a"))
+        s.add("grp", "cl2", Subscription(filter="a"))
+        s.add("grp2", "cl1", Subscription(filter="a"))
+        assert s.group_len() == 2
+        assert len(s) == 3
+        assert s.get("grp", "cl1") is not None
+        s.delete("grp", "cl1")
+        s.delete("grp", "cl2")
+        assert s.group_len() == 1  # empty group pruned
+
+
+def reference_match(flt: str, topic: str) -> bool:
+    """Independent closed-form matcher encoding the REFERENCE's semantics
+    (not the pure spec): '#' matches its parent level only when the level
+    before '#' is a literal (topics.go:612 partKey != "+"), and top-level
+    +/# filters never match $-topics. Used as a differential oracle."""
+    if not topic:
+        return False
+    F, T = flt.split("/"), topic.split("/")
+    if topic[0] == "$" and flt and flt[0] in "+#":
+        return False
+    if F[-1] == "#":
+        P = F[:-1]
+        if len(T) < len(P):
+            return False
+        if any(p != "+" and p != t for p, t in zip(P, T)):
+            return False
+        if len(T) > len(P):
+            return True
+        return len(P) == 0 or P[-1] != "+"
+    return len(F) == len(T) and all(p == "+" or p == t for p, t in zip(F, T))
+
+
+class TestDifferentialFuzz:
+    """Seeded randomized parity between the trie walk and the closed-form
+    oracle — the same harness later validates the TPU matcher."""
+
+    def test_trie_matches_oracle(self):
+        import random
+
+        rng = random.Random(1234)
+        segs = ["a", "b", "c", "dd", "", "x", "$SYS"]
+
+        def rand_topic():
+            return "/".join(rng.choice(segs) for _ in range(rng.randint(1, 4)))
+
+        def rand_filter():
+            parts = [rng.choice(segs + ["+"]) for _ in range(rng.randint(1, 4))]
+            if rng.random() < 0.3:
+                parts[-1] = "#"
+            return "/".join(parts)
+
+        index = TopicsIndex()
+        filters = {}
+        for i in range(300):
+            flt = rand_filter()
+            filters[f"cl{i}"] = flt
+            index.subscribe(f"cl{i}", Subscription(filter=flt, qos=rng.randint(0, 2)))
+        for _ in range(1500):
+            topic = rand_topic()
+            got = set(index.subscribers(topic).subscriptions)
+            want = {cl for cl, flt in filters.items() if reference_match(flt, topic)}
+            assert got == want, f"topic={topic!r} extra={got - want} missing={want - got}"
+        # churn: remove half, parity must hold and empty nodes must trim
+        for i in range(0, 300, 2):
+            index.unsubscribe(filters[f"cl{i}"], f"cl{i}")
+        for _ in range(500):
+            topic = rand_topic()
+            got = set(index.subscribers(topic).subscriptions)
+            want = {
+                f"cl{i}" for i in range(1, 300, 2) if reference_match(filters[f"cl{i}"], topic)
+            }
+            assert got == want
