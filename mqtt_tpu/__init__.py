@@ -14,3 +14,45 @@ via ``mqtt_tpu.parallel``.
 """
 
 __version__ = "0.1.0"
+
+from .clients import Client, Clients, Will
+from .inflight import Inflight
+from .server import (
+    Capabilities,
+    Compatibilities,
+    InlineClientNotEnabledError,
+    ListenerIDExistsError,
+    Options,
+    Server,
+)
+from .system import Info
+from .topics import (
+    SHARE_PREFIX,
+    SYS_PREFIX,
+    InlineSubscription,
+    Subscribers,
+    TopicsIndex,
+    is_shared_filter,
+    is_valid_filter,
+)
+
+__all__ = [
+    "Capabilities",
+    "Client",
+    "Clients",
+    "Compatibilities",
+    "Inflight",
+    "Info",
+    "InlineClientNotEnabledError",
+    "InlineSubscription",
+    "ListenerIDExistsError",
+    "Options",
+    "SHARE_PREFIX",
+    "SYS_PREFIX",
+    "Server",
+    "Subscribers",
+    "TopicsIndex",
+    "Will",
+    "is_shared_filter",
+    "is_valid_filter",
+]

@@ -1,0 +1,123 @@
+"""Network listeners: the listener interface, the id-keyed registry, and the
+built-in listener types.
+
+Behavioral parity with reference ``listeners/listeners.go`` (interface :32-39,
+registry :42-135). Accept loops are asyncio servers; the registry tracks all
+per-client tasks (the reference's ``ClientsWg``) so close can wait for them.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import ssl
+from dataclasses import dataclass
+from typing import Awaitable, Callable, Optional
+
+# An EstablishFn is called for every new connection: (listener_id, reader,
+# writer) -> awaitable (reference listeners.go:25).
+EstablishFn = Callable[[str, asyncio.StreamReader, asyncio.StreamWriter], Awaitable]
+
+TYPE_TCP = "tcp"
+TYPE_WS = "ws"
+TYPE_UNIX = "unix"
+TYPE_HEALTHCHECK = "healthcheck"
+TYPE_SYSINFO = "sysinfo"
+TYPE_MOCK = "mock"
+
+
+@dataclass
+class Config:
+    """Listener instantiation config (listeners.go:16-22)."""
+
+    type: str = ""
+    id: str = ""
+    address: str = ""
+    tls_config: Optional[ssl.SSLContext] = None
+
+
+class Listener:
+    """A network interface accepting client connections (listeners.go:32-39)."""
+
+    def __init__(self, config: Config) -> None:
+        self.config = config
+        self.log = logging.getLogger("mqtt_tpu.listener")
+
+    def id(self) -> str:
+        return self.config.id
+
+    def address(self) -> str:
+        return self.config.address
+
+    def protocol(self) -> str:
+        raise NotImplementedError
+
+    async def init(self, log: logging.Logger) -> None:
+        """Bind/prepare the listener; raise on failure."""
+        self.log = log
+
+    async def serve(self, establish: EstablishFn) -> None:
+        """Begin accepting connections, dispatching each to ``establish``."""
+        raise NotImplementedError
+
+    async def close(self, close_clients: Callable[[str], None]) -> None:
+        """Stop accepting and run ``close_clients(listener_id)``."""
+        close_clients(self.id())
+
+
+class Listeners:
+    """Id-keyed listener registry with serve/close-all and a global client
+    task group (listeners.go:42-135)."""
+
+    def __init__(self) -> None:
+        self.internal: dict[str, Listener] = {}
+        self.client_tasks: set[asyncio.Task] = set()  # the ClientsWg analog
+
+    def add(self, val: Listener) -> None:
+        self.internal[val.id()] = val
+
+    def get(self, id_: str) -> Optional[Listener]:
+        return self.internal.get(id_)
+
+    def delete(self, id_: str) -> None:
+        self.internal.pop(id_, None)
+
+    def __len__(self) -> int:
+        return len(self.internal)
+
+    def track(self, coro) -> asyncio.Task:
+        """Spawn a per-client task, tracked for close-time draining."""
+        task = asyncio.get_running_loop().create_task(coro)
+        self.client_tasks.add(task)
+        task.add_done_callback(self.client_tasks.discard)
+        return task
+
+    async def serve_all(self, establish: EstablishFn) -> None:
+        for listener in list(self.internal.values()):
+            await listener.serve(establish)
+
+    async def close_all(self, close_clients: Callable[[str], None]) -> None:
+        for listener in list(self.internal.values()):
+            await listener.close(close_clients)
+            self.delete(listener.id())
+        if self.client_tasks:
+            await asyncio.gather(*list(self.client_tasks), return_exceptions=True)
+
+
+from .mock import MockListener  # noqa: E402
+from .tcp import TCP  # noqa: E402
+
+__all__ = [
+    "Config",
+    "EstablishFn",
+    "Listener",
+    "Listeners",
+    "MockListener",
+    "TCP",
+    "TYPE_HEALTHCHECK",
+    "TYPE_MOCK",
+    "TYPE_SYSINFO",
+    "TYPE_TCP",
+    "TYPE_UNIX",
+    "TYPE_WS",
+]

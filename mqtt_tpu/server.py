@@ -1,0 +1,1397 @@
+"""The broker server: lifecycle, CONNECT handshake, packet dispatch, QoS
+flows, retained/LWT/$SYS handling, expiry loops, and persistence restore.
+
+Behavioral parity with reference ``server.go`` (the per-symbol map lives in
+SURVEY.md §2.1). The reference's goroutine-per-connection becomes an asyncio
+task per connection; the five housekeeping tickers become one asyncio event
+loop task (server.go:374-395); everything else is a synchronous call graph
+identical in shape to the reference's.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import resource
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+from . import packets as pkts
+from .clients import Client, Clients, Will
+from .hooks import (
+    STORED_CLIENTS,
+    STORED_INFLIGHT_MESSAGES,
+    STORED_RETAINED_MESSAGES,
+    STORED_SUBSCRIPTIONS,
+    STORED_SYS_INFO,
+    Hook,
+    HookOptions,
+    Hooks,
+)
+from .listeners import (
+    TYPE_HEALTHCHECK,
+    TYPE_MOCK,
+    TYPE_SYSINFO,
+    TYPE_TCP,
+    TYPE_UNIX,
+    TYPE_WS,
+    Config as ListenerConfig,
+    Listener,
+    Listeners,
+    MockListener,
+    TCP,
+)
+from .packets import (
+    CODE_DISCONNECT,
+    CODE_DISCONNECT_WILL_MESSAGE,
+    CODE_SUCCESS,
+    CODE_SUCCESS_IGNORE,
+    ERR_BAD_USERNAME_OR_PASSWORD,
+    ERR_INLINE_SUBSCRIPTION_HANDLER_INVALID,
+    ERR_NOT_AUTHORIZED,
+    ERR_PACKET_IDENTIFIER_IN_USE,
+    ERR_PACKET_IDENTIFIER_NOT_FOUND,
+    ERR_PENDING_CLIENT_WRITES_EXCEEDED,
+    ERR_PROTOCOL_VIOLATION_INVALID_SHARED_NO_LOCAL,
+    ERR_PROTOCOL_VIOLATION_REQUIRE_FIRST_CONNECT,
+    ERR_PROTOCOL_VIOLATION_SECOND_CONNECT,
+    ERR_PROTOCOL_VIOLATION_ZERO_NON_ZERO_EXPIRY,
+    ERR_QOS_NOT_SUPPORTED,
+    ERR_QUOTA_EXCEEDED,
+    ERR_RECEIVE_MAXIMUM,
+    ERR_REJECT_PACKET,
+    ERR_RETAIN_NOT_SUPPORTED,
+    ERR_SERVER_BUSY,
+    ERR_SERVER_SHUTTING_DOWN,
+    ERR_SERVER_UNAVAILABLE,
+    ERR_SESSION_TAKEN_OVER,
+    ERR_TOPIC_FILTER_INVALID,
+    ERR_UNSPECIFIED_ERROR,
+    ERR_UNSUPPORTED_PROTOCOL_VERSION,
+    QOS_CODES,
+    V5_CODES_TO_V3,
+    Code,
+    FixedHeader,
+    Packet,
+    PacketStore,
+    Properties,
+    Subscription,
+)
+from .system import Info
+from .topics import (
+    SYS_PREFIX,
+    InlineSubFn,
+    InlineSubscription,
+    TopicsIndex,
+    is_shared_filter,
+    is_valid_filter,
+)
+
+VERSION = "0.1.0"  # our framework version (reference tracks 2.7.9)
+DEFAULT_SYS_TOPIC_INTERVAL = 1  # seconds between $SYS publishes
+LOCAL_LISTENER = "local"
+INLINE_CLIENT_ID = "inline"
+
+MAX_INT64 = (1 << 63) - 1
+MAX_UINT32 = (1 << 32) - 1
+
+
+class ListenerIDExistsError(Exception):
+    """A listener with the same id already exists."""
+
+
+class InlineClientNotEnabledError(Exception):
+    """Options.inline_client must be True to use inline pub/sub."""
+
+
+@dataclass
+class Compatibilities:
+    """Compatibility-mode flags (server.go:86-93)."""
+
+    obscure_not_authorized: bool = False
+    passive_client_disconnect: bool = False
+    always_return_response_info: bool = False
+    restore_sys_info_on_restart: bool = False
+    no_inherited_properties_on_ack: bool = False
+
+
+@dataclass
+class Capabilities:
+    """Server features and limits (server.go:46-84)."""
+
+    maximum_clients: int = MAX_INT64
+    maximum_message_expiry_interval: int = 60 * 60 * 24
+    maximum_client_writes_pending: int = 1024 * 8
+    maximum_session_expiry_interval: int = MAX_UINT32
+    maximum_packet_size: int = 0
+    maximum_packet_id: int = 0xFFFF
+    receive_maximum: int = 1024
+    maximum_inflight: int = 1024 * 8
+    topic_alias_maximum: int = 0xFFFF
+    shared_sub_available: int = 1
+    minimum_protocol_version: int = 3
+    compatibilities: Compatibilities = field(default_factory=Compatibilities)
+    maximum_qos: int = 2
+    retain_available: int = 1
+    wildcard_sub_available: int = 1
+    sub_id_available: int = 1
+
+
+@dataclass
+class Options:
+    """Configurable server options (server.go:96-131)."""
+
+    listeners: list[ListenerConfig] = field(default_factory=list)
+    hooks: list[tuple[Hook, Any]] = field(default_factory=list)
+    capabilities: Capabilities = field(default_factory=Capabilities)
+    client_net_write_buffer_size: int = 0
+    client_net_read_buffer_size: int = 0
+    logger: Optional[logging.Logger] = None
+    sys_topic_resend_interval: int = 0
+    inline_client: bool = False
+
+    def ensure_defaults(self) -> None:
+        """Sane defaults when unset (server.go:208-235)."""
+        self.capabilities.maximum_packet_id = 0xFFFF  # spec maximum
+        if self.capabilities.maximum_inflight == 0:
+            self.capabilities.maximum_inflight = 1024 * 8
+        if self.sys_topic_resend_interval == 0:
+            self.sys_topic_resend_interval = DEFAULT_SYS_TOPIC_INTERVAL
+        if self.client_net_write_buffer_size == 0:
+            self.client_net_write_buffer_size = 1024 * 2
+        if self.client_net_read_buffer_size == 0:
+            self.client_net_read_buffer_size = 1024 * 2
+        if self.logger is None:
+            self.logger = logging.getLogger("mqtt_tpu")
+
+
+class _Ops:
+    """Server values propagated to clients (server.go:159-164)."""
+
+    def __init__(self, options: Options, info: Info, hooks: Hooks, log: logging.Logger) -> None:
+        self.options = options
+        self.info = info
+        self.hooks = hooks
+        self.log = log
+
+
+class Server:
+    """An MQTT broker server; create via ``Server(options)``
+    (server.go:135-205)."""
+
+    def __init__(self, options: Optional[Options] = None) -> None:
+        opts = options or Options()
+        opts.ensure_defaults()
+        self.options = opts
+        self.log = opts.logger
+        self.info = Info(version=VERSION, started=int(time.time()))
+        self.clients = Clients()
+        self.topics = TopicsIndex()
+        self.listeners = Listeners()
+        self.hooks = Hooks(self.log)
+        self.will_delayed = PacketStore()
+        self.done = asyncio.Event()
+        self._event_loop_task: Optional[asyncio.Task] = None
+        self.inline_client: Optional[Client] = None
+        self._ops = _Ops(opts, self.info, self.hooks, self.log)
+        if opts.inline_client:
+            self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
+            self.clients.add_client(self.inline_client)
+
+    # -- construction ------------------------------------------------------
+
+    def new_client(self, reader, writer, listener: str, id_: str, inline: bool) -> Client:
+        """A client wired to this server's ops (server.go:241-260)."""
+        cl = Client(reader, writer, self._ops)
+        cl.id = id_
+        cl.net.listener = listener
+        if inline:
+            cl.net.inline = True
+            # don't restrict embedding-application publishes by default
+            cl.state.inflight.reset_receive_quota((1 << 31) - 1)
+        return cl
+
+    def add_hook(self, hook: Hook, config: Any = None) -> None:
+        """Attach a hook, ideally before serve() (server.go:264-272)."""
+        hook.set_opts(self.log, HookOptions(capabilities=self.options.capabilities))
+        self.log.info("added hook %s", hook.id())
+        self.hooks.add(hook, config)
+
+    def add_listener(self, listener: Listener) -> None:
+        """Register a listener; init happens during serve (server.go:286-301)."""
+        if self.listeners.get(listener.id()) is not None:
+            raise ListenerIDExistsError(listener.id())
+        self.listeners.add(listener)
+
+    def _listener_from_config(self, conf: ListenerConfig) -> Optional[Listener]:
+        t = conf.type.lower()
+        if t == TYPE_TCP:
+            return TCP(conf)
+        if t == TYPE_MOCK:
+            return MockListener(conf.id, conf.address)
+        if t in (TYPE_WS, TYPE_UNIX, TYPE_HEALTHCHECK, TYPE_SYSINFO):
+            # built-in extra listeners are registered lazily to avoid import
+            # cycles; they live in mqtt_tpu.listeners.*
+            from . import listeners as lmod
+
+            builders = {
+                TYPE_WS: getattr(lmod, "Websocket", None),
+                TYPE_UNIX: getattr(lmod, "UnixSock", None),
+                TYPE_HEALTHCHECK: getattr(lmod, "HTTPHealthCheck", None),
+                TYPE_SYSINFO: getattr(lmod, "HTTPStats", None),
+            }
+            builder = builders.get(t)
+            if builder is not None:
+                if t == TYPE_SYSINFO:
+                    return builder(conf, self.info)
+                return builder(conf)
+        self.log.error("listener type unavailable by config: %s", conf.type)
+        return None
+
+    def add_listeners_from_config(self, configs: list[ListenerConfig]) -> None:
+        for conf in configs:
+            listener = self._listener_from_config(conf)
+            if listener is not None:
+                self.add_listener(listener)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def serve(self) -> None:
+        """Start hooks, restore persisted state, init+serve all listeners,
+        begin the housekeeping loop (server.go:334-371)."""
+        self.log.info("mqtt_tpu starting version=%s", VERSION)
+        if self.options.listeners:
+            self.add_listeners_from_config(self.options.listeners)
+        for hook, config in self.options.hooks:
+            self.add_hook(hook, config)
+
+        if self.hooks.provides(
+            STORED_CLIENTS,
+            STORED_INFLIGHT_MESSAGES,
+            STORED_RETAINED_MESSAGES,
+            STORED_SUBSCRIPTIONS,
+            STORED_SYS_INFO,
+        ):
+            self.read_store()
+
+        for listener in list(self.listeners.internal.values()):
+            await listener.init(self.log)
+        self._event_loop_task = asyncio.get_running_loop().create_task(self._event_loop())
+        await self.listeners.serve_all(self.establish_connection)
+        self.publish_sys_topics()
+        self.hooks.on_started()
+        self.log.info("mqtt_tpu server started")
+
+    async def _event_loop(self) -> None:
+        """Housekeeping ticks (server.go:374-395): expiry reaping every
+        second, $SYS publishing on its own interval."""
+        sys_interval = self.options.sys_topic_resend_interval
+        next_sys = time.monotonic() + sys_interval
+        while not self.done.is_set():
+            try:
+                await asyncio.wait_for(self.done.wait(), timeout=1.0)
+                return
+            except asyncio.TimeoutError:
+                pass
+            now = int(time.time())
+            self.clear_expired_clients(now)
+            self.clear_expired_retained_messages(now)
+            self.send_delayed_lwt(now)
+            self.clear_expired_inflights(now)
+            if time.monotonic() >= next_sys:
+                self.publish_sys_topics()
+                next_sys = time.monotonic() + sys_interval
+
+    async def establish_connection(self, listener: str, reader, writer) -> None:
+        """Attach a newly accepted connection (server.go:398-401)."""
+        task = asyncio.current_task()
+        if task is not None:  # ClientsWg analog (listeners.go:43)
+            self.listeners.client_tasks.add(task)
+            task.add_done_callback(self.listeners.client_tasks.discard)
+        cl = self.new_client(reader, writer, listener, "", False)
+        await self.attach_client(cl, listener)
+
+    async def attach_client(self, cl: Client, listener: str) -> None:
+        """Validate an incoming connection, run the CONNECT handshake, and
+        read packets until disconnect (server.go:405-494)."""
+        cl.start_write_loop()
+        err: Optional[Exception] = None
+        connected = False
+        try:
+            pk = await self.read_connection_packet(cl)
+            cl.parse_connect(listener, pk)
+            if self.info.clients_connected >= self.options.capabilities.maximum_clients:
+                if cl.properties.protocol_version < 5:
+                    self.send_connack(cl, ERR_SERVER_UNAVAILABLE, False, None)
+                else:
+                    self.send_connack(cl, ERR_SERVER_BUSY, False, None)
+                raise ERR_SERVER_BUSY()
+
+            code = self.validate_connect(cl, pk)  # [MQTT-3.1.4-1] [MQTT-3.1.4-2]
+            if code != CODE_SUCCESS:
+                self.send_connack(cl, code, False, None)
+                raise code()  # [MQTT-3.2.2-7] [MQTT-3.1.4-6]
+
+            self.hooks.on_connect(cl, pk)  # error aborts
+
+            cl.refresh_deadline(cl.state.keepalive)
+            if not self.hooks.on_connect_authenticate(cl, pk):  # [MQTT-3.1.4-2]
+                self.send_connack(cl, ERR_BAD_USERNAME_OR_PASSWORD, False, None)
+                raise ERR_BAD_USERNAME_OR_PASSWORD()
+
+            self.info.clients_connected += 1
+            connected = True
+
+            self.hooks.on_session_establish(cl, pk)
+
+            session_present = self.inherit_client_session(pk, cl)
+            self.clients.add_client(cl)  # [MQTT-4.1.0-1]
+
+            self.send_connack(cl, code, session_present, None)  # [MQTT-3.1.4-5]
+            self.will_delayed.delete(cl.id)  # [MQTT-3.1.3-9]
+
+            if session_present:
+                cl.resend_inflight_messages(True)
+
+            self.hooks.on_session_established(cl, pk)
+
+            try:
+                await cl.read(self.receive_packet)
+            except Exception as e:
+                err = e
+                self.send_lwt(cl)
+                cl.stop(e)
+            else:
+                cl.properties.will = Will()  # [MQTT-3.14.4-3] [MQTT-3.1.2-10]
+
+            self.log.debug(
+                "client disconnected: error=%s client=%s remote=%s listener=%s",
+                err, cl.id, cl.net.remote, listener,
+            )
+
+            expire = (
+                cl.properties.protocol_version == 5
+                and cl.properties.props.session_expiry_interval == 0
+            ) or (cl.properties.protocol_version < 5 and cl.properties.clean)
+            self.hooks.on_disconnect(cl, err, expire)
+
+            if expire and not cl.is_taken_over:
+                cl.clear_inflights()
+                self.unsubscribe_client(cl)
+                self.clients.delete(cl.id)  # [MQTT-4.1.0-2]
+        except Exception as e:
+            err = e
+        finally:
+            if connected:
+                self.info.clients_connected -= 1
+            cl.stop(err)
+        if err is not None and not isinstance(err, (asyncio.IncompleteReadError, ConnectionError)):
+            self.log.debug("connection ended: %s", err)
+
+    async def read_connection_packet(self, cl: Client) -> Packet:
+        """The first packet MUST be CONNECT [MQTT-3.1.0-1]
+        (server.go:498-515)."""
+        fh = FixedHeader()
+        await cl.read_fixed_header(fh)
+        if fh.type != pkts.CONNECT:
+            raise ERR_PROTOCOL_VIOLATION_REQUIRE_FIRST_CONNECT()
+        return await cl.read_packet(fh)
+
+    def receive_packet(self, cl: Client, pk: Packet) -> None:
+        """Process one inbound packet; a v5 error code disconnects the client
+        (server.go:519-534)."""
+        try:
+            self.process_packet(cl, pk)
+        except Code as code:
+            if cl.properties.protocol_version == 5 and code.code >= ERR_UNSPECIFIED_ERROR.code:
+                try:
+                    self.disconnect_client(cl, code)
+                except Exception:
+                    pass
+            self.log.warning(
+                "error processing packet: error=%s client=%s listener=%s",
+                code, cl.id, cl.net.listener,
+            )
+            raise
+
+    def validate_connect(self, cl: Client, pk: Packet) -> Code:
+        """Connect compliance checks beyond the codec's (server.go:537-556)."""
+        code = pk.connect_validate()
+        if code != CODE_SUCCESS:
+            return code
+        if (
+            cl.properties.protocol_version < 5
+            and not pk.connect.clean
+            and pk.connect.client_identifier == ""
+        ):
+            return ERR_UNSPECIFIED_ERROR
+        caps = self.options.capabilities
+        if cl.properties.protocol_version < caps.minimum_protocol_version:
+            return ERR_UNSUPPORTED_PROTOCOL_VERSION  # [MQTT-3.1.2-2]
+        if cl.properties.will.qos > caps.maximum_qos:
+            return ERR_QOS_NOT_SUPPORTED  # [MQTT-3.2.2-12]
+        if cl.properties.will.retain and caps.retain_available == 0:
+            return ERR_RETAIN_NOT_SUPPORTED  # [MQTT-3.2.2-13]
+        return code
+
+    def inherit_client_session(self, pk: Packet, cl: Client) -> bool:
+        """Session takeover: disconnect the existing client with the same id
+        and inherit (or discard) its state (server.go:561-603)."""
+        existing = self.clients.get(cl.id)
+        if existing is not None:
+            try:
+                self.disconnect_client(existing, ERR_SESSION_TAKEN_OVER)  # [MQTT-3.1.4-3]
+            except Code:
+                pass
+            if pk.connect.clean or (
+                existing.properties.clean and existing.properties.protocol_version < 5
+            ):  # [MQTT-3.1.2-4] [MQTT-3.1.4-4]
+                self.unsubscribe_client(existing)
+                existing.clear_inflights()
+                existing.state.is_taken_over = True  # after unsubscribe
+                return False  # [MQTT-3.2.2-3]
+
+            existing.state.is_taken_over = True
+            if len(existing.state.inflight) > 0:
+                cl.state.inflight = existing.state.inflight.clone()  # [MQTT-3.1.2-5]
+                if (
+                    cl.state.inflight.maximum_receive_quota == 0
+                    and self.options.capabilities.receive_maximum != 0
+                ):
+                    cl.state.inflight.reset_receive_quota(
+                        self.options.capabilities.receive_maximum
+                    )
+                    cl.state.inflight.reset_send_quota(cl.properties.props.receive_maximum)
+
+            for sub in existing.state.subscriptions.get_all().values():
+                existed = not self.topics.subscribe(cl.id, sub)  # [MQTT-3.8.4-3]
+                if not existed:
+                    self.info.subscriptions += 1
+                cl.state.subscriptions.add(sub.filter, sub)
+
+            # clean existing state so sequential takeovers don't leak
+            self.unsubscribe_client(existing)
+            existing.clear_inflights()
+
+            self.log.debug(
+                "session taken over: client=%s old_remote=%s new_remote=%s",
+                cl.id, existing.net.remote, cl.net.remote,
+            )
+            return True  # [MQTT-3.2.2-3]
+
+        if self.info.clients_connected > self.info.clients_maximum:
+            self.info.clients_maximum += 1
+        return False  # [MQTT-3.2.2-2]
+
+    def send_connack(
+        self, cl: Client, reason: Code, present: bool, properties: Optional[Properties]
+    ) -> None:
+        """Issue a CONNACK, translating v5 codes for v3 clients
+        (server.go:606-663)."""
+        if properties is None:
+            properties = Properties()
+        properties.receive_maximum = self.options.capabilities.receive_maximum  # 3.2.2.3.3
+        if cl.state.server_keepalive:  # set dynamically via the on_connect hook
+            properties.server_keep_alive = cl.state.keepalive  # [MQTT-3.1.2-21]
+            properties.server_keep_alive_flag = True
+
+        if reason.code >= ERR_UNSPECIFIED_ERROR.code:
+            if cl.properties.protocol_version < 5:
+                reason = V5_CODES_TO_V3.get(reason, reason)
+            properties.reason_string = reason.reason
+            ack = Packet(
+                fixed_header=FixedHeader(type=pkts.CONNACK),
+                session_present=False,  # [MQTT-3.2.2-6]
+                reason_code=reason.code,  # [MQTT-3.2.2-8]
+                properties=properties,
+            )
+            cl.write_packet(ack)
+            return
+
+        caps = self.options.capabilities
+        if caps.maximum_qos < 2:
+            properties.maximum_qos = caps.maximum_qos  # [MQTT-3.2.2-9]
+            properties.maximum_qos_flag = True
+        if cl.properties.props.assigned_client_id:
+            properties.assigned_client_id = cl.properties.props.assigned_client_id  # [MQTT-3.1.3-7]
+        if cl.properties.props.session_expiry_interval > caps.maximum_session_expiry_interval:
+            properties.session_expiry_interval = caps.maximum_session_expiry_interval
+            properties.session_expiry_interval_flag = True
+            cl.properties.props.session_expiry_interval = properties.session_expiry_interval
+            cl.properties.props.session_expiry_interval_flag = True
+
+        ack = Packet(
+            fixed_header=FixedHeader(type=pkts.CONNACK),
+            session_present=present,
+            reason_code=reason.code,  # [MQTT-3.2.2-8]
+            properties=properties,
+        )
+        cl.write_packet(ack)
+
+    # -- packet processing -------------------------------------------------
+
+    def process_packet(self, cl: Client, pk: Packet) -> None:
+        """Dispatch one inbound packet by type (server.go:667-730); raises a
+        Code on protocol errors."""
+        t = pk.fixed_header.type
+        err: Optional[Exception] = None
+        try:
+            if t == pkts.CONNECT:
+                self.process_connect(cl, pk)
+            elif t == pkts.DISCONNECT:
+                self.process_disconnect(cl, pk)
+            elif t == pkts.PINGREQ:
+                self.process_pingreq(cl, pk)
+            elif t == pkts.PUBLISH:
+                code = pk.publish_validate(self.options.capabilities.topic_alias_maximum)
+                if code != CODE_SUCCESS:
+                    raise code()
+                self.process_publish(cl, pk)
+            elif t == pkts.PUBACK:
+                self.process_puback(cl, pk)
+            elif t == pkts.PUBREC:
+                self.process_pubrec(cl, pk)
+            elif t == pkts.PUBREL:
+                self.process_pubrel(cl, pk)
+            elif t == pkts.PUBCOMP:
+                self.process_pubcomp(cl, pk)
+            elif t == pkts.SUBSCRIBE:
+                code = pk.subscribe_validate()
+                if code != CODE_SUCCESS:
+                    raise code()
+                self.process_subscribe(cl, pk)
+            elif t == pkts.UNSUBSCRIBE:
+                code = pk.unsubscribe_validate()
+                if code != CODE_SUCCESS:
+                    raise code()
+                self.process_unsubscribe(cl, pk)
+            elif t == pkts.AUTH:
+                code = pk.auth_validate()
+                if code != CODE_SUCCESS:
+                    raise code()
+                self.process_auth(cl, pk)
+            else:
+                raise pkts.ERR_NO_VALID_PACKET_AVAILABLE()
+        except Exception as e:
+            err = e
+            raise
+        finally:
+            self.hooks.on_packet_processed(cl, pk, err)
+
+        # post-process: drain one quota-starved inflight if quota freed up
+        if len(cl.state.inflight) > 0 and cl.state.inflight.send_quota > 0:
+            nxt = cl.state.inflight.next_immediate()
+            if nxt is not None:
+                try:
+                    cl.write_packet(nxt)
+                except Exception:
+                    pass
+                if cl.state.inflight.delete(nxt.packet_id):
+                    self.info.inflight -= 1
+                cl.state.inflight.decrease_send_quota()
+
+    def process_connect(self, cl: Client, pk: Packet) -> None:
+        """A second CONNECT is a protocol violation [MQTT-3.1.0-2]
+        (server.go:734-737)."""
+        self.send_lwt(cl)
+        raise ERR_PROTOCOL_VIOLATION_SECOND_CONNECT()
+
+    def process_pingreq(self, cl: Client, pk: Packet) -> None:
+        cl.write_packet(Packet(fixed_header=FixedHeader(type=pkts.PINGRESP)))  # [MQTT-3.12.4-1]
+
+    # -- inline client api -------------------------------------------------
+
+    def publish(self, topic: str, payload: bytes, retain: bool, qos: int) -> None:
+        """Inline publish into the broker, bypassing ACL (server.go:752-767)."""
+        if not self.options.inline_client:
+            raise InlineClientNotEnabledError()
+        self.inject_packet(
+            self.inline_client,
+            Packet(
+                fixed_header=FixedHeader(type=pkts.PUBLISH, qos=qos, retain=retain),
+                topic_name=topic,
+                payload=payload,
+                packet_id=qos,  # unprocessed inbound qos still needs a packet id
+            ),
+        )
+
+    def subscribe(self, filter: str, subscription_id: int, handler: InlineSubFn) -> None:
+        """Inline (in-process) subscription (server.go:771-808)."""
+        if not self.options.inline_client:
+            raise InlineClientNotEnabledError()
+        if handler is None:
+            raise ERR_INLINE_SUBSCRIPTION_HANDLER_INVALID()
+        if not is_valid_filter(filter, False):
+            raise ERR_TOPIC_FILTER_INVALID()
+        subscription = Subscription(identifier=subscription_id, filter=filter)
+        pk = self.hooks.on_subscribe(
+            self.inline_client,
+            Packet(
+                origin=self.inline_client.id,
+                fixed_header=FixedHeader(type=pkts.SUBSCRIBE),
+                filters=[subscription],
+            ),
+        )
+        inline_sub = InlineSubscription(
+            filter=filter, identifier=subscription_id, handler=handler
+        )
+        self.topics.inline_subscribe(inline_sub)
+        self.hooks.on_subscribed(self.inline_client, pk, bytes([CODE_SUCCESS.code]))
+        for pkv in self.topics.messages(filter):  # [MQTT-3.8.4-4]
+            handler(self.inline_client, subscription, pkv)
+
+    def unsubscribe(self, filter: str, subscription_id: int) -> None:
+        """Remove an inline subscription (server.go:813-836)."""
+        if not self.options.inline_client:
+            raise InlineClientNotEnabledError()
+        if not is_valid_filter(filter, False):
+            raise ERR_TOPIC_FILTER_INVALID()
+        pk = self.hooks.on_unsubscribe(
+            self.inline_client,
+            Packet(
+                origin=self.inline_client.id,
+                fixed_header=FixedHeader(type=pkts.UNSUBSCRIBE),
+                filters=[Subscription(identifier=subscription_id, filter=filter)],
+            ),
+        )
+        self.topics.inline_unsubscribe(subscription_id, filter)
+        self.hooks.on_unsubscribed(self.inline_client, pk)
+
+    def inject_packet(self, cl: Client, pk: Packet) -> None:
+        """Process a packet as if sent by ``cl``, bypassing the network
+        (server.go:840-854)."""
+        pk.protocol_version = cl.properties.protocol_version
+        self.process_packet(cl, pk)
+        self.info.packets_received += 1
+        if pk.fixed_header.type == pkts.PUBLISH:
+            self.info.messages_received += 1
+
+    # -- publish flow ------------------------------------------------------
+
+    def process_publish(self, cl: Client, pk: Packet) -> None:
+        """The publish hot path (server.go:857-968)."""
+        if not cl.net.inline and not is_valid_filter(pk.topic_name, True):
+            return
+
+        if cl.state.inflight.receive_quota == 0:
+            self.disconnect_client(cl, ERR_RECEIVE_MAXIMUM)  # ~[MQTT-3.3.4-7/-8]
+            return
+
+        if not cl.net.inline and not self.hooks.on_acl_check(cl, pk.topic_name, True):
+            if pk.fixed_header.qos == 0:
+                return
+            if cl.properties.protocol_version != 5:
+                self.disconnect_client(cl, ERR_NOT_AUTHORIZED)
+                return
+            ack_type = pkts.PUBREC if pk.fixed_header.qos == 2 else pkts.PUBACK
+            ack = self.build_ack(pk.packet_id, ack_type, 0, pk.properties, ERR_NOT_AUTHORIZED)
+            cl.write_packet(ack)
+            return
+
+        pk.origin = cl.id
+        pk.created = int(time.time())
+        expiry = _minimum(
+            self.options.capabilities.maximum_message_expiry_interval,
+            pk.properties.message_expiry_interval,
+        )
+        if expiry > 0:
+            pk.expiry = pk.created + expiry
+
+        if not cl.net.inline:
+            pki = cl.state.inflight.get(pk.packet_id)
+            if pki is not None:
+                if pki.fixed_header.type == pkts.PUBREC:  # [MQTT-4.3.3-10]
+                    ack = self.build_ack(
+                        pk.packet_id, pkts.PUBREC, 0, pk.properties, ERR_PACKET_IDENTIFIER_IN_USE
+                    )
+                    cl.write_packet(ack)
+                    return
+                if cl.state.inflight.delete(pk.packet_id):  # [MQTT-4.3.2-5]
+                    self.info.inflight -= 1
+
+        if pk.properties.topic_alias_flag and pk.properties.topic_alias > 0:  # [MQTT-3.3.2-11]
+            pk.topic_name = cl.state.topic_aliases.inbound.set(
+                pk.properties.topic_alias, pk.topic_name
+            )
+
+        if pk.fixed_header.qos > self.options.capabilities.maximum_qos:
+            pk.fixed_header.qos = self.options.capabilities.maximum_qos  # [MQTT-3.2.2-9]
+
+        try:
+            pk = self.hooks.on_publish(cl, pk)
+        except Code as e:
+            if e == ERR_REJECT_PACKET:
+                return
+            if e == CODE_SUCCESS_IGNORE:
+                pk.ignore = True
+            elif cl.properties.protocol_version == 5 and pk.fixed_header.qos > 0:
+                cl.write_packet(self.build_ack(pk.packet_id, pkts.PUBACK, 0, pk.properties, e))
+                return
+            # other errors: continue with the original packet (reference
+            # server.go:912-925 falls through)
+
+        if pk.fixed_header.retain:  # [MQTT-3.3.1-5]
+            self.retain_message(cl, pk)
+
+        # inline clients can't handle PUBREC/PUBREL: treat as qos 0 inbound
+        if pk.fixed_header.qos == 0 or cl.net.inline:
+            self.publish_to_subscribers(pk)
+            self.hooks.on_published(cl, pk)
+            return
+
+        cl.state.inflight.decrease_receive_quota()
+        ack = self.build_ack(
+            pk.packet_id, pkts.PUBACK, 0, pk.properties, QOS_CODES[pk.fixed_header.qos]
+        )  # [MQTT-4.3.2-4]
+        if pk.fixed_header.qos == 2:
+            ack = self.build_ack(
+                pk.packet_id, pkts.PUBREC, 0, pk.properties, CODE_SUCCESS
+            )  # [MQTT-3.3.4-1] [MQTT-4.3.3-8]
+
+        if cl.state.inflight.set(ack):
+            self.info.inflight += 1
+            self.hooks.on_qos_publish(cl, ack, ack.created, 0)
+
+        cl.write_packet(ack)
+
+        if pk.fixed_header.qos == 1:
+            if cl.state.inflight.delete(ack.packet_id):
+                self.info.inflight -= 1
+            cl.state.inflight.increase_receive_quota()
+            self.hooks.on_qos_complete(cl, ack)
+
+        self.publish_to_subscribers(pk)
+        self.hooks.on_published(cl, pk)
+
+    def retain_message(self, cl: Client, pk: Packet) -> None:
+        """(server.go:972-981)"""
+        if self.options.capabilities.retain_available == 0 or pk.ignore:
+            return
+        out = pk.copy(False)
+        r = self.topics.retain_message(out)
+        self.hooks.on_retain_message(cl, pk, r)
+        self.info.retained = len(self.topics.retained)
+
+    def publish_to_subscribers(self, pk: Packet) -> None:
+        """Match subscribers (host trie or device matcher via the
+        on_select_subscribers seam) and fan out (server.go:984-1021)."""
+        if pk.ignore:
+            return
+        if pk.created == 0:
+            pk.created = int(time.time())
+        if pk.expiry == 0:
+            expiry = _minimum(
+                self.options.capabilities.maximum_message_expiry_interval,
+                pk.properties.message_expiry_interval,
+            )
+            if expiry > 0:
+                pk.expiry = pk.created + expiry
+
+        subscribers = self.topics.subscribers(pk.topic_name)
+        if subscribers.shared:
+            subscribers = self.hooks.on_select_subscribers(subscribers, pk)
+            if not subscribers.shared_selected:
+                subscribers.select_shared()
+            subscribers.merge_shared_selected()
+
+        for inline_sub in subscribers.inline_subscriptions.values():
+            inline_sub.handler(self.inline_client, inline_sub, pk)
+
+        for id_, subs in subscribers.subscriptions.items():
+            cl = self.clients.get(id_)
+            if cl is not None:
+                try:
+                    self.publish_to_client(cl, subs, pk)
+                except Exception as e:
+                    self.log.debug(
+                        "failed publishing packet: error=%s client=%s", e, id_
+                    )
+
+    def publish_to_client(self, cl: Client, sub: Subscription, pk: Packet) -> Packet:
+        """Deliver one publish to one subscriber (server.go:1023-1113)."""
+        if sub.no_local and pk.origin == cl.id:
+            return pk  # [MQTT-3.8.3-3]
+
+        out = pk.copy(False)
+        if not self.hooks.on_acl_check(cl, pk.topic_name, False):
+            raise ERR_NOT_AUTHORIZED()
+        if not sub.fwd_retained_flag and (
+            (cl.properties.protocol_version == 5 and not sub.retain_as_published)
+            or cl.properties.protocol_version < 5
+        ):  # ![MQTT-3.3.1-13] [v3 MQTT-3.3.1-9]
+            out.fixed_header.retain = False  # [MQTT-3.3.1-12]
+
+        if sub.identifiers:  # [MQTT-3.3.4-3]
+            out.properties.subscription_identifier = sorted(
+                sub.identifiers.values()
+            )  # [MQTT-3.3.4-4] ![MQTT-3.3.4-5]
+
+        if out.fixed_header.qos > sub.qos:
+            out.fixed_header.qos = sub.qos
+        if out.fixed_header.qos > self.options.capabilities.maximum_qos:
+            out.fixed_header.qos = self.options.capabilities.maximum_qos  # [MQTT-3.2.2-9]
+
+        if cl.properties.props.topic_alias_maximum > 0:
+            alias, alias_exists = cl.state.topic_aliases.outbound.set(pk.topic_name)
+            out.properties.topic_alias = alias
+            if alias > 0:
+                out.properties.topic_alias_flag = True
+                if alias_exists:
+                    out.topic_name = ""
+
+        if out.fixed_header.qos > 0:
+            caps = self.options.capabilities
+            if len(cl.state.inflight) >= caps.maximum_inflight:
+                self.info.inflight_dropped += 1
+                self.log.warning(
+                    "client store quota reached: client=%s listener=%s", cl.id, cl.net.listener
+                )
+                raise ERR_QUOTA_EXCEEDED()
+            try:
+                i = cl.next_packet_id()  # [MQTT-4.3.2-1] [MQTT-4.3.3-1]
+            except Code:
+                self.hooks.on_packet_id_exhausted(cl, pk)
+                self.info.inflight_dropped += 1
+                self.log.warning(
+                    "packet ids exhausted: client=%s listener=%s", cl.id, cl.net.listener
+                )
+                raise ERR_QUOTA_EXCEEDED() from None
+
+            out.packet_id = i & 0xFFFF  # [MQTT-2.2.1-4]
+            sent_quota = cl.state.inflight.send_quota
+
+            if cl.state.inflight.set(out):  # [MQTT-4.3.2-3] [MQTT-4.3.3-3]
+                self.info.inflight += 1
+                self.hooks.on_qos_publish(cl, out, out.created, 0)
+                cl.state.inflight.decrease_send_quota()
+
+            if sent_quota == 0 and cl.state.inflight.maximum_send_quota > 0:
+                out.expiry = -1  # mark for immediate resend once quota frees
+                cl.state.inflight.set(out)
+                return out
+
+        if cl.net.writer is None or cl.closed:
+            raise CODE_DISCONNECT()
+
+        try:
+            cl.state.outbound.put_nowait(out)
+            cl.state.outbound_qty += 1
+        except asyncio.QueueFull:
+            self.info.messages_dropped += 1
+            self.hooks.on_publish_dropped(cl, pk)
+            if out.fixed_header.qos > 0:
+                cl.state.inflight.delete(out.packet_id)  # rollback inflight
+                cl.state.inflight.increase_send_quota()
+            raise ERR_PENDING_CLIENT_WRITES_EXCEEDED() from None
+
+        return out
+
+    def publish_retained_to_client(self, cl: Client, sub: Subscription, existed: bool) -> None:
+        """Send matching retained messages after a subscribe
+        (server.go:1115-1133)."""
+        if is_shared_filter(sub.filter):
+            return  # 4.8.2 Non-normative: no retained on shared subscribe
+        if (sub.retain_handling == 1 and existed) or sub.retain_handling == 2:
+            return  # [MQTT-3.3.1-10] [MQTT-3.3.1-11]
+        # value-copy: the reference ranges over Subscription values, so the
+        # trie-stored subscription never carries fwd_retained_flag
+        sub = replace(sub, fwd_retained_flag=True)
+        for pkv in self.topics.messages(sub.filter):  # [MQTT-3.8.4-4]
+            try:
+                self.publish_to_client(cl, sub, pkv)
+            except Exception as e:
+                self.log.debug(
+                    "failed to publish retained message: error=%s client=%s", e, cl.id
+                )
+                continue
+            self.hooks.on_retain_published(cl, pkv)
+
+    def build_ack(
+        self, packet_id: int, pkt: int, qos: int, properties: Properties, reason: Code
+    ) -> Packet:
+        """A standardized ack for puback/pubrec/pubrel/pubcomp
+        (server.go:1136-1157)."""
+        if self.options.capabilities.compatibilities.no_inherited_properties_on_ack:
+            properties = Properties()
+        if reason.code >= ERR_UNSPECIFIED_ERROR.code:
+            properties.reason_string = reason.reason
+        now = int(time.time())
+        return Packet(
+            fixed_header=FixedHeader(type=pkt, qos=qos),
+            packet_id=packet_id,  # [MQTT-2.2.1-5]
+            reason_code=reason.code,  # [MQTT-3.4.2-1]
+            properties=properties,
+            created=now,
+            expiry=now + self.options.capabilities.maximum_message_expiry_interval,
+        )
+
+    # -- qos acks ----------------------------------------------------------
+
+    def process_puback(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1160-1172)"""
+        if cl.state.inflight.get(pk.packet_id) is None:
+            return  # omit ErrPacketIdentifierNotFound
+        if cl.state.inflight.delete(pk.packet_id):  # [MQTT-4.3.2-5]
+            cl.state.inflight.increase_send_quota()
+            self.info.inflight -= 1
+            self.hooks.on_qos_complete(cl, pk)
+
+    def process_pubrec(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1175-1192)"""
+        if cl.state.inflight.get(pk.packet_id) is None:  # [MQTT-4.3.3-7/-13]
+            cl.write_packet(
+                self.build_ack(
+                    pk.packet_id, pkts.PUBREL, 1, pk.properties, ERR_PACKET_IDENTIFIER_NOT_FOUND
+                )
+            )
+            return
+        if pk.reason_code >= ERR_UNSPECIFIED_ERROR.code or not pk.reason_code_valid():
+            if cl.state.inflight.delete(pk.packet_id):
+                self.info.inflight -= 1
+            self.hooks.on_qos_dropped(cl, pk)
+            return  # MQTT5 section 4.13.2 paragraph 2
+        ack = self.build_ack(pk.packet_id, pkts.PUBREL, 1, pk.properties, CODE_SUCCESS)
+        cl.state.inflight.decrease_receive_quota()
+        cl.state.inflight.set(ack)  # [MQTT-4.3.3-5]
+        cl.write_packet(ack)
+
+    def process_pubrel(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1195-1224)"""
+        if cl.state.inflight.get(pk.packet_id) is None:  # [MQTT-4.3.3-7/-13]
+            cl.write_packet(
+                self.build_ack(
+                    pk.packet_id, pkts.PUBCOMP, 0, pk.properties, ERR_PACKET_IDENTIFIER_NOT_FOUND
+                )
+            )
+            return
+        if pk.reason_code >= ERR_UNSPECIFIED_ERROR.code or not pk.reason_code_valid():
+            if cl.state.inflight.delete(pk.packet_id):
+                self.info.inflight -= 1
+            self.hooks.on_qos_dropped(cl, pk)
+            return
+        ack = self.build_ack(pk.packet_id, pkts.PUBCOMP, 0, pk.properties, CODE_SUCCESS)
+        cl.state.inflight.set(ack)
+        cl.write_packet(ack)
+        cl.state.inflight.increase_receive_quota()
+        cl.state.inflight.increase_send_quota()
+        if cl.state.inflight.delete(pk.packet_id):  # [MQTT-4.3.3-12]
+            self.info.inflight -= 1
+            self.hooks.on_qos_complete(cl, pk)
+
+    def process_pubcomp(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1227-1237)"""
+        cl.state.inflight.increase_receive_quota()
+        cl.state.inflight.increase_send_quota()
+        if cl.state.inflight.delete(pk.packet_id):
+            self.info.inflight -= 1
+            self.hooks.on_qos_complete(cl, pk)
+
+    # -- subscribe / unsubscribe -------------------------------------------
+
+    def process_subscribe(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1240-1312)"""
+        pk = self.hooks.on_subscribe(cl, pk)
+        code = CODE_SUCCESS
+        if cl.state.inflight.get(pk.packet_id) is not None:
+            code = ERR_PACKET_IDENTIFIER_IN_USE
+
+        caps = self.options.capabilities
+        filter_existed = [False] * len(pk.filters)
+        reason_codes = bytearray(len(pk.filters))
+        for i, sub in enumerate(pk.filters):
+            if code != CODE_SUCCESS:
+                reason_codes[i] = code.code  # NB 3.9.3 Non-normative 0x91
+                continue
+            if not is_valid_filter(sub.filter, False):
+                reason_codes[i] = ERR_TOPIC_FILTER_INVALID.code
+            elif sub.no_local and is_shared_filter(sub.filter):
+                reason_codes[i] = ERR_PROTOCOL_VIOLATION_INVALID_SHARED_NO_LOCAL.code  # [MQTT-3.8.3-4]
+            elif not self.hooks.on_acl_check(cl, sub.filter, False):
+                reason_codes[i] = ERR_NOT_AUTHORIZED.code
+                if caps.compatibilities.obscure_not_authorized:
+                    reason_codes[i] = ERR_UNSPECIFIED_ERROR.code
+            else:
+                is_new = self.topics.subscribe(cl.id, sub)  # [MQTT-3.8.4-3]
+                if is_new:
+                    self.info.subscriptions += 1
+                cl.state.subscriptions.add(sub.filter, sub)  # [MQTT-3.2.2-10]
+                # granted qos caps at server max [MQTT-3.2.2-9] without
+                # mutating the trie-stored subscription (the reference caps a
+                # value copy, server.go:1269-1274)
+                filter_existed[i] = not is_new
+                reason_codes[i] = min(sub.qos, caps.maximum_qos)  # [MQTT-3.9.3-1]
+
+            if reason_codes[i] > 2 and cl.properties.protocol_version < 5:  # MQTT3
+                reason_codes[i] = ERR_UNSPECIFIED_ERROR.code
+
+        ack = Packet(  # [MQTT-3.8.4-1] [MQTT-3.8.4-5]
+            fixed_header=FixedHeader(type=pkts.SUBACK),
+            packet_id=pk.packet_id,  # [MQTT-2.2.1-6] [MQTT-3.8.4-2]
+            reason_codes=bytes(reason_codes),  # [MQTT-3.8.4-6]
+            properties=Properties(user=pk.properties.user),
+        )
+        if code.code >= ERR_UNSPECIFIED_ERROR.code:
+            ack.properties.reason_string = code.reason
+
+        self.hooks.on_subscribed(cl, pk, bytes(reason_codes))
+        cl.write_packet(ack)
+
+        for i, sub in enumerate(pk.filters):  # [MQTT-3.3.1-9]
+            if reason_codes[i] >= ERR_UNSPECIFIED_ERROR.code:
+                continue
+            self.publish_retained_to_client(cl, sub, filter_existed[i])
+
+    def process_unsubscribe(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1315-1356)"""
+        code = CODE_SUCCESS
+        if cl.state.inflight.get(pk.packet_id) is not None:
+            code = ERR_PACKET_IDENTIFIER_IN_USE
+        pk = self.hooks.on_unsubscribe(cl, pk)
+        reason_codes = bytearray(len(pk.filters))
+        for i, sub in enumerate(pk.filters):  # [MQTT-3.10.4-6] [MQTT-3.11.3-1]
+            if code != CODE_SUCCESS:
+                reason_codes[i] = code.code
+                continue
+            if self.topics.unsubscribe(sub.filter, cl.id):
+                self.info.subscriptions -= 1
+                reason_codes[i] = CODE_SUCCESS.code
+            else:
+                reason_codes[i] = pkts.CODE_NO_SUBSCRIPTION_EXISTED.code
+            cl.state.subscriptions.delete(sub.filter)  # [MQTT-3.10.4-2]
+
+        ack = Packet(  # [MQTT-3.10.4-4]
+            fixed_header=FixedHeader(type=pkts.UNSUBACK),
+            packet_id=pk.packet_id,  # [MQTT-2.2.1-6] [MQTT-3.10.4-5]
+            reason_codes=bytes(reason_codes),  # [MQTT-3.11.3-2]
+            properties=Properties(user=pk.properties.user),
+        )
+        if code.code >= ERR_UNSPECIFIED_ERROR.code:
+            ack.properties.reason_string = code.reason
+
+        self.hooks.on_unsubscribed(cl, pk)
+        cl.write_packet(ack)
+
+    def unsubscribe_client(self, cl: Client) -> None:
+        """Remove all of a client's subscriptions (server.go:1359-1379)."""
+        filter_map = cl.state.subscriptions.get_all()
+        for k in filter_map:
+            cl.state.subscriptions.delete(k)
+        if cl.is_taken_over:
+            return
+        for k in filter_map:
+            if self.topics.unsubscribe(k, cl.id):
+                self.info.subscriptions -= 1
+        self.hooks.on_unsubscribed(
+            cl,
+            Packet(
+                fixed_header=FixedHeader(type=pkts.UNSUBSCRIBE),
+                filters=list(filter_map.values()),
+            ),
+        )
+
+    # -- auth / disconnect -------------------------------------------------
+
+    def process_auth(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1382-1389)"""
+        self.hooks.on_auth_packet(cl, pk)
+
+    def process_disconnect(self, cl: Client, pk: Packet) -> None:
+        """(server.go:1392-1410)"""
+        if pk.properties.session_expiry_interval_flag:
+            if (
+                pk.properties.session_expiry_interval > 0
+                and cl.properties.props.session_expiry_interval == 0
+            ):
+                raise ERR_PROTOCOL_VIOLATION_ZERO_NON_ZERO_EXPIRY()
+            cl.properties.props.session_expiry_interval = pk.properties.session_expiry_interval
+            cl.properties.props.session_expiry_interval_flag = True
+
+        if pk.reason_code == CODE_DISCONNECT_WILL_MESSAGE.code:  # [MQTT-3.1.2.5]
+            raise CODE_DISCONNECT_WILL_MESSAGE()
+
+        self.will_delayed.delete(cl.id)  # [MQTT-3.1.3-9] [MQTT-3.1.2-8]
+        cl.stop(CODE_DISCONNECT())  # [MQTT-3.14.4-2]
+
+    def disconnect_client(self, cl: Client, code: Code) -> None:
+        """Send DISCONNECT and close (server.go:1413-1437). Raises the code
+        for error-class disconnects (mirrors the reference's error return)."""
+        out = Packet(
+            fixed_header=FixedHeader(type=pkts.DISCONNECT),
+            reason_code=code.code,
+            properties=Properties(),
+        )
+        if code.code >= ERR_UNSPECIFIED_ERROR.code:
+            out.properties.reason_string = code.reason  # [MQTT-3.14.2-1]
+        try:
+            cl.write_packet(out)
+        except Exception:
+            pass  # we're already disconnecting; write errors don't matter
+        if not self.options.capabilities.compatibilities.passive_client_disconnect:
+            cl.stop(code)
+            if code.code >= ERR_UNSPECIFIED_ERROR.code:
+                raise code()
+
+    # -- $SYS / housekeeping -----------------------------------------------
+
+    def publish_sys_topics(self) -> None:
+        """Publish retained $SYS values (server.go:1442-1492)."""
+        now = int(time.time())
+        self.info.memory_alloc = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        self.info.threads = threading.active_count()
+        self.info.time = now
+        self.info.uptime = now - self.info.started
+        self.info.clients_total = len(self.clients)
+        self.info.clients_disconnected = self.info.clients_total - self.info.clients_connected
+
+        info = self.info.clone()
+        topics = {
+            SYS_PREFIX + "/broker/version": info.version,
+            SYS_PREFIX + "/broker/time": str(info.time),
+            SYS_PREFIX + "/broker/uptime": str(info.uptime),
+            SYS_PREFIX + "/broker/started": str(info.started),
+            SYS_PREFIX + "/broker/load/bytes/received": str(info.bytes_received),
+            SYS_PREFIX + "/broker/load/bytes/sent": str(info.bytes_sent),
+            SYS_PREFIX + "/broker/clients/connected": str(info.clients_connected),
+            SYS_PREFIX + "/broker/clients/disconnected": str(info.clients_disconnected),
+            SYS_PREFIX + "/broker/clients/maximum": str(info.clients_maximum),
+            SYS_PREFIX + "/broker/clients/total": str(info.clients_total),
+            SYS_PREFIX + "/broker/packets/received": str(info.packets_received),
+            SYS_PREFIX + "/broker/packets/sent": str(info.packets_sent),
+            SYS_PREFIX + "/broker/messages/received": str(info.messages_received),
+            SYS_PREFIX + "/broker/messages/sent": str(info.messages_sent),
+            SYS_PREFIX + "/broker/messages/dropped": str(info.messages_dropped),
+            SYS_PREFIX + "/broker/messages/inflight": str(info.inflight),
+            SYS_PREFIX + "/broker/retained": str(info.retained),
+            SYS_PREFIX + "/broker/subscriptions": str(info.subscriptions),
+            SYS_PREFIX + "/broker/system/memory": str(info.memory_alloc),
+            SYS_PREFIX + "/broker/system/threads": str(info.threads),
+        }
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
+            created=now,
+        )
+        for topic, payload in topics.items():
+            pk.topic_name = topic
+            pk.payload = payload.encode()
+            self.topics.retain_message(pk.copy(False))
+            self.publish_to_subscribers(pk)
+        self.hooks.on_sys_info_tick(info)
+
+    async def close(self) -> None:
+        """Gracefully stop the server, listeners, clients, and hooks
+        (server.go:1495-1504)."""
+        self.done.set()
+        self.log.info("gracefully stopping server")
+        await self.listeners.close_all(self._close_listener_clients)
+        self.hooks.on_stopped()
+        self.hooks.stop()
+        if self._event_loop_task is not None:
+            self._event_loop_task.cancel()
+        self.log.info("mqtt_tpu server stopped")
+
+    def _close_listener_clients(self, listener: str) -> None:
+        """(server.go:1507-1512)"""
+        for cl in self.clients.get_by_listener(listener):
+            try:
+                self.disconnect_client(cl, ERR_SERVER_SHUTTING_DOWN)
+            except Code:
+                pass
+
+    def send_lwt(self, cl: Client) -> None:
+        """Issue (or delay) a client's will message (server.go:1515-1551)."""
+        if cl.properties.will.flag == 0:
+            return
+        modified = self.hooks.on_will(cl, cl.properties.will)
+        now = int(time.time())
+        pk = Packet(
+            fixed_header=FixedHeader(
+                type=pkts.PUBLISH,
+                retain=modified.retain,  # [MQTT-3.1.2-14/-15]
+                qos=modified.qos,
+            ),
+            topic_name=modified.topic_name,
+            payload=modified.payload,
+            properties=Properties(user=modified.user),
+            origin=cl.id,
+            created=now,
+        )
+        if cl.properties.will.will_delay_interval > 0:
+            pk.connect.will_properties.will_delay_interval = (
+                cl.properties.will.will_delay_interval
+            )
+            pk.expiry = now + pk.connect.will_properties.will_delay_interval
+            self.will_delayed.add(cl.id, pk)
+            return
+        if pk.fixed_header.retain:
+            self.retain_message(cl, pk)
+        self.publish_to_subscribers(pk)  # [MQTT-3.1.2-8]
+        cl.properties.will.flag = 0  # [MQTT-3.1.2-10]
+        self.hooks.on_will_sent(cl, pk)
+
+    # -- persistence restore (server.go:1554-1692) -------------------------
+
+    def read_store(self) -> None:
+        if self.hooks.provides(STORED_CLIENTS):
+            clients = self.hooks.stored_clients()
+            self.load_clients(clients)
+            self.log.debug("loaded clients from store: len=%d", len(clients))
+        if self.hooks.provides(STORED_SUBSCRIPTIONS):
+            subs = self.hooks.stored_subscriptions()
+            self.load_subscriptions(subs)
+            self.log.debug("loaded subscriptions from store: len=%d", len(subs))
+        if self.hooks.provides(STORED_INFLIGHT_MESSAGES):
+            inflight = self.hooks.stored_inflight_messages()
+            self.load_inflight(inflight)
+            self.log.debug("loaded inflights from store: len=%d", len(inflight))
+        if self.hooks.provides(STORED_RETAINED_MESSAGES):
+            retained = self.hooks.stored_retained_messages()
+            self.load_retained(retained)
+            self.log.debug("loaded retained messages from store: len=%d", len(retained))
+        if self.hooks.provides(STORED_SYS_INFO):
+            sys_info = self.hooks.stored_sys_info()
+            if sys_info is not None:
+                self.load_server_info(sys_info.info)
+                self.log.debug("loaded $SYS info from store")
+
+    def load_server_info(self, v: Info) -> None:
+        if self.options.capabilities.compatibilities.restore_sys_info_on_restart:
+            self.info.bytes_received = v.bytes_received
+            self.info.bytes_sent = v.bytes_sent
+            self.info.clients_maximum = v.clients_maximum
+            self.info.clients_total = v.clients_total
+            self.info.clients_disconnected = v.clients_disconnected
+            self.info.messages_received = v.messages_received
+            self.info.messages_sent = v.messages_sent
+            self.info.messages_dropped = v.messages_dropped
+            self.info.packets_received = v.packets_received
+            self.info.packets_sent = v.packets_sent
+            self.info.inflight_dropped = v.inflight_dropped
+        self.info.retained = v.retained
+        self.info.inflight = v.inflight
+        self.info.subscriptions = v.subscriptions
+
+    def load_subscriptions(self, v: list) -> None:
+        for sub in v:
+            sb = Subscription(
+                filter=sub.filter,
+                retain_handling=sub.retain_handling,
+                qos=sub.qos,
+                retain_as_published=sub.retain_as_published,
+                no_local=sub.no_local,
+                identifier=sub.identifier,
+            )
+            if self.topics.subscribe(sub.client, sb):
+                cl = self.clients.get(sub.client)
+                if cl is not None:
+                    cl.state.subscriptions.add(sub.filter, sb)
+
+    def load_clients(self, v: list) -> None:
+        for c in v:
+            cl = self.new_client(None, None, c.listener, c.id, False)
+            cl.properties.username = c.username
+            cl.properties.clean = c.clean
+            cl.properties.protocol_version = c.protocol_version
+            cl.properties.props = Properties(
+                session_expiry_interval=c.properties.session_expiry_interval,
+                session_expiry_interval_flag=c.properties.session_expiry_interval_flag,
+                authentication_method=c.properties.authentication_method,
+                authentication_data=c.properties.authentication_data,
+                request_problem_info_flag=c.properties.request_problem_info_flag,
+                request_problem_info=c.properties.request_problem_info,
+                request_response_info=c.properties.request_response_info,
+                receive_maximum=c.properties.receive_maximum,
+                topic_alias_maximum=c.properties.topic_alias_maximum,
+                user=list(c.properties.user),
+                maximum_packet_size=c.properties.maximum_packet_size,
+            )
+            cl.properties.will = Will(
+                payload=c.will.payload,
+                user=list(c.will.user),
+                topic_name=c.will.topic_name,
+                flag=c.will.flag,
+                will_delay_interval=c.will.will_delay_interval,
+                qos=c.will.qos,
+                retain=c.will.retain,
+            )
+            # restored clients are disconnected and expire normally
+            cl.stop(ERR_SERVER_SHUTTING_DOWN())
+            expire = (
+                cl.properties.protocol_version == 5
+                and cl.properties.props.session_expiry_interval == 0
+            ) or (cl.properties.protocol_version < 5 and cl.properties.clean)
+            self.hooks.on_disconnect(cl, ERR_SERVER_SHUTTING_DOWN(), expire)
+            if expire:
+                cl.clear_inflights()
+                self.unsubscribe_client(cl)
+            else:
+                self.clients.add_client(cl)
+
+    def load_inflight(self, v: list) -> None:
+        for msg in v:
+            cl = self.clients.get(msg.client)
+            if cl is not None:
+                cl.state.inflight.set(msg.to_packet())
+
+    def load_retained(self, v: list) -> None:
+        for msg in v:
+            self.topics.retain_message(msg.to_packet())
+
+    # -- expiry loops (server.go:1696-1758) --------------------------------
+
+    def clear_expired_clients(self, dt: int) -> None:
+        for id_, client in self.clients.get_all().items():
+            disconnected = client.stop_time
+            if disconnected == 0:
+                continue
+            expire = self.options.capabilities.maximum_session_expiry_interval
+            if (
+                client.properties.protocol_version == 5
+                and client.properties.props.session_expiry_interval_flag
+            ):
+                expire = client.properties.props.session_expiry_interval
+            if disconnected + expire < dt:
+                self.hooks.on_client_expired(client)
+                self.clients.delete(id_)  # [MQTT-4.1.0-2]
+
+    def clear_expired_retained_messages(self, now: int) -> None:
+        for filter_, pk in self.topics.retained.get_all().items():
+            expired = pk.protocol_version == 5 and 0 < pk.expiry < now  # [MQTT-3.3.2-5]
+            enforced = (
+                self.options.capabilities.maximum_message_expiry_interval > 0
+                and now - pk.created > self.options.capabilities.maximum_message_expiry_interval
+            )
+            if expired or enforced:
+                self.topics.retained.delete(filter_)
+                self.hooks.on_retained_expired(filter_)
+
+    def clear_expired_inflights(self, now: int) -> None:
+        for client in self.clients.get_all().values():
+            deleted = client.clear_expired_inflights(
+                now, self.options.capabilities.maximum_message_expiry_interval
+            )
+            for id_ in deleted:
+                self.hooks.on_qos_dropped(client, Packet(packet_id=id_))
+
+    def send_delayed_lwt(self, dt: int) -> None:
+        for id_, pk in self.will_delayed.get_all().items():
+            if dt > pk.expiry:
+                self.publish_to_subscribers(pk)  # [MQTT-3.1.2-8]
+                cl = self.clients.get(id_)
+                if cl is not None:
+                    if pk.fixed_header.retain:
+                        self.retain_message(cl, pk)
+                    cl.properties.will = Will()  # [MQTT-3.1.2-10]
+                    self.hooks.on_will_sent(cl, pk)
+                self.will_delayed.delete(id_)
+
+
+def _minimum(a: int, b: int) -> int:
+    """Minimum of the non-zero values of a and b; 0 when both are zero
+    (server.go:1767-1780)."""
+    if a != 0:
+        if b != 0 and b < a:
+            return b
+        return a
+    return b

@@ -1,0 +1,45 @@
+"""$SYS broker counters.
+
+Behavioral parity with reference ``system/system.go:12-61`` (21 gauges /
+counters published as retained ``$SYS/broker/...`` topics). Python ints under
+the GIL replace Go's sync/atomic; the asyncio data plane mutates them from a
+single thread and the device feeder only reads.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, replace
+
+
+@dataclass
+class Info:
+    """Atomic-style counters on $SYS topics (system.go:12-34)."""
+
+    version: str = ""  # the server version
+    started: int = 0  # unix ts the server started
+    time: int = 0  # current unix ts
+    uptime: int = 0  # seconds since start
+    bytes_received: int = 0
+    bytes_sent: int = 0
+    clients_connected: int = 0
+    clients_disconnected: int = 0
+    clients_maximum: int = 0
+    clients_total: int = 0
+    messages_received: int = 0
+    messages_sent: int = 0
+    messages_dropped: int = 0
+    retained: int = 0
+    inflight: int = 0
+    inflight_dropped: int = 0
+    subscriptions: int = 0
+    packets_received: int = 0
+    packets_sent: int = 0
+    memory_alloc: int = 0
+    threads: int = 0
+
+    def clone(self) -> "Info":
+        """Point-in-time copy (system.go:37-59)."""
+        return replace(self)
+
+    def as_dict(self) -> dict:
+        return asdict(self)

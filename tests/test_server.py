@@ -1,0 +1,684 @@
+"""End-to-end broker tests over in-memory socket pairs — the analog of the
+reference's net.Pipe() scenarios (server_test.go): raw wire bytes in, exact
+response packets out, for v3.1.1 and v5, plus hook-fake behavioral checks.
+"""
+
+import asyncio
+import socket
+
+import pytest
+
+from mqtt_tpu import Capabilities, Options, Server
+from mqtt_tpu.hooks import (
+    ON_ACL_CHECK,
+    ON_CONNECT_AUTHENTICATE,
+    ON_PACKET_READ,
+    ON_PUBLISH,
+    Hook,
+    Hooks,
+)
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.packets import (
+    CONNACK,
+    CONNECT,
+    DISCONNECT,
+    PINGREQ,
+    PINGRESP,
+    PUBACK,
+    PUBCOMP,
+    PUBLISH,
+    PUBREC,
+    PUBREL,
+    SUBACK,
+    SUBSCRIBE,
+    UNSUBACK,
+    UNSUBSCRIBE,
+    Code,
+    ConnectParams,
+    FixedHeader,
+    Packet,
+    Subscription,
+    codes,
+    decode_length,
+    decode_packet,
+    encode_packet,
+)
+
+TIMEOUT = 3.0
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=15))
+
+
+def connect_packet(client_id="test", version=4, clean=True, keepalive=30, will=None):
+    cp = ConnectParams(
+        protocol_name=b"MQTT",
+        clean=clean,
+        keepalive=keepalive,
+        client_identifier=client_id,
+    )
+    if will:
+        cp.will_flag = True
+        cp.will_topic = will[0]
+        cp.will_payload = will[1]
+        cp.will_qos = will[2] if len(will) > 2 else 0
+    return encode_packet(
+        Packet(fixed_header=FixedHeader(type=CONNECT), protocol_version=version, connect=cp)
+    )
+
+
+async def read_wire_packet(reader, version=4):
+    """Read one framed packet off the stream and decode it."""
+    first = await asyncio.wait_for(reader.readexactly(1), TIMEOUT)
+    buf = bytearray(first)
+    while True:
+        b = await asyncio.wait_for(reader.readexactly(1), TIMEOUT)
+        buf += b
+        if not (b[0] & 0x80):
+            break
+    remaining, _ = decode_length(bytes(buf), 1)
+    if remaining:
+        buf += await asyncio.wait_for(reader.readexactly(remaining), TIMEOUT)
+    return decode_packet(bytes(buf), version)
+
+
+class Harness:
+    """One broker plus helpers to attach raw in-memory client connections."""
+
+    def __init__(self, options=None, allow=True):
+        self.server = Server(options or Options(inline_client=True))
+        if allow:
+            self.server.add_hook(AllowHook())
+        self.tasks = []
+
+    async def attach(self):
+        """Create a socketpair; server side becomes an attached client."""
+        s1, s2 = socket.socketpair()
+        s1.setblocking(False)
+        s2.setblocking(False)
+        client_reader, client_writer = await asyncio.open_connection(sock=s1)
+        server_reader, server_writer = await asyncio.open_connection(sock=s2)
+        cl = self.server.new_client(server_reader, server_writer, "t1", "", False)
+        task = asyncio.get_running_loop().create_task(self.server.attach_client(cl, "t1"))
+        self.tasks.append(task)
+        return client_reader, client_writer, task
+
+    async def connect(self, client_id="test", version=4, expect_code=0, **kw):
+        reader, writer, task = await self.attach()
+        writer.write(connect_packet(client_id, version, **kw))
+        await writer.drain()
+        ack = await read_wire_packet(reader, version)
+        assert ack.fixed_header.type == CONNACK
+        assert ack.reason_code == expect_code, f"connack code {ack.reason_code:#x}"
+        return reader, writer, task
+
+    async def shutdown(self):
+        for t in self.tasks:
+            if not t.done():
+                t.cancel()
+        await asyncio.gather(*self.tasks, return_exceptions=True)
+
+
+class TestEstablishConnection:
+    def test_connect_v4(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("zen", 4))
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.readexactly(4), TIMEOUT)
+            assert raw == bytes.fromhex("20020000")  # exact CONNACK bytes
+            writer.write(encode_packet(Packet(fixed_header=FixedHeader(type=DISCONNECT), protocol_version=4)))
+            await writer.drain()
+            await asyncio.wait_for(task, TIMEOUT)
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_connect_v5_properties(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("zen5", version=5)
+            assert h.server.clients.get("zen5") is not None
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_first_packet_must_be_connect(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.attach()
+            writer.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await writer.drain()
+            await asyncio.wait_for(task, TIMEOUT)  # connection dropped
+            data = await asyncio.wait_for(reader.read(16), TIMEOUT)
+            assert data == b""  # no CONNACK, just close
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_auth_default_deny(self):
+        async def scenario():
+            h = Harness(allow=False)  # no hooks: OR-default deny-all
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("nope", 4))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 4)
+            assert ack.fixed_header.type == CONNACK
+            # v5 0x86 translates to v3 0x05 not-authorized (codes.go:141-148)
+            assert ack.reason_code == 0x05
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_maximum_clients(self):
+        async def scenario():
+            opts = Options(capabilities=Capabilities(maximum_clients=0))
+            h = Harness(opts)
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("late", 4))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 4)
+            assert ack.reason_code == 0x03  # v3 server unavailable
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_pingreq_pingresp(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("pinger")
+            writer.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await writer.drain()
+            resp = await read_wire_packet(reader)
+            assert resp.fixed_header.type == PINGRESP
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestPubSub:
+    def test_subscribe_publish_roundtrip(self):
+        async def scenario():
+            h = Harness()
+            sub_r, sub_w, _ = await h.connect("subber")
+            sub_w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=11,
+                        filters=[Subscription(filter="a/b/+", qos=0)],
+                    )
+                )
+            )
+            await sub_w.drain()
+            suback = await read_wire_packet(sub_r)
+            assert suback.fixed_header.type == SUBACK
+            assert suback.reason_codes == b"\x00"
+
+            pub_r, pub_w, _ = await h.connect("pubber")
+            pub_w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH),
+                        protocol_version=4,
+                        topic_name="a/b/c",
+                        payload=b"hello",
+                    )
+                )
+            )
+            await pub_w.drain()
+            msg = await read_wire_packet(sub_r)
+            assert msg.fixed_header.type == PUBLISH
+            assert msg.topic_name == "a/b/c"
+            assert msg.payload == b"hello"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_qos1_flow(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("q1")
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, qos=1),
+                        protocol_version=4,
+                        topic_name="q/1",
+                        packet_id=7,
+                        payload=b"x",
+                    )
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r)
+            assert ack.fixed_header.type == PUBACK
+            assert ack.packet_id == 7
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_qos2_flow(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("q2")
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, qos=2),
+                        protocol_version=4,
+                        topic_name="q/2",
+                        packet_id=9,
+                        payload=b"x",
+                    )
+                )
+            )
+            await w.drain()
+            rec = await read_wire_packet(r)
+            assert rec.fixed_header.type == PUBREC and rec.packet_id == 9
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBREL, qos=1),
+                        protocol_version=4,
+                        packet_id=9,
+                    )
+                )
+            )
+            await w.drain()
+            comp = await read_wire_packet(r)
+            assert comp.fixed_header.type == PUBCOMP and comp.packet_id == 9
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_qos_downgrade_to_subscription(self):
+        async def scenario():
+            h = Harness()
+            sub_r, sub_w, _ = await h.connect("downsub")
+            sub_w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=1,
+                        filters=[Subscription(filter="dn/t", qos=0)],
+                    )
+                )
+            )
+            await sub_w.drain()
+            await read_wire_packet(sub_r)  # suback
+
+            pr, pw, _ = await h.connect("downpub")
+            pw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, qos=1),
+                        protocol_version=4,
+                        topic_name="dn/t",
+                        packet_id=3,
+                        payload=b"m",
+                    )
+                )
+            )
+            await pw.drain()
+            await read_wire_packet(pr)  # puback to publisher
+            msg = await read_wire_packet(sub_r)
+            assert msg.fixed_header.qos == 0  # min(sub 0, msg 1)
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retained_delivered_on_subscribe(self):
+        async def scenario():
+            h = Harness()
+            pr, pw, _ = await h.connect("retainer")
+            pw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                        protocol_version=4,
+                        topic_name="ret/t",
+                        payload=b"keepme",
+                    )
+                )
+            )
+            await pw.drain()
+            await asyncio.sleep(0.05)
+            assert len(h.server.topics.retained) == 1
+
+            sr, sw, _ = await h.connect("late-sub")
+            sw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=2,
+                        filters=[Subscription(filter="ret/#", qos=0)],
+                    )
+                )
+            )
+            await sw.drain()
+            suback = await read_wire_packet(sr)
+            assert suback.fixed_header.type == SUBACK
+            msg = await read_wire_packet(sr)
+            assert msg.topic_name == "ret/t"
+            assert msg.payload == b"keepme"
+            assert msg.fixed_header.retain  # fwd_retained keeps the flag
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("unsub")
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=4,
+                        filters=[Subscription(filter="u/t", qos=0)],
+                    )
+                )
+            )
+            await w.drain()
+            await read_wire_packet(r)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=UNSUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=5,
+                        filters=[Subscription(filter="u/t")],
+                    )
+                )
+            )
+            await w.drain()
+            unsuback = await read_wire_packet(r)
+            assert unsuback.fixed_header.type == UNSUBACK
+            assert len(h.server.topics.subscribers("u/t").subscriptions) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestSessionsAndWills:
+    def test_session_takeover(self):
+        async def scenario():
+            h = Harness()
+            r1, w1, t1 = await h.connect("dup", version=5, clean=False)
+            r2, w2, t2 = await h.connect("dup", version=5, clean=False)
+            # first client receives DISCONNECT(session taken over)
+            pk = await read_wire_packet(r1, 5)
+            assert pk.fixed_header.type == DISCONNECT
+            assert pk.reason_code == 0x8E
+            assert h.server.clients.get("dup") is not None
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_lwt_published_on_abnormal_disconnect(self):
+        async def scenario():
+            h = Harness()
+            sr, sw, _ = await h.connect("watcher")
+            sw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=6,
+                        filters=[Subscription(filter="lwt/t", qos=0)],
+                    )
+                )
+            )
+            await sw.drain()
+            await read_wire_packet(sr)
+
+            dr, dw, dt = await h.connect("dier", will=("lwt/t", b"gone", 0))
+            dw.transport.abort()  # abrupt connection loss
+            msg = await read_wire_packet(sr)
+            assert msg.topic_name == "lwt/t"
+            assert msg.payload == b"gone"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_clean_disconnect_no_lwt(self):
+        async def scenario():
+            h = Harness()
+            sr, sw, _ = await h.connect("watcher2")
+            sw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=6,
+                        filters=[Subscription(filter="lwt2/t", qos=0)],
+                    )
+                )
+            )
+            await sw.drain()
+            await read_wire_packet(sr)
+
+            dr, dw, dt = await h.connect("polite", will=("lwt2/t", b"gone", 0))
+            dw.write(encode_packet(Packet(fixed_header=FixedHeader(type=DISCONNECT), protocol_version=4)))
+            await dw.drain()
+            await asyncio.wait_for(dt, TIMEOUT)
+            # no will should arrive; publish a sentinel to prove ordering
+            h.server.publish("lwt2/t", b"sentinel", False, 0)
+            msg = await read_wire_packet(sr)
+            assert msg.payload == b"sentinel"
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestInlineClient:
+    def test_inline_pub_sub(self):
+        async def scenario():
+            h = Harness()
+            got = []
+            h.server.subscribe("in/+", 1, lambda cl, sub, pk: got.append(pk.topic_name))
+            h.server.publish("in/x", b"v", False, 0)
+            assert got == ["in/x"]
+            h.server.unsubscribe("in/+", 1)
+            h.server.publish("in/y", b"v", False, 0)
+            assert got == ["in/x"]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inline_requires_option(self):
+        from mqtt_tpu import InlineClientNotEnabledError
+
+        s = Server(Options(inline_client=False))
+        with pytest.raises(InlineClientNotEnabledError):
+            s.publish("a", b"b", False, 0)
+        with pytest.raises(InlineClientNotEnabledError):
+            s.subscribe("a", 1, lambda *a: None)
+
+
+class TestSysTopics:
+    def test_sys_topics_retained(self):
+        async def scenario():
+            h = Harness()
+            h.server.publish_sys_topics()
+            pks = h.server.topics.messages("$SYS/#")
+            topics = {p.topic_name for p in pks}
+            assert "$SYS/broker/version" in topics
+            assert "$SYS/broker/clients/connected" in topics
+            assert len(topics) == 20
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestHooksDispatcher:
+    def test_modifier_chain_order(self):
+        hooks = Hooks()
+
+        class Adder(Hook):
+            def __init__(self, tag):
+                super().__init__()
+                self.tag = tag
+
+            def id(self):
+                return self.tag
+
+            def provides(self, b):
+                return b == ON_PACKET_READ
+
+            def on_packet_read(self, cl, pk):
+                pk.topic_name += self.tag
+                return pk
+
+        hooks.add(Adder("a"), None)
+        hooks.add(Adder("b"), None)
+        pk = hooks.on_packet_read(None, Packet(topic_name="x"))
+        assert pk.topic_name == "xab"
+
+    def test_reject_short_circuits(self):
+        hooks = Hooks()
+
+        class Rejecter(Hook):
+            def id(self):
+                return "rej"
+
+            def provides(self, b):
+                return b == ON_PUBLISH
+
+            def on_publish(self, cl, pk):
+                raise codes.ERR_REJECT_PACKET()
+
+        hooks.add(Rejecter(), None)
+        with pytest.raises(Code) as e:
+            hooks.on_publish(None, Packet())
+        assert e.value == codes.ERR_REJECT_PACKET
+
+    def test_auth_or_semantics(self):
+        hooks = Hooks()
+        assert not hooks.on_connect_authenticate(None, Packet())  # default deny
+
+        class Denier(Hook):
+            def id(self):
+                return "deny"
+
+            def provides(self, b):
+                return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+        hooks.add(Denier(), None)
+        assert not hooks.on_acl_check(None, "t", True)
+        hooks.add(AllowHook(), None)
+        assert hooks.on_connect_authenticate(None, Packet())
+        assert hooks.on_acl_check(None, "t", True)
+
+
+class TestInflight:
+    def test_set_get_delete(self):
+        from mqtt_tpu.inflight import Inflight
+
+        i = Inflight()
+        assert i.set(Packet(packet_id=1, created=10))
+        assert not i.set(Packet(packet_id=1, created=11))
+        assert i.get(1) is not None
+        assert len(i) == 1
+        assert i.delete(1)
+        assert not i.delete(1)
+
+    def test_quotas(self):
+        from mqtt_tpu.inflight import Inflight
+
+        i = Inflight()
+        i.reset_receive_quota(2)
+        i.decrease_receive_quota()
+        i.decrease_receive_quota()
+        i.decrease_receive_quota()  # floors at 0
+        assert i.receive_quota == 0
+        i.increase_receive_quota()
+        assert i.receive_quota == 1
+        for _ in range(5):
+            i.increase_receive_quota()
+        assert i.receive_quota == 2  # capped at maximum
+
+    def test_get_all_sorted_and_immediate(self):
+        from mqtt_tpu.inflight import Inflight
+
+        i = Inflight()
+        i.set(Packet(packet_id=1, created=30))
+        i.set(Packet(packet_id=2, created=10))
+        i.set(Packet(packet_id=3, created=20, expiry=-1))
+        assert [p.packet_id for p in i.get_all(False)] == [2, 3, 1]
+        nxt = i.next_immediate()
+        assert nxt is not None and nxt.packet_id == 3
+
+    def test_clone(self):
+        from mqtt_tpu.inflight import Inflight
+
+        i = Inflight()
+        i.set(Packet(packet_id=5))
+        c = i.clone()
+        assert c.get(5) is not None
+        c.delete(5)
+        assert i.get(5) is not None
+
+
+class TestRetainFlagRegression:
+    def test_live_publish_after_retained_has_retain_cleared(self):
+        """The trie-stored subscription must not keep fwd_retained_flag after
+        retained delivery: a later LIVE publish with retain=1 must reach the
+        subscriber with retain=0 [MQTT-3.3.1-12]."""
+
+        async def scenario():
+            h = Harness()
+            pr, pw, _ = await h.connect("retainer2")
+            pw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                        protocol_version=4,
+                        topic_name="rf/t",
+                        payload=b"old",
+                    )
+                )
+            )
+            await pw.drain()
+            await asyncio.sleep(0.05)
+
+            sr, sw, _ = await h.connect("flag-sub")
+            sw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=4,
+                        packet_id=3,
+                        filters=[Subscription(filter="rf/t", qos=0)],
+                    )
+                )
+            )
+            await sw.drain()
+            await read_wire_packet(sr)  # suback
+            retained = await read_wire_packet(sr)
+            assert retained.fixed_header.retain  # retained replay keeps flag
+
+            pw.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                        protocol_version=4,
+                        topic_name="rf/t",
+                        payload=b"live",
+                    )
+                )
+            )
+            await pw.drain()
+            live = await read_wire_packet(sr)
+            assert live.payload == b"live"
+            assert not live.fixed_header.retain  # [MQTT-3.3.1-12]
+            await h.shutdown()
+
+        run(scenario())
