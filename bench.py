@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Benchmark: batched publish-topic matching against a large wildcard
+subscription index on the real device.
+
+Implements BASELINE.json config #2 — N subscriptions over 3-level topics
+with ~10% single-level ``+`` wildcards — and measures sustained
+publish-topic matches/sec through the device matcher (host tokenization +
+device NFA match + result transfer). North-star target: >= 10M matches/sec
+@ 1M subscriptions on one v5e-1 (BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Environment overrides: BENCH_SUBS, BENCH_BATCH, BENCH_ITERS, BENCH_LEVELS.
+"""
+
+import json
+import os
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+TARGET_MATCHES_PER_SEC = 10_000_000  # the BASELINE.json north star
+
+
+def build_index(n_subs: int, rng: random.Random):
+    from mqtt_tpu.packets import Subscription
+    from mqtt_tpu.topics import TopicsIndex
+
+    v0 = [f"region{i}" for i in range(100)]
+    v1 = [f"device{i}" for i in range(100)]
+    v2 = [f"metric{i}" for i in range(100)]
+    index = TopicsIndex()
+    for i in range(n_subs):
+        parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+        if rng.random() < 0.10:  # 10% single-level wildcards
+            parts[rng.randrange(3)] = "+"
+        index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+    return index, (v0, v1, v2)
+
+
+def main() -> None:
+    n_subs = int(os.environ.get("BENCH_SUBS", 1_000_000))
+    batch = int(os.environ.get("BENCH_BATCH", 4096))
+    iters = int(os.environ.get("BENCH_ITERS", 30))
+    max_levels = int(os.environ.get("BENCH_LEVELS", 4))
+    rng = random.Random(7)
+
+    t0 = time.time()
+    index, (v0, v1, v2) = build_index(n_subs, rng)
+    t_build = time.time() - t0
+    print(f"# built {n_subs} subs in {t_build:.1f}s", file=sys.stderr)
+
+    import jax
+    import jax.numpy as jnp
+
+    from mqtt_tpu.ops import TpuMatcher
+    from mqtt_tpu.ops.hashing import tokenize_topics
+
+    matcher = TpuMatcher(index, max_levels=max_levels, frontier=8, out_slots=64)
+    t0 = time.time()
+    matcher.rebuild()
+    print(
+        f"# CSR compile {time.time() - t0:.1f}s: nodes={matcher.csr.num_nodes} "
+        f"subs={matcher.csr.num_subs} device={jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+
+    # pre-generate a topic pool and tokenize per batch on the host
+    pool = [
+        f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+        for _ in range(batch * 4)
+    ]
+    batches = []
+    for i in range(4):
+        topics = pool[i * batch : (i + 1) * batch]
+        tok1, tok2, lengths, is_dollar, _ = tokenize_topics(
+            topics, max_levels, matcher.csr.salt
+        )
+        batches.append(tuple(jnp.asarray(a) for a in (tok1, tok2, lengths, is_dollar)))
+
+    def run_one(i):
+        out, totals, overflow = matcher.match_tokens(*batches[i % len(batches)])
+        return out
+
+    # warmup / compile
+    run_one(0).block_until_ready()
+    t0 = time.time()
+    run_one(1).block_until_ready()
+    print(f"# steady-state single batch {(time.time()-t0)*1e3:.2f}ms", file=sys.stderr)
+
+    lat = []
+    t_start = time.time()
+    for i in range(iters):
+        t1 = time.time()
+        run_one(i).block_until_ready()
+        lat.append(time.time() - t1)
+    elapsed = time.time() - t_start
+
+    matches_per_sec = (iters * batch) / elapsed
+    p99 = sorted(lat)[max(0, int(len(lat) * 0.99) - 1)] * 1e3
+    print(
+        f"# {iters} x {batch} topics in {elapsed:.3f}s; p99 batch latency {p99:.2f}ms",
+        file=sys.stderr,
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"publish_topic_matches_per_sec@{n_subs}_wildcard_subs",
+                "value": round(matches_per_sec),
+                "unit": "matches/s",
+                "vs_baseline": round(matches_per_sec / TARGET_MATCHES_PER_SEC, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

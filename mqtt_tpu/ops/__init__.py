@@ -1,0 +1,31 @@
+"""TPU device plane: the batched wildcard topic matcher.
+
+This package lifts the reference's hot loop — ``TopicsIndex.Subscribers()``
+(reference topics.go:583-628), the wildcard trie walk executed once per
+PUBLISH — onto the TPU as a batched NFA-over-CSR kernel:
+
+- ``csr``      — compiles the host trie into device-resident CSR arrays
+- ``hashing``  — host-side topic-level tokenization and dual u32 hashing
+- ``matcher``  — the jitted batched match kernel + the broker-facing
+                 ``TpuMatcher`` (drop-in for ``TopicsIndex.subscribers``)
+
+The host trie in ``mqtt_tpu.topics`` remains the bit-identical oracle and
+the fallback path (frontier/output overflow, in-flight delta windows).
+"""
+
+from .csr import CsrIndex, SubEntry, KIND_CLIENT, KIND_INLINE, KIND_SHARED
+from .hashing import hash_token, tokenize_topics
+from .matcher import MatchResult, TpuMatcher, match_batch
+
+__all__ = [
+    "CsrIndex",
+    "KIND_CLIENT",
+    "KIND_INLINE",
+    "KIND_SHARED",
+    "MatchResult",
+    "SubEntry",
+    "TpuMatcher",
+    "hash_token",
+    "match_batch",
+    "tokenize_topics",
+]

@@ -1,0 +1,169 @@
+"""Compile the host trie into device-resident CSR arrays.
+
+The subscription trie (``mqtt_tpu.topics.TopicsIndex``) becomes a static
+node table with three edge classes per node — sorted literal edges (binary
+searched by token hash), one ``+`` child, one ``#`` child — plus two CSR
+subscription lists per node:
+
+- ``reg``  — client and shared subscriptions attached at the node
+- ``inl``  — inline subscriptions (kept separate so the terminal child-``#``
+  gather can exclude them, replicating reference topics.go:615)
+
+Sub ids index a host-side :class:`SubEntry` table carrying the client/group
+metadata (QoS, identifiers, NoLocal...) — the device returns ids only and
+the host performs merge / shared-group selection, preserving reference
+semantics (SURVEY.md §7 stage 4).
+
+Building walks the *actual* host trie, so the device index is structurally
+identical to the oracle by construction.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import numpy as np
+
+from ..topics import TopicsIndex, _Particle
+from .hashing import hash_token
+
+KIND_CLIENT = 0  # a normal client subscription
+KIND_SHARED = 1  # a $SHARE group member
+KIND_INLINE = 2  # an in-process inline subscription
+
+
+@dataclass
+class SubEntry:
+    """Host-side metadata for one device sub id."""
+
+    kind: int
+    client: str  # client id (CLIENT/SHARED) or "" (INLINE)
+    group_filter: str  # full $SHARE filter (SHARED only)
+    subscription: Any  # packets.Subscription or topics.InlineSubscription
+
+
+@dataclass
+class CsrIndex:
+    """The device-side CSR encoding of the subscription trie."""
+
+    # node tables, length N (+1 for the CSR pointers)
+    edge_ptr: np.ndarray  # int32[N+1] — literal-edge range per node
+    edge_tok1: np.ndarray  # uint32[E] — sorted within each node's range
+    edge_tok2: np.ndarray  # uint32[E] — verification hash per edge
+    edge_dest: np.ndarray  # int32[E]
+    plus_child: np.ndarray  # int32[N], -1 if none
+    hash_child: np.ndarray  # int32[N], -1 if none
+    reg_ptr: np.ndarray  # int32[N+1] — client+shared sub ids per node
+    reg_ids: np.ndarray  # int32[R]
+    inl_ptr: np.ndarray  # int32[N+1] — inline sub ids per node
+    inl_ids: np.ndarray  # int32[I]
+    top_wild: np.ndarray  # bool[S] — client sub whose filter starts with +/#
+    # host-side
+    subs: list[SubEntry] = field(default_factory=list)
+    salt: int = 0
+    max_degree: int = 0
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.plus_child)
+
+    @property
+    def num_subs(self) -> int:
+        return len(self.subs)
+
+
+def build_csr(index: TopicsIndex, salt: int = 0, _retries: int = 4) -> CsrIndex:
+    """Walk the host trie and emit the CSR index.
+
+    Retries with a new hash salt if two distinct sibling edge tokens collide
+    on hash1 (probability ~degree^2/2^33 per node).
+    """
+    nodes: list[_Particle] = []
+    node_id: dict[int, int] = {}  # id(particle) -> dense id
+
+    # iterative walk (deep tries must not recurse)
+    stack = [index.root]
+    while stack:
+        p = stack.pop()
+        node_id[id(p)] = len(nodes)
+        nodes.append(p)
+        stack.extend(p.particles.values())
+
+    n = len(nodes)
+    subs: list[SubEntry] = []
+    top_wild_flags: list[bool] = []
+
+    def add_sub(entry: SubEntry, top_wild: bool) -> int:
+        subs.append(entry)
+        top_wild_flags.append(top_wild)
+        return len(subs) - 1
+
+    edge_ptr = np.zeros(n + 1, dtype=np.int32)
+    reg_ptr = np.zeros(n + 1, dtype=np.int32)
+    inl_ptr = np.zeros(n + 1, dtype=np.int32)
+    edge_tok1: list[int] = []
+    edge_tok2: list[int] = []
+    edge_dest: list[int] = []
+    reg_ids: list[int] = []
+    inl_ids: list[int] = []
+    plus_child = np.full(n, -1, dtype=np.int32)
+    hash_child = np.full(n, -1, dtype=np.int32)
+    max_degree = 0
+
+    for nid, p in enumerate(nodes):
+        literals = []
+        for key, child in p.particles.items():
+            cid = node_id[id(child)]
+            if key == "+":
+                plus_child[nid] = cid
+            elif key == "#":
+                hash_child[nid] = cid
+            else:
+                h1, h2 = hash_token(key, salt)
+                literals.append((h1, h2, cid))
+        literals.sort()
+        for i in range(1, len(literals)):
+            if literals[i][0] == literals[i - 1][0]:
+                if _retries <= 0:
+                    raise RuntimeError("sibling edge hash collision; exhausted salts")
+                return build_csr(index, salt=salt + 1, _retries=_retries - 1)
+        max_degree = max(max_degree, len(literals))
+        for h1, h2, cid in literals:
+            edge_tok1.append(h1)
+            edge_tok2.append(h2)
+            edge_dest.append(cid)
+        edge_ptr[nid + 1] = len(edge_tok1)
+
+        for client, sub in p.subscriptions.get_all().items():
+            top = bool(sub.filter) and sub.filter[0] in "+#"
+            reg_ids.append(
+                add_sub(SubEntry(KIND_CLIENT, client, "", sub), top)
+            )
+        for group_filter_subs in p.shared.get_all().values():
+            for client, sub in group_filter_subs.items():
+                # the $-exclusion never applies to shared subs
+                reg_ids.append(
+                    add_sub(SubEntry(KIND_SHARED, client, sub.filter, sub), False)
+                )
+        reg_ptr[nid + 1] = len(reg_ids)
+        for ident, inline_sub in p.inline_subscriptions.get_all().items():
+            inl_ids.append(add_sub(SubEntry(KIND_INLINE, "", "", inline_sub), False))
+        inl_ptr[nid + 1] = len(inl_ids)
+
+    return CsrIndex(
+        edge_ptr=edge_ptr,
+        edge_tok1=np.asarray(edge_tok1, dtype=np.uint32),
+        edge_tok2=np.asarray(edge_tok2, dtype=np.uint32),
+        edge_dest=np.asarray(edge_dest, dtype=np.int32),
+        plus_child=plus_child,
+        hash_child=hash_child,
+        reg_ptr=reg_ptr,
+        reg_ids=np.asarray(reg_ids, dtype=np.int32),
+        inl_ptr=inl_ptr,
+        inl_ids=np.asarray(inl_ids, dtype=np.int32),
+        top_wild=np.asarray(top_wild_flags, dtype=bool),
+        subs=subs,
+        salt=salt,
+        max_degree=max_degree,
+    )

@@ -1,0 +1,56 @@
+"""Host-side topic tokenization and hashing for the device matcher.
+
+Strings never reach the TPU: topic levels are tokenized and hashed on the
+host (SURVEY.md §7 hard-part #3). Each token gets two independent 32-bit
+hashes — hash1 keys the sorted literal-edge binary search, hash2 verifies
+the hit — so a false device match requires a simultaneous 64-bit collision
+(~2^-64 per lookup). The builder additionally guarantees hash1 uniqueness
+within each node's edge list (see csr.py), keeping the search well-defined.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from functools import lru_cache
+
+import numpy as np
+
+
+@lru_cache(maxsize=1 << 20)
+def hash_token(token: str, salt: int = 0) -> tuple[int, int]:
+    """Two independent u32 hashes of one topic level token."""
+    d = hashlib.blake2b(
+        token.encode("utf-8"), digest_size=8, salt=salt.to_bytes(8, "little")
+    ).digest()
+    return int.from_bytes(d[:4], "little"), int.from_bytes(d[4:], "little")
+
+
+def tokenize_topics(
+    topics: list[str], max_levels: int, salt: int = 0
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Tokenize and hash a batch of PUBLISH topics.
+
+    Returns ``(tok1[B,L], tok2[B,L], lengths[B], is_dollar[B], overflow[B])``
+    — hashes padded with zeros past each topic's level count; ``overflow``
+    marks topics with more than ``max_levels`` levels (routed to the host
+    trie fallback).
+    """
+    b = len(topics)
+    tok1 = np.zeros((b, max_levels), dtype=np.uint32)
+    tok2 = np.zeros((b, max_levels), dtype=np.uint32)
+    lengths = np.zeros(b, dtype=np.int32)
+    is_dollar = np.zeros(b, dtype=bool)
+    overflow = np.zeros(b, dtype=bool)
+    for i, topic in enumerate(topics):
+        parts = topic.split("/")
+        n = len(parts)
+        if n > max_levels:
+            overflow[i] = True
+            n = max_levels
+        lengths[i] = n
+        is_dollar[i] = topic.startswith("$")
+        for d in range(n):
+            h1, h2 = hash_token(parts[d], salt)
+            tok1[i, d] = h1
+            tok2[i, d] = h2
+    return tok1, tok2, lengths, is_dollar, overflow

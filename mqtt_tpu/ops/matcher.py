@@ -1,0 +1,343 @@
+"""The batched TPU topic matcher: an NFA frontier walk over the CSR trie.
+
+One jitted call matches a batch of PUBLISH topics against the device-resident
+subscription index (reference hot loop: topics.go:593-628). Per level the
+frontier advances through sorted-literal binary search and the ``+`` edge,
+``#`` children are gathered at every level, and terminal gathers replicate
+the reference's corner cases exactly:
+
+- ``filter/#`` matches ``filter`` itself only via the literal terminal child
+  (the ``partKey != "+"`` rule, topics.go:612)
+- the terminal child-``#`` gather excludes inline subscriptions (the
+  parent-inline quirk, topics.go:615)
+- client subscriptions with a top-level wildcard never match ``$``-topics
+  [MQTT-4.7.1-1/2]; shared and inline subscriptions are exempt
+  (topics.go:637)
+
+Shapes are fully static (XLA-friendly): ``L`` padded levels, ``F`` frontier
+slots, ``K`` output sub-id slots; frontier or output overflow routes the
+topic to the host trie, so results stay bit-identical at any parameter
+choice.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..topics import Subscribers, TopicsIndex
+from .csr import KIND_CLIENT, KIND_INLINE, KIND_SHARED, CsrIndex, build_csr
+from .hashing import tokenize_topics
+
+
+def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None) -> Subscribers:
+    """Merge device sub ids (local to ``table``) into a Subscribers result,
+    preserving host gather semantics: per-client merge, shared keyed on the
+    group filter, inline keyed on identifier. Shared by the single-device
+    and mesh-sharded matchers."""
+    if seen is None:
+        seen = set()
+    for sid in sids:
+        sid = int(sid)
+        if sid < 0 or sid >= len(table) or sid in seen:
+            continue
+        seen.add(sid)
+        entry = table[sid]
+        if entry.kind == KIND_CLIENT:
+            cls = subs.subscriptions.get(entry.client, entry.subscription)
+            subs.subscriptions[entry.client] = cls.merge(entry.subscription)
+        elif entry.kind == KIND_SHARED:
+            subs.shared.setdefault(entry.group_filter, {})[entry.client] = entry.subscription
+        else:
+            subs.inline_subscriptions[entry.subscription.identifier] = entry.subscription
+    return subs
+
+
+@dataclass
+class MatchResult:
+    """Raw device output for one batch."""
+
+    sub_ids: np.ndarray  # int32[B,K], -1 padded / $-masked
+    counts: np.ndarray  # int32[B] — total gathered (pre-$-mask)
+    overflow: np.ndarray  # bool[B] — frontier/output/level overflow
+
+
+def match_core(
+    edge_ptr,
+    edge_tok1,
+    edge_tok2,
+    edge_dest,
+    plus_child,
+    hash_child,
+    reg_ptr,
+    inl_ptr,
+    all_ids,
+    inl_offset,
+    top_wild,
+    tok1,
+    tok2,
+    lengths,
+    is_dollar,
+    *,
+    frontier: int = 16,
+    out_slots: int = 64,
+    search_iters: int = 16,
+):
+    """Match ``B`` topics (``tok1/tok2[B,L]``) against the CSR index.
+
+    Returns ``(sub_ids[B,K], counts[B], overflow[B])``.
+    """
+    b, max_levels = tok1.shape
+    f = frontier
+
+    ev_starts = []
+    ev_lens = []
+
+    def emit(nodes, ptr, id_offset):
+        """Queue a gather event per frontier slot for ``nodes`` (or -1)."""
+        valid = nodes >= 0
+        safe = jnp.where(valid, nodes, 0)
+        start = jnp.where(valid, ptr[safe] + id_offset, 0)
+        length = jnp.where(valid, ptr[safe + 1] - ptr[safe], 0)
+        ev_starts.append(start)
+        ev_lens.append(length)
+
+    def literal_children(nodes, t1, t2):
+        """Binary search each node's sorted literal edges for the level
+        token; -1 when absent. Fixed ``search_iters`` iterations."""
+        valid = nodes >= 0
+        safe = jnp.where(valid, nodes, 0)
+        lo = edge_ptr[safe]
+        hi = edge_ptr[safe + 1]
+        hi0 = hi
+        n_edges = edge_tok1.shape[0]
+        for _ in range(search_iters):
+            cont = lo < hi
+            mid = (lo + hi) // 2
+            mid_safe = jnp.clip(mid, 0, n_edges - 1)
+            go_right = cont & (edge_tok1[mid_safe] < t1)
+            new_lo = jnp.where(go_right, mid + 1, lo)
+            new_hi = jnp.where(cont & ~go_right, mid, hi)
+            lo, hi = new_lo, new_hi
+        pos = lo
+        pos_safe = jnp.where(pos < hi0, pos, jnp.maximum(hi0 - 1, 0))
+        hit = (
+            valid
+            & (pos < hi0)
+            & (edge_tok1[pos_safe] == t1)
+            & (edge_tok2[pos_safe] == t2)
+        )
+        return jnp.where(hit, edge_dest[pos_safe], -1)
+
+    nodes = jnp.full((b, f), -1, dtype=jnp.int32)
+    has_topic = lengths > 0
+    nodes = nodes.at[:, 0].set(jnp.where(has_topic, 0, -1))
+    frontier_overflow = jnp.zeros(b, dtype=bool)
+
+    for d in range(max_levels):
+        active = (d < lengths)[:, None]  # [B,1]
+        is_term = (d == lengths - 1)[:, None]
+        cur = jnp.where(active, nodes, -1)
+        valid = cur >= 0
+        safe = jnp.where(valid, cur, 0)
+
+        # any-level '#' gather: subs + shared + inline (topics.go:621-625)
+        hc = jnp.where(valid, hash_child[safe], -1)
+        emit(hc, reg_ptr, 0)
+        emit(hc, inl_ptr, inl_offset)
+
+        t1 = tok1[:, d][:, None]
+        t2 = tok2[:, d][:, None]
+        lit = literal_children(cur, t1, t2)
+        plus = jnp.where(valid, plus_child[safe], -1)
+
+        # terminal gathers (topics.go:603-617)
+        lit_t = jnp.where(is_term, lit, -1)
+        plus_t = jnp.where(is_term, plus, -1)
+        emit(lit_t, reg_ptr, 0)
+        emit(lit_t, inl_ptr, inl_offset)
+        emit(plus_t, reg_ptr, 0)
+        emit(plus_t, inl_ptr, inl_offset)
+        # filter/# matches filter via the LITERAL terminal child only, and
+        # gathers no inline subs (the partKey != "+" + parent-inline quirks)
+        lit_t_safe = jnp.where(lit_t >= 0, lit_t, 0)
+        wild_t = jnp.where(lit_t >= 0, hash_child[lit_t_safe], -1)
+        emit(wild_t, reg_ptr, 0)
+
+        # advance the frontier for non-terminal topics
+        adv = active & ~is_term
+        cand = jnp.concatenate(
+            [jnp.where(adv, lit, -1), jnp.where(adv, plus, -1)], axis=1
+        )  # [B,2F]
+        n_valid = (cand >= 0).sum(axis=1)
+        frontier_overflow = frontier_overflow | (n_valid > f)
+        order = jnp.argsort(cand < 0, axis=1, stable=True)  # valid first
+        nodes = jnp.take_along_axis(cand, order, axis=1)[:, :f]
+
+    # expand gather events into K output slots
+    ev_start = jnp.stack(ev_starts, axis=1).reshape(b, -1)  # [B,E*F]
+    ev_len = jnp.stack(ev_lens, axis=1).reshape(b, -1)
+    offsets = jnp.cumsum(ev_len, axis=1)
+    totals = offsets[:, -1]
+
+    ks = jnp.arange(out_slots)
+    ev_idx = jax.vmap(lambda off: jnp.searchsorted(off, ks, side="right"))(offsets)
+    ev_idx = jnp.minimum(ev_idx, offsets.shape[1] - 1)
+    prev = jnp.where(
+        ev_idx > 0,
+        jnp.take_along_axis(offsets, jnp.maximum(ev_idx - 1, 0), axis=1),
+        0,
+    )
+    base = jnp.take_along_axis(ev_start, ev_idx, axis=1)
+    pos = base + (ks[None, :] - prev)
+    pos_safe = jnp.clip(pos, 0, all_ids.shape[0] - 1)
+    sids = all_ids[pos_safe]
+
+    in_range = ks[None, :] < totals[:, None]
+    sid_safe = jnp.where(in_range, sids, 0)
+    dollar_masked = is_dollar[:, None] & top_wild[sid_safe]
+    out = jnp.where(in_range & ~dollar_masked, sids, -1)
+    overflow = frontier_overflow | (totals > out_slots)
+    return out, totals, overflow
+
+
+# The jitted entry point; match_core stays un-jitted so mqtt_tpu.parallel can
+# shard_map it over a device mesh.
+match_batch = partial(
+    jax.jit, static_argnames=("frontier", "out_slots", "search_iters")
+)(match_core)
+
+
+class TpuMatcher:
+    """Broker-facing device matcher: compiles the host trie to CSR, matches
+    batches on device, merges results host-side, and falls back to the host
+    trie on overflow or staleness — results are always bit-identical to
+    ``TopicsIndex.subscribers``."""
+
+    def __init__(
+        self,
+        topics: TopicsIndex,
+        max_levels: int = 8,
+        frontier: int = 16,
+        out_slots: int = 64,
+    ) -> None:
+        self.topics = topics
+        self.max_levels = max_levels
+        self.frontier = frontier
+        self.out_slots = out_slots
+        self.csr: Optional[CsrIndex] = None
+        self._device_arrays = None
+        self._built_version = -1
+        self._search_iters = 1
+
+    # -- index lifecycle ---------------------------------------------------
+
+    def rebuild(self) -> None:
+        """Recompile the host trie into device arrays."""
+        version = self.topics.version
+        csr = build_csr(self.topics)
+        all_ids = np.concatenate([csr.reg_ids, csr.inl_ids]).astype(np.int32)
+        if all_ids.size == 0:
+            all_ids = np.zeros(1, dtype=np.int32)
+        top_wild = csr.top_wild
+        if top_wild.size == 0:
+            top_wild = np.zeros(1, dtype=bool)
+        # XLA gathers need non-empty operands even on never-taken paths
+        edge_tok1, edge_tok2, edge_dest = csr.edge_tok1, csr.edge_tok2, csr.edge_dest
+        if edge_tok1.size == 0:
+            edge_tok1 = np.zeros(1, dtype=np.uint32)
+            edge_tok2 = np.zeros(1, dtype=np.uint32)
+            edge_dest = np.full(1, -1, dtype=np.int32)
+        self._search_iters = max(1, math.ceil(math.log2(max(2, csr.max_degree + 1))) + 1)
+        self._device_arrays = tuple(
+            jnp.asarray(a)
+            for a in (
+                csr.edge_ptr,
+                edge_tok1,
+                edge_tok2,
+                edge_dest,
+                csr.plus_child,
+                csr.hash_child,
+                csr.reg_ptr,
+                csr.inl_ptr,
+                all_ids,
+                np.int32(len(csr.reg_ids)),
+                top_wild,
+            )
+        )
+        self.csr = csr
+        self._built_version = version
+
+    @property
+    def stale(self) -> bool:
+        return self._built_version != self.topics.version
+
+    @property
+    def device_arrays(self) -> tuple:
+        """The CSR index as device arrays (built on demand)."""
+        if self._device_arrays is None or self.stale:
+            self.rebuild()
+        return self._device_arrays
+
+    @property
+    def search_iters(self) -> int:
+        return self._search_iters
+
+    def match_tokens(self, tok1, tok2, lengths, is_dollar):
+        """Raw device match over pre-tokenized topics; returns device
+        ``(sub_ids[B,K], totals[B], overflow[B])``. The benchmark path."""
+        return match_batch(
+            *self.device_arrays,
+            tok1,
+            tok2,
+            lengths,
+            is_dollar,
+            frontier=self.frontier,
+            out_slots=self.out_slots,
+            search_iters=self._search_iters,
+        )
+
+    # -- matching ----------------------------------------------------------
+
+    def match_topics(self, topics: list[str]) -> list[Subscribers]:
+        """Match a batch of topics; every result is bit-identical to the
+        host trie (overflowing topics are re-walked on host)."""
+        if self.csr is None or self.stale:
+            self.rebuild()
+        tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
+            topics, self.max_levels, self.csr.salt
+        )
+        out, totals, overflow = match_batch(
+            *self._device_arrays,
+            jnp.asarray(tok1),
+            jnp.asarray(tok2),
+            jnp.asarray(lengths),
+            jnp.asarray(is_dollar),
+            frontier=self.frontier,
+            out_slots=self.out_slots,
+            search_iters=self._search_iters,
+        )
+        out = np.asarray(out)
+        overflow = np.asarray(overflow) | len_overflow
+        results = []
+        for i, topic in enumerate(topics):
+            if not topic:
+                results.append(Subscribers())  # empty topic never matches
+            elif overflow[i]:
+                results.append(self.topics.subscribers(topic))  # host fallback
+            else:
+                results.append(self._expand(out[i]))
+        return results
+
+    def subscribers(self, topic: str) -> Subscribers:
+        """Drop-in for ``TopicsIndex.subscribers`` (batch of one)."""
+        return self.match_topics([topic])[0]
+
+    def _expand(self, sids: np.ndarray) -> Subscribers:
+        return expand_sids(self.csr.subs, sids, Subscribers())

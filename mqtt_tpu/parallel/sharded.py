@@ -1,0 +1,248 @@
+"""Subscription-sharded matching over a 2D device mesh.
+
+Mesh axes:
+
+- ``batch`` — data parallelism over the PUBLISH topic batch
+- ``subs``  — model-style parallelism over the subscription set: each device
+  along this axis holds the CSR trie of its subscription shard
+
+One jitted step matches every (topic-shard, sub-shard) tile locally and
+``all_gather``s the per-shard match lists over the ``subs`` axis (ICI), so
+every batch row ends with the full union of sub ids. The host maps local
+sub ids through per-shard tables and merges — bit-identical to the
+single-device matcher, which is bit-identical to the host trie.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:
+    from jax import shard_map as _shard_map
+
+    _REP_KWARG = "check_vma"
+except ImportError:  # older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _REP_KWARG = "check_rep"
+
+
+def shard_map(*args, disable_rep_check=False, **kwargs):
+    if disable_rep_check:
+        kwargs[_REP_KWARG] = False
+    return _shard_map(*args, **kwargs)
+
+from ..packets import Subscription
+from ..topics import Subscribers, TopicsIndex
+from ..ops.csr import KIND_CLIENT, KIND_SHARED, build_csr
+from ..ops.hashing import tokenize_topics
+from ..ops.matcher import expand_sids, match_core
+
+
+def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
+    """A 2D (batch, subs) mesh over the given (default: all) devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if batch_axis is None:
+        batch_axis = 2 if n % 2 == 0 and n > 1 else 1
+    subs_axis = n // batch_axis
+    grid = np.array(devices[: batch_axis * subs_axis]).reshape(batch_axis, subs_axis)
+    return Mesh(grid, ("batch", "subs"))
+
+
+def _pad_to(a: np.ndarray, n: int, fill=0) -> np.ndarray:
+    if len(a) >= n:
+        return a
+    pad = np.full(n - len(a), fill, dtype=a.dtype)
+    return np.concatenate([a, pad])
+
+
+class ShardedTpuMatcher:
+    """Shards a TopicsIndex's subscriptions across the ``subs`` mesh axis
+    and matches topic batches with one SPMD step."""
+
+    def __init__(
+        self,
+        topics: TopicsIndex,
+        mesh: Optional[Mesh] = None,
+        max_levels: int = 8,
+        frontier: int = 16,
+        out_slots: int = 64,
+    ) -> None:
+        self.topics = topics
+        self.mesh = mesh or make_mesh()
+        self.max_levels = max_levels
+        self.frontier = frontier
+        self.out_slots = out_slots
+        self.n_shards = self.mesh.shape["subs"]
+        self.n_batch = self.mesh.shape["batch"]
+        self.shard_tables: list[list] = []
+        self.shard_salts: list[int] = []
+        self._arrays: Optional[tuple] = None
+        self._step = None
+        self._built_version = -1
+        self._search_iters = 4
+
+    # -- build -------------------------------------------------------------
+
+    def rebuild(self) -> None:
+        """Partition subscriptions round-robin into per-shard tries, compile
+        each to CSR, pad to common shapes, and stack on the shard axis."""
+        version = self.topics.version
+        full = build_csr(self.topics)
+        shard_indexes = [TopicsIndex() for _ in range(self.n_shards)]
+        for i, entry in enumerate(full.subs):
+            target = shard_indexes[i % self.n_shards]
+            if entry.kind in (KIND_CLIENT, KIND_SHARED):
+                target.subscribe(entry.client, entry.subscription)
+            else:
+                target.inline_subscribe(entry.subscription)
+        csrs = [build_csr(ix, salt=full.salt) for ix in shard_indexes]
+        self.shard_tables = [c.subs for c in csrs]
+        self.shard_salts = [c.salt for c in csrs]
+        if len(set(self.shard_salts)) != 1 or self.shard_salts[0] != full.salt:
+            # extremely unlikely (per-shard salt bump); rebuild all on the
+            # highest salt so topic hashing is uniform across shards
+            salt = max(self.shard_salts)
+            csrs = [build_csr(ix, salt=salt) for ix in shard_indexes]
+            self.shard_tables = [c.subs for c in csrs]
+            self.shard_salts = [c.salt for c in csrs]
+
+        def stack(get, fill=0, min_len=1):
+            arrs = [np.asarray(get(c)) for c in csrs]
+            n = max(min_len, max(len(a) for a in arrs))
+            return np.stack([_pad_to(a, n, fill) for a in arrs])
+
+        max_degree = max(c.max_degree for c in csrs)
+        self._search_iters = max(1, int(np.ceil(np.log2(max(2, max_degree + 1)))) + 1)
+        # convert to device arrays ONCE here; per-batch calls reuse them
+        self._arrays = tuple(
+            jnp.asarray(a)
+            for a in (
+                stack(lambda c: c.edge_ptr, min_len=2),
+                stack(lambda c: c.edge_tok1.astype(np.uint32)),
+                stack(lambda c: c.edge_tok2.astype(np.uint32)),
+                stack(lambda c: c.edge_dest, fill=-1),
+                stack(lambda c: c.plus_child, fill=-1),
+                stack(lambda c: c.hash_child, fill=-1),
+                stack(lambda c: c.reg_ptr, min_len=2),
+                stack(lambda c: c.inl_ptr, min_len=2),
+                stack(
+                    lambda c: np.concatenate([c.reg_ids, c.inl_ids]).astype(np.int32),
+                    fill=-1,
+                ),
+                np.asarray([np.int32(len(c.reg_ids)) for c in csrs]),
+                stack(lambda c: c.top_wild.astype(bool)),
+            )
+        )
+        self._compile_step()
+        self._built_version = version
+
+    def _compile_step(self) -> None:
+        mesh = self.mesh
+        frontier, out_slots, iters = self.frontier, self.out_slots, self._search_iters
+
+        def step(
+            edge_ptr, edge_tok1, edge_tok2, edge_dest, plus_child, hash_child,
+            reg_ptr, inl_ptr, all_ids, inl_offset, top_wild,
+            tok1, tok2, lengths, is_dollar,
+        ):
+            # each device: its sub shard (leading dim 1) x its batch tile
+            out, totals, overflow = match_core(
+                edge_ptr[0], edge_tok1[0], edge_tok2[0], edge_dest[0],
+                plus_child[0], hash_child[0], reg_ptr[0], inl_ptr[0],
+                all_ids[0], inl_offset[0], top_wild[0],
+                tok1, tok2, lengths, is_dollar,
+                frontier=frontier, out_slots=out_slots, search_iters=iters,
+            )
+            # union across the subs axis rides ICI
+            out_g = jax.lax.all_gather(out, "subs")  # [S, b_local, K]
+            tot_g = jax.lax.all_gather(totals, "subs")  # [S, b_local]
+            ovf_g = jax.lax.all_gather(overflow, "subs")
+            return out_g, tot_g, ovf_g
+
+        shard_spec = P("subs")
+        batch_spec = P("batch")
+        self._step = jax.jit(
+            shard_map(
+                step,
+                mesh=mesh,
+                in_specs=(shard_spec,) * 9 + (P("subs"), shard_spec)
+                + (batch_spec,) * 4,
+                out_specs=(P(None, "batch", None), P(None, "batch"), P(None, "batch")),
+                disable_rep_check=True,
+            )
+        )
+
+    @property
+    def stale(self) -> bool:
+        return self._built_version != self.topics.version
+
+    # -- matching ----------------------------------------------------------
+
+    def match_topics(self, topics: list[str]) -> list[Subscribers]:
+        if self._arrays is None or self.stale:
+            self.rebuild()
+        b = len(topics)
+        # pad the batch to a multiple of the batch axis
+        pad = (-b) % self.n_batch
+        padded = topics + [""] * pad
+        tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
+            padded, self.max_levels, self.shard_salts[0]
+        )
+        out, totals, overflow = self._step(
+            *self._arrays,
+            jnp.asarray(tok1), jnp.asarray(tok2), jnp.asarray(lengths), jnp.asarray(is_dollar),
+        )
+        out = np.asarray(out)  # [S, B, K]
+        overflow = np.asarray(overflow).any(axis=0) | len_overflow  # [B]
+        results = []
+        for i, topic in enumerate(topics):
+            if not topic:
+                results.append(Subscribers())
+            elif overflow[i]:
+                results.append(self.topics.subscribers(topic))
+            else:
+                results.append(self._expand(out[:, i, :]))
+        return results
+
+    def subscribers(self, topic: str) -> Subscribers:
+        return self.match_topics([topic])[0]
+
+    def _expand(self, shard_sids: np.ndarray) -> Subscribers:
+        """Union per-shard local sub ids into one Subscribers set."""
+        subs = Subscribers()
+        for s in range(self.n_shards):
+            expand_sids(self.shard_tables[s], shard_sids[s], subs, seen=set())
+        return subs
+
+
+def dryrun_multichip(n_devices: int) -> None:
+    """Create an ``n_devices`` mesh, jit the FULL sharded match step (batch
+    DP x subscription sharding with an all_gather union over ICI), and run
+    one step on tiny shapes. The driver invokes this on a virtual CPU mesh
+    to validate the multi-chip path without hardware."""
+    devices = jax.devices()[:n_devices]
+    assert len(devices) == n_devices, (
+        f"need {n_devices} devices, have {len(jax.devices())} "
+        "(set XLA_FLAGS=--xla_force_host_platform_device_count)"
+    )
+    mesh = make_mesh(devices)
+    index = TopicsIndex()
+    filters = ["a/b/c", "a/+/c", "a/#", "d/e", "+/e", "x/y/z", "q/+/+", "#"]
+    for i, flt in enumerate(filters * 4):
+        index.subscribe(f"cl{i}", Subscription(filter=flt, qos=i % 3))
+    matcher = ShardedTpuMatcher(index, mesh=mesh, max_levels=4, frontier=8, out_slots=32)
+    topics = ["a/b/c", "d/e", "x/y/z", "q/w/e", "nope", "a/z/c", "e", "a/b"]
+    results = matcher.match_topics(topics)
+    # verify against the host oracle — the dryrun must not just compile
+    for topic, dev in zip(topics, results):
+        host = index.subscribers(topic)
+        assert set(dev.subscriptions) == set(host.subscriptions), (
+            topic, set(dev.subscriptions), set(host.subscriptions)
+        )

@@ -267,6 +267,9 @@ class TopicsIndex:
         self.retained = PacketStore()
         self.root = _Particle("", None)
         self._lock = threading.RLock()
+        # bumped on every subscription mutation; device indexes (mqtt_tpu.ops)
+        # compare against it to detect staleness
+        self.version = 0
 
     # -- mutation ----------------------------------------------------------
 
@@ -274,6 +277,7 @@ class TopicsIndex:
         """Add a subscription; returns True if it was new (topics.go:401-419).
         ``$SHARE/<group>/<filter>`` roots the subtree at depth 2."""
         with self._lock:
+            self.version += 1
             prefix, _ = isolate_particle(subscription.filter, 0)
             if prefix.upper() == SHARE_PREFIX:
                 group, _ = isolate_particle(subscription.filter, 1)
@@ -298,6 +302,7 @@ class TopicsIndex:
             particle = self._seek(filter, d)
             if particle is None:
                 return False
+            self.version += 1
             if share_sub:
                 group, _ = isolate_particle(filter, 1)
                 particle.shared.delete(group, client)
@@ -310,6 +315,7 @@ class TopicsIndex:
         """Add an in-process subscription keyed on its identifier; returns
         True if new (topics.go:368-378)."""
         with self._lock:
+            self.version += 1
             n = self._set(subscription.filter, 0)
             existed = n.inline_subscriptions.get(subscription.identifier) is not None
             n.inline_subscriptions.add_inline(subscription)
@@ -320,6 +326,7 @@ class TopicsIndex:
             particle = self._seek(filter, 0)
             if particle is None:
                 return False
+            self.version += 1
             particle.inline_subscriptions.delete(id_)
             if len(particle.inline_subscriptions) == 0:
                 self._trim(particle)

@@ -1,0 +1,146 @@
+"""Device matcher conformance: the TPU CSR/NFA matcher must be bit-identical
+to the host trie (the oracle) on the same corpora that validate the trie —
+the wildcard matrix, shared groups, $-exclusions, and a randomized
+differential fuzz with live churn (SURVEY.md §7 stages 4-5)."""
+
+import random
+
+import pytest
+
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import SHARE_PREFIX, InlineSubscription, TopicsIndex
+from mqtt_tpu.ops import TpuMatcher
+
+from tests.test_topics import FIND_MATRIX
+
+
+def canon(subs):
+    """Canonicalize a Subscribers result for set comparison: client -> (qos,
+    no_local, sorted positive identifiers); shared -> group filters ->
+    client sets; inline -> identifier set. Zero-valued identifier entries
+    are excluded (Go-map zero-value semantics make them unobservable)."""
+    return (
+        {
+            c: (s.qos, s.no_local, tuple(sorted(v for v in (s.identifiers or {c: s.identifier}).values() if v > 0)))
+            for c, s in subs.subscriptions.items()
+        },
+        {g: frozenset(m) for g, m in subs.shared.items()},
+        frozenset(subs.inline_subscriptions),
+    )
+
+
+@pytest.mark.parametrize("filter_,topic,matched", FIND_MATRIX, ids=[f"{f}~{t}" for f, t, _ in FIND_MATRIX])
+def test_find_matrix_on_device(filter_, topic, matched):
+    index = TopicsIndex()
+    index.subscribe("cl1", Subscription(filter=filter_))
+    matcher = TpuMatcher(index)
+    subs = matcher.subscribers(topic)
+    assert (len(subs.subscriptions) == 1) == matched
+    assert canon(subs) == canon(index.subscribers(topic))
+
+
+def test_scan_subscribers_table_on_device():
+    index = TopicsIndex()
+    index.subscribe("cl1", Subscription(qos=1, filter="a/b/c", identifier=22))
+    index.subscribe("cl1", Subscription(qos=1, filter="a/b/c/d/e/f"))
+    index.subscribe("cl1", Subscription(qos=2, filter="a/b/c/d/+/f"))
+    index.subscribe("cl2", Subscription(qos=0, filter="a/#"))
+    index.subscribe("cl2", Subscription(qos=1, filter="a/b/c"))
+    index.subscribe("cl2", Subscription(qos=2, filter="a/b/+", identifier=77))
+    index.subscribe("cl2", Subscription(qos=2, filter="d/e/f", identifier=7237))
+    index.subscribe("cl2", Subscription(qos=2, filter="$SYS/uptime", identifier=3))
+    index.subscribe("cl3", Subscription(qos=1, filter="+/b", identifier=234))
+    index.subscribe("cl4", Subscription(qos=0, filter="#", identifier=5))
+    index.subscribe("cl2", Subscription(qos=0, filter="$SYS/test", identifier=2))
+    matcher = TpuMatcher(index)
+    for topic in ["a/b/c", "d/e/f/g", "a/b", "$SYS/uptime", "$SYS/test", "x"]:
+        assert canon(matcher.subscribers(topic)) == canon(index.subscribers(topic)), topic
+
+
+def test_shared_and_inline_on_device():
+    index = TopicsIndex()
+    index.subscribe("cl1", Subscription(qos=1, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=111))
+    index.subscribe("cl2", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp/a/b/c", identifier=112))
+    index.subscribe("cl3", Subscription(qos=0, filter=SHARE_PREFIX + "/tmp2/a/b/+", identifier=113))
+    index.subscribe("cl4", Subscription(qos=0, filter="a/b/c"))
+    index.inline_subscribe(InlineSubscription(filter="a/+/c", identifier=9, handler=lambda *a: None))
+    index.inline_subscribe(InlineSubscription(filter="a/#", identifier=8, handler=lambda *a: None))
+    matcher = TpuMatcher(index)
+    for topic in ["a/b/c", "a/x/c", "a", "a/b"]:
+        assert canon(matcher.subscribers(topic)) == canon(index.subscribers(topic)), topic
+
+
+def test_inline_parent_hash_quirk_on_device():
+    # an inline sub on a/# must NOT match topic "a" (topics.go:615 quirk)
+    index = TopicsIndex()
+    index.inline_subscribe(InlineSubscription(filter="a/#", identifier=1, handler=lambda *a: None))
+    matcher = TpuMatcher(index)
+    assert len(matcher.subscribers("a").inline_subscriptions) == 0
+    assert len(matcher.subscribers("a/b").inline_subscriptions) == 1
+
+
+def test_differential_fuzz_with_churn():
+    rng = random.Random(99)
+    segs = ["a", "b", "c", "dd", "", "x", "$SYS", "long-segment-name"]
+
+    def rand_topic():
+        return "/".join(rng.choice(segs) for _ in range(rng.randint(1, 6)))
+
+    def rand_filter():
+        parts = [rng.choice(segs + ["+"]) for _ in range(rng.randint(1, 6))]
+        if rng.random() < 0.25:
+            parts[-1] = "#"
+        return "/".join(parts)
+
+    index = TopicsIndex()
+    filters = {}
+    for i in range(500):
+        flt = rand_filter()
+        filters[f"cl{i}"] = flt
+        index.subscribe(f"cl{i}", Subscription(filter=flt, qos=rng.randint(0, 2), identifier=rng.choice([0, 0, i])))
+    matcher = TpuMatcher(index)
+
+    topics = [rand_topic() for _ in range(600)]
+    device = matcher.match_topics(topics)
+    for topic, dev in zip(topics, device):
+        host = index.subscribers(topic)
+        assert canon(dev) == canon(host), topic
+
+    # churn: unsubscribe a third, add some, then verify staleness triggers
+    # rebuild and results stay identical
+    for i in range(0, 500, 3):
+        index.unsubscribe(filters[f"cl{i}"], f"cl{i}")
+    for i in range(500, 550):
+        flt = rand_filter()
+        filters[f"cl{i}"] = flt
+        index.subscribe(f"cl{i}", Subscription(filter=flt, qos=1))
+    assert matcher.stale
+    topics = [rand_topic() for _ in range(300)]
+    for topic, dev in zip(topics, matcher.match_topics(topics)):
+        assert canon(dev) == canon(index.subscribers(topic)), topic
+
+
+def test_overflow_falls_back_to_host():
+    index = TopicsIndex()
+    # >out_slots matching subs on one topic forces output overflow
+    for i in range(40):
+        index.subscribe(f"cl{i}", Subscription(filter="hot/topic", qos=0))
+    matcher = TpuMatcher(index, out_slots=16)
+    subs = matcher.subscribers("hot/topic")
+    assert len(subs.subscriptions) == 40
+
+    # deep topic beyond max_levels falls back too
+    deep = "/".join(["d"] * 20)
+    index.subscribe("deep", Subscription(filter=deep))
+    matcher2 = TpuMatcher(index, max_levels=8)
+    assert "deep" in matcher2.subscribers(deep).subscriptions
+
+
+def test_frontier_overflow_falls_back():
+    index = TopicsIndex()
+    # many '+' forks at each level explode the frontier beyond 2 slots
+    for i, flt in enumerate(["+/+/+/a", "+/+/a/+", "+/a/+/+", "a/+/+/+", "a/a/a/a"]):
+        index.subscribe(f"w{i}", Subscription(filter=flt))
+    matcher = TpuMatcher(index, frontier=2)
+    subs = matcher.subscribers("a/a/a/a")
+    assert len(subs.subscriptions) == 5

@@ -1,0 +1,60 @@
+"""Multi-chip sharded matcher: runs on the virtual 8-device CPU mesh
+(conftest sets xla_force_host_platform_device_count=8) and must be
+bit-identical to the host trie."""
+
+import random
+
+import jax
+import pytest
+
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import SHARE_PREFIX, TopicsIndex
+from mqtt_tpu.parallel import ShardedTpuMatcher, dryrun_multichip, make_mesh
+
+from tests.test_ops_matcher import canon
+
+
+def test_eight_virtual_devices_present():
+    assert len(jax.devices()) >= 8
+
+
+def test_dryrun_multichip():
+    dryrun_multichip(8)
+
+
+def test_sharded_matches_host_oracle():
+    rng = random.Random(31337)
+    segs = ["a", "b", "c", "d", "", "x"]
+
+    def rand_topic():
+        return "/".join(rng.choice(segs) for _ in range(rng.randint(1, 5)))
+
+    def rand_filter():
+        parts = [rng.choice(segs + ["+"]) for _ in range(rng.randint(1, 5))]
+        if rng.random() < 0.25:
+            parts[-1] = "#"
+        return "/".join(parts)
+
+    index = TopicsIndex()
+    for i in range(200):
+        index.subscribe(f"cl{i}", Subscription(filter=rand_filter(), qos=rng.randint(0, 2)))
+    for i in range(20):
+        index.subscribe(
+            f"sh{i}", Subscription(filter=f"{SHARE_PREFIX}/g{i % 3}/{rand_filter()}")
+        )
+    matcher = ShardedTpuMatcher(index, mesh=make_mesh(jax.devices()[:8]), max_levels=6)
+    topics = [rand_topic() for _ in range(64)]
+    for topic, dev in zip(topics, matcher.match_topics(topics)):
+        assert canon(dev) == canon(index.subscribers(topic)), topic
+
+
+def test_sharded_churn_rebuild():
+    index = TopicsIndex()
+    for i in range(50):
+        index.subscribe(f"cl{i}", Subscription(filter=f"t/{i}"))
+    matcher = ShardedTpuMatcher(index, mesh=make_mesh(jax.devices()[:4]))
+    assert set(matcher.subscribers("t/7").subscriptions) == {"cl7"}
+    index.unsubscribe("t/7", "cl7")
+    index.subscribe("new", Subscription(filter="t/7"))
+    assert matcher.stale
+    assert set(matcher.subscribers("t/7").subscriptions) == {"new"}
