@@ -1,0 +1,93 @@
+"""Broker daemon entry point: ``python -m mqtt_tpu``.
+
+The analog of the reference's config-file entry (cmd/docker/main.go:20-57)
+plus the fork CLI's flag surface (cmd/main.go:25-29): a config file drives
+listeners/hooks, or flags stand up a default TCP/WS/$SYS broker with
+allow-all auth.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import logging
+import signal
+import sys
+
+from . import config as config_mod
+from .hooks.auth import AllowHook, AuthHook, AuthOptions
+from .listeners import Config as ListenerConfig, HTTPStats, TCP, Websocket
+from .server import Options, Server
+
+
+def build_server(args) -> Server:
+    opts = None
+    if args.config:
+        opts = config_mod.from_file(args.config)
+    if opts is None:
+        opts = Options(inline_client=True)
+    server = Server(opts)
+    from .hooks import ON_CONNECT_AUTHENTICATE
+
+    has_auth = any(h.provides(ON_CONNECT_AUTHENTICATE) for h, _ in opts.hooks)
+    if not has_auth:
+        if args.auth:
+            with open(args.auth, "rb") as f:
+                from .hooks.auth import Ledger
+
+                ledger = Ledger()
+                ledger.unmarshal(f.read())
+            server.add_hook(AuthHook(), AuthOptions(ledger=ledger))
+        else:
+            server.add_hook(AllowHook())
+    if not opts.listeners and len(server.listeners) == 0:
+        server.add_listener(TCP(ListenerConfig(type="tcp", id="tcp", address=f":{args.port}")))
+        if args.ws_port:
+            server.add_listener(
+                Websocket(ListenerConfig(type="ws", id="ws", address=f":{args.ws_port}"))
+            )
+        if args.stats_port:
+            server.add_listener(
+                HTTPStats(
+                    ListenerConfig(type="sysinfo", id="stats", address=f":{args.stats_port}"),
+                    server.info,
+                )
+            )
+    return server
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="mqtt_tpu", description="TPU-native MQTT broker"
+    )
+    parser.add_argument("--config", help="path to a YAML/JSON config file")
+    parser.add_argument("--auth", help="path to a YAML/JSON auth ledger file")
+    parser.add_argument("--port", type=int, default=1883, help="MQTT TCP port")
+    parser.add_argument("--ws-port", type=int, default=0, help="MQTT WebSocket port")
+    parser.add_argument("--stats-port", type=int, default=0, help="$SYS stats HTTP port")
+    parser.add_argument("--log-level", default="info")
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(
+        level=args.log_level.upper(), format="%(asctime)s %(levelname)s %(name)s %(message)s"
+    )
+
+    async def run() -> None:
+        server = build_server(args)
+        await server.serve()
+        stop = asyncio.Event()
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:
+                pass
+        await stop.wait()
+        await server.close()
+
+    asyncio.run(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

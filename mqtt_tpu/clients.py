@@ -31,6 +31,7 @@ from .packets import (
 )
 from .topics import OutboundTopicAliases, Subscriptions, TopicAliases
 from .utils import LockedMap
+from .utils.mempool import get_buffer, put_buffer
 
 DEFAULT_KEEPALIVE = 10  # default connection keepalive seconds (clients.go:25)
 DEFAULT_CLIENT_PROTOCOL_VERSION = 4  # (clients.go:26)
@@ -371,17 +372,22 @@ class Client:
 
         pk = self.ops.hooks.on_packet_encode(self, pk)
 
-        buf = pkts.encode_packet(pk)
-        if pk.mods.max_size > 0 and len(buf) > pk.mods.max_size:
-            raise ERR_PACKET_TOO_LARGE()  # [MQTT-3.1.2-24] [MQTT-3.1.2-25]
+        buf = get_buffer()
+        try:
+            pkts.ENCODERS[pk.fixed_header.type](pk, buf)
+            if pk.mods.max_size > 0 and len(buf) > pk.mods.max_size:
+                raise ERR_PACKET_TOO_LARGE()  # [MQTT-3.1.2-24] [MQTT-3.1.2-25]
+            data = bytes(buf)
+        finally:
+            put_buffer(buf)
 
-        self.net.writer.write(buf)
+        self.net.writer.write(data)
 
-        self.ops.info.bytes_sent += len(buf)
+        self.ops.info.bytes_sent += len(data)
         self.ops.info.packets_sent += 1
         if pk.fixed_header.type == pkts.PUBLISH:
             self.ops.info.messages_sent += 1
-        self.ops.hooks.on_packet_sent(self, pk, buf)
+        self.ops.hooks.on_packet_sent(self, pk, data)
 
 
 class Clients(LockedMap[str, Client]):

@@ -1,0 +1,155 @@
+"""File-based configuration: YAML/JSON bytes -> server Options, including
+built-in hook and listener instantiation.
+
+Behavioral parity with reference ``config/config.go:25-175``: JSON iff the
+first byte is ``{``, otherwise YAML; hook configs map to the built-in
+auth/storage/debug hooks; listener configs pass through to
+``Server.add_listeners_from_config``; a ``logging.level`` sets the logger.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+from typing import Any, Optional
+
+from .hooks.auth import AllowHook, AuthHook, AuthOptions, Ledger
+from .hooks.debug import DebugHook, DebugOptions
+from .hooks.storage.memory import MemoryStore
+from .hooks.storage.redis import RedisOptions, RedisStore
+from .hooks.storage.sqlite import SqliteOptions, SqliteStore
+from .listeners import Config as ListenerConfig
+from .server import Capabilities, Compatibilities, Options
+
+
+def _to_logger(level: str) -> logging.Logger:
+    """Configure the broker logger from config; with no level set, leave the
+    logger untouched so CLI flags / embedding apps stay in control."""
+    logger = logging.getLogger("mqtt_tpu")
+    if level:
+        try:
+            logger.setLevel(level.upper())
+        except ValueError:
+            logger.setLevel(logging.INFO)
+        # only attach our own handler when nothing else will emit records
+        if not logger.handlers and not logging.getLogger().handlers:
+            handler = logging.StreamHandler(sys.stdout)
+            handler.setFormatter(
+                logging.Formatter("%(asctime)s %(levelname)s %(name)s %(message)s")
+            )
+            logger.addHandler(handler)
+    return logger
+
+
+def _capabilities_from(d: dict) -> Capabilities:
+    caps = Capabilities()
+    compat = d.pop("compatibilities", None)
+    for k, v in d.items():
+        if hasattr(caps, k):
+            setattr(caps, k, v)
+    if compat:
+        for k, v in compat.items():
+            if hasattr(caps.compatibilities, k):
+                setattr(caps.compatibilities, k, v)
+    return caps
+
+
+def _hooks_from(d: dict) -> list[tuple[Any, Any]]:
+    """Instantiate built-in hooks from their config sections
+    (config.go:71-145)."""
+    hooks: list[tuple[Any, Any]] = []
+    auth = d.get("auth")
+    if auth is not None:
+        if auth.get("allow_all"):
+            hooks.append((AllowHook(), None))
+        else:
+            ledger = Ledger()
+            ledger.unmarshal(json.dumps(auth.get("ledger") or {}).encode())
+            hooks.append((AuthHook(), AuthOptions(ledger=ledger)))
+    storage = d.get("storage") or {}
+    if storage.get("sqlite") is not None:
+        cfg = storage["sqlite"] or {}
+        hooks.append(
+            (
+                SqliteStore(),
+                SqliteOptions(
+                    path=cfg.get("path", "mqtt_tpu.db"), sync=cfg.get("sync", False)
+                ),
+            )
+        )
+    if storage.get("memory") is not None:
+        hooks.append((MemoryStore(), None))
+    if storage.get("redis") is not None:
+        cfg = storage["redis"] or {}
+        hooks.append(
+            (
+                RedisStore(),
+                RedisOptions(
+                    address=cfg.get("address", "localhost:6379"),
+                    username=cfg.get("username", ""),
+                    password=cfg.get("password", ""),
+                    database=cfg.get("database", 0),
+                    h_prefix=cfg.get("h_prefix", "mqtt-tpu-"),
+                ),
+            )
+        )
+    debug = d.get("debug")
+    if debug is not None:
+        hooks.append(
+            (
+                DebugHook(),
+                DebugOptions(
+                    enable=debug.get("enable", True),
+                    show_packet_data=debug.get("show_packet_data", False),
+                    show_pings=debug.get("show_pings", False),
+                    show_passwords=debug.get("show_passwords", False),
+                ),
+            )
+        )
+    return hooks
+
+
+def from_bytes(b: bytes) -> Optional[Options]:
+    """Unmarshal JSON or YAML config bytes into server Options
+    (config.go:149-175)."""
+    if not b:
+        return None
+    if b[:1] == b"{":
+        raw = json.loads(b)
+    else:
+        import yaml
+
+        raw = yaml.safe_load(b)
+    if not raw:
+        return None
+
+    opts = Options()
+    top = raw.get("options") or raw  # accept flat or nested layout
+    for k in (
+        "sys_topic_resend_interval",
+        "inline_client",
+        "client_net_write_buffer_size",
+        "client_net_read_buffer_size",
+    ):
+        if k in top:
+            setattr(opts, k, top[k])
+    if "capabilities" in top and top["capabilities"]:
+        opts.capabilities = _capabilities_from(dict(top["capabilities"]))
+
+    opts.listeners = [
+        ListenerConfig(
+            type=conf.get("type", ""),
+            id=conf.get("id", ""),
+            address=conf.get("address", ""),
+        )
+        for conf in (raw.get("listeners") or [])
+    ]
+    opts.hooks = _hooks_from(raw.get("hooks") or {})
+    opts.logger = _to_logger((raw.get("logging") or {}).get("level", ""))
+    return opts
+
+
+def from_file(path: str) -> Optional[Options]:
+    with open(path, "rb") as f:
+        return from_bytes(f.read())

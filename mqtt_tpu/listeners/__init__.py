@@ -65,6 +65,68 @@ class Listener:
         close_clients(self.id())
 
 
+def split_host_port(address: str) -> tuple[str, int]:
+    """Parse host:port, handling bracketed IPv6 literals."""
+    host, _, port = address.rpartition(":")
+    if host.startswith("[") and host.endswith("]"):
+        host = host[1:-1]
+    return host or "0.0.0.0", int(port or 0)
+
+
+class StreamListener(Listener):
+    """Shared scaffolding for stream-socket listeners: establish dispatch,
+    serve arming, and the disconnect-clients-then-wait close ordering."""
+
+    def __init__(self, config: Config) -> None:
+        super().__init__(config)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._establish: Optional[EstablishFn] = None
+
+    def address(self) -> str:
+        if self._server is not None and self._server.sockets:
+            name = self._server.sockets[0].getsockname()
+            if isinstance(name, tuple):
+                return f"{name[0]}:{name[1]}"
+            return str(name)
+        return self.config.address
+
+    async def _handle(self, reader, writer, establish: EstablishFn) -> None:
+        """Dispatch one accepted connection; override to wrap the streams
+        (e.g. websocket framing)."""
+        await establish(self.id(), reader, writer)
+
+    async def _on_connection(self, reader, writer) -> None:
+        establish = self._establish
+        if establish is None:  # not serving yet
+            writer.close()
+            return
+        try:
+            await self._handle(reader, writer, establish)
+        except Exception as e:
+            self.log.debug("establish error on %s: %s", self.id(), e)
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def serve(self, establish: EstablishFn) -> None:
+        self._establish = establish
+
+    async def close(self, close_clients: Callable[[str], None]) -> None:
+        # Stop accepting, then disconnect attached clients FIRST — their
+        # handler tasks must end before wait_closed() can complete.
+        if self._server is not None:
+            self._server.close()
+        close_clients(self.id())
+        if self._server is not None:
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+            except Exception:
+                pass
+            self._server = None
+
+
 class Listeners:
     """Id-keyed listener registry with serve/close-all and a global client
     task group (listeners.go:42-135)."""
@@ -104,15 +166,22 @@ class Listeners:
             await asyncio.gather(*list(self.client_tasks), return_exceptions=True)
 
 
+from .http import HTTPHealthCheck, HTTPStats  # noqa: E402
 from .mock import MockListener  # noqa: E402
+from .net import Net  # noqa: E402
 from .tcp import TCP  # noqa: E402
+from .unixsock import UnixSock  # noqa: E402
+from .websocket import Websocket  # noqa: E402
 
 __all__ = [
     "Config",
     "EstablishFn",
+    "HTTPHealthCheck",
+    "HTTPStats",
     "Listener",
     "Listeners",
     "MockListener",
+    "Net",
     "TCP",
     "TYPE_HEALTHCHECK",
     "TYPE_MOCK",
@@ -120,4 +189,6 @@ __all__ = [
     "TYPE_TCP",
     "TYPE_UNIX",
     "TYPE_WS",
+    "UnixSock",
+    "Websocket",
 ]

@@ -1,0 +1,83 @@
+"""HTTP utility listeners: healthcheck and $SYS stats.
+
+Behavioral parity with reference ``listeners/http_healthcheck.go:19-99``
+(200-OK on GET /healthcheck) and ``listeners/http_sysinfo.go:23-121``
+(JSON dump of system.Info). Implemented as minimal asyncio HTTP/1.1
+responders — no framework dependency.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from ..system import Info
+from . import Config, EstablishFn, StreamListener, split_host_port
+
+
+class _HttpListener(StreamListener):
+    """Shared accept loop for the single-purpose HTTP listeners."""
+
+    def protocol(self) -> str:
+        return "https" if self.config.tls_config else "http"
+
+    async def init(self, log: logging.Logger) -> None:
+        self.log = log
+        host, port = split_host_port(self.config.address)
+        self._server = await asyncio.start_server(
+            self._on_connection, host, port, ssl=self.config.tls_config
+        )
+
+    async def serve(self, establish: EstablishFn) -> None:
+        pass  # HTTP listeners never establish MQTT clients
+
+    async def _on_connection(self, reader, writer):  # overrides StreamListener
+        try:
+            request = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 10)
+            line = request.split(b"\r\n", 1)[0].decode("latin-1")
+            parts = line.split(" ")
+            method, path = (parts + ["", ""])[:2]
+            status, body, ctype = self._respond(method, path)
+            writer.write(
+                (
+                    f"HTTP/1.1 {status}\r\n"
+                    f"Content-Type: {ctype}\r\n"
+                    f"Content-Length: {len(body)}\r\n"
+                    "Connection: close\r\n\r\n"
+                ).encode()
+                + body
+            )
+            await writer.drain()
+        except Exception:
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+        raise NotImplementedError
+
+
+class HTTPHealthCheck(_HttpListener):
+    """Responds 200 OK to GET /healthcheck (http_healthcheck.go:59-63)."""
+
+    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+        if method == "GET" and path == "/healthcheck":
+            return "200 OK", b"", "text/plain"
+        return "405 Method Not Allowed" if method != "GET" else "404 Not Found", b"", "text/plain"
+
+
+class HTTPStats(_HttpListener):
+    """Serves the $SYS info values as JSON (http_sysinfo.go:112-121)."""
+
+    def __init__(self, config: Config, sys_info: Info) -> None:
+        super().__init__(config)
+        self.sys_info = sys_info
+
+    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+        if method != "GET":
+            return "405 Method Not Allowed", b"", "text/plain"
+        body = json.dumps(self.sys_info.clone().as_dict()).encode()
+        return "200 OK", body, "application/json"

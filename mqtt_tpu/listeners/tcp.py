@@ -9,69 +9,19 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Callable, Optional
 
-from . import Config, EstablishFn, Listener
+from . import Config, StreamListener, split_host_port
 
 
-class TCP(Listener):
+class TCP(StreamListener):
     """A TCP listener, optionally TLS-wrapped (tcp.go:19-27)."""
-
-    def __init__(self, config: Config) -> None:
-        super().__init__(config)
-        self._server: Optional[asyncio.base_events.Server] = None
-        self._establish: Optional[EstablishFn] = None
 
     def protocol(self) -> str:
         return "tcp"
 
-    def address(self) -> str:
-        if self._server is not None and self._server.sockets:
-            host, port = self._server.sockets[0].getsockname()[:2]
-            return f"{host}:{port}"
-        return self.config.address
-
     async def init(self, log: logging.Logger) -> None:
-        """Bind the socket (tcp.go:57-69); the accept callback dispatches
-        once serve() has provided the establish function."""
         self.log = log
-        host, _, port = self.config.address.rpartition(":")
-        if host.startswith("[") and host.endswith("]"):
-            host = host[1:-1]  # IPv6 literal, e.g. [::1]:1883
+        host, port = split_host_port(self.config.address)
         self._server = await asyncio.start_server(
-            self._on_connection,
-            host or "0.0.0.0",
-            int(port or 0),
-            ssl=self.config.tls_config,
+            self._on_connection, host, port, ssl=self.config.tls_config
         )
-
-    async def _on_connection(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        establish = self._establish
-        if establish is None:  # not serving yet; drop the connection
-            writer.close()
-            return
-        try:
-            await establish(self.id(), reader, writer)
-        except Exception as e:
-            self.log.debug("establish error on %s: %s", self.id(), e)
-        finally:
-            try:
-                writer.close()
-            except Exception:
-                pass
-
-    async def serve(self, establish: EstablishFn) -> None:
-        self._establish = establish
-
-    async def close(self, close_clients: Callable[[str], None]) -> None:
-        # Stop accepting, then disconnect attached clients FIRST — their
-        # handler tasks must end before wait_closed() can complete.
-        if self._server is not None:
-            self._server.close()
-        close_clients(self.id())
-        if self._server is not None:
-            try:
-                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
-            except Exception:
-                pass
-            self._server = None

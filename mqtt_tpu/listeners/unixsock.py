@@ -1,0 +1,39 @@
+"""Unix-domain socket listener.
+
+Behavioral parity with reference ``listeners/unixsock.go:19-102``: removes a
+stale socket file before binding.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+from typing import Callable
+
+from . import Config, StreamListener
+
+
+class UnixSock(StreamListener):
+    def protocol(self) -> str:
+        return "unix"
+
+    def address(self) -> str:
+        return self.config.address
+
+    async def init(self, log: logging.Logger) -> None:
+        self.log = log
+        try:
+            os.unlink(self.config.address)  # remove stale socket (unixsock.go:58)
+        except FileNotFoundError:
+            pass
+        self._server = await asyncio.start_unix_server(
+            self._on_connection, path=self.config.address
+        )
+
+    async def close(self, close_clients: Callable[[str], None]) -> None:
+        await super().close(close_clients)
+        try:
+            os.unlink(self.config.address)
+        except OSError:
+            pass

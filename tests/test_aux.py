@@ -1,0 +1,484 @@
+"""Aux-surface tests: storage hooks (write-through + restore), auth ledger,
+debug hook, websocket/unix/http listeners, config loader, mempool."""
+
+import asyncio
+import json
+import socket
+import struct
+
+import pytest
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu import config as config_mod
+from mqtt_tpu.hooks.auth import (
+    ACCESS_READ_ONLY,
+    ACCESS_READ_WRITE,
+    ACCESS_WRITE_ONLY,
+    ACLRule,
+    AllowHook,
+    AuthHook,
+    AuthOptions,
+    AuthRule,
+    Ledger,
+    RString,
+    UserRule,
+    match_topic,
+)
+from mqtt_tpu.hooks.debug import DebugHook, DebugOptions
+from mqtt_tpu.hooks.storage.memory import MemoryStore
+from mqtt_tpu.hooks.storage.sqlite import SqliteOptions, SqliteStore
+from mqtt_tpu.listeners import (
+    Config as LConfig,
+    HTTPHealthCheck,
+    HTTPStats,
+    UnixSock,
+    Websocket,
+)
+from mqtt_tpu.packets import (
+    CONNACK,
+    CONNECT,
+    PUBLISH,
+    SUBSCRIBE,
+    ConnectParams,
+    FixedHeader,
+    Packet,
+    Subscription,
+    decode_length,
+    decode_packet,
+    encode_packet,
+)
+from mqtt_tpu.utils.mempool import BufferPool
+
+from tests.test_server import Harness, connect_packet, read_wire_packet, run
+
+
+# -- ledger / auth ---------------------------------------------------------
+
+
+class FakeClient:
+    def __init__(self, id_="c1", username=b"alice", remote="1.2.3.4:5"):
+        self.id = id_
+        self.properties = type("P", (), {"username": username})()
+        self.net = type("N", (), {"remote": remote})()
+
+
+class TestMatchTopic:
+    # the ledger's own matcher differs from the trie walk by design
+    def test_matches(self):
+        assert match_topic("a/b/+/c", "a/b/d/c") == (["d"], True)
+        assert match_topic("a/#", "a/b/c") == (["b/c"], True)
+        assert match_topic("a/b", "a/b") == ([], True)
+        assert match_topic("a/b/#", "a/b")[1] is False  # no parent-level match
+        assert match_topic("a/+", "a")[1] is False
+        assert match_topic("a/b", "a/c")[1] is False
+
+
+class TestRString:
+    def test_matches(self):
+        assert RString("").matches("anything")
+        assert RString("*").matches("anything")
+        assert RString("exact").matches("exact")
+        assert not RString("exact").matches("other")
+        assert RString("pre*").matches("prefix-anything")
+        assert not RString("pre*").matches("pr")
+
+
+class TestLedger:
+    def _pk(self, password=b"secret"):
+        return Packet(connect=ConnectParams(password=password))
+
+    def test_users_first(self):
+        ledger = Ledger(users={"alice": UserRule(password=RString("secret"))})
+        assert ledger.auth_ok(FakeClient(), self._pk())[1]
+        assert not ledger.auth_ok(FakeClient(), self._pk(b"wrong"))[1]
+
+    def test_users_disallow(self):
+        ledger = Ledger(
+            users={"alice": UserRule(password=RString("secret"), disallow=True)}
+        )
+        assert not ledger.auth_ok(FakeClient(), self._pk())[1]
+
+    def test_auth_rules_in_order(self):
+        ledger = Ledger(auth=[AuthRule(username=RString("alice"), allow=True)])
+        assert ledger.auth_ok(FakeClient(), self._pk())[1]
+        assert ledger.auth_ok(FakeClient(username=b"bob"), self._pk())[1] is False
+
+    def test_acl_filters(self):
+        ledger = Ledger(
+            users={
+                "alice": UserRule(
+                    acl={
+                        RString("read/#"): ACCESS_READ_ONLY,
+                        RString("write/#"): ACCESS_WRITE_ONLY,
+                        RString("both/#"): ACCESS_READ_WRITE,
+                    }
+                )
+            }
+        )
+        cl = FakeClient()
+        assert ledger.acl_ok(cl, "read/x", False)[1]
+        assert not ledger.acl_ok(cl, "read/x", True)[1]
+        assert ledger.acl_ok(cl, "write/x", True)[1]
+        assert not ledger.acl_ok(cl, "write/x", False)[1]
+        assert ledger.acl_ok(cl, "both/x", True)[1]
+        assert ledger.acl_ok(cl, "both/x", False)[1]
+
+    def test_acl_rules_then_auth_fallback(self):
+        ledger = Ledger(
+            auth=[AuthRule(username=RString("alice"), allow=True)],
+            acl=[ACLRule(username=RString("bob"), filters={RString("b/#"): ACCESS_READ_WRITE})],
+        )
+        assert ledger.acl_ok(FakeClient(), "anything", True)[1]  # via auth fallback
+        assert ledger.acl_ok(FakeClient(username=b"bob"), "b/x", True)[1]
+        assert not ledger.acl_ok(FakeClient(username=b"carol"), "b/x", True)[1]
+
+    def test_unmarshal_json_yaml(self):
+        data = {
+            "users": {"u": {"password": "p", "acl": {"t/#": ACCESS_READ_WRITE}}},
+            "auth": [{"username": "x", "allow": True}],
+            "acl": [{"client": "c*", "filters": {"f/#": ACCESS_READ_ONLY}}],
+        }
+        for raw in (json.dumps(data).encode(), __import__("yaml").safe_dump(data).encode()):
+            ledger = Ledger()
+            ledger.unmarshal(raw)
+            assert "u" in ledger.users
+            assert ledger.auth[0].allow
+            assert ledger.acl[0].client == "c*"
+
+    def test_auth_hook(self):
+        hook = AuthHook()
+        hook.init(AuthOptions(ledger=Ledger(auth=[AuthRule(allow=True)])))
+        assert hook.on_connect_authenticate(FakeClient(), self._pk())
+        assert hook.on_acl_check(FakeClient(), "t", True)
+
+
+# -- storage hooks ---------------------------------------------------------
+
+
+def _roundtrip_store(make_hook):
+    """Drive a broker session with a storage hook attached, then restore a
+    fresh broker from the same store and check the five datasets."""
+
+    async def scenario():
+        store = make_hook()
+        h = Harness()
+        h.server.add_hook(store, getattr(store, "_test_config", None))
+
+        # v4 + clean=False is a persistent session (restore keeps it;
+        # v5 with session-expiry 0 would expire on load, server.go:1667)
+        r, w, _ = await h.connect("persist-cl", version=4, clean=False)
+        w.write(
+            encode_packet(
+                Packet(
+                    fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                    protocol_version=4,
+                    packet_id=1,
+                    filters=[Subscription(filter="stored/+", qos=1)],
+                )
+            )
+        )
+        await w.drain()
+        await read_wire_packet(r, 4)
+        w.write(
+            encode_packet(
+                Packet(
+                    fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                    protocol_version=4,
+                    topic_name="stored/ret",
+                    payload=b"keep",
+                )
+            )
+        )
+        await w.drain()
+        await asyncio.sleep(0.05)
+        h.server.publish_sys_topics()
+
+        subs = store.stored_subscriptions()
+        assert [s.filter for s in subs] == ["stored/+"]
+        clients = store.stored_clients()
+        assert [c.id for c in clients] == ["persist-cl"]
+        retained = store.stored_retained_messages()
+        # $SYS topics are retained too; find ours
+        assert any(m.topic_name == "stored/ret" and m.payload == b"keep" for m in retained)
+        assert store.stored_sys_info() is not None
+
+        # restore into a fresh broker, attaching the already-initialized
+        # store without re-running init
+        h2 = Harness()
+        h2.server.hooks._hooks = h2.server.hooks._hooks + [store]
+        h2.server.read_store()
+        assert h2.server.clients.get("persist-cl") is not None
+        assert len(h2.server.topics.subscribers("stored/x").subscriptions) == 1
+        assert any(
+            p.topic_name == "stored/ret" for p in h2.server.topics.messages("stored/#")
+        )
+        await h.shutdown()
+        await h2.shutdown()
+
+    run(scenario())
+
+
+class TestStorageHooks:
+    def test_memory_store_roundtrip(self):
+        _roundtrip_store(MemoryStore)
+
+    def test_sqlite_store_roundtrip(self, tmp_path):
+        def make():
+            store = SqliteStore()
+            store._test_config = SqliteOptions(path=str(tmp_path / "t.db"))
+            return store
+
+        _roundtrip_store(make)
+
+    def test_sqlite_persists_across_instances(self, tmp_path):
+        path = str(tmp_path / "p.db")
+        s1 = SqliteStore()
+        s1.init(SqliteOptions(path=path))
+        s1._set("CL_x", b'{"id": "x"}')
+        s1.stop()
+        s2 = SqliteStore()
+        s2.init(SqliteOptions(path=path))
+        assert s2._get("CL_x") == b'{"id": "x"}'
+        assert s2._iter("CL") == [b'{"id": "x"}']
+        s2._del("CL_x")
+        assert s2._get("CL_x") is None
+        s2.stop()
+
+    def test_redis_store_gated(self):
+        from mqtt_tpu.hooks.storage.redis import RedisStore
+
+        store = RedisStore()
+        with pytest.raises((RuntimeError, Exception)):
+            store.init(None)  # redis lib absent or server unreachable
+
+
+# -- debug hook ------------------------------------------------------------
+
+
+class TestDebugHook:
+    def test_logs_packet_flow(self, caplog):
+        import logging
+
+        hook = DebugHook()
+        hook.init(DebugOptions(show_packet_data=True))
+        hook.log = logging.getLogger("debugtest")
+        with caplog.at_level(logging.DEBUG, logger="debugtest"):
+            cl = FakeClient()
+            hook.on_packet_read(cl, Packet(fixed_header=FixedHeader(type=PUBLISH), topic_name="t", payload=b"x"))
+            hook.on_packet_sent(cl, Packet(fixed_header=FixedHeader(type=CONNACK)), b"")
+        assert "PUBLISH << c1" in caplog.text
+        assert "CONNACK >> c1" in caplog.text
+
+    def test_pings_hidden_by_default(self, caplog):
+        import logging
+
+        from mqtt_tpu.packets import PINGREQ
+
+        hook = DebugHook()
+        hook.init(None)
+        hook.log = logging.getLogger("debugtest2")
+        with caplog.at_level(logging.DEBUG, logger="debugtest2"):
+            hook.on_packet_read(FakeClient(), Packet(fixed_header=FixedHeader(type=PINGREQ)))
+        assert "PINGREQ" not in caplog.text
+
+
+# -- listeners -------------------------------------------------------------
+
+
+def _ws_client_frame(payload: bytes) -> bytes:
+    """A masked client->server binary frame."""
+    mask = b"\x01\x02\x03\x04"
+    masked = bytes(b ^ mask[i % 4] for i, b in enumerate(payload))
+    n = len(payload)
+    if n < 126:
+        return struct.pack("!BB", 0x82, 0x80 | n) + mask + masked
+    return struct.pack("!BBH", 0x82, 0x80 | 126, n) + mask + masked
+
+
+class TestWebsocketListener:
+    def test_mqtt_over_websocket(self):
+        async def scenario():
+            h = Harness()
+            ws = Websocket(LConfig(type="ws", id="ws1", address="127.0.0.1:0"))
+            h.server.add_listener(ws)
+            await ws.init(h.server.log)
+            await ws.serve(h.server.establish_connection)
+            host, port = ws.address().rsplit(":", 1)
+
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write(
+                b"GET /mqtt HTTP/1.1\r\n"
+                b"Host: x\r\nUpgrade: websocket\r\nConnection: Upgrade\r\n"
+                b"Sec-WebSocket-Key: dGhlIHNhbXBsZSBub25jZQ==\r\n"
+                b"Sec-WebSocket-Protocol: mqtt\r\n"
+                b"Sec-WebSocket-Version: 13\r\n\r\n"
+            )
+            await writer.drain()
+            resp = await asyncio.wait_for(reader.readuntil(b"\r\n\r\n"), 3)
+            assert b"101 Switching Protocols" in resp
+            assert b"Sec-WebSocket-Protocol: mqtt" in resp
+
+            # send CONNECT in a masked binary frame; read CONNACK frame back
+            writer.write(_ws_client_frame(connect_packet("wsclient", 4)))
+            await writer.drain()
+            head = await asyncio.wait_for(reader.readexactly(2), 3)
+            assert head[0] & 0x0F == 0x2  # binary frame
+            length = head[1] & 0x7F
+            payload = await asyncio.wait_for(reader.readexactly(length), 3)
+            ack = decode_packet(payload, 4)
+            assert ack.fixed_header.type == CONNACK
+            assert ack.reason_code == 0
+            assert h.server.clients.get("wsclient") is not None
+            writer.close()
+            await ws.close(lambda _: None)
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestUnixListener:
+    def test_mqtt_over_unix_socket(self, tmp_path):
+        async def scenario():
+            h = Harness()
+            path = str(tmp_path / "mqtt.sock")
+            ul = UnixSock(LConfig(type="unix", id="u1", address=path))
+            h.server.add_listener(ul)
+            await ul.init(h.server.log)
+            await ul.serve(h.server.establish_connection)
+            reader, writer = await asyncio.open_unix_connection(path)
+            writer.write(connect_packet("unixclient"))
+            await writer.drain()
+            ack = await read_wire_packet(reader)
+            assert ack.fixed_header.type == CONNACK and ack.reason_code == 0
+            writer.close()
+            await ul.close(lambda _: None)
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestHttpListeners:
+    async def _http_get(self, host, port, path):
+        reader, writer = await asyncio.open_connection(host, int(port))
+        writer.write(f"GET {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
+        await writer.drain()
+        data = await asyncio.wait_for(reader.read(65536), 3)
+        writer.close()
+        return data
+
+    def test_healthcheck(self):
+        async def scenario():
+            hc = HTTPHealthCheck(LConfig(type="healthcheck", id="h1", address="127.0.0.1:0"))
+            await hc.init(__import__("logging").getLogger("t"))
+            host, port = hc.address().rsplit(":", 1)
+            ok = await self._http_get(host, port, "/healthcheck")
+            assert ok.startswith(b"HTTP/1.1 200")
+            missing = await self._http_get(host, port, "/nope")
+            assert missing.startswith(b"HTTP/1.1 404")
+            await hc.close(lambda _: None)
+
+        run(scenario())
+
+    def test_sysinfo(self):
+        async def scenario():
+            h = Harness()
+            st = HTTPStats(
+                LConfig(type="sysinfo", id="s1", address="127.0.0.1:0"), h.server.info
+            )
+            await st.init(h.server.log)
+            host, port = st.address().rsplit(":", 1)
+            data = await self._http_get(host, port, "/")
+            body = data.split(b"\r\n\r\n", 1)[1]
+            info = json.loads(body)
+            assert info["version"] == "0.1.0"
+            await st.close(lambda _: None)
+            await h.shutdown()
+
+        run(scenario())
+
+
+# -- config ----------------------------------------------------------------
+
+
+class TestConfig:
+    def test_yaml_config(self):
+        raw = b"""
+listeners:
+  - type: tcp
+    id: t1
+    address: ":0"
+  - type: ws
+    id: ws1
+    address: ":0"
+hooks:
+  auth:
+    allow_all: true
+  debug:
+    show_pings: true
+options:
+  inline_client: true
+  capabilities:
+    maximum_qos: 1
+    compatibilities:
+      obscure_not_authorized: true
+logging:
+  level: warning
+"""
+        opts = config_mod.from_bytes(raw)
+        assert opts is not None
+        assert len(opts.listeners) == 2
+        assert opts.inline_client
+        assert opts.capabilities.maximum_qos == 1
+        assert opts.capabilities.compatibilities.obscure_not_authorized
+        kinds = [type(h).__name__ for h, _ in opts.hooks]
+        assert kinds == ["AllowHook", "DebugHook"]
+
+    def test_json_config(self):
+        raw = json.dumps(
+            {
+                "listeners": [{"type": "tcp", "id": "t1", "address": ":0"}],
+                "hooks": {"auth": {"allow_all": True}},
+            }
+        ).encode()
+        opts = config_mod.from_bytes(raw)
+        assert len(opts.listeners) == 1
+        assert type(opts.hooks[0][0]).__name__ == "AllowHook"
+
+    def test_config_driven_server_boots(self):
+        async def scenario():
+            raw = b"""
+listeners:
+  - type: tcp
+    id: cfg-tcp
+    address: "127.0.0.1:0"
+hooks:
+  auth:
+    allow_all: true
+"""
+            opts = config_mod.from_bytes(raw)
+            server = Server(opts)
+            await server.serve()
+            addr = server.listeners.get("cfg-tcp").address()
+            host, port = addr.rsplit(":", 1)
+            reader, writer = await asyncio.open_connection(host, int(port))
+            writer.write(connect_packet("cfg-client"))
+            await writer.drain()
+            ack = await read_wire_packet(reader)
+            assert ack.reason_code == 0
+            writer.close()
+            await server.close()
+
+        run(scenario())
+
+
+class TestMempool:
+    def test_pool_reuse_and_cap(self):
+        pool = BufferPool(max_size=8)
+        b = pool.get()
+        b += b"12345"
+        pool.put(b)
+        b2 = pool.get()
+        assert b2 is b and len(b2) == 0  # cleared and reused
+        big = bytearray(b"123456789")
+        pool.put(big)
+        assert pool.get() is not big  # oversized discarded
