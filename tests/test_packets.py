@@ -1,24 +1,16 @@
 """Golden packet catalogue + codec conformance.
 
-The model is the reference's golden catalogue (packets/tpackets.go, ~300
-cases of raw bytes <-> expected struct): every case here pins exact wire
-bytes for decode and encode, including malformed variants. Round-trip and
-validation tests extend coverage beyond the hand-pinned vectors.
+The catalogue itself lives in ``tpackets.py`` (the analog of the
+reference's packets/tpackets.go): every case pins exact wire bytes for
+decode and encode, including malformed variants. Round-trip and validation
+tests extend coverage beyond the hand-pinned vectors.
 """
 
-from dataclasses import dataclass, field
-
 import pytest
+from tpackets import CASES, fhdr
 
 from mqtt_tpu.packets import (
     AUTH,
-    CONNACK,
-    CONNECT,
-    DISCONNECT,
-    PINGREQ,
-    PINGRESP,
-    PUBACK,
-    PUBCOMP,
     PUBLISH,
     PUBREC,
     PUBREL,
@@ -28,411 +20,13 @@ from mqtt_tpu.packets import (
     UNSUBSCRIBE,
     Code,
     ConnectParams,
-    FixedHeader,
     Packet,
     Properties,
     Subscription,
-    UserProperty,
     codes,
     decode_packet,
     encode_packet,
 )
-
-
-@dataclass
-class Case:
-    desc: str
-    raw: bytes
-    packet: Packet | None = None
-    version: int = 4
-    decode_err: Code | None = None  # expected decode failure
-    fail_first: Code | None = None  # expected fixed-header decode failure
-    group: str = ""  # "decode", "encode", or "" for both directions
-
-
-def fhdr(type_, qos=0, dup=False, retain=False, remaining=0):
-    return FixedHeader(type=type_, qos=qos, dup=dup, retain=retain, remaining=remaining)
-
-
-CASES: list[Case] = [
-    # ---- CONNECT ---------------------------------------------------------
-    Case(
-        "connect v4 basic",
-        bytes.fromhex("1010 0004 4d515454 04 02 003c 0004 7a656e33".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(CONNECT, remaining=16),
-            protocol_version=4,
-            connect=ConnectParams(
-                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
-            ),
-        ),
-    ),
-    Case(
-        "connect v5 with session expiry",
-        bytes.fromhex("1016 0004 4d515454 05 02 003c 05 11 00000078 0004 7a656e33".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(CONNECT, remaining=22),
-            protocol_version=5,
-            properties=Properties(session_expiry_interval=120, session_expiry_interval_flag=True),
-            connect=ConnectParams(
-                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
-            ),
-        ),
-    ),
-    Case(
-        "connect v4 with will",
-        bytes.fromhex(
-            "101f 0004 4d515454 04 0e 003c 0004 7a656e33 0003 6c7774 0008 6e6f74616761696e".replace(" ", "")
-        ),
-        Packet(
-            fixed_header=fhdr(CONNECT, remaining=31),
-            protocol_version=4,
-            connect=ConnectParams(
-                protocol_name=b"MQTT",
-                clean=True,
-                keepalive=60,
-                client_identifier="zen3",
-                will_flag=True,
-                will_qos=1,
-                will_topic="lwt",
-                will_payload=b"notagain",
-            ),
-        ),
-    ),
-    Case(
-        "connect v3 MQIsdp",
-        bytes.fromhex("1011 0006 4d5149736470 03 02 001e 0003 7a656e".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(CONNECT, remaining=17),
-            protocol_version=3,
-            connect=ConnectParams(
-                protocol_name=b"MQIsdp", clean=True, keepalive=30, client_identifier="zen"
-            ),
-        ),
-        version=3,
-    ),
-    Case(
-        "connect truncated keepalive",
-        bytes.fromhex("1009 0004 4d515454 04 02 00".replace(" ", "")),
-        decode_err=codes.ERR_MALFORMED_KEEPALIVE,
-        group="decode",
-    ),
-    Case(
-        "connect body shorter than declared remaining",
-        bytes.fromhex("100c 0004 4d515454 04 02 00".replace(" ", "")),
-        decode_err=codes.ERR_MALFORMED_PACKET,
-        group="decode",
-    ),
-    Case(
-        "connect username flag but no username",
-        bytes.fromhex("1010 0004 4d515454 04 82 003c 0004 7a656e33".replace(" ", "")),
-        decode_err=codes.ERR_PROTOCOL_VIOLATION_FLAG_NO_USERNAME,
-        group="decode",
-    ),
-    # ---- CONNACK ---------------------------------------------------------
-    Case(
-        "connack v4 accepted",
-        bytes.fromhex("20020000"),
-        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4),
-    ),
-    Case(
-        "connack v4 session present",
-        bytes.fromhex("20020100"),
-        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, session_present=True),
-    ),
-    Case(
-        "connack v5 empty properties",
-        bytes.fromhex("2003000000"),
-        Packet(fixed_header=fhdr(CONNACK, remaining=3), protocol_version=5),
-        version=5,
-    ),
-    Case(
-        "connack v5 bad username or password",
-        bytes.fromhex("2003008600"),
-        Packet(
-            fixed_header=fhdr(CONNACK, remaining=3),
-            protocol_version=5,
-            reason_code=0x86,
-        ),
-        version=5,
-    ),
-    # ---- PUBLISH ---------------------------------------------------------
-    Case(
-        "publish qos0 v4",
-        bytes.fromhex("300c 0005 612f622f63 68656c6c6f".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(PUBLISH, remaining=12),
-            protocol_version=4,
-            topic_name="a/b/c",
-            payload=b"hello",
-        ),
-    ),
-    Case(
-        "publish qos1 v4",
-        bytes.fromhex("320e 0005 612f622f63 0007 68656c6c6f".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(PUBLISH, qos=1, remaining=14),
-            protocol_version=4,
-            topic_name="a/b/c",
-            packet_id=7,
-            payload=b"hello",
-        ),
-    ),
-    Case(
-        "publish qos2 retain dup v4",
-        bytes.fromhex("3d0e 0005 612f622f63 0007 68656c6c6f".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(PUBLISH, qos=2, dup=True, retain=True, remaining=14),
-            protocol_version=4,
-            topic_name="a/b/c",
-            packet_id=7,
-            payload=b"hello",
-        ),
-    ),
-    Case(
-        "publish v5 empty properties",
-        bytes.fromhex("300d 0005 612f622f63 00 68656c6c6f".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(PUBLISH, remaining=13),
-            protocol_version=5,
-            topic_name="a/b/c",
-            payload=b"hello",
-        ),
-        version=5,
-    ),
-    Case(
-        "publish v5 user property",
-        bytes.fromhex("3016 0005 612f622f63 09 26 00026869 00027468 68656c6c6f".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(PUBLISH, remaining=22),
-            protocol_version=5,
-            topic_name="a/b/c",
-            properties=Properties(user=[UserProperty("hi", "th")]),
-            payload=b"hello",
-        ),
-        version=5,
-    ),
-    Case(
-        "publish invalid utf8 topic",
-        bytes.fromhex("3009 0005 612f62ffc3 6869".replace(" ", "")),
-        decode_err=codes.ERR_MALFORMED_TOPIC,
-        group="decode",
-    ),
-    Case(
-        "publish qos3 rejected at header",
-        bytes.fromhex("3600"),
-        fail_first=codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE,
-        group="decode",
-    ),
-    Case(
-        "publish dup without qos rejected",
-        bytes.fromhex("3800"),
-        fail_first=codes.ERR_PROTOCOL_VIOLATION_DUP_NO_QOS,
-        group="decode",
-    ),
-    # ---- PUBACK / PUBREC / PUBREL / PUBCOMP ------------------------------
-    Case(
-        "puback v4",
-        bytes.fromhex("40020007"),
-        Packet(fixed_header=fhdr(PUBACK, remaining=2), protocol_version=4, packet_id=7),
-    ),
-    Case(
-        "puback v5 reason code",
-        bytes.fromhex("4003000710"),
-        Packet(
-            fixed_header=fhdr(PUBACK, remaining=3),
-            protocol_version=5,
-            packet_id=7,
-            reason_code=0x10,
-        ),
-        version=5,
-        group="decode",  # encode of rc<0x80 with no props omits reason byte
-    ),
-    Case(
-        "puback v5 error reason encodes reason byte",
-        bytes.fromhex("4003000793"),
-        Packet(
-            fixed_header=fhdr(PUBACK, remaining=3),
-            protocol_version=5,
-            packet_id=7,
-            reason_code=0x93,
-        ),
-        version=5,
-    ),
-    Case(
-        "pubrec v4",
-        bytes.fromhex("50020007"),
-        Packet(fixed_header=fhdr(PUBREC, remaining=2), protocol_version=4, packet_id=7),
-    ),
-    Case(
-        "pubrel v4",
-        bytes.fromhex("62020007"),
-        Packet(fixed_header=fhdr(PUBREL, qos=1, remaining=2), protocol_version=4, packet_id=7),
-    ),
-    Case(
-        "pubrel v5 packet id not found",
-        bytes.fromhex("6203000792"),
-        Packet(
-            fixed_header=fhdr(PUBREL, qos=1, remaining=3),
-            protocol_version=5,
-            packet_id=7,
-            reason_code=0x92,
-        ),
-        version=5,
-    ),
-    Case(
-        "pubrel bad flags",
-        bytes.fromhex("60020007"),
-        fail_first=codes.ERR_MALFORMED_FLAGS,
-        group="decode",
-    ),
-    Case(
-        "pubcomp v4",
-        bytes.fromhex("70020007"),
-        Packet(fixed_header=fhdr(PUBCOMP, remaining=2), protocol_version=4, packet_id=7),
-    ),
-    # ---- SUBSCRIBE / SUBACK ----------------------------------------------
-    Case(
-        "subscribe v4",
-        bytes.fromhex("820a 0015 0005 612f622f63 01".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=10),
-            protocol_version=4,
-            packet_id=21,
-            filters=[Subscription(filter="a/b/c", qos=1)],
-        ),
-    ),
-    Case(
-        "subscribe v5 options",
-        bytes.fromhex("820b 0015 00 0005 612f622f63 2e".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=11),
-            protocol_version=5,
-            packet_id=21,
-            filters=[
-                Subscription(
-                    filter="a/b/c",
-                    qos=2,
-                    no_local=True,
-                    retain_as_published=True,
-                    retain_handling=2,
-                )
-            ],
-        ),
-        version=5,
-    ),
-    Case(
-        "subscribe v5 subscription identifier",
-        bytes.fromhex("820d 0015 02 0b 05 0005 612f622f63 01".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=13),
-            protocol_version=5,
-            packet_id=21,
-            properties=Properties(subscription_identifier=[5]),
-            filters=[Subscription(filter="a/b/c", qos=1, identifier=5)],
-        ),
-        version=5,
-    ),
-    Case(
-        "subscribe qos out of range",
-        bytes.fromhex("820a 0015 0005 612f622f63 03".replace(" ", "")),
-        decode_err=codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE,
-        group="decode",
-    ),
-    Case(
-        "subscribe bad flags",
-        bytes.fromhex("800a 0015 0005 612f622f63 01".replace(" ", "")),
-        fail_first=codes.ERR_MALFORMED_FLAGS,
-        group="decode",
-    ),
-    Case(
-        "suback v4",
-        bytes.fromhex("90030015 01".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(SUBACK, remaining=3),
-            protocol_version=4,
-            packet_id=21,
-            reason_codes=b"\x01",
-        ),
-    ),
-    Case(
-        "suback v5",
-        bytes.fromhex("9004001500 80".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(SUBACK, remaining=4),
-            protocol_version=5,
-            packet_id=21,
-            reason_codes=b"\x80",
-        ),
-        version=5,
-    ),
-    # ---- UNSUBSCRIBE / UNSUBACK ------------------------------------------
-    Case(
-        "unsubscribe v4",
-        bytes.fromhex("a209 0015 0005 612f622f63".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=9),
-            protocol_version=4,
-            packet_id=21,
-            filters=[Subscription(filter="a/b/c")],
-        ),
-    ),
-    Case(
-        "unsubscribe v5 two filters",
-        bytes.fromhex("a212 0015 00 0005 612f622f63 0006 642f652f6623".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=18),
-            protocol_version=5,
-            packet_id=21,
-            filters=[Subscription(filter="a/b/c"), Subscription(filter="d/e/f#")],
-        ),
-        version=5,
-    ),
-    Case(
-        "unsuback v4",
-        bytes.fromhex("b0020015"),
-        Packet(fixed_header=fhdr(UNSUBACK, remaining=2), protocol_version=4, packet_id=21),
-    ),
-    Case(
-        "unsuback v5",
-        bytes.fromhex("b005001500 0011".replace(" ", "")),
-        Packet(
-            fixed_header=fhdr(UNSUBACK, remaining=5),
-            protocol_version=5,
-            packet_id=21,
-            reason_codes=b"\x00\x11",
-        ),
-        version=5,
-    ),
-    # ---- PING / DISCONNECT / AUTH ----------------------------------------
-    Case("pingreq", bytes.fromhex("c000"), Packet(fixed_header=fhdr(PINGREQ), protocol_version=4)),
-    Case("pingresp", bytes.fromhex("d000"), Packet(fixed_header=fhdr(PINGRESP), protocol_version=4)),
-    Case(
-        "disconnect v4",
-        bytes.fromhex("e000"),
-        Packet(fixed_header=fhdr(DISCONNECT), protocol_version=4),
-    ),
-    Case(
-        "disconnect v5 server shutting down",
-        bytes.fromhex("e0028b00"),
-        Packet(
-            fixed_header=fhdr(DISCONNECT, remaining=2),
-            protocol_version=5,
-            reason_code=0x8B,
-        ),
-        version=5,
-    ),
-    Case(
-        "auth v5 continue authentication",
-        bytes.fromhex("f0021800"),
-        Packet(
-            fixed_header=fhdr(AUTH, remaining=2),
-            protocol_version=5,
-            reason_code=0x18,
-        ),
-        version=5,
-    ),
-]
 
 
 def _decode_cases():
@@ -504,6 +98,19 @@ class TestValidate:
         )
         assert pk.connect_validate() == codes.ERR_PROTOCOL_VIOLATION_WILL_FLAG_NO_PAYLOAD
 
+    def test_connect_will_qos_out_of_range(self):
+        pk = Packet(
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                will_flag=True,
+                will_topic="t",
+                will_payload=b"x",
+                will_qos=3,
+            ),
+            protocol_version=4,
+        )
+        assert pk.connect_validate() == codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE
+
     def test_connect_surplus_will_retain(self):
         pk = Packet(
             connect=ConnectParams(protocol_name=b"MQTT", will_retain=True), protocol_version=4
@@ -515,6 +122,18 @@ class TestValidate:
             connect=ConnectParams(protocol_name=b"MQTT", password=b"x"), protocol_version=4
         )
         assert pk.connect_validate() == codes.ERR_PROTOCOL_VIOLATION_PASSWORD_NO_FLAG
+
+    def test_connect_username_no_flag(self):
+        pk = Packet(
+            connect=ConnectParams(protocol_name=b"MQTT", username=b"x"), protocol_version=4
+        )
+        assert pk.connect_validate() == codes.ERR_PROTOCOL_VIOLATION_USERNAME_NO_FLAG
+
+    def test_connect_password_flag_no_password(self):
+        pk = Packet(
+            connect=ConnectParams(protocol_name=b"MQTT", password_flag=True), protocol_version=4
+        )
+        assert pk.connect_validate() == codes.ERR_PROTOCOL_VIOLATION_FLAG_NO_PASSWORD
 
     def test_publish_validate(self):
         pk = Packet(fixed_header=fhdr(PUBLISH, qos=1), topic_name="t", packet_id=0)

@@ -1,0 +1,982 @@
+"""The golden packet catalogue: raw wire bytes <-> expected Packet structs.
+
+Modeled on the reference's conformance table (packets/tpackets.go, ~300
+cases of RawBytes/Packet/FailFirst/Expect per packet type): every case pins
+exact wire bytes for decode and/or encode, including malformed and
+spec-violation variants for both v3.1.1 and v5. ``test_packets.py`` runs
+each case in both directions plus encode(decode(bytes)) == bytes.
+"""
+
+from dataclasses import dataclass
+
+from mqtt_tpu.packets import (
+    AUTH,
+    CONNACK,
+    CONNECT,
+    DISCONNECT,
+    PINGREQ,
+    PINGRESP,
+    PUBACK,
+    PUBCOMP,
+    PUBLISH,
+    PUBREC,
+    PUBREL,
+    SUBACK,
+    SUBSCRIBE,
+    UNSUBACK,
+    UNSUBSCRIBE,
+    Code,
+    ConnectParams,
+    FixedHeader,
+    Packet,
+    Properties,
+    Subscription,
+    UserProperty,
+    codes,
+)
+from mqtt_tpu.packets import ERR_NO_VALID_PACKET_AVAILABLE
+
+
+@dataclass
+class Case:
+    desc: str
+    raw: bytes
+    packet: Packet | None = None
+    version: int = 4
+    decode_err: Code | None = None  # expected decode failure
+    fail_first: Code | None = None  # expected fixed-header decode failure
+    group: str = ""  # "decode", "encode", or "" for both directions
+
+
+def fhdr(type_, qos=0, dup=False, retain=False, remaining=0):
+    return FixedHeader(type=type_, qos=qos, dup=dup, retain=retain, remaining=remaining)
+
+
+def hx(s: str) -> bytes:
+    return bytes.fromhex(s.replace(" ", ""))
+
+
+CASES: list[Case] = [
+    # ---- CONNECT ---------------------------------------------------------
+    Case(
+        "connect v4 basic",
+        hx("1010 0004 4d515454 04 02 003c 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=16),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 with session expiry",
+        hx("1016 0004 4d515454 05 02 003c 05 11 00000078 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=22),
+            protocol_version=5,
+            properties=Properties(session_expiry_interval=120, session_expiry_interval_flag=True),
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect v4 with will",
+        hx("101f 0004 4d515454 04 0e 003c 0004 7a656e33 0003 6c7774 0008 6e6f74616761696e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=31),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                will_flag=True,
+                will_qos=1,
+                will_topic="lwt",
+                will_payload=b"notagain",
+            ),
+        ),
+    ),
+    Case(
+        "connect v3 MQIsdp",
+        hx("1011 0006 4d5149736470 03 02 001e 0003 7a656e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=17),
+            protocol_version=3,
+            connect=ConnectParams(
+                protocol_name=b"MQIsdp", clean=True, keepalive=30, client_identifier="zen"
+            ),
+        ),
+        version=3,
+    ),
+    Case(
+        "connect v4 username password",
+        hx("101a 0004 4d515454 04 c2 003c 0004 7a656e33 0003 7a656e 0003 746561"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=26),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                username_flag=True,
+                password_flag=True,
+                username=b"zen",
+                password=b"tea",
+            ),
+        ),
+    ),
+    Case(
+        "connect v4 dirty session keepalive zero",
+        hx("1010 0004 4d515454 04 00 0000 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=16),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=False, keepalive=0, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect v4 empty client id",
+        hx("100c 0004 4d515454 04 02 003c 0000"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=12),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier=""
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 empty properties",
+        hx("1011 0004 4d515454 05 02 003c 00 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=17),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 rich properties",
+        hx(
+            "102c 0004 4d515454 05 02 003c 1b 11 0000001e 17 01 19 01 21 0014"
+            " 22 000a 26 0001 6b 0001 76 27 000001f4 0004 7a656e33"
+        ),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=44),
+            protocol_version=5,
+            properties=Properties(
+                session_expiry_interval=30,
+                session_expiry_interval_flag=True,
+                request_problem_info=1,
+                request_problem_info_flag=True,
+                request_response_info=1,
+                receive_maximum=20,
+                topic_alias_maximum=10,
+                user=[UserProperty("k", "v")],
+                maximum_packet_size=500,
+            ),
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 will properties",
+        hx(
+            "102e 0004 4d515454 05 2e 003c 00 0004 7a656e33 13 01 01"
+            " 02 00000078 03 0004 74657874 18 0000003c 0003 6c7774 0002 6869"
+        ),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=46),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                will_flag=True,
+                will_qos=1,
+                will_retain=True,
+                will_topic="lwt",
+                will_payload=b"hi",
+                will_properties=Properties(
+                    payload_format=1,
+                    payload_format_flag=True,
+                    message_expiry_interval=120,
+                    content_type="text",
+                    will_delay_interval=60,
+                ),
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 auth method and data",
+        hx("101e 0004 4d515454 05 02 003c 0d 15 0005 504c41494e 16 0002 abcd 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=30),
+            protocol_version=5,
+            properties=Properties(
+                authentication_method="PLAIN", authentication_data=b"\xab\xcd"
+            ),
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen3"
+            ),
+        ),
+    ),
+    Case(
+        "connect truncated keepalive",
+        hx("1009 0004 4d515454 04 02 00"),
+        decode_err=codes.ERR_MALFORMED_KEEPALIVE,
+        group="decode",
+    ),
+    Case(
+        "connect body shorter than declared remaining",
+        hx("100c 0004 4d515454 04 02 00"),
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    Case(
+        "connect username flag but no username",
+        hx("1010 0004 4d515454 04 82 003c 0004 7a656e33"),
+        decode_err=codes.ERR_PROTOCOL_VIOLATION_FLAG_NO_USERNAME,
+        group="decode",
+    ),
+    Case(
+        "connect will flag but truncated will topic",
+        hx("1010 0004 4d515454 04 06 003c 0004 7a656e33"),
+        decode_err=codes.ERR_MALFORMED_WILL_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "connect truncated protocol name",
+        hx("1004 0004 4d51"),
+        decode_err=codes.ERR_MALFORMED_PROTOCOL_NAME,
+        group="decode",
+    ),
+    Case(
+        "connect missing flags",
+        hx("1007 0004 4d515454 04"),
+        decode_err=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "connect password flag but truncated password",
+        hx("1010 0004 4d515454 04 42 003c 0004 7a656e33"),
+        decode_err=codes.ERR_MALFORMED_PASSWORD,
+        group="decode",
+    ),
+    Case(
+        "connect v5 property invalid for connect",
+        hx("1014 0004 4d515454 05 02 003c 03 23 0005 0004 7a656e33"),
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    # ---- CONNACK ---------------------------------------------------------
+    Case(
+        "connack v4 accepted",
+        hx("20020000"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4),
+    ),
+    Case(
+        "connack v4 session present",
+        hx("20020100"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, session_present=True),
+    ),
+    Case(
+        "connack v4 unacceptable protocol version",
+        hx("20020001"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, reason_code=1),
+    ),
+    Case(
+        "connack v5 empty properties",
+        hx("2003000000"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=3), protocol_version=5),
+        version=5,
+    ),
+    Case(
+        "connack v5 bad username or password",
+        hx("2003008600"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=3),
+            protocol_version=5,
+            reason_code=0x86,
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v5 server properties",
+        hx(
+            "2027 00 00 24 11 00000078 12 0004 7a656e33 13 000a 21 0005 22 0003"
+            " 24 01 25 01 27 00000400 28 01 29 01 2a 01"
+        ),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=39),
+            protocol_version=5,
+            properties=Properties(
+                session_expiry_interval=120,
+                session_expiry_interval_flag=True,
+                assigned_client_id="zen3",
+                server_keep_alive=10,
+                server_keep_alive_flag=True,
+                receive_maximum=5,
+                topic_alias_maximum=3,
+                maximum_qos=1,
+                maximum_qos_flag=True,
+                retain_available=1,
+                retain_available_flag=True,
+                maximum_packet_size=1024,
+                wildcard_sub_available=1,
+                wildcard_sub_available_flag=True,
+                sub_id_available=1,
+                sub_id_available_flag=True,
+                shared_sub_available=1,
+                shared_sub_available_flag=True,
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v5 reason string",
+        hx("2009 00 80 06 1f 0003 626164"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=9),
+            protocol_version=5,
+            reason_code=0x80,
+            properties=Properties(reason_string="bad"),
+        ),
+        version=5,
+    ),
+    Case(
+        "connack empty body",
+        hx("2000"),
+        decode_err=codes.ERR_MALFORMED_SESSION_PRESENT,
+        group="decode",
+    ),
+    Case(
+        "connack missing reason code",
+        hx("200100"),
+        decode_err=codes.ERR_MALFORMED_REASON_CODE,
+        group="decode",
+    ),
+    Case(
+        "connack v5 truncated properties",
+        hx("2003 00 00 05"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    # ---- PUBLISH ---------------------------------------------------------
+    Case(
+        "publish qos0 v4",
+        hx("300c 0005 612f622f63 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=12),
+            protocol_version=4,
+            topic_name="a/b/c",
+            payload=b"hello",
+        ),
+    ),
+    Case(
+        "publish qos1 v4",
+        hx("320e 0005 612f622f63 0007 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=1, remaining=14),
+            protocol_version=4,
+            topic_name="a/b/c",
+            packet_id=7,
+            payload=b"hello",
+        ),
+    ),
+    Case(
+        "publish qos2 retain dup v4",
+        hx("3d0e 0005 612f622f63 0007 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=2, dup=True, retain=True, remaining=14),
+            protocol_version=4,
+            topic_name="a/b/c",
+            packet_id=7,
+            payload=b"hello",
+        ),
+    ),
+    Case(
+        "publish empty payload",
+        hx("3007 0005 612f622f63"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=7),
+            protocol_version=4,
+            topic_name="a/b/c",
+        ),
+    ),
+    Case(
+        "publish two byte remaining length",
+        hx("30 cf01 0005 612f622f63") + b"a" * 200,
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=207),
+            protocol_version=4,
+            topic_name="a/b/c",
+            payload=b"a" * 200,
+        ),
+    ),
+    Case(
+        "publish v5 empty properties",
+        hx("300d 0005 612f622f63 00 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=13),
+            protocol_version=5,
+            topic_name="a/b/c",
+            payload=b"hello",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 qos2",
+        hx("340d 0003 612f62 0009 00 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=2, remaining=13),
+            protocol_version=5,
+            topic_name="a/b",
+            packet_id=9,
+            payload=b"hello",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 user property",
+        hx("3016 0005 612f622f63 09 26 00026869 00027468 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=22),
+            protocol_version=5,
+            topic_name="a/b/c",
+            properties=Properties(user=[UserProperty("hi", "th")]),
+            payload=b"hello",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 topic alias only",
+        hx("300b 0000 03 23 0005 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=11),
+            protocol_version=5,
+            topic_name="",
+            properties=Properties(topic_alias=5, topic_alias_flag=True),
+            payload=b"hello",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 expiry format content type",
+        hx("3016 0003 612f62 0e 01 01 02 0000000a 03 0004 74657874 6869"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=22),
+            protocol_version=5,
+            topic_name="a/b",
+            properties=Properties(
+                payload_format=1,
+                payload_format_flag=True,
+                message_expiry_interval=10,
+                content_type="text",
+            ),
+            payload=b"hi",
+        ),
+        version=5,
+    ),
+    Case(
+        # encode gates response-info props on Mods.allow_response_info, so
+        # this vector is decode-only (reference packets.go Mods semantics)
+        "publish v5 response topic correlation",
+        hx("3013 0003 612f62 0b 08 0003 722f74 09 0002 abcd 6869"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=19),
+            protocol_version=5,
+            topic_name="a/b",
+            properties=Properties(response_topic="r/t", correlation_data=b"\xab\xcd"),
+            payload=b"hi",
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "publish invalid utf8 topic",
+        hx("3009 0005 612f62ffc3 6869"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "publish qos1 missing packet id",
+        hx("3205 0003 612f62"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "publish v5 truncated properties",
+        hx("3008 0003 612f62 05 2300"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "publish qos3 rejected at header",
+        hx("3600"),
+        fail_first=codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE,
+        group="decode",
+    ),
+    Case(
+        "publish dup without qos rejected",
+        hx("3800"),
+        fail_first=codes.ERR_PROTOCOL_VIOLATION_DUP_NO_QOS,
+        group="decode",
+    ),
+    # ---- PUBACK / PUBREC / PUBREL / PUBCOMP ------------------------------
+    Case(
+        "puback v4",
+        hx("40020007"),
+        Packet(fixed_header=fhdr(PUBACK, remaining=2), protocol_version=4, packet_id=7),
+    ),
+    Case(
+        "puback v5 reason code",
+        hx("4003000710"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x10,
+        ),
+        version=5,
+        group="decode",  # encode of rc<0x80 with no props omits reason byte
+    ),
+    Case(
+        "puback v5 error reason encodes reason byte",
+        hx("4003000793"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x93,
+        ),
+        version=5,
+    ),
+    Case(
+        "puback v5 reason string",
+        hx("400a 0007 10 06 1f 0003 626164"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=10),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x10,
+            properties=Properties(reason_string="bad"),
+        ),
+        version=5,
+    ),
+    Case(
+        "puback truncated packet id",
+        hx("400100"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "pubrec v4",
+        hx("50020007"),
+        Packet(fixed_header=fhdr(PUBREC, remaining=2), protocol_version=4, packet_id=7),
+    ),
+    Case(
+        "pubrec v5 quota exceeded",
+        hx("5003000797"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x97,
+        ),
+        version=5,
+    ),
+    Case(
+        "pubrel v4",
+        hx("62020007"),
+        Packet(fixed_header=fhdr(PUBREL, qos=1, remaining=2), protocol_version=4, packet_id=7),
+    ),
+    Case(
+        "pubrel v5 success omits reason byte",
+        hx("62020007"),
+        Packet(fixed_header=fhdr(PUBREL, qos=1, remaining=2), protocol_version=5, packet_id=7),
+        version=5,
+    ),
+    Case(
+        "pubrel v5 packet id not found",
+        hx("6203000792"),
+        Packet(
+            fixed_header=fhdr(PUBREL, qos=1, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x92,
+        ),
+        version=5,
+    ),
+    Case(
+        "pubrel bad flags",
+        hx("60020007"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "pubcomp v4",
+        hx("70020007"),
+        Packet(fixed_header=fhdr(PUBCOMP, remaining=2), protocol_version=4, packet_id=7),
+    ),
+    Case(
+        "pubcomp v5 packet id not found",
+        hx("7003000792"),
+        Packet(
+            fixed_header=fhdr(PUBCOMP, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x92,
+        ),
+        version=5,
+    ),
+    # ---- SUBSCRIBE / SUBACK ----------------------------------------------
+    Case(
+        "subscribe v4",
+        hx("820a 0015 0005 612f622f63 01"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=10),
+            protocol_version=4,
+            packet_id=21,
+            filters=[Subscription(filter="a/b/c", qos=1)],
+        ),
+    ),
+    Case(
+        "subscribe v4 multiple filters",
+        hx("8214 0015 0003 612f62 00 0003 642f23 01 0003 632f2b 02"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=20),
+            protocol_version=4,
+            packet_id=21,
+            filters=[
+                Subscription(filter="a/b", qos=0),
+                Subscription(filter="d/#", qos=1),
+                Subscription(filter="c/+", qos=2),
+            ],
+        ),
+    ),
+    Case(
+        "subscribe v5 options",
+        hx("820b 0015 00 0005 612f622f63 2e"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=11),
+            protocol_version=5,
+            packet_id=21,
+            filters=[
+                Subscription(
+                    filter="a/b/c",
+                    qos=2,
+                    no_local=True,
+                    retain_as_published=True,
+                    retain_handling=2,
+                )
+            ],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 subscription identifier",
+        hx("820d 0015 02 0b 05 0005 612f622f63 01"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=13),
+            protocol_version=5,
+            packet_id=21,
+            properties=Properties(subscription_identifier=[5]),
+            filters=[Subscription(filter="a/b/c", qos=1, identifier=5)],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 shared subscription",
+        hx("8214 0015 00 000e 2473686172652f7465612f612f62 01"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=20),
+            protocol_version=5,
+            packet_id=21,
+            filters=[Subscription(filter="$share/tea/a/b", qos=1)],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe qos out of range",
+        hx("820a 0015 0005 612f622f63 03"),
+        decode_err=codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE,
+        group="decode",
+    ),
+    Case(
+        "subscribe v4 missing qos",
+        hx("8209 0015 0005 612f622f63"),
+        decode_err=codes.ERR_MALFORMED_QOS,
+        group="decode",
+    ),
+    Case(
+        "subscribe truncated filter",
+        hx("8207 0015 0005 612f62"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "subscribe invalid utf8 filter",
+        hx("8208 0015 0003 61ff62 00"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "subscribe bad flags",
+        hx("800a 0015 0005 612f622f63 01"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "suback v4",
+        hx("90030015 01"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=3),
+            protocol_version=4,
+            packet_id=21,
+            reason_codes=b"\x01",
+        ),
+    ),
+    Case(
+        "suback v4 multiple grants",
+        hx("9006 0015 00 01 02 80"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=6),
+            protocol_version=4,
+            packet_id=21,
+            reason_codes=b"\x00\x01\x02\x80",
+        ),
+    ),
+    Case(
+        "suback v5",
+        hx("9004001500 80"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=21,
+            reason_codes=b"\x80",
+        ),
+        version=5,
+    ),
+    Case(
+        "suback v5 reason string",
+        hx("900a 0015 06 1f 0003 626164 01"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=10),
+            protocol_version=5,
+            packet_id=21,
+            properties=Properties(reason_string="bad"),
+            reason_codes=b"\x01",
+        ),
+        version=5,
+    ),
+    Case(
+        "suback bad flags",
+        hx("9100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    # ---- UNSUBSCRIBE / UNSUBACK ------------------------------------------
+    Case(
+        "unsubscribe v4",
+        hx("a209 0015 0005 612f622f63"),
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=9),
+            protocol_version=4,
+            packet_id=21,
+            filters=[Subscription(filter="a/b/c")],
+        ),
+    ),
+    Case(
+        "unsubscribe v5 two filters",
+        hx("a212 0015 00 0005 612f622f63 0006 642f652f6623"),
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=18),
+            protocol_version=5,
+            packet_id=21,
+            filters=[Subscription(filter="a/b/c"), Subscription(filter="d/e/f#")],
+        ),
+        version=5,
+    ),
+    Case(
+        "unsubscribe truncated filter",
+        hx("a206 0015 0005 6162"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe invalid utf8 filter",
+        hx("a207 0015 0003 61ff62"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe bad flags",
+        hx("a000"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "unsuback v4",
+        hx("b0020015"),
+        Packet(fixed_header=fhdr(UNSUBACK, remaining=2), protocol_version=4, packet_id=21),
+    ),
+    Case(
+        "unsuback v5",
+        hx("b005001500 0011"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=5),
+            protocol_version=5,
+            packet_id=21,
+            reason_codes=b"\x00\x11",
+        ),
+        version=5,
+    ),
+    Case(
+        "unsuback v5 reason string",
+        hx("b00b 0015 06 1f 0003 626164 0011"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=11),
+            protocol_version=5,
+            packet_id=21,
+            properties=Properties(reason_string="bad"),
+            reason_codes=b"\x00\x11",
+        ),
+        version=5,
+    ),
+    Case(
+        "unsuback truncated packet id",
+        hx("b00100"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    # ---- PING / DISCONNECT / AUTH ----------------------------------------
+    Case("pingreq", hx("c000"), Packet(fixed_header=fhdr(PINGREQ), protocol_version=4)),
+    Case("pingresp", hx("d000"), Packet(fixed_header=fhdr(PINGRESP), protocol_version=4)),
+    Case(
+        "pingreq invalid flags",
+        hx("c100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "pingresp invalid flags",
+        hx("d100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "disconnect v4",
+        hx("e000"),
+        Packet(fixed_header=fhdr(DISCONNECT), protocol_version=4),
+    ),
+    Case(
+        "disconnect v5 server shutting down",
+        hx("e0028b00"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x8B,
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 session expiry",
+        hx("e007 04 05 11 0000003c"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=7),
+            protocol_version=5,
+            reason_code=0x04,
+            properties=Properties(session_expiry_interval=60, session_expiry_interval_flag=True),
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 server reference",
+        hx("e009 9c 07 1c 0004 656c7365"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=9),
+            protocol_version=5,
+            reason_code=0x9C,
+            properties=Properties(server_reference="else"),
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 property invalid for disconnect",
+        hx("e005 00 03 23 0005"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "disconnect invalid flags",
+        hx("e100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "auth v5 continue authentication",
+        hx("f0021800"),
+        Packet(
+            fixed_header=fhdr(AUTH, remaining=2),
+            protocol_version=5,
+            reason_code=0x18,
+        ),
+        version=5,
+    ),
+    Case(
+        "auth v5 reauthenticate",
+        hx("f0021900"),
+        Packet(
+            fixed_header=fhdr(AUTH, remaining=2),
+            protocol_version=5,
+            reason_code=0x19,
+        ),
+        version=5,
+    ),
+    Case(
+        "auth v5 method and data",
+        hx("f00f 18 0d 15 0005 504c41494e 16 0002 abcd"),
+        Packet(
+            fixed_header=fhdr(AUTH, remaining=15),
+            protocol_version=5,
+            reason_code=0x18,
+            properties=Properties(
+                authentication_method="PLAIN", authentication_data=b"\xab\xcd"
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "auth empty body",
+        hx("f000"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_REASON_CODE,
+        group="decode",
+    ),
+    # ---- framing ---------------------------------------------------------
+    Case(
+        "remaining length varint overflow",
+        hx("10ffffffff7f"),
+        decode_err=codes.ERR_MALFORMED_VARIABLE_BYTE_INTEGER,
+        group="decode",
+    ),
+    Case(
+        "reserved packet type zero",
+        hx("0000"),
+        decode_err=ERR_NO_VALID_PACKET_AVAILABLE,
+        group="decode",
+    ),
+]
