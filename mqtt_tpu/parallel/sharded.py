@@ -227,11 +227,52 @@ def dryrun_multichip(n_devices: int) -> None:
     DP x subscription sharding with an all_gather union over ICI), and run
     one step on tiny shapes. The driver invokes this on a virtual CPU mesh
     to validate the multi-chip path without hardware."""
-    devices = jax.devices()[:n_devices]
-    assert len(devices) == n_devices, (
-        f"need {n_devices} devices, have {len(jax.devices())} "
-        "(set XLA_FLAGS=--xla_force_host_platform_device_count)"
-    )
+    # The environment may pin a single-accelerator default platform (e.g.
+    # one real TPU). Provision n virtual CPU devices BEFORE the first
+    # backend query — clients for every platform (incl. cpu) are created on
+    # the first jax.devices() call and read their config at that point.
+    import os
+    import re
+
+    try:
+        # only ever raise the count — the config value overrides a larger
+        # XLA_FLAGS request, so clamping down would break later callers
+        m = re.search(
+            r"--xla_force_host_platform_device_count=(\d+)",
+            os.environ.get("XLA_FLAGS", ""),
+        )
+        current = max(
+            int(m.group(1)) if m else 1,
+            int(getattr(jax.config, "jax_num_cpu_devices", 0) or 0),
+        )
+        jax.config.update("jax_num_cpu_devices", max(n_devices, current))
+        provisioned = True
+    except Exception:  # already-initialized backend or older jax
+        provisioned = False
+        flags = os.environ.get("XLA_FLAGS", "")
+        m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+        if m is None or int(m.group(1)) < n_devices:
+            new_flag = f"--xla_force_host_platform_device_count={n_devices}"
+            flags = re.sub(
+                r"--xla_force_host_platform_device_count=\d+", new_flag, flags
+            ) if m else f"{flags} {new_flag}".strip()
+            os.environ["XLA_FLAGS"] = flags
+    devices = jax.devices()
+    if len(devices) < n_devices:
+        devices = jax.devices("cpu")
+    if len(devices) < n_devices:
+        raise RuntimeError(
+            f"need {n_devices} devices, have {len(devices)}"
+            + (
+                ""
+                if provisioned
+                else " — a JAX backend was initialized before dryrun_multichip()"
+                " could provision virtual CPU devices; call it first in the"
+                " process or set XLA_FLAGS=--xla_force_host_platform_device_count"
+                f"={n_devices} before starting python"
+            )
+        )
+    devices = devices[:n_devices]
     mesh = make_mesh(devices)
     index = TopicsIndex()
     filters = ["a/b/c", "a/+/c", "a/#", "d/e", "+/e", "x/y/z", "q/+/+", "#"]

@@ -7,7 +7,7 @@ tests extend coverage beyond the hand-pinned vectors.
 """
 
 import pytest
-from tpackets import CASES, fhdr
+from tests.tpackets import CASES, fhdr
 
 from mqtt_tpu.packets import (
     AUTH,
@@ -75,7 +75,8 @@ class TestRoundTrips:
 
 class TestValidate:
     def test_connect_validate_ok(self):
-        pk = decode_packet(CASES[0].raw, 4)
+        case = next(c for c in CASES if c.desc == "connect v4 basic")
+        pk = decode_packet(case.raw, 4)
         assert pk.connect_validate() == codes.CODE_SUCCESS
 
     def test_connect_bad_protocol_name(self):
