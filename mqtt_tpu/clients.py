@@ -245,17 +245,105 @@ class Client:
 
     async def read(self, packet_handler: Callable[["Client", Packet], Optional[Awaitable]]) -> None:
         """The blocking per-packet read loop (clients.go:363-388); raises on
-        connection error, keepalive timeout, or a handler error."""
+        connection error, keepalive timeout, or a handler error.
+
+        Packets are framed in bulk: each socket read drains everything
+        available, the native frame scanner (mqtt_tpu/native) splits it
+        into complete packets, and each is decoded straight from the
+        buffer — one await per socket read instead of one per header byte,
+        which is what keeps the asyncio data plane within reach of the
+        reference's goroutine throughput (SURVEY.md §7 hard-part #5).
+        """
+        from .native import MAX_FRAMES_PER_SCAN, frame_scan, varint_decode
+
+        caps = self.ops.options.capabilities
+        rbuf = bytearray()
+        self.refresh_deadline(self.state.keepalive)
         while True:
             if self.closed:
                 return
-            self.refresh_deadline(self.state.keepalive)
-            fh = FixedHeader()
-            await self.read_fixed_header(fh)
-            pk = await self.read_packet(fh)
-            result = packet_handler(self, pk)
-            if asyncio.iscoroutine(result):
-                await result
+            frames, consumed, err = frame_scan(
+                rbuf, max_frames=MAX_FRAMES_PER_SCAN,
+                max_packet_size=caps.maximum_packet_size,
+            )
+            # account for and process every complete packet
+            start = 0
+            for f in frames:
+                fh = FixedHeader()
+                fh.decode(f.first_byte)
+                fh.remaining = f.remaining
+                body = bytes(rbuf[f.body_offset : f.body_offset + f.remaining])
+                self.ops.info.bytes_received += (f.body_offset - start) + f.remaining
+                start = f.body_offset + f.remaining
+                pk = self._decode_body(fh, body)
+                result = packet_handler(self, pk)
+                if asyncio.iscoroutine(result):
+                    await result
+                if self.closed:
+                    return
+            del rbuf[:consumed]
+            if err == -2:
+                raise ERR_PACKET_TOO_LARGE()  # [MQTT-3.2.2-15]
+            if err == -1:
+                # replay the per-byte path for the precise reason code
+                FixedHeader().decode(rbuf[0])  # raises for bad header bytes
+                raise pkts.ERR_MALFORMED_VARIABLE_BYTE_INTEGER()
+            if len(frames) == MAX_FRAMES_PER_SCAN:
+                continue  # more complete packets may still be buffered
+            if frames:
+                # progress made — extend the keepalive deadline. A trickle
+                # of partial-packet bytes deliberately does NOT extend it.
+                self.refresh_deadline(self.state.keepalive)
+            data = await self._read_more(self._missing_bytes(rbuf, varint_decode))
+            if not data:
+                raise ConnectionClosedError()
+            rbuf += data
+
+    @staticmethod
+    def _missing_bytes(rbuf: bytearray, varint_decode) -> int:
+        """How many more bytes complete the partial packet at the head of
+        the buffer (0 = unknown): lets a huge body arrive in one readexactly
+        instead of 64 KiB nibbles that would rescan the buffer each time."""
+        if len(rbuf) < 2:
+            return 0
+        try:
+            remaining, vb = varint_decode(bytes(rbuf[1:5]))
+        except ValueError:
+            return 0
+        if vb == 0:
+            return 0
+        return max(0, 1 + vb + remaining - len(rbuf))
+
+    def _decode_body(self, fh: FixedHeader, body: bytes) -> Packet:
+        """Decode one framed packet body and run the on_packet_read chain
+        (the bulk-path core of read_packet, clients.go:462-520)."""
+        self.ops.info.packets_received += 1
+        pk = Packet(fixed_header=fh, protocol_version=self.properties.protocol_version)
+        decoder = pkts.DECODERS.get(fh.type)
+        if decoder is None:
+            raise pkts.ERR_NO_VALID_PACKET_AVAILABLE()
+        decoder(pk, body)
+        if fh.type == pkts.PUBLISH:
+            self.ops.info.messages_received += 1
+        return self.ops.hooks.on_packet_read(self, pk)
+
+    async def _read_more(self, need: int = 0) -> bytes:
+        """One bulk socket read honoring the keepalive deadline. ``need``>0
+        waits for exactly that many bytes (completing a known partial
+        packet); otherwise reads whatever is available up to 64 KiB."""
+        if self.net.reader is None:
+            raise ConnectionClosedError()
+        if need > 0:
+            coro = self.net.reader.readexactly(need)
+        else:
+            coro = self.net.reader.read(65536)
+        if self._deadline is None:
+            return await coro
+        timeout = self._deadline - time.monotonic()
+        if timeout <= 0:
+            coro.close()
+            raise asyncio.TimeoutError()
+        return await asyncio.wait_for(coro, timeout)
 
     def stop(self, err: Optional[Exception] = None) -> None:
         """Idempotently end the client: close the transport, cancel the

@@ -1,0 +1,329 @@
+"""ctypes bindings for the native host data-plane core (mqtt_native.c).
+
+The shared library is compiled on demand from the checked-in C source
+(cached next to it, keyed on source mtime) and loaded via ctypes; every
+entry point has a pure-Python fallback, so the package works — just
+slower — when no C toolchain is present. ``lib()`` returns the loaded
+library or ``None``.
+
+Wired into the package hot paths:
+
+- ``tokenize_topics_native`` — batch topic→hash arrays (ops/hashing.py
+  picks it up when available; bit-identical to the Python path, which the
+  differential tests in tests/test_native.py enforce)
+- ``frame_scan`` + ``varint_decode`` — bulk packet framing in the client
+  read loop (clients.Client.read)
+
+``hash_token_native`` / ``varint_encode`` / ``utf8_valid`` expose the
+remaining C entry points; their fallbacks delegate to packets/codec.py so
+there is a single Python source of truth for those rules.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+from typing import Optional
+
+import numpy as np
+
+_log = logging.getLogger("mqtt_tpu.native")
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "mqtt_native.c")
+_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_TRIED = False
+
+# Per-scan frame cap: bounds the output arrays while the read loop keeps
+# rescanning until the buffer is drained, so it is not a throughput cap.
+MAX_FRAMES_PER_SCAN = 256
+
+
+def _so_path() -> str:
+    tag = f"{sys.implementation.cache_tag}-{os.uname().machine}"
+    return os.path.join(_HERE, f"libmqtt_native-{tag}.so")
+
+
+def _build(so: str) -> bool:
+    """Compile mqtt_native.c → so. Returns False (and logs) on failure."""
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        # build to a temp file then atomically rename, so concurrent
+        # processes never load a half-written library
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        try:
+            cmd = [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0:
+                os.replace(tmp, so)
+                return True
+            _log.debug("native build with %s failed: %s", cc, r.stderr.decode())
+        except (OSError, subprocess.SubprocessError) as e:
+            _log.debug("native build with %s failed: %s", cc, e)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it on first use; None if
+    unavailable (no toolchain / unsupported platform)."""
+    global _LIB, _TRIED
+    if _LIB is not None or _TRIED:
+        return _LIB
+    with _LOCK:
+        if _LIB is not None or _TRIED:
+            return _LIB
+        _TRIED = True
+        if os.environ.get("MQTT_TPU_NO_NATIVE"):
+            return None
+        so = _so_path()
+        try:
+            stale = (not os.path.exists(so)) or (
+                os.path.getmtime(so) < os.path.getmtime(_SRC)
+            )
+            if stale and not _build(so):
+                return None
+            cdll = ctypes.CDLL(so)
+        except OSError as e:
+            _log.debug("native library unavailable: %s", e)
+            return None
+        _declare(cdll)
+        _LIB = cdll
+        return _LIB
+
+
+def _declare(l: ctypes.CDLL) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    l.mqtt_hash_token.restype = ctypes.c_uint64
+    l.mqtt_hash_token.argtypes = [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64]
+    l.mqtt_tokenize_topics.restype = None
+    l.mqtt_tokenize_topics.argtypes = [
+        ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int32), u8p, u8p,
+    ]
+    l.mqtt_varint_decode.restype = ctypes.c_int
+    l.mqtt_varint_decode.argtypes = [
+        ctypes.c_char_p, ctypes.c_size_t, ctypes.POINTER(ctypes.c_uint32)
+    ]
+    l.mqtt_varint_encode.restype = ctypes.c_int
+    l.mqtt_varint_encode.argtypes = [ctypes.c_uint32, u8p]
+    l.mqtt_fh_validate.restype = ctypes.c_int
+    l.mqtt_fh_validate.argtypes = [ctypes.c_uint8]
+    l.mqtt_frame_scan.restype = ctypes.c_int64
+    l.mqtt_frame_scan.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int64), u8p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32),
+    ]
+    l.mqtt_utf8_valid.restype = ctypes.c_int
+    l.mqtt_utf8_valid.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+# -- high-level wrappers ----------------------------------------------------
+
+
+def hash_token_native(token: bytes, salt: int = 0) -> Optional[int]:
+    """8-byte blake2b of one token; None when the library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    return l.mqtt_hash_token(token, len(token), salt)
+
+
+def tokenize_topics_native(topics: list[str], max_levels: int, salt: int = 0):
+    """Native batch tokenization with the exact output contract of
+    ops/hashing.tokenize_topics; None when the library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    n = len(topics)
+    encoded = [t.encode("utf-8") for t in topics]
+    offsets = np.zeros(n + 1, dtype=np.int64)
+    for i, e in enumerate(encoded):
+        offsets[i + 1] = offsets[i] + len(e)
+    buf = b"".join(encoded)
+    tok1 = np.zeros((n, max_levels), dtype=np.uint32)
+    tok2 = np.zeros((n, max_levels), dtype=np.uint32)
+    lengths = np.zeros(n, dtype=np.int32)
+    is_dollar = np.zeros(n, dtype=np.uint8)
+    overflow = np.zeros(n, dtype=np.uint8)
+    if n:
+        l.mqtt_tokenize_topics(
+            buf,
+            offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            n, max_levels, salt,
+            tok1.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            tok2.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            lengths.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            is_dollar.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            overflow.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+    return tok1, tok2, lengths, is_dollar.astype(bool), overflow.astype(bool)
+
+
+def varint_decode(buf: bytes) -> tuple[int, int]:
+    """Returns (value, bytes_consumed); consumed 0 = need more bytes.
+    Raises ValueError on a malformed integer."""
+    l = lib()
+    if l is None:
+        return _varint_decode_py(buf)
+    value = ctypes.c_uint32()
+    r = l.mqtt_varint_decode(buf, len(buf), ctypes.byref(value))
+    if r < 0:
+        raise ValueError("malformed variable byte integer")
+    return value.value, r
+
+
+def _varint_decode_py(buf: bytes) -> tuple[int, int]:
+    value = 0
+    shift = 0
+    for i, b in enumerate(buf[:4]):
+        value |= (b & 0x7F) << shift
+        if value > 268435455:
+            raise ValueError("malformed variable byte integer")
+        if not b & 0x80:
+            return value, i + 1
+        shift += 7
+    if len(buf) >= 4:
+        raise ValueError("malformed variable byte integer")
+    return 0, 0
+
+
+def varint_encode(value: int) -> bytes:
+    l = lib()
+    if l is None:
+        return _varint_encode_py(value)
+    out = (ctypes.c_uint8 * 4)()
+    n = l.mqtt_varint_encode(value, out)
+    if n < 0:
+        raise ValueError("value exceeds maximum variable byte integer")
+    return bytes(out[:n])
+
+
+def _varint_encode_py(value: int) -> bytes:
+    from ..packets.codec import encode_length
+
+    if value > 268435455:
+        raise ValueError("value exceeds maximum variable byte integer")
+    out = bytearray()
+    encode_length(out, value)
+    return bytes(out)
+
+
+def utf8_valid(data: bytes) -> bool:
+    """Strict UTF-8 incl. the MQTT NUL rejection [MQTT-1.5.4-2]."""
+    l = lib()
+    if l is None:
+        from ..packets.codec import valid_utf8
+
+        return valid_utf8(data)
+    return bool(l.mqtt_utf8_valid(data, len(data)))
+
+
+class Frame:
+    """One complete packet located by frame_scan."""
+
+    __slots__ = ("first_byte", "body_offset", "remaining")
+
+    def __init__(self, first_byte: int, body_offset: int, remaining: int):
+        self.first_byte = first_byte
+        self.body_offset = body_offset
+        self.remaining = remaining
+
+
+def frame_scan(
+    buf: bytes, max_frames: int = 1024, max_packet_size: int = 0
+) -> tuple[list[Frame], int, int]:
+    """Split a raw read buffer into complete MQTT packets.
+
+    Returns ``(frames, consumed, err)``. ``frames`` holds every complete
+    packet found before any error (the caller still processes them).
+    ``err``: 0 ok, -1 malformed header/varint, -2 packet-too-large; on
+    error ``consumed`` points at the offending packet's first byte.
+    """
+    l = lib()
+    if l is None:
+        return _frame_scan_py(buf, max_frames, max_packet_size)
+    body_offsets = np.zeros(max_frames, dtype=np.int64)
+    first_bytes = np.zeros(max_frames, dtype=np.uint8)
+    remainings = np.zeros(max_frames, dtype=np.uint32)
+    consumed = ctypes.c_int64()
+    err = ctypes.c_int32()
+    if isinstance(buf, (bytearray, memoryview)):
+        # zero-copy view of the mutable read buffer
+        holder = (ctypes.c_char * len(buf)).from_buffer(buf) if len(buf) else b""
+        ptr = ctypes.addressof(holder) if len(buf) else None
+    else:
+        holder = buf
+        ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value if buf else None
+    n = l.mqtt_frame_scan(
+        ptr, len(buf), max_frames, max_packet_size,
+        body_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        first_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        remainings.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        ctypes.byref(consumed), ctypes.byref(err),
+    )
+    frames = [
+        Frame(int(first_bytes[i]), int(body_offsets[i]), int(remainings[i]))
+        for i in range(n)
+    ]
+    return frames, consumed.value, err.value
+
+
+_FH_FLAG_OK = {  # type → required flags; PUBLISH checked separately.
+    # type 0 (reserved) with zero flags passes here — the decoder dispatch
+    # rejects it with NoValidPacketAvailable, matching FixedHeader.decode.
+    6: 0x02, 8: 0x02, 10: 0x02,
+    0: 0, 1: 0, 2: 0, 4: 0, 5: 0, 7: 0, 9: 0, 11: 0, 12: 0, 13: 0, 14: 0, 15: 0,
+}
+
+
+def _fh_validate_py(b: int) -> bool:
+    type_ = b >> 4
+    flags = b & 0x0F
+    if type_ == 3:
+        qos = (flags >> 1) & 0x03
+        return qos < 3 and not (flags & 0x08 and qos == 0)
+    want = _FH_FLAG_OK.get(type_)
+    return want is not None and flags == want
+
+
+def _frame_scan_py(
+    buf: bytes, max_frames: int, max_packet_size: int
+) -> tuple[list[Frame], int, int]:
+    frames: list[Frame] = []
+    pos = 0
+    n = len(buf)
+    while len(frames) < max_frames and pos < n:
+        if not _fh_validate_py(buf[pos]):
+            return frames, pos, -1
+        if pos + 1 >= n:
+            break
+        try:
+            remaining, vb = _varint_decode_py(buf[pos + 1 :])
+        except ValueError:
+            return frames, pos, -1
+        if vb == 0:
+            break
+        if max_packet_size and remaining + 1 > max_packet_size:
+            return frames, pos, -2
+        if pos + 1 + vb + remaining > n:
+            break
+        frames.append(Frame(buf[pos], pos + 1 + vb, remaining))
+        pos += 1 + vb + remaining
+    return frames, pos, 0

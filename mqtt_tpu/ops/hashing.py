@@ -6,6 +6,11 @@ hashes — hash1 keys the sorted literal-edge binary search, hash2 verifies
 the hit — so a false device match requires a simultaneous 64-bit collision
 (~2^-64 per lookup). The builder additionally guarantees hash1 uniqueness
 within each node's edge list (see csr.py), keeping the search well-defined.
+
+The batch path delegates to the native core (mqtt_tpu/native) when a C
+toolchain is available; ``tokenize_topics_py`` is the always-available
+pure-Python reference, and tests/test_native.py enforces that the two are
+bit-identical.
 """
 
 from __future__ import annotations
@@ -25,16 +30,10 @@ def hash_token(token: str, salt: int = 0) -> tuple[int, int]:
     return int.from_bytes(d[:4], "little"), int.from_bytes(d[4:], "little")
 
 
-def tokenize_topics(
+def tokenize_topics_py(
     topics: list[str], max_levels: int, salt: int = 0
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Tokenize and hash a batch of PUBLISH topics.
-
-    Returns ``(tok1[B,L], tok2[B,L], lengths[B], is_dollar[B], overflow[B])``
-    — hashes padded with zeros past each topic's level count; ``overflow``
-    marks topics with more than ``max_levels`` levels (routed to the host
-    trie fallback).
-    """
+    """Pure-Python reference tokenizer (see ``tokenize_topics``)."""
     b = len(topics)
     tok1 = np.zeros((b, max_levels), dtype=np.uint32)
     tok2 = np.zeros((b, max_levels), dtype=np.uint32)
@@ -54,3 +53,21 @@ def tokenize_topics(
             tok1[i, d] = h1
             tok2[i, d] = h2
     return tok1, tok2, lengths, is_dollar, overflow
+
+
+def tokenize_topics(
+    topics: list[str], max_levels: int, salt: int = 0
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Tokenize and hash a batch of PUBLISH topics.
+
+    Returns ``(tok1[B,L], tok2[B,L], lengths[B], is_dollar[B], overflow[B])``
+    — hashes padded with zeros past each topic's level count; ``overflow``
+    marks topics with more than ``max_levels`` levels (routed to the host
+    trie fallback).
+    """
+    from ..native import tokenize_topics_native
+
+    native = tokenize_topics_native(topics, max_levels, salt)
+    if native is not None:
+        return native
+    return tokenize_topics_py(topics, max_levels, salt)

@@ -19,7 +19,7 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Optional
 
 from . import packets as pkts
-from .clients import Client, Clients, Will
+from .clients import Client, Clients, ConnectionClosedError, Will
 from .hooks import (
     STORED_CLIENTS,
     STORED_INFLIGHT_MESSAGES,
@@ -262,6 +262,11 @@ class Server:
         """Start hooks, restore persisted state, init+serve all listeners,
         begin the housekeeping loop (server.go:334-371)."""
         self.log.info("mqtt_tpu starting version=%s", VERSION)
+        # warm the native core now — its first-use lazy compile would
+        # otherwise block the event loop mid-connection
+        from .native import available as _native_available
+
+        await asyncio.get_running_loop().run_in_executor(None, _native_available)
         if self.options.listeners:
             self.add_listeners_from_config(self.options.listeners)
         for hook, config in self.options.hooks:
@@ -387,7 +392,9 @@ class Server:
             if connected:
                 self.info.clients_connected -= 1
             cl.stop(err)
-        if err is not None and not isinstance(err, (asyncio.IncompleteReadError, ConnectionError)):
+        if err is not None and not isinstance(
+            err, (asyncio.IncompleteReadError, ConnectionError, ConnectionClosedError)
+        ):
             self.log.debug("connection ended: %s", err)
 
     async def read_connection_packet(self, cl: Client) -> Packet:

@@ -1,0 +1,244 @@
+"""Differential tests: native C core vs the pure-Python reference paths.
+
+The native library is optional; when it can't be built these tests skip
+(except the pure-Python fallback cases, which always run).
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from mqtt_tpu import native
+from mqtt_tpu.native import (
+    Frame,
+    _frame_scan_py,
+    _varint_decode_py,
+    _varint_encode_py,
+    frame_scan,
+    hash_token_native,
+    tokenize_topics_native,
+    utf8_valid,
+    varint_decode,
+    varint_encode,
+)
+from mqtt_tpu.ops.hashing import tokenize_topics_py
+from tests.tpackets import CASES
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+@needs_native
+class TestBlake2b:
+    def test_matches_hashlib(self):
+        rng = random.Random(11)
+        for _ in range(500):
+            n = rng.randrange(0, 300)
+            tok = bytes(rng.randrange(256) for _ in range(n))
+            salt = rng.randrange(1 << 63)
+            want = int.from_bytes(
+                hashlib.blake2b(
+                    tok, digest_size=8, salt=salt.to_bytes(8, "little")
+                ).digest(),
+                "little",
+            )
+            assert hash_token_native(tok, salt) == want
+
+    def test_multiblock_boundaries(self):
+        for n in (0, 1, 127, 128, 129, 255, 256, 257, 1024):
+            tok = bytes(range(256)) * 5
+            tok = tok[:n]
+            want = int.from_bytes(
+                hashlib.blake2b(
+                    tok, digest_size=8, salt=(0).to_bytes(8, "little")
+                ).digest(),
+                "little",
+            )
+            assert hash_token_native(tok, 0) == want
+
+
+@needs_native
+class TestTokenize:
+    def test_matches_python(self):
+        rng = random.Random(12)
+        words = ["a", "bb", "sensor", "+", "#", "$SYS", "x" * 40, "", "日本語"]
+        topics = ["", "/", "//", "a//b/", "$SYS/broker/load"]
+        for _ in range(300):
+            topics.append(
+                "/".join(rng.choice(words) for _ in range(rng.randrange(1, 12)))
+            )
+        for salt in (0, 7, 123456789):
+            py = tokenize_topics_py(topics, 8, salt)
+            nat = tokenize_topics_native(topics, 8, salt)
+            for a, b in zip(py, nat):
+                assert np.array_equal(a, b)
+
+    def test_empty_batch(self):
+        nat = tokenize_topics_native([], 4, 0)
+        assert nat[0].shape == (0, 4)
+
+
+class TestVarint:
+    @pytest.mark.parametrize(
+        "value,encoded",
+        [
+            (0, b"\x00"),
+            (127, b"\x7f"),
+            (128, b"\x80\x01"),
+            (16383, b"\xff\x7f"),
+            (16384, b"\x80\x80\x01"),
+            (2097151, b"\xff\xff\x7f"),
+            (2097152, b"\x80\x80\x80\x01"),
+            (268435455, b"\xff\xff\xff\x7f"),
+        ],
+    )
+    def test_roundtrip(self, value, encoded):
+        assert varint_encode(value) == encoded
+        assert _varint_encode_py(value) == encoded
+        assert varint_decode(encoded) == (value, len(encoded))
+        assert _varint_decode_py(encoded) == (value, len(encoded))
+
+    def test_incomplete(self):
+        assert varint_decode(b"\x80")[1] == 0
+        assert _varint_decode_py(b"\x80")[1] == 0
+
+    def test_overflow(self):
+        with pytest.raises(ValueError):
+            varint_decode(b"\xff\xff\xff\xff")
+        with pytest.raises(ValueError):
+            _varint_decode_py(b"\xff\xff\xff\xff")
+        with pytest.raises(ValueError):
+            varint_encode(268435456)
+
+    def test_differential_random(self):
+        rng = random.Random(13)
+        for _ in range(300):
+            v = rng.randrange(268435456)
+            e = varint_encode(v)
+            assert e == _varint_encode_py(v)
+            assert varint_decode(e) == _varint_decode_py(e) == (v, len(e))
+
+
+class TestFrameScan:
+    def _scan_both(self, buf, **kw):
+        got = frame_scan(buf, **kw)
+        py = _frame_scan_py(buf, kw.get("max_frames", 1024), kw.get("max_packet_size", 0))
+        assert [
+            (f.first_byte, f.body_offset, f.remaining) for f in got[0]
+        ] == [(f.first_byte, f.body_offset, f.remaining) for f in py[0]]
+        assert got[1:] == py[1:]
+        return got
+
+    def test_golden_catalogue_stream(self):
+        """Concatenate all well-formed golden packets and re-find each one."""
+        good = [c for c in CASES if c.decode_err is None and c.fail_first is None]
+        buf = b"".join(c.raw for c in good)
+        frames, consumed, err = self._scan_both(buf)
+        assert err == 0
+        assert consumed == len(buf)
+        assert len(frames) == len(good)
+        pos = 0
+        for f, c in zip(frames, good):
+            assert f.first_byte == c.raw[pos - pos]  # first byte of this packet
+            assert buf[f.body_offset : f.body_offset + f.remaining] in c.raw
+            pos += len(c.raw)
+
+    def test_partial_tail(self):
+        pk = bytes.fromhex("30080003612f62706179")  # publish a/b "pay" (8 body bytes)
+        frames, consumed, err = self._scan_both(pk + pk[:4])
+        assert err == 0 and len(frames) == 1 and consumed == len(pk)
+
+    def test_reserved_type_scans_as_frame(self):
+        # type 0 with zero flags passes header validation; the decoder
+        # dispatch is what rejects it (matching FixedHeader.decode)
+        frames, consumed, err = self._scan_both(b"\x00\x00")
+        assert err == 0 and len(frames) == 1
+
+    def test_malformed_header_flags(self):
+        # PINGREQ with nonzero flags violates [MQTT-3.12.1-1]
+        frames, consumed, err = self._scan_both(b"\xc1\x00")
+        assert err == -1 and consumed == 0
+
+    def test_malformed_second_packet(self):
+        pk = bytes.fromhex("c000")  # PINGREQ
+        bad = b"\x63\x00"  # PUBLISH with QoS 3
+        frames, consumed, err = self._scan_both(pk + bad)
+        # the complete PINGREQ before the error is still returned
+        assert err == -1 and consumed == len(pk) and len(frames) == 1
+
+    def test_max_packet_size(self):
+        pk = bytes.fromhex("30080003612f62706179")
+        frames, consumed, err = self._scan_both(pk, max_packet_size=5)
+        assert err == -2 and consumed == 0
+        frames, consumed, err = self._scan_both(pk, max_packet_size=100)
+        assert err == 0 and len(frames) == 1
+
+    def test_max_frames(self):
+        ping = bytes.fromhex("c000")
+        frames, consumed, err = self._scan_both(ping * 10, max_frames=3)
+        assert err == 0 and len(frames) == 3 and consumed == 6
+
+    def test_incomplete_varint(self):
+        frames, consumed, err = self._scan_both(b"\x30\xff")
+        assert err == 0 and frames == [] and consumed == 0
+
+    def test_bytearray_input_zero_copy_path(self):
+        # the client read loop passes its mutable bytearray buffer
+        pk = bytearray(bytes.fromhex("c000") * 3)
+        frames, consumed, err = frame_scan(pk)
+        assert err == 0 and len(frames) == 3 and consumed == 6
+        del pk[:consumed]  # must not raise BufferError (no live exports)
+        assert len(pk) == 0
+
+    def test_empty_buffer(self):
+        frames, consumed, err = self._scan_both(b"")
+        assert err == 0 and frames == [] and consumed == 0
+
+    def test_dup_without_qos_rejected(self):
+        # PUBLISH DUP=1 QoS=0 violates [MQTT-3.3.1-2]
+        frames, consumed, err = self._scan_both(b"\x38\x00")
+        assert err == -1
+
+
+class TestUtf8:
+    @pytest.mark.parametrize(
+        "data,ok",
+        [
+            (b"plain", True),
+            ("日本語".encode(), True),
+            (b"with\x00nul", False),  # [MQTT-1.5.4-2]
+            (b"\xc0\xaf", False),  # overlong '/'
+            (b"\xed\xa0\x80", False),  # surrogate
+            (b"\xf4\x90\x80\x80", False),  # > U+10FFFF
+            (b"\xff", False),
+            (b"\xe2\x82", False),  # truncated
+            ("\U0010ffff".encode(), True),
+            (b"", True),
+        ],
+    )
+    def test_cases(self, data, ok):
+        assert utf8_valid(data) is ok
+        # python fallback path agreement
+        py_ok = b"\x00" not in data
+        if py_ok:
+            try:
+                data.decode("utf-8", "strict")
+            except UnicodeDecodeError:
+                py_ok = False
+        assert py_ok is ok
+
+
+@needs_native
+def test_matcher_pipeline_uses_native(monkeypatch):
+    """tokenize_topics (the matcher input path) must agree with the
+    pure-Python reference even when served by the native core."""
+    from mqtt_tpu.ops.hashing import tokenize_topics
+
+    topics = ["a/b/c", "$share/g/t", "", "x/+/#"]
+    nat = tokenize_topics(topics, 4, 3)
+    py = tokenize_topics_py(topics, 4, 3)
+    for a, b in zip(nat, py):
+        assert np.array_equal(a, b)
