@@ -85,6 +85,11 @@ def lib() -> Optional[ctypes.CDLL]:
         _TRIED = True
         if os.environ.get("MQTT_TPU_NO_NATIVE"):
             return None
+        if sys.byteorder != "little":
+            # the C hashing assumes little-endian loads; on big-endian hosts
+            # its hashes would silently disagree with the host-side oracle
+            _log.debug("native core disabled: big-endian host")
+            return None
         so = _so_path()
         try:
             stale = (not os.path.exists(so)) or (

@@ -8,17 +8,21 @@ PUBLISH — onto the TPU as a batched NFA-over-CSR kernel:
 - ``hashing``  — host-side topic-level tokenization and dual u32 hashing
 - ``matcher``  — the jitted batched match kernel + the broker-facing
                  ``TpuMatcher`` (drop-in for ``TopicsIndex.subscribers``)
+- ``delta``    — ``DeltaMatcher``: snapshot + host delta overlay +
+                 background CSR rebuild, for live brokers under churn
 
 The host trie in ``mqtt_tpu.topics`` remains the bit-identical oracle and
 the fallback path (frontier/output overflow, in-flight delta windows).
 """
 
 from .csr import CsrIndex, SubEntry, KIND_CLIENT, KIND_INLINE, KIND_SHARED
+from .delta import DeltaMatcher
 from .hashing import hash_token, tokenize_topics
 from .matcher import MatchResult, TpuMatcher, match_batch
 
 __all__ = [
     "CsrIndex",
+    "DeltaMatcher",
     "KIND_CLIENT",
     "KIND_INLINE",
     "KIND_SHARED",

@@ -1,0 +1,229 @@
+"""Delta-staged device matcher: serve from a frozen CSR snapshot while the
+trie churns, stay bit-identical, rebuild in the background.
+
+The plain :class:`~mqtt_tpu.ops.matcher.TpuMatcher` recompiles the whole CSR
+index whenever the trie version moves — a full rebuild is seconds at 1M
+subscriptions, which no live broker can afford on every SUBSCRIBE. The
+reference never has this problem because its walk reads the live trie under
+a mutex (topics.go:593-628); the device index trades that for snapshot
+semantics, so this module supplies the staleness story (SURVEY.md §7
+stage 5, hard part #2):
+
+- The device keeps serving the last compiled snapshot.
+- Every trie mutation (via ``TopicsIndex.add_observer``) records the mutated
+  filter in a host-side *delta overlay*: an append log plus a mini-trie of
+  just the mutated filters. Client/shared mutations are recorded as client
+  subscriptions and inline mutations as inline subscriptions, so the
+  overlay applies the same $-topic exclusion rules [MQTT-4.7.1-1/2] as the
+  real walk (an inline delta on ``#`` must flag ``$SYS/...`` topics even
+  though a client delta on ``#`` must not).
+- Per matched topic, the mini-trie answers "could any mutation since the
+  snapshot affect this topic's subscriber set?" — a topic that matches no
+  delta filter has, by construction, an identical subscriber set in the
+  snapshot and the live trie, so the device result is served; affected
+  topics re-walk the live host trie. Results are therefore bit-identical to
+  ``TopicsIndex.subscribers`` at every instant, at any rebuild cadence.
+- A background thread recompiles the CSR when the overlay grows past
+  ``rebuild_after`` filters (or on demand via :meth:`flush`); the overlay
+  generation swaps atomically and carries over only the mutations that
+  arrived while the walk ran.
+
+Because the overlay mini-trie IS a ``TopicsIndex``, its walk applies every
+matching rule — including the parent-inline quirk (topics.go:615) — so the
+affected-check is exact: a topic is routed to the host walk iff some
+recorded mutation can actually reach it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from ..packets import Subscription
+from ..topics import InlineSubscription, Subscribers, TopicsIndex
+from .matcher import TpuMatcher
+
+_DELTA_CLIENT = "\x00delta"  # mini-trie marker client; never a real client id
+_log = logging.getLogger("mqtt_tpu.ops.delta")
+
+
+def _noop_handler(*_a) -> None:  # pragma: no cover - marker, never invoked
+    pass
+
+
+class _Snapshot(TpuMatcher):
+    """A TpuMatcher that never self-rebuilds: the delta overlay makes
+    serving a stale snapshot safe, so staleness is frozen off."""
+
+    @property
+    def stale(self) -> bool:  # noqa: D401 - see class docstring
+        return False
+
+
+class _Gen:
+    """One snapshot generation: the compiled device index plus the overlay
+    of filters mutated since its build started."""
+
+    __slots__ = ("snap", "delta_trie", "deltas", "seen")
+
+    def __init__(self, snap: _Snapshot, deltas: list[tuple[str, str]]) -> None:
+        self.snap = snap
+        self.delta_trie = TopicsIndex()
+        self.deltas: list[tuple[str, str]] = []
+        self.seen: set[tuple[str, str]] = set()
+        for f, kind in deltas:
+            self.record(f, kind)
+
+    def record(self, filter: str, kind: str) -> None:
+        key = (filter, kind)
+        self.deltas.append(key)
+        if key in self.seen:
+            return
+        self.seen.add(key)
+        if filter:
+            if kind == "inline":
+                # inline markers follow inline gather rules (no $-exclusion)
+                self.delta_trie.inline_subscribe(
+                    InlineSubscription(filter=filter, identifier=1, handler=_noop_handler)
+                )
+            else:
+                self.delta_trie.subscribe(_DELTA_CLIENT, Subscription(filter=filter))
+
+    def affected(self, topic: str) -> bool:
+        """True when some mutation since the snapshot may change ``topic``'s
+        subscriber set."""
+        if not self.deltas:
+            return False
+        s = self.delta_trie.subscribers(topic)
+        return bool(s.subscriptions or s.shared or s.inline_subscriptions)
+
+
+class DeltaMatcher:
+    """Drop-in for ``TopicsIndex.subscribers`` that serves device matches
+    from a snapshot + host delta overlay and rebuilds off the hot path.
+
+    Parameters
+    ----------
+    rebuild_after:
+        Overlay size (mutation events) that triggers a background recompile.
+        The overlay stays correct at any size — this only tunes how much
+        traffic takes the slower host path.
+    background:
+        When True (default), rebuilds run on a daemon thread; when False,
+        call :meth:`flush` to recompile synchronously (tests, benchmarks).
+    """
+
+    def __init__(
+        self,
+        topics: TopicsIndex,
+        max_levels: int = 8,
+        frontier: int = 16,
+        out_slots: int = 64,
+        rebuild_after: int = 1024,
+        background: bool = True,
+    ) -> None:
+        self.topics = topics
+        self.max_levels = max_levels
+        self.frontier = frontier
+        self.out_slots = out_slots
+        self.rebuild_after = rebuild_after
+        self.background = background
+        self._lock = threading.Lock()  # guards generation swap + delta append
+        self._rebuild_lock = threading.Lock()  # one rebuild at a time
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        snap = _Snapshot(topics, max_levels, frontier, out_slots)
+        snap.rebuild()
+        self._gen = _Gen(snap, [])
+        topics.add_observer(self._on_mutation)
+        if background:
+            self._thread = threading.Thread(
+                target=self._rebuild_loop, name="mqtt-tpu-csr-rebuild", daemon=True
+            )
+            self._thread.start()
+
+    # -- delta stream --------------------------------------------------------
+
+    def _on_mutation(self, filter: str, kind: str) -> None:
+        with self._lock:
+            gen = self._gen
+            gen.record(filter, kind)
+            pending = len(gen.deltas)
+        if pending >= self.rebuild_after:
+            self._wake.set()
+
+    @property
+    def pending_deltas(self) -> int:
+        with self._lock:
+            return len(self._gen.deltas)
+
+    # -- rebuild -------------------------------------------------------------
+
+    def _build_snapshot(self) -> _Snapshot:
+        """Compile the live trie without holding its lock; concurrent
+        structural mutations can tear the walk (RuntimeError from a mutated
+        dict iteration, KeyError from a node inserted mid-walk), in which
+        case retry — every mutation racing the walk is in the delta overlay,
+        so a successful walk is always safe to serve."""
+        snap = _Snapshot(self.topics, self.max_levels, self.frontier, self.out_slots)
+        for _ in range(8):
+            try:
+                snap.rebuild()
+                return snap
+            except (RuntimeError, KeyError):
+                continue
+        with self.topics._lock:  # mutation storm: build quiesced
+            snap.rebuild()
+        return snap
+
+    def _rebuild_once(self) -> None:
+        with self._rebuild_lock:
+            with self._lock:
+                old = self._gen
+                k = len(old.deltas)
+            if k == 0:
+                return
+            snap = self._build_snapshot()
+            with self._lock:
+                # mutations that raced the walk (appended after index k)
+                # might be missing from the new snapshot: carry them over
+                self._gen = _Gen(snap, old.deltas[k:])
+
+    def flush(self) -> None:
+        """Synchronously fold all pending deltas into a fresh snapshot."""
+        self._rebuild_once()
+
+    def _rebuild_loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait()
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self._rebuild_once()
+            except Exception:
+                # never let the rebuild thread die: a degraded matcher keeps
+                # serving (host path), a dead one degrades forever
+                _log.exception("background CSR rebuild failed; will retry")
+                self._stop.wait(1.0)
+                self._wake.set()
+
+    def close(self) -> None:
+        self.topics.remove_observer(self._on_mutation)
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    # -- matching ------------------------------------------------------------
+
+    def match_topics(self, topics: list[str]) -> list[Subscribers]:
+        """Match a batch of topics, bit-identical to the live host trie."""
+        gen = self._gen  # atomic read: one generation per call
+        return gen.snap.match_topics(topics, route_to_host=gen.affected)
+
+    def subscribers(self, topic: str) -> Subscribers:
+        """Drop-in for ``TopicsIndex.subscribers`` (batch of one)."""
+        return self.match_topics([topic])[0]

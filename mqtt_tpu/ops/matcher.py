@@ -36,6 +36,28 @@ from .csr import KIND_CLIENT, KIND_INLINE, KIND_SHARED, CsrIndex, build_csr
 from .hashing import tokenize_topics
 
 
+def _bucket(n: int, minimum: int = 16) -> int:
+    """The smallest power-of-two >= n (at least ``minimum``) — the shape
+    bucket that keeps XLA executables reusable across index rebuilds."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _pad_to(a: np.ndarray, size: int, fill) -> np.ndarray:
+    if len(a) >= size:
+        return a
+    return np.concatenate([a, np.full(size - len(a), fill, dtype=a.dtype)])
+
+
+def _pad_ptr(ptr: np.ndarray, extra: int) -> np.ndarray:
+    """Extend a CSR pointer array by ``extra`` empty trailing ranges."""
+    if extra == 0:
+        return ptr
+    return np.concatenate([ptr, np.full(extra, ptr[-1], dtype=ptr.dtype)])
+
+
 def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None) -> Subscribers:
     """Merge device sub ids (local to ``table``) into a Subscribers result,
     preserving host gather semantics: per-client merge, shared keyed on the
@@ -239,33 +261,46 @@ class TpuMatcher:
     # -- index lifecycle ---------------------------------------------------
 
     def rebuild(self) -> None:
-        """Recompile the host trie into device arrays."""
+        """Recompile the host trie into device arrays.
+
+        Every array is padded to a power-of-two bucket so that successive
+        rebuilds under churn reuse the jitted executable — shapes (and
+        therefore XLA compilations) only change when a bucket doubles.
+        Padding is semantically inert: padded nodes are unreachable (their
+        CSR ranges are empty and no edge points at them) and padded edge /
+        id slots sit beyond every node's pointer range.
+        """
         version = self.topics.version
         csr = build_csr(self.topics)
+        n = csr.num_nodes
+        nb = _bucket(n)
+        pad_n = nb - n
+        edge_ptr = _pad_ptr(csr.edge_ptr, pad_n)
+        reg_ptr = _pad_ptr(csr.reg_ptr, pad_n)
+        inl_ptr = _pad_ptr(csr.inl_ptr, pad_n)
+        plus_child = _pad_to(csr.plus_child, nb, -1)
+        hash_child = _pad_to(csr.hash_child, nb, -1)
+        eb = _bucket(len(csr.edge_dest))
+        edge_tok1 = _pad_to(csr.edge_tok1, eb, 0)
+        edge_tok2 = _pad_to(csr.edge_tok2, eb, 0)
+        edge_dest = _pad_to(csr.edge_dest, eb, -1)
         all_ids = np.concatenate([csr.reg_ids, csr.inl_ids]).astype(np.int32)
-        if all_ids.size == 0:
-            all_ids = np.zeros(1, dtype=np.int32)
-        top_wild = csr.top_wild
-        if top_wild.size == 0:
-            top_wild = np.zeros(1, dtype=bool)
-        # XLA gathers need non-empty operands even on never-taken paths
-        edge_tok1, edge_tok2, edge_dest = csr.edge_tok1, csr.edge_tok2, csr.edge_dest
-        if edge_tok1.size == 0:
-            edge_tok1 = np.zeros(1, dtype=np.uint32)
-            edge_tok2 = np.zeros(1, dtype=np.uint32)
-            edge_dest = np.full(1, -1, dtype=np.int32)
-        self._search_iters = max(1, math.ceil(math.log2(max(2, csr.max_degree + 1))) + 1)
+        all_ids = _pad_to(all_ids, _bucket(len(all_ids)), 0)
+        top_wild = _pad_to(csr.top_wild, _bucket(len(csr.subs)), False)
+        # round the binary-search depth up so it, too, changes rarely
+        iters = max(1, math.ceil(math.log2(max(2, csr.max_degree + 1))) + 1)
+        self._search_iters = min(32, math.ceil(iters / 4) * 4)
         self._device_arrays = tuple(
             jnp.asarray(a)
             for a in (
-                csr.edge_ptr,
+                edge_ptr,
                 edge_tok1,
                 edge_tok2,
                 edge_dest,
-                csr.plus_child,
-                csr.hash_child,
-                csr.reg_ptr,
-                csr.inl_ptr,
+                plus_child,
+                hash_child,
+                reg_ptr,
+                inl_ptr,
                 all_ids,
                 np.int32(len(csr.reg_ids)),
                 top_wild,
@@ -305,9 +340,14 @@ class TpuMatcher:
 
     # -- matching ----------------------------------------------------------
 
-    def match_topics(self, topics: list[str]) -> list[Subscribers]:
+    def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
         """Match a batch of topics; every result is bit-identical to the
-        host trie (overflowing topics are re-walked on host)."""
+        host trie (overflowing topics are re-walked on host).
+
+        ``route_to_host`` optionally forces extra topics onto the host walk
+        (the delta overlay's affected-check in mqtt_tpu.ops.delta); the
+        host path is always correct, so any predicate preserves parity.
+        """
         if self.csr is None or self.stale:
             self.rebuild()
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
@@ -329,7 +369,7 @@ class TpuMatcher:
         for i, topic in enumerate(topics):
             if not topic:
                 results.append(Subscribers())  # empty topic never matches
-            elif overflow[i]:
+            elif overflow[i] or (route_to_host is not None and route_to_host(topic)):
                 results.append(self.topics.subscribers(topic))  # host fallback
             else:
                 results.append(self._expand(out[i]))

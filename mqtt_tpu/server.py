@@ -151,6 +151,10 @@ class Options:
     logger: Optional[logging.Logger] = None
     sys_topic_resend_interval: int = 0
     inline_client: bool = False
+    # route publish-topic matching through the delta-staged device matcher
+    # (mqtt_tpu.ops.delta.DeltaMatcher) instead of the host trie walk; results
+    # are bit-identical, the index lives on the TPU (SURVEY.md north star)
+    device_matcher: bool = False
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -196,6 +200,11 @@ class Server:
         self._event_loop_task: Optional[asyncio.Task] = None
         self.inline_client: Optional[Client] = None
         self._ops = _Ops(opts, self.info, self.hooks, self.log)
+        self.matcher = None  # device matcher; None = host trie walk
+        if opts.device_matcher:
+            from .ops.delta import DeltaMatcher
+
+            self.matcher = DeltaMatcher(self.topics)
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -796,7 +805,10 @@ class Server:
             if expiry > 0:
                 pk.expiry = pk.created + expiry
 
-        subscribers = self.topics.subscribers(pk.topic_name)
+        if self.matcher is not None:
+            subscribers = self.matcher.subscribers(pk.topic_name)
+        else:
+            subscribers = self.topics.subscribers(pk.topic_name)
         if subscribers.shared:
             subscribers = self.hooks.on_select_subscribers(subscribers, pk)
             if not subscribers.shared_selected:
@@ -1192,6 +1204,10 @@ class Server:
         self.done.set()
         self.log.info("gracefully stopping server")
         await self.listeners.close_all(self._close_listener_clients)
+        # after client teardown: shutdown LWT publishes and clean-session
+        # unsubscribes must still flow through the live delta overlay
+        if self.matcher is not None:
+            self.matcher.close()
         self.hooks.on_stopped()
         self.hooks.stop()
         if self._event_loop_task is not None:

@@ -270,6 +270,26 @@ class TopicsIndex:
         # bumped on every subscription mutation; device indexes (mqtt_tpu.ops)
         # compare against it to detect staleness
         self.version = 0
+        # mutation observers: called with (filter, kind) under the trie lock,
+        # after the version bump; kind is "sub" (client/shared subscription)
+        # or "inline". The delta-staged device matcher (mqtt_tpu.ops.delta)
+        # uses this to route affected topics to the host walk while a stale
+        # device snapshot keeps serving everything else.
+        self._observers: list[Callable[[str, str], None]] = []
+
+    def add_observer(self, fn: Callable[[str, str], None]) -> None:
+        """Register a subscription-mutation observer (delta stream consumer)."""
+        with self._lock:
+            self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable[[str, str], None]) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
+
+    def _notify(self, filter: str, kind: str) -> None:
+        for fn in self._observers:
+            fn(filter, kind)
 
     # -- mutation ----------------------------------------------------------
 
@@ -288,6 +308,7 @@ class TopicsIndex:
                 n = self._set(subscription.filter, 0)
                 existed = n.subscriptions.get(client) is not None
                 n.subscriptions.add(client, subscription)
+            self._notify(subscription.filter, "sub")
             return not existed
 
     def unsubscribe(self, filter: str, client: str) -> bool:
@@ -309,6 +330,7 @@ class TopicsIndex:
             else:
                 particle.subscriptions.delete(client)
             self._trim(particle)
+            self._notify(filter, "sub")
             return True
 
     def inline_subscribe(self, subscription: InlineSubscription) -> bool:
@@ -319,6 +341,7 @@ class TopicsIndex:
             n = self._set(subscription.filter, 0)
             existed = n.inline_subscriptions.get(subscription.identifier) is not None
             n.inline_subscriptions.add_inline(subscription)
+            self._notify(subscription.filter, "inline")
             return not existed
 
     def inline_unsubscribe(self, id_: int, filter: str) -> bool:
@@ -330,6 +353,7 @@ class TopicsIndex:
             particle.inline_subscriptions.delete(id_)
             if len(particle.inline_subscriptions) == 0:
                 self._trim(particle)
+            self._notify(filter, "inline")
             return True
 
     def retain_message(self, pk: Packet) -> int:

@@ -142,8 +142,11 @@ class TestFrameScan:
         assert len(frames) == len(good)
         pos = 0
         for f, c in zip(frames, good):
-            assert f.first_byte == c.raw[pos - pos]  # first byte of this packet
-            assert buf[f.body_offset : f.body_offset + f.remaining] in c.raw
+            assert f.first_byte == c.raw[0]  # first byte of this packet
+            # body = raw minus fixed header (first byte + varint length)
+            header_len = len(c.raw) - f.remaining
+            assert f.body_offset == pos + header_len
+            assert buf[f.body_offset : f.body_offset + f.remaining] == c.raw[header_len:]
             pos += len(c.raw)
 
     def test_partial_tail(self):
