@@ -106,9 +106,14 @@ class DeltaMatcher:
     Parameters
     ----------
     rebuild_after:
-        Overlay size (mutation events) that triggers a background recompile.
-        The overlay stays correct at any size — this only tunes how much
-        traffic takes the slower host path.
+        Overlay size (mutation events) that triggers an immediate background
+        recompile. The overlay stays correct at any size — this only tunes
+        how much traffic takes the slower host path.
+    rebuild_interval:
+        The background thread additionally folds a NON-empty overlay every
+        this many seconds, so a quiet broker (e.g. all subscribes at connect
+        time, publishes after) drains its overlay instead of serving the
+        host path forever below the count threshold.
     background:
         When True (default), rebuilds run on a daemon thread; when False,
         call :meth:`flush` to recompile synchronously (tests, benchmarks).
@@ -121,6 +126,7 @@ class DeltaMatcher:
         frontier: int = 16,
         out_slots: int = 64,
         rebuild_after: int = 1024,
+        rebuild_interval: float = 1.0,
         background: bool = True,
     ) -> None:
         self.topics = topics
@@ -128,6 +134,7 @@ class DeltaMatcher:
         self.frontier = frontier
         self.out_slots = out_slots
         self.rebuild_after = rebuild_after
+        self.rebuild_interval = rebuild_interval
         self.background = background
         self._lock = threading.Lock()  # guards generation swap + delta append
         self._rebuild_lock = threading.Lock()  # one rebuild at a time
@@ -197,7 +204,9 @@ class DeltaMatcher:
 
     def _rebuild_loop(self) -> None:
         while not self._stop.is_set():
-            self._wake.wait()
+            # wake on overflow OR on the interval tick, so a quiet overlay
+            # still drains (count threshold alone could starve forever)
+            self._wake.wait(timeout=self.rebuild_interval)
             self._wake.clear()
             if self._stop.is_set():
                 return

@@ -41,7 +41,7 @@ from ..packets import Subscription
 from ..topics import Subscribers, TopicsIndex
 from ..ops.csr import KIND_CLIENT, KIND_SHARED, build_csr
 from ..ops.hashing import tokenize_topics
-from ..ops.matcher import expand_sids, match_core
+from ..ops.matcher import _pad_to, expand_sids, match_core
 
 
 def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
@@ -53,13 +53,6 @@ def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
     subs_axis = n // batch_axis
     grid = np.array(devices[: batch_axis * subs_axis]).reshape(batch_axis, subs_axis)
     return Mesh(grid, ("batch", "subs"))
-
-
-def _pad_to(a: np.ndarray, n: int, fill=0) -> np.ndarray:
-    if len(a) >= n:
-        return a
-    pad = np.full(n - len(a), fill, dtype=a.dtype)
-    return np.concatenate([a, pad])
 
 
 class ShardedTpuMatcher:

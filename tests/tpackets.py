@@ -966,6 +966,406 @@ CASES: list[Case] = [
         decode_err=codes.ERR_MALFORMED_REASON_CODE,
         group="decode",
     ),
+    # ---- CONNECT (extended) ----------------------------------------------
+    Case(
+        "connect v4 will qos1 retain",
+        hx("101f 0004 4d515454 04 2e 003c 0004 7a656e33 0003 6c7774 0008 6e6f74616761696e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=31),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                will_flag=True,
+                will_qos=1,
+                will_retain=True,
+                will_topic="lwt",
+                will_payload=b"notagain",
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 zero byte username with password",
+        hx("1018 0004 4d515454 05 c2 003c 00 0004 7a656e33 0000 0003 746561"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=24),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                username_flag=True,
+                username=b"",
+                password_flag=True,
+                password=b"tea",
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect will flag but truncated will payload",
+        hx("1015 0004 4d515454 04 06 003c 0004 7a656e33 0003 6c7774"),
+        decode_err=codes.ERR_MALFORMED_WILL_PAYLOAD,
+        group="decode",
+    ),
+    Case(
+        "connect v5 truncated will properties",
+        hx("1012 0004 4d515454 05 06 003c 00 0004 7a656e33 05"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_WILL_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "connect client id embedded NUL [MQTT-1.5.4-2]",
+        hx("1010 0004 4d515454 04 02 003c 0004 7a65006e"),
+        decode_err=codes.ERR_CLIENT_IDENTIFIER_NOT_VALID,
+        group="decode",
+    ),
+    Case(
+        "connect client id UTF-16 surrogate D800",
+        hx("100f 0004 4d515454 04 02 003c 0003 eda080"),
+        decode_err=codes.ERR_CLIENT_IDENTIFIER_NOT_VALID,
+        group="decode",
+    ),
+    Case(
+        "connect client id UTF-16 surrogate DFFF",
+        hx("100f 0004 4d515454 04 02 003c 0003 edbfbf"),
+        decode_err=codes.ERR_CLIENT_IDENTIFIER_NOT_VALID,
+        group="decode",
+    ),
+    # ---- CONNACK (extended) ----------------------------------------------
+    Case(
+        "connack v4 identifier rejected",
+        hx("20020002"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, reason_code=2),
+    ),
+    Case(
+        "connack v4 server unavailable",
+        hx("20020003"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, reason_code=3),
+    ),
+    Case(
+        "connack v4 bad username or password",
+        hx("20020004"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, reason_code=4),
+    ),
+    Case(
+        "connack v4 not authorized",
+        hx("20020005"),
+        Packet(fixed_header=fhdr(CONNACK, remaining=2), protocol_version=4, reason_code=5),
+    ),
+    Case(
+        "connack v5 session present with success",
+        hx("2003010000"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=3),
+            protocol_version=5,
+            session_present=True,
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v5 server keepalive",
+        hx("2006 0000 03 13 000a"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=6),
+            protocol_version=5,
+            properties=Properties(server_keep_alive=10, server_keep_alive_flag=True),
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v5 assigned client id",
+        hx("200a 0000 07 12 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=10),
+            protocol_version=5,
+            properties=Properties(assigned_client_id="zen3"),
+        ),
+        version=5,
+    ),
+    Case(
+        "connack session present masks to low bit",
+        hx("20020200"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=2),
+            protocol_version=4,
+            session_present=False,
+        ),
+        group="decode",  # reference decodeByteBool: 1&b, no error (codec.go:81-86)
+    ),
+    # ---- PUBLISH (extended) ----------------------------------------------
+    Case(
+        "publish v5 message expiry and topic alias",
+        hx("3012 0005 612f622f63 08 02 0000003c 23 0005 6869"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=18),
+            protocol_version=5,
+            topic_name="a/b/c",
+            properties=Properties(
+                message_expiry_interval=60, topic_alias=5, topic_alias_flag=True
+            ),
+            payload=b"hi",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 payload format and content type",
+        hx("3013 0005 612f622f63 09 01 01 03 0004 74657874 6869"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=19),
+            protocol_version=5,
+            topic_name="a/b/c",
+            properties=Properties(
+                payload_format=1, payload_format_flag=True, content_type="text"
+            ),
+            payload=b"hi",
+        ),
+        version=5,
+    ),
+    Case(
+        "publish qos2 missing packet id",
+        hx("3407 0005 612f622f63"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "publish truncated topic",
+        hx("3003 0005 61"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "publish remaining exceeds buffer",
+        hx("3010 0005 612f622f63"),
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    # ---- PUBACK / PUBREC / PUBREL / PUBCOMP (extended) -------------------
+    Case(
+        "puback v5 no matching subscribers",
+        hx("4004 0007 10 00"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x10,
+        ),
+        version=5,
+        # sub-0x80 reason with no props re-encodes to the 2-byte short form
+        group="decode",
+    ),
+    Case(
+        "puback v5 truncated properties",
+        hx("4004 0007 10 05"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "pubrec v5 not authorized",
+        hx("5003 0007 87"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x87,
+        ),
+        version=5,
+    ),
+    Case(
+        "pubcomp v5 reason string",
+        hx("700a 0007 92 06 1f 0003 626164"),
+        Packet(
+            fixed_header=fhdr(PUBCOMP, remaining=10),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x92,
+            properties=Properties(reason_string="bad"),
+        ),
+        version=5,
+    ),
+    Case(
+        "pubrel v5 truncated properties",
+        hx("6204 0007 92 05"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    # ---- SUBSCRIBE / SUBACK (extended) -----------------------------------
+    Case(
+        "subscribe v5 no local and retain as published",
+        hx("8209 0010 00 0003 612f62 0d"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=9),
+            protocol_version=5,
+            packet_id=16,
+            filters=[
+                Subscription(filter="a/b", qos=1, no_local=True, retain_as_published=True)
+            ],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 retain handling 1",
+        hx("8209 0011 00 0003 612f62 10"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=9),
+            protocol_version=5,
+            packet_id=17,
+            filters=[Subscription(filter="a/b", retain_handling=1)],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 retain handling 2",
+        hx("8209 0012 00 0003 612f62 20"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=9),
+            protocol_version=5,
+            packet_id=18,
+            filters=[Subscription(filter="a/b", retain_handling=2)],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v4 three filters",
+        hx("820e 0003 0001 61 01 0001 62 02 0001 63 00"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=14),
+            protocol_version=4,
+            packet_id=3,
+            filters=[
+                Subscription(filter="a", qos=1),
+                Subscription(filter="b", qos=2),
+                Subscription(filter="c", qos=0),
+            ],
+        ),
+    ),
+    Case(
+        "subscribe v5 truncated properties",
+        hx("8203 0010 05"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "suback v4 failure grant",
+        hx("9003 0005 80"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=3),
+            protocol_version=4,
+            packet_id=5,
+            reason_codes=b"\x80",
+        ),
+    ),
+    Case(
+        "suback v5 mixed grants with failure",
+        hx("9006 0010 00 00 01 87"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=6),
+            protocol_version=5,
+            packet_id=16,
+            reason_codes=b"\x00\x01\x87",
+        ),
+        version=5,
+    ),
+    # ---- UNSUBSCRIBE / UNSUBACK (extended) -------------------------------
+    Case(
+        "unsubscribe v5 user property",
+        hx("a20f 0012 07 26 0001 6b 0001 76 0003 612f62"),
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=15),
+            protocol_version=5,
+            packet_id=18,
+            properties=Properties(user=[UserProperty("k", "v")]),
+            filters=[Subscription(filter="a/b")],
+        ),
+        version=5,
+    ),
+    Case(
+        "unsuback v5 two codes",
+        hx("b005 0010 00 00 11"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=5),
+            protocol_version=5,
+            packet_id=16,
+            reason_codes=b"\x00\x11",
+        ),
+        version=5,
+    ),
+    # ---- DISCONNECT / AUTH (extended) ------------------------------------
+    Case(
+        "disconnect v5 session taken over",
+        hx("e002 8e 00"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x8E,
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 keep alive timeout",
+        hx("e002 8d 00"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x8D,
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 one byte body ignores reason",
+        hx("e001 8e"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=1),
+            protocol_version=5,
+            reason_code=0,  # remaining must be >1 to carry a reason (packets.go:568)
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "auth v5 success empty properties",
+        hx("f002 00 00"),
+        Packet(fixed_header=fhdr(AUTH, remaining=2), protocol_version=5),
+        version=5,
+    ),
+    # ---- fixed header flags ----------------------------------------------
+    Case(
+        "connack invalid flags",
+        hx("2100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "puback invalid flags",
+        hx("4100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "pubrec invalid flags",
+        hx("5100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "pubcomp invalid flags",
+        hx("7100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    Case(
+        "unsuback invalid flags",
+        hx("b100"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
     # ---- framing ---------------------------------------------------------
     Case(
         "remaining length varint overflow",
