@@ -682,3 +682,450 @@ class TestRetainFlagRegression:
             await h.shutdown()
 
         run(scenario())
+
+
+def sub_packet(pid, filters, version=4):
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+            protocol_version=version,
+            packet_id=pid,
+            filters=filters,
+        )
+    )
+
+
+def pub_packet(topic, payload, qos=0, pid=0, version=4, retain=False, props=None):
+    pk = Packet(
+        fixed_header=FixedHeader(type=PUBLISH, qos=qos, retain=retain),
+        protocol_version=version,
+        topic_name=topic,
+        packet_id=pid,
+        payload=payload,
+    )
+    if props is not None:
+        pk.properties = props
+    return encode_packet(pk)
+
+
+class TestTopicAliases:
+    def test_inbound_alias_resolves_empty_topic(self):
+        """v5 publisher sets an alias then sends alias-only publishes; the
+        subscriber sees the real topic both times (server.go:904-906)."""
+
+        async def scenario():
+            from mqtt_tpu.packets import Properties
+
+            h = Harness()
+            sr, sw, _ = await h.connect("alias-sub")
+            sw.write(sub_packet(1, [Subscription(filter="al/t", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+
+            pr, pw, _ = await h.connect("alias-pub", version=5)
+            pw.write(
+                pub_packet(
+                    "al/t", b"one", version=5,
+                    props=Properties(topic_alias=4, topic_alias_flag=True),
+                )
+            )
+            pw.write(
+                pub_packet(
+                    "", b"two", version=5,
+                    props=Properties(topic_alias=4, topic_alias_flag=True),
+                )
+            )
+            await pw.drain()
+            m1 = await read_wire_packet(sr)
+            m2 = await read_wire_packet(sr)
+            assert (m1.topic_name, m1.payload) == ("al/t", b"one")
+            assert (m2.topic_name, m2.payload) == ("al/t", b"two")
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_outbound_alias_assigned_when_client_allows(self):
+        """A v5 subscriber advertising topic_alias_maximum gets an alias on
+        first delivery and an empty topic afterwards (server.go:1052-1061)."""
+
+        async def scenario():
+            from mqtt_tpu.packets import Properties
+
+            h = Harness()
+            reader, writer, task = await h.attach()
+            cp = ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=30,
+                client_identifier="alias-out",
+            )
+            writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=CONNECT),
+                        protocol_version=5,
+                        properties=Properties(topic_alias_maximum=8),
+                        connect=cp,
+                    )
+                )
+            )
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+            writer.write(sub_packet(1, [Subscription(filter="ob/t", qos=0)], version=5))
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+
+            h.server.publish("ob/t", b"m1", False, 0)
+            h.server.publish("ob/t", b"m2", False, 0)
+            m1 = await read_wire_packet(reader, 5)
+            m2 = await read_wire_packet(reader, 5)
+            assert m1.topic_name == "ob/t" and m1.properties.topic_alias == 1
+            assert m2.topic_name == "" and m2.properties.topic_alias == 1
+            assert m2.payload == b"m2"
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestQuotasAndLimits:
+    def test_receive_maximum_disconnect(self):
+        """Exceeding the in-flight receive quota with unacked QoS2 publishes
+        disconnects with ErrReceiveMaximum (server.go:862-864)."""
+
+        async def scenario():
+            opts = Options(capabilities=Capabilities(receive_maximum=1))
+            h = Harness(opts)
+            reader, writer, task = await h.connect("greedy", version=5)
+            writer.write(pub_packet("q/t", b"a", qos=2, pid=1, version=5))
+            await writer.drain()
+            rec = await read_wire_packet(reader, 5)
+            assert rec.fixed_header.type == PUBREC
+            # second QoS2 publish without completing the first
+            writer.write(pub_packet("q/t", b"b", qos=2, pid=2, version=5))
+            await writer.drain()
+            disc = await read_wire_packet(reader, 5)
+            assert disc.fixed_header.type == DISCONNECT
+            assert disc.reason_code == codes.ERR_RECEIVE_MAXIMUM.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_maximum_packet_size_drops_oversized(self):
+        """Messages larger than the client's maximum packet size are not
+        delivered to it [MQTT-3.1.2-24] (clients.go:595-598)."""
+
+        async def scenario():
+            from mqtt_tpu.packets import Properties
+
+            h = Harness()
+            reader, writer, task = await h.attach()
+            writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=CONNECT),
+                        protocol_version=5,
+                        properties=Properties(maximum_packet_size=25),
+                        connect=ConnectParams(
+                            protocol_name=b"MQTT", clean=True, keepalive=30,
+                            client_identifier="small",
+                        ),
+                    )
+                )
+            )
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+            writer.write(sub_packet(1, [Subscription(filter="mx/t", qos=0)], version=5))
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+
+            h.server.publish("mx/t", b"x" * 100, False, 0)  # oversized: dropped
+            h.server.publish("mx/t", b"ok", False, 0)
+            m = await read_wire_packet(reader, 5)
+            assert m.payload == b"ok"
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestDelayedLWT:
+    def test_will_delay_interval_defers_and_reconnect_cancels(self):
+        """A v5 will with a delay interval is queued, published by the
+        delayed-LWT tick, and cancelled by reconnection (server.go:1744-1758,
+        [MQTT-3.1.3-9])."""
+
+        async def scenario():
+            import time as _time
+            from mqtt_tpu.packets import Properties
+
+            h = Harness()
+            sr, sw, _ = await h.connect("lwt-watcher")
+            sw.write(sub_packet(1, [Subscription(filter="dl/t", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+
+            async def connect_with_delayed_will():
+                reader, writer, task = await h.attach()
+                cp = ConnectParams(
+                    protocol_name=b"MQTT", clean=False, keepalive=30,
+                    client_identifier="doomed", will_flag=True,
+                    will_topic="dl/t", will_payload=b"gone",
+                )
+                cp.will_properties = Properties(will_delay_interval=30)
+                writer.write(
+                    encode_packet(
+                        Packet(
+                            fixed_header=FixedHeader(type=CONNECT),
+                            protocol_version=5,
+                            connect=cp,
+                        )
+                    )
+                )
+                await writer.drain()
+                await read_wire_packet(reader, 5)
+                return reader, writer, task
+
+            reader, writer, task = await connect_with_delayed_will()
+            writer.close()  # abnormal disconnect
+            await asyncio.sleep(0.1)
+            assert len(h.server.will_delayed) == 1
+
+            # not yet due: nothing published
+            h.server.send_delayed_lwt(int(_time.time()))
+            with pytest.raises(asyncio.TimeoutError):
+                await read_wire_packet(sr)
+
+            # due: published to the watcher
+            h.server.send_delayed_lwt(int(_time.time()) + 3600)
+            m = await read_wire_packet(sr)
+            assert (m.topic_name, m.payload) == ("dl/t", b"gone")
+            assert len(h.server.will_delayed) == 0
+
+            # reconnect cancels a re-queued delayed will [MQTT-3.1.3-9]
+            reader, writer, task = await connect_with_delayed_will()
+            writer.close()
+            await asyncio.sleep(0.1)
+            assert len(h.server.will_delayed) == 1
+            reader, writer, task = await connect_with_delayed_will()
+            assert len(h.server.will_delayed) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestTakeover:
+    def test_takeover_inherits_inflight_and_resends_dup(self):
+        """Session takeover moves unacked QoS1 inflights to the new
+        connection and resends them with DUP (server.go:561-603,
+        clients.go:302-327)."""
+
+        async def scenario():
+            h = Harness()
+            r1, w1, _ = await h.connect("dur", clean=False)
+            w1.write(sub_packet(1, [Subscription(filter="tk/t", qos=1)]))
+            await w1.drain()
+            await read_wire_packet(r1)
+
+            h.server.publish("tk/t", b"keep", False, 1)
+            m = await read_wire_packet(r1)
+            assert m.fixed_header.type == PUBLISH and m.fixed_header.qos == 1
+            assert not m.fixed_header.dup
+
+            # second connection with same id takes over without acking
+            r2, w2, _ = await h.connect("dur", clean=False, expect_code=0)
+            redo = await read_wire_packet(r2)
+            assert redo.fixed_header.type == PUBLISH
+            assert redo.payload == b"keep"
+            assert redo.fixed_header.dup  # [MQTT-3.3.1-1] resend marks DUP
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_second_connect_is_protocol_violation(self):
+        """A second CONNECT on a live connection disconnects the client
+        (server.go:734-738, [MQTT-3.1.0-2])."""
+
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("twice", version=5)
+            writer.write(connect_packet("twice", 5))
+            await writer.drain()
+            disc = await read_wire_packet(reader, 5)
+            assert disc.fixed_header.type == DISCONNECT
+            assert disc.reason_code == codes.ERR_PROTOCOL_VIOLATION_SECOND_CONNECT.code
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestSubscriptionOptions:
+    def test_subscription_identifier_attached(self):
+        """v5 subscription identifiers ride on delivered publishes, sorted
+        [MQTT-3.3.4-3/4] (server.go:1033-1040)."""
+
+        async def scenario():
+            from mqtt_tpu.packets import Properties
+
+            h = Harness()
+            reader, writer, task = await h.connect("subid", version=5)
+            pk = Packet(
+                fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                protocol_version=5,
+                packet_id=2,
+                properties=Properties(subscription_identifier=[7]),
+                filters=[Subscription(filter="si/t", qos=0, identifier=7)],
+            )
+            writer.write(encode_packet(pk))
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+
+            h.server.publish("si/t", b"x", False, 0)
+            m = await read_wire_packet(reader, 5)
+            assert m.properties.subscription_identifier == [7]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_no_local_suppresses_echo(self):
+        """A no-local subscriber never receives its own publishes
+        [MQTT-3.8.3-3] (server.go:1024-1026)."""
+
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("nl", version=5)
+            writer.write(
+                sub_packet(1, [Subscription(filter="nl/t", qos=0, no_local=True)], version=5)
+            )
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+
+            writer.write(pub_packet("nl/t", b"echo", version=5))
+            await writer.drain()
+            with pytest.raises(asyncio.TimeoutError):
+                await read_wire_packet(reader, 5)
+
+            # another client's publish still arrives
+            h.server.publish("nl/t", b"other", False, 0)
+            m = await read_wire_packet(reader, 5)
+            assert m.payload == b"other"
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestExpiryLoops:
+    def test_clear_expired_retained_messages(self):
+        async def scenario():
+            import time as _time
+
+            h = Harness()
+            opts = h.server.options
+            r, w, _ = await h.connect("ret", version=5)
+            from mqtt_tpu.packets import Properties
+
+            w.write(
+                pub_packet(
+                    "ex/t", b"v", version=5, retain=True,
+                    props=Properties(message_expiry_interval=5),
+                )
+            )
+            await w.drain()
+            await asyncio.sleep(0.1)
+            assert len(h.server.topics.retained) == 1
+            h.server.clear_expired_retained_messages(int(_time.time()) + 60)
+            assert len(h.server.topics.retained) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_clear_expired_clients(self):
+        async def scenario():
+            import time as _time
+
+            h = Harness()
+            # v4 with clean=False survives disconnect (server.go:484);
+            # a v5 session with no expiry property would end immediately
+            r, w, _ = await h.connect("mortal", clean=False)
+            w.close()
+            await asyncio.sleep(0.1)
+            assert h.server.clients.get("mortal") is not None
+            # session expiry defaults to the server maximum; far future expires
+            h.server.clear_expired_clients(int(_time.time()) + 2 ** 33)
+            assert h.server.clients.get("mortal") is None
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_clear_expired_inflights(self):
+        async def scenario():
+            import time as _time
+
+            h = Harness()
+            r, w, _ = await h.connect("ifm", clean=False)
+            w.write(sub_packet(1, [Subscription(filter="if/t", qos=1)]))
+            await w.drain()
+            await read_wire_packet(r)
+            h.server.publish("if/t", b"x", False, 1)
+            await read_wire_packet(r)  # delivered, never acked
+            cl = h.server.clients.get("ifm")
+            assert len(cl.state.inflight) == 1
+            h.server.clear_expired_inflights(int(_time.time()) + 2 ** 33)
+            assert len(cl.state.inflight) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestServerAPIs:
+    def test_disconnect_client_sends_v5_disconnect(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("kickme", version=5)
+            cl = h.server.clients.get("kickme")
+            # error-class codes re-raise after stopping (mirrors the
+            # reference's error return, server.go:1413-1437)
+            with pytest.raises(Code):
+                h.server.disconnect_client(cl, codes.ERR_ADMINISTRATIVE_ACTION)
+            disc = await read_wire_packet(reader, 5)
+            assert disc.fixed_header.type == DISCONNECT
+            assert disc.reason_code == codes.ERR_ADMINISTRATIVE_ACTION.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_client_clears_trie(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("unsub-all")
+            writer.write(
+                sub_packet(1, [Subscription(filter="ua/1", qos=0), Subscription(filter="ua/2", qos=0)])
+            )
+            await writer.drain()
+            await read_wire_packet(reader)
+            assert len(h.server.topics.subscribers("ua/1").subscriptions) == 1
+            cl = h.server.clients.get("unsub-all")
+            h.server.unsubscribe_client(cl)
+            assert len(h.server.topics.subscribers("ua/1").subscriptions) == 0
+            assert len(h.server.topics.subscribers("ua/2").subscriptions) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inject_packet_publishes(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.connect("inj-sub")
+            writer.write(sub_packet(1, [Subscription(filter="in/t", qos=0)]))
+            await writer.drain()
+            await read_wire_packet(reader)
+            cl = h.server.clients.get("inj-sub")
+            h.server.inject_packet(
+                cl,
+                Packet(
+                    fixed_header=FixedHeader(type=PUBLISH),
+                    topic_name="in/t",
+                    payload=b"injected",
+                ),
+            )
+            m = await read_wire_packet(reader)
+            assert m.payload == b"injected"
+            await h.shutdown()
+
+        run(scenario())
