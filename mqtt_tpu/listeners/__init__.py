@@ -166,7 +166,7 @@ class Listeners:
             await asyncio.gather(*list(self.client_tasks), return_exceptions=True)
 
 
-from .http import HTTPHealthCheck, HTTPStats  # noqa: E402
+from .http import Dashboard, HTTPHealthCheck, HTTPStats  # noqa: E402
 from .mock import MockListener  # noqa: E402
 from .net import Net  # noqa: E402
 from .tcp import TCP  # noqa: E402
@@ -177,6 +177,7 @@ __all__ = [
     "Config",
     "EstablishFn",
     "HTTPHealthCheck",
+    "Dashboard",
     "HTTPStats",
     "Listener",
     "Listeners",

@@ -9,8 +9,16 @@ responders — no framework dependency.
 from __future__ import annotations
 
 import asyncio
+import base64
+import collections
+import hmac
 import json
 import logging
+import resource
+import threading
+import time
+from html import escape
+
 from ..system import Info
 from . import Config, EstablishFn, StreamListener, split_host_port
 
@@ -37,16 +45,27 @@ class _HttpListener(StreamListener):
             line = request.split(b"\r\n", 1)[0].decode("latin-1")
             parts = line.split(" ")
             method, path = (parts + ["", ""])[:2]
-            status, body, ctype = self._respond(method, path)
-            writer.write(
-                (
-                    f"HTTP/1.1 {status}\r\n"
-                    f"Content-Type: {ctype}\r\n"
-                    f"Content-Length: {len(body)}\r\n"
-                    "Connection: close\r\n\r\n"
-                ).encode()
-                + body
-            )
+            if not self._authorized(request):
+                writer.write(
+                    b"HTTP/1.1 401 Unauthorized\r\n"
+                    b'WWW-Authenticate: Basic realm="mqtt_tpu"\r\n'
+                    b"Content-Length: 0\r\nConnection: close\r\n\r\n"
+                )
+            else:
+                try:
+                    status, body, ctype = self._respond(method, path)
+                except Exception:
+                    self.log.exception("http handler failed: path=%s", path)
+                    status, body, ctype = "500 Internal Server Error", b"", "text/plain"
+                writer.write(
+                    (
+                        f"HTTP/1.1 {status}\r\n"
+                        f"Content-Type: {ctype}\r\n"
+                        f"Content-Length: {len(body)}\r\n"
+                        "Connection: close\r\n\r\n"
+                    ).encode()
+                    + body
+                )
             await writer.drain()
         except Exception:
             pass
@@ -55,6 +74,9 @@ class _HttpListener(StreamListener):
                 writer.close()
             except Exception:
                 pass
+
+    def _authorized(self, request: bytes) -> bool:
+        return True
 
     def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
         raise NotImplementedError
@@ -81,3 +103,144 @@ class HTTPStats(_HttpListener):
             return "405 Method Not Allowed", b"", "text/plain"
         body = json.dumps(self.sys_info.clone().as_dict()).encode()
         return "200 OK", body, "application/json"
+
+
+class Dashboard(_HttpListener):
+    """The fork CLI's basic-auth'd status dashboard
+    (cmd/server/listener.go:182-358): ``/information`` (indented $SYS JSON),
+    ``/connections`` (HTML client table), ``/clientsrawdata`` (per-client
+    JSON), ``/processrecords`` (periodic process snapshots).
+
+    ``auth`` maps username -> password for HTTP basic auth; an empty map
+    disables the check. The process recorder samples lazily, at most once
+    per ``record_interval`` seconds (the reference records on a 60s timer).
+    """
+
+    def __init__(
+        self,
+        config: Config,
+        sys_info: Info,
+        clients,
+        auth: dict[str, str] | None = None,
+        listener_summary: str = "",
+        record_interval: float = 60.0,
+        max_records: int = 7 * 24 * 60,  # reference keeps 7 days of minutes
+    ) -> None:
+        super().__init__(config)
+        self.sys_info = sys_info
+        self.clients = clients
+        self.auth = auth or {}
+        self.listener_summary = listener_summary
+        self.record_interval = record_interval
+        self._records: collections.deque = collections.deque(maxlen=max_records)
+        self._last_record = 0.0
+
+    # -- process recorder ---------------------------------------------------
+
+    def _maybe_record(self) -> None:
+        now = time.time()
+        if now - self._last_record < self.record_interval and self._records:
+            return
+        self._last_record = now
+        usage = resource.getrusage(resource.RUSAGE_SELF)
+        self._records.append(
+            {
+                "time": int(now),
+                "rss_bytes": usage.ru_maxrss * 1024,
+                "threads": threading.active_count(),
+                "clients_connected": self.sys_info.clients_connected,
+                "messages_received": self.sys_info.messages_received,
+                "messages_sent": self.sys_info.messages_sent,
+            }
+        )
+
+    # -- request handling ---------------------------------------------------
+
+    def _authorized(self, request: bytes) -> bool:
+        if not self.auth:
+            return True
+        for line in request.split(b"\r\n"):
+            if line.lower().startswith(b"authorization: basic "):
+                try:
+                    userpass = base64.b64decode(line.split(b" ", 2)[2]).decode()
+                    user, _, pwd = userpass.partition(":")
+                except Exception:
+                    return False
+                return hmac.compare_digest(self.auth.get(user, ""), pwd)
+        return False
+
+    def _client_rows(self) -> tuple[list[list[str]], dict[str, int]]:
+        rows = []
+        counts: dict[str, int] = {}
+        for cl in self.clients.get_all().values():
+            if cl.net.listener == "local" or cl.id == "inline":
+                continue
+            filters = sorted(cl.state.subscriptions.get_all())
+            username = (
+                cl.properties.username.decode("utf-8", "replace")
+                if isinstance(cl.properties.username, (bytes, bytearray))
+                else str(cl.properties.username)
+            )
+            rows.append(
+                [
+                    username,
+                    cl.id,
+                    str(cl.net.remote),
+                    str(cl.properties.protocol_version),
+                    cl.net.listener,
+                    str(len(filters)),
+                    "\n".join(filters),
+                ]
+            )
+            counts[cl.net.listener] = counts.get(cl.net.listener, 0) + 1
+        rows.sort(key=lambda r: r[0] + r[1])
+        return rows, counts
+
+    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+        if method != "GET":
+            return "405 Method Not Allowed", b"", "text/plain"
+        self._maybe_record()
+        if path == "/information":
+            body = json.dumps(self.sys_info.clone().as_dict(), indent=2).encode()
+            return "200 OK", body, "application/json"
+        if path == "/clientsrawdata":
+            out = [
+                {
+                    "id": cl.id,
+                    "remote": cl.net.remote,
+                    "listener": cl.net.listener,
+                    "protocol_version": cl.properties.protocol_version,
+                    "clean_session": cl.properties.clean,
+                    "subscriptions": sorted(cl.state.subscriptions.get_all()),
+                    "inflight": len(cl.state.inflight),
+                    "done": cl.closed,
+                }
+                for cl in self.clients.get_all().values()
+                if cl.net.listener != "local" and cl.id != "inline"
+            ]
+            return "200 OK", json.dumps(out, indent=2).encode(), "application/json"
+        if path == "/processrecords":
+            return "200 OK", json.dumps(list(self._records), indent=2).encode(), "application/json"
+        if path == "/connections":
+            rows, counts = self._client_rows()
+            uptime = self.sys_info.uptime
+            cells = "".join(
+                "<tr>" + "".join(f"<td>{escape(c)}</td>" for c in row) + "</tr>"
+                for row in rows
+            )
+            body = (
+                "<html><head><meta charset='utf-8'>"
+                "<meta http-equiv='refresh' content='180'>"
+                "<title>mqtt_tpu connections</title>"
+                "<style>table{border-collapse:collapse}"
+                "td,th{border:1px solid #999;padding:4px 8px;font:14px monospace}"
+                "th{background:#eee}</style></head><body>"
+                f"<h2>connections</h2>"
+                f"<p>uptime: {uptime}s &mdash; {escape(self.listener_summary)}</p>"
+                f"<p>{escape('; '.join(f'{k}: {v}' for k, v in sorted(counts.items())))}</p>"
+                "<table><tr><th>username</th><th>client id</th><th>remote</th>"
+                "<th>ver</th><th>listener</th><th>#subs</th><th>filters</th></tr>"
+                f"{cells}</table></body></html>"
+            ).encode()
+            return "200 OK", body, "text/html; charset=utf-8"
+        return "404 Not Found", b"", "text/plain"

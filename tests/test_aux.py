@@ -482,3 +482,150 @@ class TestMempool:
         big = bytearray(b"123456789")
         pool.put(big)
         assert pool.get() is not big  # oversized discarded
+
+
+# -- dashboard / authfile / CLI --------------------------------------------
+
+
+class TestDashboard:
+    async def _http_get(self, host, port, path, auth=None):
+        import base64
+
+        reader, writer = await asyncio.open_connection(host, int(port))
+        hdr = f"GET {path} HTTP/1.1\r\nHost: x\r\n"
+        if auth:
+            hdr += "Authorization: Basic " + base64.b64encode(auth.encode()).decode() + "\r\n"
+        writer.write((hdr + "\r\n").encode())
+        await writer.drain()
+        data = await asyncio.wait_for(reader.readexactly(12), 3)
+        try:
+            data += await asyncio.wait_for(reader.read(262144), 3)
+        except asyncio.TimeoutError:
+            pass
+        writer.close()
+        return data
+
+    def test_endpoints_and_basic_auth(self):
+        from mqtt_tpu.listeners import Dashboard
+
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("dash-cl")
+            d = Dashboard(
+                LConfig(type="dashboard", id="d1", address="127.0.0.1:0"),
+                h.server.info,
+                h.server.clients,
+                auth={"admin": "pw"},
+                listener_summary="mqtt: test",
+            )
+            await d.init(h.server.log)
+            host, port = d.address().rsplit(":", 1)
+
+            denied = await self._http_get(host, port, "/information")
+            assert b"401" in denied.split(b"\r\n", 1)[0]
+            badpw = await self._http_get(host, port, "/information", "admin:nope")
+            assert b"401" in badpw.split(b"\r\n", 1)[0]
+
+            info = await self._http_get(host, port, "/information", "admin:pw")
+            body = json.loads(info.split(b"\r\n\r\n", 1)[1])
+            assert "clients_connected" in body
+
+            conns = await self._http_get(host, port, "/connections", "admin:pw")
+            assert b"dash-cl" in conns and b"text/html" in conns
+
+            raw = await self._http_get(host, port, "/clientsrawdata", "admin:pw")
+            assert b'"id": "dash-cl"' in raw
+
+            rec = await self._http_get(host, port, "/processrecords", "admin:pw")
+            records = json.loads(rec.split(b"\r\n\r\n", 1)[1])
+            assert records and "rss_bytes" in records[0]
+
+            missing = await self._http_get(host, port, "/nope", "admin:pw")
+            assert b"404" in missing.split(b"\r\n", 1)[0]
+            await d.close(lambda _: None)
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestObfuscation:
+    def test_roundtrip_and_passthrough(self):
+        from mqtt_tpu.utils.obfuscate import obfuscate, try_deobfuscate
+
+        for pwd in ["", "a", "hunter2", "pass:with colon", "ünïcødé"]:
+            coded = obfuscate(pwd)
+            assert coded.startswith("$MOB$") and coded != pwd
+            assert try_deobfuscate(coded) == pwd
+        assert try_deobfuscate("plaintext") == "plaintext"
+        # distinct passwords -> distinct codings
+        assert obfuscate("aaaa") != obfuscate("aaab")
+
+
+class TestAuthfile:
+    def test_sample_roundtrip(self, tmp_path):
+        from mqtt_tpu.hooks.auth.authfile import (
+            from_authfile,
+            init_authfile,
+            parse_authfile,
+        )
+        from mqtt_tpu.utils.obfuscate import obfuscate
+
+        p = tmp_path / "auth.yaml"
+        init_authfile(str(p))
+        ledger = from_authfile(str(p))
+        # disallowed sample user skipped (auth.go:56-59)
+        assert "sample-acl-user" not in ledger.users
+        assert str(ledger.users["device01"].password) == "secret01"
+        assert ledger.users["operator"].acl
+
+        coded = f"coded:\n    password: '{obfuscate('s3cret')}'\n"
+        led = parse_authfile(coded.encode(), coded_pwd=True)
+        assert str(led.users["coded"].password) == "s3cret"
+        led_plain = parse_authfile(coded.encode(), coded_pwd=False)
+        assert str(led_plain.users["coded"].password).startswith("$MOB$")
+
+
+class TestCLI:
+    def test_initauth_and_code_password(self, tmp_path, capsys):
+        from mqtt_tpu.__main__ import main
+
+        p = tmp_path / "a.yaml"
+        assert main(["initauth", str(p)]) == 0
+        assert p.exists()
+        assert main(["code-password", "hunter2"]) == 0
+        out = capsys.readouterr().out
+        assert "$MOB$" in out
+
+    def test_genecc(self, tmp_path, monkeypatch):
+        from mqtt_tpu.__main__ import main
+
+        monkeypatch.chdir(tmp_path)
+        assert main(["genecc"]) == 0
+        for f in ["root-key.ec.pem", "root.ec.pem", "cert-key.ec.pem", "cert.ec.pem"]:
+            assert (tmp_path / f).exists(), f
+
+    def test_admin_user_requires_password(self):
+        from mqtt_tpu.__main__ import main
+
+        with pytest.raises(SystemExit):
+            main(["serve", "--admin-user", "admin"])  # missing :PASS
+
+    def test_tls_port_requires_cert_and_key(self):
+        from mqtt_tpu.__main__ import build_server
+        import types
+
+        args = types.SimpleNamespace(
+            config=None, auth=None, coded_pwd=False, disable_auth=True,
+            admin_user=None, port=18999, tls_port=18998, cert=None, key=None,
+            rootca=None, ws_port=0, stats_port=0, dashboard_port=0, msg_timeout=0,
+        )
+        with pytest.raises(SystemExit):
+            build_server(args)
+
+    def test_flags_before_subcommand_survive(self, monkeypatch):
+        import mqtt_tpu.__main__ as m
+
+        captured = {}
+        monkeypatch.setattr(m, "cmd_serve", lambda a: captured.update(vars(a)) or 0)
+        assert m.main(["--port", "1999", "serve"]) == 0
+        assert captured["port"] == 1999
