@@ -16,6 +16,7 @@ from typing import Any, Optional
 
 from .hooks.auth import AllowHook, AuthHook, AuthOptions, Ledger
 from .hooks.debug import DebugHook, DebugOptions
+from .hooks.storage.logkv import LogKVOptions, LogKVStore
 from .hooks.storage.memory import MemoryStore
 from .hooks.storage.redis import RedisOptions, RedisStore
 from .hooks.storage.sqlite import SqliteOptions, SqliteStore
@@ -80,6 +81,19 @@ def _hooks_from(d: dict) -> list[tuple[Any, Any]]:
         )
     if storage.get("memory") is not None:
         hooks.append((MemoryStore(), None))
+    if storage.get("logkv") is not None:
+        cfg = storage["logkv"] or {}
+        hooks.append(
+            (
+                LogKVStore(),
+                LogKVOptions(
+                    path=cfg.get("path", "mqtt_tpu_logkv"),
+                    sync=cfg.get("sync", False),
+                    gc_interval=cfg.get("gc_interval", 300.0),
+                    gc_discard_ratio=cfg.get("gc_discard_ratio", 0.5),
+                ),
+            )
+        )
     if storage.get("redis") is not None:
         cfg = storage["redis"] or {}
         hooks.append(

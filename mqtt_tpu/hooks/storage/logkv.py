@@ -1,0 +1,206 @@
+"""Log-structured embedded KV storage hook — the analog of the reference's
+badger/pebble backends (hooks/storage/badger/badger.go, pebble/pebble.go).
+
+Bitcask-style design: every ``_set``/``_del`` appends a CRC-framed record
+to the active segment file while a full in-memory map serves reads; on
+open, segments replay in order (tolerating a torn tail record, so a crash
+mid-write loses at most that record — the same contract an LSM write-ahead
+log gives). A background GC thread mirrors the badger hook's value-log GC
+loop (badger.go:110-121): when the dead-record ratio of the log exceeds
+``gc_discard_ratio`` it compacts the live map into a fresh segment and
+deletes the old ones. ``sync=True`` fsyncs per append (the pebble hook's
+``Mode: Sync``).
+
+Record framing: ``op(1) klen(4) vlen(4) key value crc32(4)`` with crc over
+everything before it; op 1=set, 2=delete.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+import zlib
+from typing import Any, Iterable, Optional
+
+from .base import StorageHook
+
+DEFAULT_PATH = "mqtt_tpu_logkv"
+_HEADER = struct.Struct("<BII")
+_CRC = struct.Struct("<I")
+_OP_SET = 1
+_OP_DEL = 2
+
+
+class LogKVOptions:
+    def __init__(
+        self,
+        path: str = DEFAULT_PATH,
+        sync: bool = False,
+        gc_interval: float = 5 * 60.0,
+        gc_discard_ratio: float = 0.5,
+        max_segment_bytes: int = 64 * 1024 * 1024,
+    ) -> None:
+        self.path = path
+        self.sync = sync
+        self.gc_interval = gc_interval
+        self.gc_discard_ratio = gc_discard_ratio
+        self.max_segment_bytes = max_segment_bytes
+
+
+def _segments(path: str) -> list[str]:
+    names = [n for n in os.listdir(path) if n.startswith("seg") and n.endswith(".log")]
+    return sorted(names)
+
+
+class LogKVStore(StorageHook):
+    """Mirrors broker state into an append-only segmented log."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.config = LogKVOptions()
+        self._map: dict[str, bytes] = {}
+        self._lock = threading.RLock()
+        self._file = None
+        self._seg_seq = 0
+        self._live_bytes = 0  # payload bytes of live records
+        self._total_bytes = 0  # payload bytes appended since last compaction
+        self._stop_gc = threading.Event()
+        self._gc_thread: Optional[threading.Thread] = None
+
+    def id(self) -> str:
+        return "logkv-db"
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def init(self, config: Any) -> None:
+        if config is not None and not isinstance(config, LogKVOptions):
+            raise TypeError("invalid config type provided")
+        self.config = config or LogKVOptions()
+        os.makedirs(self.config.path, exist_ok=True)
+        with self._lock:
+            for name in _segments(self.config.path):
+                self._replay(os.path.join(self.config.path, name))
+                self._seg_seq = max(self._seg_seq, int(name[3:-4]) + 1)
+            self._live_bytes = sum(len(k) + len(v) for k, v in self._map.items())
+            self._open_segment()
+        if self.config.gc_interval > 0:
+            self._gc_thread = threading.Thread(
+                target=self._gc_loop, name="mqtt-tpu-logkv-gc", daemon=True
+            )
+            self._gc_thread.start()
+
+    def stop(self) -> None:
+        self._stop_gc.set()
+        if self._gc_thread is not None:
+            self._gc_thread.join(timeout=5)
+            self._gc_thread = None
+        with self._lock:
+            if self._file is not None:
+                self._file.flush()
+                os.fsync(self._file.fileno())
+                self._file.close()
+                self._file = None
+
+    # -- log machinery -------------------------------------------------------
+
+    def _open_segment(self) -> None:
+        name = f"seg{self._seg_seq:06d}.log"
+        self._seg_seq += 1
+        self._file = open(os.path.join(self.config.path, name), "ab")
+
+    def _replay(self, filepath: str) -> None:
+        """Apply one segment's records to the in-memory map; stop at the
+        first torn or corrupt record (crash tolerance)."""
+        with open(filepath, "rb") as f:
+            data = f.read()
+        pos = 0
+        while pos + _HEADER.size + _CRC.size <= len(data):
+            op, klen, vlen = _HEADER.unpack_from(data, pos)
+            end = pos + _HEADER.size + klen + vlen
+            if op not in (_OP_SET, _OP_DEL) or end + _CRC.size > len(data):
+                break
+            (crc,) = _CRC.unpack_from(data, end)
+            if crc != zlib.crc32(data[pos:end]):
+                break
+            key = data[pos + _HEADER.size : pos + _HEADER.size + klen].decode("utf-8")
+            if op == _OP_SET:
+                self._map[key] = data[pos + _HEADER.size + klen : end]
+            else:
+                self._map.pop(key, None)
+            pos = end + _CRC.size
+
+    def _append(self, op: int, key: str, value: bytes) -> None:
+        kb = key.encode("utf-8")
+        rec = _HEADER.pack(op, len(kb), len(value)) + kb + value
+        rec += _CRC.pack(zlib.crc32(rec))
+        self._file.write(rec)
+        if self.config.sync:
+            self._file.flush()
+            os.fsync(self._file.fileno())
+        self._total_bytes += len(kb) + len(value)
+        if self._file.tell() >= self.config.max_segment_bytes:
+            self._file.flush()
+            self._file.close()
+            self._open_segment()
+
+    # -- gc / compaction -----------------------------------------------------
+
+    def _gc_loop(self) -> None:
+        while not self._stop_gc.wait(self.config.gc_interval):
+            try:
+                self.compact(self.config.gc_discard_ratio)
+            except Exception:
+                self.log.exception("logkv gc failed; will retry")
+
+    def compact(self, discard_ratio: float = 0.0) -> bool:
+        """Rewrite the live map into a fresh segment when the dead ratio
+        exceeds ``discard_ratio``; returns True if compaction ran."""
+        with self._lock:
+            if self._file is None:
+                return False
+            dead = self._total_bytes - self._live_bytes
+            if self._total_bytes == 0 or dead / max(1, self._total_bytes) < discard_ratio:
+                return False
+            old = _segments(self.config.path)
+            self._file.flush()
+            self._file.close()
+            self._open_segment()
+            for key, value in self._map.items():
+                self._append(_OP_SET, key, value)
+            self._file.flush()
+            os.fsync(self._file.fileno())
+            for name in old:
+                os.unlink(os.path.join(self.config.path, name))
+            self._total_bytes = self._live_bytes
+            return True
+
+    # -- KV interface --------------------------------------------------------
+
+    def _set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            if self._file is None:
+                self.log.error("logkv store not open")
+                return
+            prev = self._map.get(key)
+            self._map[key] = value
+            self._live_bytes += len(value) - (len(prev) if prev is not None else -len(key))
+            self._append(_OP_SET, key, value)
+
+    def _get(self, key: str) -> Optional[bytes]:
+        with self._lock:
+            return self._map.get(key)
+
+    def _del(self, key: str) -> None:
+        with self._lock:
+            if self._file is None:
+                self.log.error("logkv store not open")
+                return
+            prev = self._map.pop(key, None)
+            if prev is not None:
+                self._live_bytes -= len(key) + len(prev)
+            self._append(_OP_DEL, key, b"")
+
+    def _iter(self, prefix: str) -> Iterable[bytes]:
+        with self._lock:
+            return [v for k, v in self._map.items() if k.startswith(prefix)]
