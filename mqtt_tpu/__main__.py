@@ -178,6 +178,14 @@ def build_server(args) -> Server:
             if args.admin_user:
                 user, _, pwd = args.admin_user.partition(":")
                 auth_map[user] = pwd
+            else:
+                # the dashboard exposes client ids, usernames, remote IPs and
+                # subscription filters — never serve it unauthenticated (the
+                # reference fork's dashboard is always credentialed)
+                raise SystemExit(
+                    "--dashboard-port requires --admin-user USER:PASS "
+                    "(the dashboard exposes connected-client details)"
+                )
             server.add_listener(
                 Dashboard(
                     ListenerConfig(type="dashboard", id="web", address=f":{args.dashboard_port}"),
@@ -258,7 +266,13 @@ def main(argv=None) -> int:
 
         arg("--config", help="path to a YAML/JSON config file")
         arg("--auth", help="path to a YAML authfile")
-        arg("--coded-pwd", action="store_true", help="authfile passwords are obfuscated")
+        arg(
+            "--coded-pwd",
+            action="store_true",
+            help="authfile passwords are obfuscated with THIS tool's "
+            "code-password subcommand ($MOB$ scheme; NOT compatible with "
+            "the Go fork's toolbox CodeString format)",
+        )
         arg("--disable-auth", action="store_true", help="allow all clients")
         arg("--admin-user", help="USER:PASS granted broker + dashboard access")
         arg("--port", type=int, default=1883, help="MQTT TCP port")

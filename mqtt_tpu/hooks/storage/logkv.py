@@ -128,6 +128,10 @@ class LogKVStore(StorageHook):
                 self._map[key] = data[pos + _HEADER.size + klen : end]
             else:
                 self._map.pop(key, None)
+            # count every replayed record (set AND del) so dead-bytes
+            # accounting survives a restart — otherwise pre-existing garbage
+            # never triggers GC until fresh appends re-accumulate
+            self._total_bytes += klen + vlen
             pos = end + _CRC.size
 
     def _append(self, op: int, key: str, value: bytes) -> None:

@@ -14,12 +14,12 @@ import collections
 import hmac
 import json
 import logging
-import resource
 import threading
 import time
 from html import escape
 
 from ..system import Info
+from ..utils.proc import rss_bytes
 from . import Config, EstablishFn, StreamListener, split_host_port
 
 
@@ -142,11 +142,10 @@ class Dashboard(_HttpListener):
         if now - self._last_record < self.record_interval and self._records:
             return
         self._last_record = now
-        usage = resource.getrusage(resource.RUSAGE_SELF)
         self._records.append(
             {
                 "time": int(now),
-                "rss_bytes": usage.ru_maxrss * 1024,
+                "rss_bytes": rss_bytes(),
                 "threads": threading.active_count(),
                 "clients_connected": self.sys_info.clients_connected,
                 "messages_received": self.sys_info.messages_received,
@@ -166,7 +165,16 @@ class Dashboard(_HttpListener):
                     user, _, pwd = userpass.partition(":")
                 except Exception:
                     return False
-                return hmac.compare_digest(self.auth.get(user, ""), pwd)
+                # membership must be explicit: a missing user must NOT fall
+                # through to comparing against "" (which would authorize any
+                # username with an empty password); bytes also keep
+                # compare_digest safe for non-ASCII credentials
+                expected = self.auth.get(user)
+                return (
+                    expected is not None
+                    and expected != ""
+                    and hmac.compare_digest(expected.encode(), pwd.encode())
+                )
         return False
 
     def _client_rows(self) -> tuple[list[list[str]], dict[str, int]]:

@@ -20,7 +20,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 try:
     from jax import shard_map as _shard_map
@@ -113,9 +113,13 @@ class ShardedTpuMatcher:
 
         max_degree = max(c.max_degree for c in csrs)
         self._search_iters = max(1, int(np.ceil(np.log2(max(2, max_degree + 1)))) + 1)
-        # convert to device arrays ONCE here; per-batch calls reuse them
+        # place every stacked array on the mesh ONCE, leading (shard) dim
+        # split over the ``subs`` axis — an explicit NamedSharding, NOT a
+        # default-device jnp.asarray, so no other backend (e.g. a real TPU
+        # when the mesh is a virtual CPU one) is ever touched
+        shard_sharding = NamedSharding(self.mesh, P("subs"))
         self._arrays = tuple(
-            jnp.asarray(a)
+            jax.device_put(np.asarray(a), shard_sharding)
             for a in (
                 stack(lambda c: c.edge_ptr, min_len=2),
                 stack(lambda c: c.edge_tok1.astype(np.uint32)),
@@ -188,9 +192,13 @@ class ShardedTpuMatcher:
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
             padded, self.max_levels, self.shard_salts[0]
         )
+        batch_sharding = NamedSharding(self.mesh, P("batch"))
         out, totals, overflow = self._step(
             *self._arrays,
-            jnp.asarray(tok1), jnp.asarray(tok2), jnp.asarray(lengths), jnp.asarray(is_dollar),
+            *(
+                jax.device_put(np.asarray(a), batch_sharding)
+                for a in (tok1, tok2, lengths, is_dollar)
+            ),
         )
         out = np.asarray(out)  # [S, B, K]
         overflow = np.asarray(overflow).any(axis=0) | len_overflow  # [B]
@@ -221,9 +229,31 @@ def dryrun_multichip(n_devices: int) -> None:
     one step on tiny shapes. The driver invokes this on a virtual CPU mesh
     to validate the multi-chip path without hardware."""
     # The environment may pin a single-accelerator default platform (e.g.
-    # one real TPU). Provision n virtual CPU devices BEFORE the first
-    # backend query — clients for every platform (incl. cpu) are created on
-    # the first jax.devices() call and read their config at that point.
+    # one real TPU) whose plugin may not even be healthy in the driver
+    # sandbox. The dryrun must never touch any non-CPU backend: pin the
+    # platform to cpu (both the env var and the live config) and provision
+    # n virtual CPU devices BEFORE the first backend query — clients read
+    # their config at first use.
+    import os
+
+    prior_platforms = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass  # backend already initialized; the cpu query below still tries
+    try:
+        _dryrun_body(n_devices)
+    finally:
+        # the in-process pin is unavoidably sticky once jax initializes, but
+        # the env mutation must not leak into child processes spawned later
+        if prior_platforms is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prior_platforms
+
+
+def _dryrun_body(n_devices: int) -> None:
     import os
     import re
 
@@ -250,9 +280,25 @@ def dryrun_multichip(n_devices: int) -> None:
                 r"--xla_force_host_platform_device_count=\d+", new_flag, flags
             ) if m else f"{flags} {new_flag}".strip()
             os.environ["XLA_FLAGS"] = flags
-    devices = jax.devices()
-    if len(devices) < n_devices:
+    # query ONLY the cpu backend — a bare jax.devices() initializes every
+    # registered platform plugin, which is exactly the failure mode in a
+    # TPU-unhealthy driver environment (MULTICHIP_r01)
+    try:
         devices = jax.devices("cpu")
+    except RuntimeError:
+        # backends already initialized under a platform set without cpu
+        devices = []
+    if len(devices) < n_devices:
+        # last resort, for a host whose backends were already initialized
+        # before this call (so CPU provisioning couldn't apply) but which
+        # has n real accelerators: run on those. Never reached when the CPU
+        # provisioning above succeeded, so the driver path stays CPU-only.
+        try:
+            all_devices = jax.devices()
+            if len(all_devices) >= n_devices:
+                devices = all_devices
+        except Exception:
+            pass
     if len(devices) < n_devices:
         raise RuntimeError(
             f"need {n_devices} devices, have {len(devices)}"

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import asyncio
 import logging
-import resource
 import threading
 import time
 from dataclasses import dataclass, field, replace
@@ -80,6 +79,7 @@ from .packets import (
     Subscription,
 )
 from .system import Info
+from .utils.proc import rss_bytes
 from .topics import (
     SYS_PREFIX,
     InlineSubFn,
@@ -1157,7 +1157,7 @@ class Server:
     def publish_sys_topics(self) -> None:
         """Publish retained $SYS values (server.go:1442-1492)."""
         now = int(time.time())
-        self.info.memory_alloc = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        self.info.memory_alloc = rss_bytes()
         self.info.threads = threading.active_count()
         self.info.time = now
         self.info.uptime = now - self.info.started

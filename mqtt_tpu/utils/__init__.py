@@ -1,5 +1,6 @@
 """Shared host-side utilities."""
 
 from .locked import LockedMap
+from .proc import rss_bytes
 
-__all__ = ["LockedMap"]
+__all__ = ["LockedMap", "rss_bytes"]

@@ -27,6 +27,11 @@ def obfuscate(plain: str) -> str:
     return _MARK + coded.rstrip("=")
 
 
+def is_obfuscated(value: str) -> bool:
+    """True when ``value`` carries the obfuscation marker."""
+    return value.startswith(_MARK)
+
+
 def try_deobfuscate(value: str) -> str:
     """Decode an obfuscated password; plain strings pass through."""
     if not value.startswith(_MARK):

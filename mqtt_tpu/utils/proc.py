@@ -1,0 +1,19 @@
+"""Process introspection helpers shared by $SYS stats and the dashboard."""
+
+from __future__ import annotations
+
+import sys
+
+
+def rss_bytes() -> int:
+    """Resident-set high-water mark of this process, in bytes.
+
+    ``ru_maxrss`` is KiB on Linux but bytes on macOS; ``resource`` does not
+    exist on Windows (where this returns 0 rather than breaking import).
+    """
+    try:
+        import resource
+    except ImportError:  # pragma: no cover - Windows
+        return 0
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    return usage.ru_maxrss * (1 if sys.platform == "darwin" else 1024)

@@ -542,6 +542,33 @@ class TestDashboard:
 
             missing = await self._http_get(host, port, "/nope", "admin:pw")
             assert b"404" in missing.split(b"\r\n", 1)[0]
+
+            # regression (ADVICE r1): unknown user + empty password must NOT
+            # authorize (the get(user, "") == "" bypass)
+            bypass = await self._http_get(host, port, "/information", "ghost:")
+            assert b"401" in bypass.split(b"\r\n", 1)[0]
+            colonless = await self._http_get(host, port, "/information", "ghost")
+            assert b"401" in colonless.split(b"\r\n", 1)[0]
+            await d.close(lambda _: None)
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_empty_configured_password_never_authorizes(self):
+        from mqtt_tpu.listeners import Dashboard
+
+        async def scenario():
+            h = Harness()
+            d = Dashboard(
+                LConfig(type="dashboard", id="d2", address="127.0.0.1:0"),
+                h.server.info,
+                h.server.clients,
+                auth={"admin": ""},
+            )
+            await d.init(h.server.log)
+            host, port = d.address().rsplit(":", 1)
+            r = await self._http_get(host, port, "/information", "admin:")
+            assert b"401" in r.split(b"\r\n", 1)[0]
             await d.close(lambda _: None)
             await h.shutdown()
 
