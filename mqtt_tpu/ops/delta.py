@@ -41,7 +41,7 @@ import threading
 from typing import Optional
 
 from ..packets import Subscription
-from ..topics import InlineSubscription, Subscribers, TopicsIndex
+from ..topics import InlineSubscription, Mutation, Subscribers, TopicsIndex
 from .matcher import TpuMatcher
 
 _DELTA_CLIENT = "\x00delta"  # mini-trie marker client; never a real client id
@@ -59,6 +59,19 @@ class _Snapshot(TpuMatcher):
     @property
     def stale(self) -> bool:  # noqa: D401 - see class docstring
         return False
+
+
+def _sharded_snapshot_cls():
+    """The mesh-sharded analog of _Snapshot (imported lazily: mqtt_tpu.ops
+    must not pull jax.sharding machinery unless a mesh is actually used)."""
+    from ..parallel.sharded import ShardedTpuMatcher
+
+    class _ShardedSnapshot(ShardedTpuMatcher):
+        @property
+        def stale(self) -> bool:
+            return False
+
+    return _ShardedSnapshot
 
 
 class _Gen:
@@ -117,6 +130,11 @@ class DeltaMatcher:
     background:
         When True (default), rebuilds run on a daemon thread; when False,
         call :meth:`flush` to recompile synchronously (tests, benchmarks).
+    mesh:
+        When given, the snapshot is a mesh-sharded matcher
+        (``mqtt_tpu.parallel.ShardedTpuMatcher``) whose incremental rebuild
+        recompiles only the shards touched since the last fold — the same
+        overlay correctness story at per-shard rebuild cost.
     """
 
     def __init__(
@@ -128,6 +146,8 @@ class DeltaMatcher:
         rebuild_after: int = 1024,
         rebuild_interval: float = 1.0,
         background: bool = True,
+        mesh=None,
+        transfer_slots: Optional[int] = None,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
@@ -141,8 +161,23 @@ class DeltaMatcher:
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        snap = _Snapshot(topics, max_levels, frontier, out_slots)
+        # ONE snapshot matcher reused across generations: both matcher kinds
+        # swap their compiled state atomically, and the sharded one folds
+        # deltas incrementally (per-shard) instead of recompiling the world
+        if mesh is not None:
+            snap = _sharded_snapshot_cls()(
+                topics,
+                mesh=mesh,
+                max_levels=max_levels,
+                frontier=frontier,
+                out_slots=out_slots,
+            )
+        else:
+            snap = _Snapshot(
+                topics, max_levels, frontier, out_slots, transfer_slots=transfer_slots
+            )
         snap.rebuild()
+        self._snap = snap
         self._gen = _Gen(snap, [])
         topics.add_observer(self._on_mutation)
         if background:
@@ -151,12 +186,17 @@ class DeltaMatcher:
             )
             self._thread.start()
 
+    @property
+    def stats(self):
+        """The underlying matcher's observability counters."""
+        return self._snap.stats
+
     # -- delta stream --------------------------------------------------------
 
-    def _on_mutation(self, filter: str, kind: str) -> None:
+    def _on_mutation(self, m: Mutation) -> None:
         with self._lock:
             gen = self._gen
-            gen.record(filter, kind)
+            gen.record(m.filter, m.kind)
             pending = len(gen.deltas)
         if pending >= self.rebuild_after:
             self._wake.set()
@@ -168,22 +208,21 @@ class DeltaMatcher:
 
     # -- rebuild -------------------------------------------------------------
 
-    def _build_snapshot(self) -> _Snapshot:
-        """Compile the live trie without holding its lock; concurrent
-        structural mutations can tear the walk (RuntimeError from a mutated
-        dict iteration, KeyError from a node inserted mid-walk), in which
-        case retry — every mutation racing the walk is in the delta overlay,
-        so a successful walk is always safe to serve."""
-        snap = _Snapshot(self.topics, self.max_levels, self.frontier, self.out_slots)
+    def _rebuild_snapshot(self) -> None:
+        """Fold the live trie into the snapshot without holding its lock;
+        concurrent structural mutations can tear the walk (RuntimeError from
+        a mutated dict iteration, KeyError from a node inserted mid-walk),
+        in which case retry — every mutation racing the walk is in the delta
+        overlay, so a successful walk is always safe to serve. The sharded
+        snapshot handles tears internally, so its rebuild succeeds first try."""
         for _ in range(8):
             try:
-                snap.rebuild()
-                return snap
+                self._snap.rebuild()
+                return
             except (RuntimeError, KeyError):
                 continue
         with self.topics._lock:  # mutation storm: build quiesced
-            snap.rebuild()
-        return snap
+            self._snap.rebuild()
 
     def _rebuild_once(self) -> None:
         with self._rebuild_lock:
@@ -192,11 +231,11 @@ class DeltaMatcher:
                 k = len(old.deltas)
             if k == 0:
                 return
-            snap = self._build_snapshot()
+            self._rebuild_snapshot()
             with self._lock:
                 # mutations that raced the walk (appended after index k)
                 # might be missing from the new snapshot: carry them over
-                self._gen = _Gen(snap, old.deltas[k:])
+                self._gen = _Gen(self._snap, old.deltas[k:])
 
     def flush(self) -> None:
         """Synchronously fold all pending deltas into a fresh snapshot."""
@@ -221,6 +260,8 @@ class DeltaMatcher:
 
     def close(self) -> None:
         self.topics.remove_observer(self._on_mutation)
+        if hasattr(self._snap, "close"):
+            self._snap.close()  # detach the sharded snapshot's own observer
         self._stop.set()
         self._wake.set()
         if self._thread is not None:
@@ -228,10 +269,15 @@ class DeltaMatcher:
 
     # -- matching ------------------------------------------------------------
 
+    def match_topics_async(self, topics: list[str]):
+        """Issue one batch; the returned resolver yields the results.
+        The generation (snapshot + overlay) is captured at issue time."""
+        gen = self._gen  # atomic read: one generation per call
+        return gen.snap.match_topics_async(topics, route_to_host=gen.affected)
+
     def match_topics(self, topics: list[str]) -> list[Subscribers]:
         """Match a batch of topics, bit-identical to the live host trie."""
-        gen = self._gen  # atomic read: one generation per call
-        return gen.snap.match_topics(topics, route_to_host=gen.affected)
+        return self.match_topics_async(topics)()
 
     def subscribers(self, topic: str) -> Subscribers:
         """Drop-in for ``TopicsIndex.subscribers`` (batch of one)."""

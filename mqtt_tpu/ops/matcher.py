@@ -23,6 +23,7 @@ choice.
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass
 from functools import partial
 from typing import Optional
@@ -88,6 +89,38 @@ class MatchResult:
     sub_ids: np.ndarray  # int32[B,K], -1 padded / $-masked
     counts: np.ndarray  # int32[B] — total gathered (pre-$-mask)
     overflow: np.ndarray  # bool[B] — frontier/output/level overflow
+
+
+@dataclass
+class MatcherStats:
+    """Observability counters for a device matcher (SURVEY §5 tracing note).
+
+    ``host_fallbacks`` counts topics re-walked on the host for any reason;
+    ``overflows`` counts the subset caused by frontier/output/level overflow
+    (the rest are delta-overlay routes). Exported as ``$SYS/broker/matcher``
+    values by the server when a device matcher is active.
+    """
+
+    batches: int = 0
+    topics: int = 0
+    host_fallbacks: int = 0
+    overflows: int = 0
+    rebuilds: int = 0
+    rebuild_seconds: float = 0.0
+
+    def as_dict(self) -> dict:
+        out = {
+            "batches": self.batches,
+            "topics": self.topics,
+            "host_fallbacks": self.host_fallbacks,
+            "overflows": self.overflows,
+            "rebuilds": self.rebuilds,
+            "rebuild_seconds": round(self.rebuild_seconds, 3),
+        }
+        out["fallback_ratio"] = (
+            round(self.host_fallbacks / self.topics, 6) if self.topics else 0.0
+        )
+        return out
 
 
 def match_core(
@@ -236,6 +269,64 @@ match_batch = partial(
 )(match_core)
 
 
+def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
+    """Pack a tokenized batch into ONE int32 host array ``[B, 2L+2]`` so a
+    match call performs a single H2D transfer. Every individual transfer
+    pays the link round trip (65ms+ on tunneled devices), so four small
+    arrays per call would quadruple the e2e wall."""
+    return np.concatenate(
+        [
+            tok1.view(np.int32),
+            tok2.view(np.int32),
+            lengths[:, None].astype(np.int32),
+            is_dollar[:, None].astype(np.int32),
+        ],
+        axis=1,
+    )
+
+
+@partial(
+    jax.jit,
+    static_argnames=("frontier", "out_slots", "search_iters", "transfer_slots"),
+)
+def match_batch_packed(*args, frontier, out_slots, search_iters, transfer_slots):
+    """match_core with ONE packed input transfer and ONE packed output
+    transfer per batch.
+
+    Input: the CSR arrays plus a single ``[B, 2L+2]`` int32 token block
+    from :func:`pack_tokens` (bitcast back to uint32 device-side). Output:
+    ``[B, transfer_slots+2]`` int32 = (sid prefix | total | overflow).
+    Host↔device links with high per-transfer cost (PCIe round trips;
+    worse, tunneled devices) make per-array transfers the dominant e2e
+    cost; topics whose match count exceeds the transferred prefix are
+    re-walked on host, so any ``transfer_slots`` preserves bit-identical
+    results."""
+    *csr_args, packed_tokens = args
+    L = (packed_tokens.shape[1] - 2) // 2
+    tok1 = jax.lax.bitcast_convert_type(packed_tokens[:, :L], jnp.uint32)
+    tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
+    lengths = packed_tokens[:, 2 * L]
+    is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
+    out, totals, overflow = match_core(
+        *csr_args,
+        tok1,
+        tok2,
+        lengths,
+        is_dollar,
+        frontier=frontier,
+        out_slots=out_slots,
+        search_iters=search_iters,
+    )
+    return jnp.concatenate(
+        [
+            out[:, :transfer_slots],
+            totals[:, None].astype(jnp.int32),
+            overflow[:, None].astype(jnp.int32),
+        ],
+        axis=1,
+    )
+
+
 class TpuMatcher:
     """Broker-facing device matcher: compiles the host trie to CSR, matches
     batches on device, merges results host-side, and falls back to the host
@@ -248,15 +339,22 @@ class TpuMatcher:
         max_levels: int = 8,
         frontier: int = 16,
         out_slots: int = 64,
+        transfer_slots: Optional[int] = None,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
         self.frontier = frontier
         self.out_slots = out_slots
-        self.csr: Optional[CsrIndex] = None
-        self._device_arrays = None
-        self._built_version = -1
-        self._search_iters = 1
+        # how many sid slots come back per topic in the single packed D2H;
+        # topics with more matches (but no device overflow) re-walk on host.
+        # Smaller values trade rare host walks for less D2H traffic — the
+        # dominant e2e cost on high-latency host<->device links.
+        self.transfer_slots = min(transfer_slots or out_slots, out_slots)
+        self.stats = MatcherStats()
+        # one (csr, device_arrays, search_iters, built_version) tuple,
+        # swapped atomically by rebuild() so a concurrent match never mixes
+        # arrays and salt from different generations
+        self._state: Optional[tuple] = None
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -270,6 +368,7 @@ class TpuMatcher:
         CSR ranges are empty and no edge points at them) and padded edge /
         id slots sit beyond every node's pointer range.
         """
+        t0 = time.perf_counter()
         version = self.topics.version
         csr = build_csr(self.topics)
         n = csr.num_nodes
@@ -289,8 +388,8 @@ class TpuMatcher:
         top_wild = _pad_to(csr.top_wild, _bucket(len(csr.subs)), False)
         # round the binary-search depth up so it, too, changes rarely
         iters = max(1, math.ceil(math.log2(max(2, csr.max_degree + 1))) + 1)
-        self._search_iters = min(32, math.ceil(iters / 4) * 4)
-        self._device_arrays = tuple(
+        search_iters = min(32, math.ceil(iters / 4) * 4)
+        device_arrays = tuple(
             jnp.asarray(a)
             for a in (
                 edge_ptr,
@@ -306,39 +405,105 @@ class TpuMatcher:
                 top_wild,
             )
         )
-        self.csr = csr
-        self._built_version = version
+        self._state = (csr, device_arrays, search_iters, version)
+        self.stats.rebuilds += 1
+        self.stats.rebuild_seconds += time.perf_counter() - t0
+
+    @property
+    def csr(self) -> Optional[CsrIndex]:
+        st = self._state
+        return st[0] if st is not None else None
 
     @property
     def stale(self) -> bool:
-        return self._built_version != self.topics.version
+        st = self._state
+        return st is None or st[3] != self.topics.version
 
     @property
     def device_arrays(self) -> tuple:
         """The CSR index as device arrays (built on demand)."""
-        if self._device_arrays is None or self.stale:
+        if self._state is None or self.stale:
             self.rebuild()
-        return self._device_arrays
+        return self._state[1]
 
     @property
     def search_iters(self) -> int:
-        return self._search_iters
+        st = self._state
+        return st[2] if st is not None else 1
 
     def match_tokens(self, tok1, tok2, lengths, is_dollar):
         """Raw device match over pre-tokenized topics; returns device
         ``(sub_ids[B,K], totals[B], overflow[B])``. The benchmark path."""
+        if self._state is None or self.stale:
+            self.rebuild()
+        _, arrays, search_iters, _ = self._state
         return match_batch(
-            *self.device_arrays,
+            *arrays,
             tok1,
             tok2,
             lengths,
             is_dollar,
             frontier=self.frontier,
             out_slots=self.out_slots,
-            search_iters=self._search_iters,
+            search_iters=search_iters,
         )
 
     # -- matching ----------------------------------------------------------
+
+    def match_topics_async(self, topics: list[str], route_to_host=None):
+        """Issue one device match batch and return a zero-arg resolver.
+
+        The device call is dispatched asynchronously (JAX async dispatch);
+        calling the resolver performs the D2H sync and the host-side
+        expansion, returning ``list[Subscribers]``. Keeping a second batch
+        in flight while the first resolves hides the host<->device round
+        trip — the broker's staging loop and the benchmark both rely on it.
+        """
+        if self._state is None or self.stale:
+            self.rebuild()
+        csr, arrays, search_iters, _ = self._state
+        ts = self.transfer_slots
+        tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
+            topics, self.max_levels, csr.salt
+        )
+        packed_dev = match_batch_packed(
+            *arrays,
+            jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
+            frontier=self.frontier,
+            out_slots=self.out_slots,
+            search_iters=search_iters,
+            transfer_slots=ts,
+        )
+
+        def resolve() -> list[Subscribers]:
+            packed = np.asarray(packed_dev)  # ONE D2H: [B, ts+2]
+            out = packed[:, :ts]
+            totals = packed[:, ts]
+            # host route: device overflow, >max_levels topics, or more
+            # matches than the transferred prefix carries
+            overflow = packed[:, ts + 1].astype(bool) | len_overflow
+            host_route = overflow | (totals > ts)
+            results = []
+            stats = self.stats
+            stats.batches += 1
+            stats.topics += len(topics)
+            for i, topic in enumerate(topics):
+                if not topic:
+                    results.append(Subscribers())  # empty topic never matches
+                elif host_route[i] or (
+                    route_to_host is not None and route_to_host(topic)
+                ):
+                    stats.host_fallbacks += 1
+                    stats.overflows += int(overflow[i])
+                    results.append(self.topics.subscribers(topic))  # host fallback
+                else:
+                    row = out[i]
+                    results.append(
+                        expand_sids(csr.subs, row[row >= 0], Subscribers())
+                    )
+            return results
+
+        return resolve
 
     def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
         """Match a batch of topics; every result is bit-identical to the
@@ -348,36 +513,8 @@ class TpuMatcher:
         (the delta overlay's affected-check in mqtt_tpu.ops.delta); the
         host path is always correct, so any predicate preserves parity.
         """
-        if self.csr is None or self.stale:
-            self.rebuild()
-        tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
-            topics, self.max_levels, self.csr.salt
-        )
-        out, totals, overflow = match_batch(
-            *self._device_arrays,
-            jnp.asarray(tok1),
-            jnp.asarray(tok2),
-            jnp.asarray(lengths),
-            jnp.asarray(is_dollar),
-            frontier=self.frontier,
-            out_slots=self.out_slots,
-            search_iters=self._search_iters,
-        )
-        out = np.asarray(out)
-        overflow = np.asarray(overflow) | len_overflow
-        results = []
-        for i, topic in enumerate(topics):
-            if not topic:
-                results.append(Subscribers())  # empty topic never matches
-            elif overflow[i] or (route_to_host is not None and route_to_host(topic)):
-                results.append(self.topics.subscribers(topic))  # host fallback
-            else:
-                results.append(self._expand(out[i]))
-        return results
+        return self.match_topics_async(topics, route_to_host)()
 
     def subscribers(self, topic: str) -> Subscribers:
         """Drop-in for ``TopicsIndex.subscribers`` (batch of one)."""
         return self.match_topics([topic])[0]
-
-    def _expand(self, sids: np.ndarray) -> Subscribers:
-        return expand_sids(self.csr.subs, sids, Subscribers())

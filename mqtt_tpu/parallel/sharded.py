@@ -11,11 +11,23 @@ One jitted step matches every (topic-shard, sub-shard) tile locally and
 every batch row ends with the full union of sub ids. The host maps local
 sub ids through per-shard tables and merges — bit-identical to the
 single-device matcher, which is bit-identical to the host trie.
+
+Shard assignment is a stable hash of (client, filter) — NOT round-robin
+over enumeration order — so one subscription mutation touches exactly one
+shard. The matcher keeps a per-shard replica ``TopicsIndex`` maintained
+from the trie's mutation stream (``TopicsIndex.add_observer``), marks the
+owning shard dirty, and an incremental ``rebuild()`` recompiles only dirty
+shards: cost per mutation is bounded by one shard (~1/S of the index)
+instead of the full index (reference mutation semantics: topics.go:479-522).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import logging
+import threading
+import time
+import zlib
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,10 +50,12 @@ def shard_map(*args, disable_rep_check=False, **kwargs):
     return _shard_map(*args, **kwargs)
 
 from ..packets import Subscription
-from ..topics import Subscribers, TopicsIndex
-from ..ops.csr import KIND_CLIENT, KIND_SHARED, build_csr
+from ..topics import Mutation, Subscribers, TopicsIndex
+from ..ops.csr import KIND_CLIENT, KIND_INLINE, KIND_SHARED, build_csr
 from ..ops.hashing import tokenize_topics
-from ..ops.matcher import _pad_to, expand_sids, match_core
+from ..ops.matcher import MatcherStats, _bucket, _pad_to, expand_sids, match_core
+
+_log = logging.getLogger("mqtt_tpu.parallel")
 
 
 def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
@@ -55,9 +69,26 @@ def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
     return Mesh(grid, ("batch", "subs"))
 
 
+def shard_of(kind, client: str, filter: str, identifier: int, n_shards: int) -> int:
+    """Stable shard assignment: a deterministic hash of the subscription's
+    identity, independent of enumeration order or churn history — so the
+    same subscription always lands on the same shard and a mutation dirties
+    exactly one shard."""
+    if kind in (KIND_INLINE, "inline"):
+        key = f"\x00inline\x00{identifier}\x00{filter}"
+    else:
+        key = f"{client}\x00{filter}"
+    return zlib.crc32(key.encode("utf-8", "surrogatepass")) % n_shards
+
+
 class ShardedTpuMatcher:
     """Shards a TopicsIndex's subscriptions across the ``subs`` mesh axis
-    and matches topic batches with one SPMD step."""
+    and matches topic batches with one SPMD step.
+
+    With ``incremental=True`` (default) the matcher subscribes to the
+    trie's mutation stream and ``rebuild()`` recompiles only the shards
+    whose subscriptions changed; call :meth:`close` to detach the observer.
+    """
 
     def __init__(
         self,
@@ -66,6 +97,7 @@ class ShardedTpuMatcher:
         max_levels: int = 8,
         frontier: int = 16,
         out_slots: int = 64,
+        incremental: bool = True,
     ) -> None:
         self.topics = topics
         self.mesh = mesh or make_mesh()
@@ -74,51 +106,187 @@ class ShardedTpuMatcher:
         self.out_slots = out_slots
         self.n_shards = self.mesh.shape["subs"]
         self.n_batch = self.mesh.shape["batch"]
-        self.shard_tables: list[list] = []
-        self.shard_salts: list[int] = []
-        self._arrays: Optional[tuple] = None
-        self._step = None
+        self.incremental = incremental
+        self.stats = MatcherStats()
+        # one (arrays, tables, salt, search_iters, step) tuple swapped
+        # atomically so a concurrent match never mixes generations
+        self._compiled: Optional[tuple] = None
         self._built_version = -1
-        self._search_iters = 4
+        # per-shard replica tries + their last compiled CSRs + dirty flags;
+        # guarded by _state_lock (held briefly — the observer runs under the
+        # main trie's lock, so installs must never block on slow work)
+        self._state_lock = threading.Lock()
+        self._replicas: Optional[list[TopicsIndex]] = None
+        self._csrs: Optional[list] = None
+        self._dirty = [False] * self.n_shards
+        self._salt = 0
+        self._step_cache: dict[int, Callable] = {}
+        if incremental:
+            topics.add_observer(self._on_mutation)
+
+    def close(self) -> None:
+        """Detach from the trie's mutation stream."""
+        self.topics.remove_observer(self._on_mutation)
+
+    # -- delta stream --------------------------------------------------------
+
+    def _on_mutation(self, m: Mutation) -> None:
+        """Apply one trie mutation to the owning shard's replica and mark it
+        dirty. Called under the main trie's lock — must stay fast and must
+        never raise into the broker's subscribe path."""
+        with self._state_lock:
+            reps = self._replicas
+            if reps is None:
+                return  # first full build will capture current state
+            s = shard_of(m.kind, m.client, m.filter, m.identifier, self.n_shards)
+            try:
+                rep = reps[s]
+                if m.kind == "inline":
+                    if m.op == "add":
+                        rep.inline_subscribe(m.subscription)
+                    else:
+                        rep.inline_unsubscribe(m.identifier, m.filter)
+                else:
+                    if m.op == "add":
+                        rep.subscribe(m.client, m.subscription)
+                    else:
+                        rep.unsubscribe(m.filter, m.client)
+                self._dirty[s] = True
+            except Exception:
+                _log.exception("shard replica update failed; forcing full rebuild")
+                self._replicas = None
 
     # -- build -------------------------------------------------------------
 
     def rebuild(self) -> None:
-        """Partition subscriptions round-robin into per-shard tries, compile
-        each to CSR, pad to common shapes, and stack on the shard axis."""
-        version = self.topics.version
-        full = build_csr(self.topics)
-        shard_indexes = [TopicsIndex() for _ in range(self.n_shards)]
-        for i, entry in enumerate(full.subs):
-            target = shard_indexes[i % self.n_shards]
+        """Bring the compiled index up to date.
+
+        Full path (first build, or after a replica fault): walk the live
+        trie, partition by stable hash into fresh replicas, compile all
+        shards. Incremental path: recompile only dirty shards' replicas and
+        restack — cost bounded by the dirty shards, not the index."""
+        t0 = time.perf_counter()
+        if self._replicas is None or not self.incremental:
+            self._full_rebuild()
+        else:
+            self._incremental_rebuild()
+        self.stats.rebuilds += 1
+        self.stats.rebuild_seconds += time.perf_counter() - t0
+
+    def _partition(self, full) -> list[TopicsIndex]:
+        replicas = [TopicsIndex() for _ in range(self.n_shards)]
+        for entry in full.subs:
             if entry.kind in (KIND_CLIENT, KIND_SHARED):
-                target.subscribe(entry.client, entry.subscription)
+                s = shard_of(
+                    entry.kind, entry.client, entry.subscription.filter, 0, self.n_shards
+                )
+                replicas[s].subscribe(entry.client, entry.subscription)
             else:
-                target.inline_subscribe(entry.subscription)
-        csrs = [build_csr(ix, salt=full.salt) for ix in shard_indexes]
-        self.shard_tables = [c.subs for c in csrs]
-        self.shard_salts = [c.salt for c in csrs]
-        if len(set(self.shard_salts)) != 1 or self.shard_salts[0] != full.salt:
-            # extremely unlikely (per-shard salt bump); rebuild all on the
-            # highest salt so topic hashing is uniform across shards
-            salt = max(self.shard_salts)
-            csrs = [build_csr(ix, salt=salt) for ix in shard_indexes]
-            self.shard_tables = [c.subs for c in csrs]
-            self.shard_salts = [c.salt for c in csrs]
+                s = shard_of(
+                    entry.kind,
+                    "",
+                    entry.subscription.filter,
+                    entry.subscription.identifier,
+                    self.n_shards,
+                )
+                replicas[s].inline_subscribe(entry.subscription)
+        return replicas
+
+    def _full_rebuild(self) -> None:
+        for attempt in range(8):
+            v0 = self.topics.version
+            try:
+                full = build_csr(self.topics, salt=self._salt)
+            except (RuntimeError, KeyError):
+                continue  # concurrent mutation tore the walk; retry
+            replicas = self._partition(full)
+            csrs = self._compile_all(replicas)
+            with self._state_lock:
+                if self.topics.version == v0:
+                    self._replicas = replicas
+                    self._csrs = csrs
+                    self._dirty = [False] * self.n_shards
+                    self._salt = csrs[0].salt
+                    self._assemble(csrs)
+                    self._built_version = v0
+                    return
+            # a mutation landed while we walked: the fresh replicas may miss
+            # it (the observer was still feeding the OLD replicas) — retry
+        # mutation storm: quiesce the trie and build consistent state
+        with self.topics._lock:
+            v0 = self.topics.version
+            full = build_csr(self.topics, salt=self._salt)
+            replicas = self._partition(full)
+            csrs = self._compile_all(replicas)
+            with self._state_lock:
+                self._replicas = replicas
+                self._csrs = csrs
+                self._dirty = [False] * self.n_shards
+                self._salt = csrs[0].salt
+                self._assemble(csrs)
+                self._built_version = v0
+
+    def _incremental_rebuild(self) -> None:
+        version = self.topics.version
+        dirty = [s for s in range(self.n_shards) if self._dirty[s]]
+        if not dirty and self._compiled is not None:
+            self._built_version = version
+            return
+        csrs = list(self._csrs)
+        for s in dirty:
+            # clear BEFORE compiling: a mutation racing the compile re-marks
+            # the shard, so it is recompiled next round even if this walk
+            # already included it
+            self._dirty[s] = False
+            csrs[s] = self._compile_shard(s)
+        salts = {c.salt for c in csrs}
+        if len(salts) > 1:
+            # a shard compile hit a hash collision and bumped its salt:
+            # topic hashing must be uniform, recompile everything on max
+            self._salt = max(salts)
+            for s in range(self.n_shards):
+                csrs[s] = self._compile_shard(s)
+        self._csrs = csrs
+        self._assemble(csrs)
+        self._built_version = version
+
+    def _compile_shard(self, s: int):
+        rep = self._replicas[s]
+        for _ in range(8):
+            try:
+                return build_csr(rep, salt=self._salt)
+            except (RuntimeError, KeyError):
+                continue  # replica mutated mid-walk; retry
+        with rep._lock:  # mutation storm on this shard: build quiesced
+            return build_csr(rep, salt=self._salt)
+
+    def _compile_all(self, replicas: list[TopicsIndex]) -> list:
+        csrs = [build_csr(ix, salt=self._salt) for ix in replicas]
+        salts = {c.salt for c in csrs}
+        if len(salts) > 1:  # per-shard salt bump: re-unify on the highest
+            salt = max(salts)
+            csrs = [build_csr(ix, salt=salt) for ix in replicas]
+        return csrs
+
+    def _assemble(self, csrs) -> None:
+        """Stack per-shard CSRs into mesh-placed device arrays and swap the
+        compiled generation atomically. Shapes are power-of-two bucketed so
+        churn rebuilds reuse the jitted executable."""
 
         def stack(get, fill=0, min_len=1):
             arrs = [np.asarray(get(c)) for c in csrs]
-            n = max(min_len, max(len(a) for a in arrs))
+            n = _bucket(max(min_len, max(len(a) for a in arrs)), minimum=max(2, min_len))
             return np.stack([_pad_to(a, n, fill) for a in arrs])
 
         max_degree = max(c.max_degree for c in csrs)
-        self._search_iters = max(1, int(np.ceil(np.log2(max(2, max_degree + 1)))) + 1)
+        iters = max(1, int(np.ceil(np.log2(max(2, max_degree + 1)))) + 1)
+        search_iters = min(32, int(np.ceil(iters / 4)) * 4)
         # place every stacked array on the mesh ONCE, leading (shard) dim
         # split over the ``subs`` axis — an explicit NamedSharding, NOT a
         # default-device jnp.asarray, so no other backend (e.g. a real TPU
         # when the mesh is a virtual CPU one) is ever touched
         shard_sharding = NamedSharding(self.mesh, P("subs"))
-        self._arrays = tuple(
+        arrays = tuple(
             jax.device_put(np.asarray(a), shard_sharding)
             for a in (
                 stack(lambda c: c.edge_ptr, min_len=2),
@@ -137,14 +305,20 @@ class ShardedTpuMatcher:
                 stack(lambda c: c.top_wild.astype(bool)),
             )
         )
-        self._compile_step()
-        self._built_version = version
+        tables = [c.subs for c in csrs]
+        step = self._get_step(search_iters)
+        self._compiled = (arrays, tables, csrs[0].salt, search_iters, step)
 
-    def _compile_step(self) -> None:
+    def _get_step(self, search_iters: int):
+        """The jitted SPMD step for a given binary-search depth. Cached so
+        churn rebuilds with unchanged shapes reuse the XLA executable."""
+        step = self._step_cache.get(search_iters)
+        if step is not None:
+            return step
         mesh = self.mesh
-        frontier, out_slots, iters = self.frontier, self.out_slots, self._search_iters
+        frontier, out_slots, iters = self.frontier, self.out_slots, search_iters
 
-        def step(
+        def step_fn(
             edge_ptr, edge_tok1, edge_tok2, edge_dest, plus_child, hash_child,
             reg_ptr, inl_ptr, all_ids, inl_offset, top_wild,
             tok1, tok2, lengths, is_dollar,
@@ -165,9 +339,9 @@ class ShardedTpuMatcher:
 
         shard_spec = P("subs")
         batch_spec = P("batch")
-        self._step = jax.jit(
+        step = jax.jit(
             shard_map(
-                step,
+                step_fn,
                 mesh=mesh,
                 in_specs=(shard_spec,) * 9 + (P("subs"), shard_spec)
                 + (batch_spec,) * 4,
@@ -175,26 +349,35 @@ class ShardedTpuMatcher:
                 disable_rep_check=True,
             )
         )
+        self._step_cache[search_iters] = step
+        return step
 
     @property
     def stale(self) -> bool:
-        return self._built_version != self.topics.version
+        return self._compiled is None or self._built_version != self.topics.version
 
     # -- matching ----------------------------------------------------------
 
-    def match_topics(self, topics: list[str]) -> list[Subscribers]:
-        if self._arrays is None or self.stale:
+    def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
+        """Match a batch of topics; every result is bit-identical to the
+        host trie (overflowing topics are re-walked on host).
+
+        ``route_to_host`` optionally forces extra topics onto the host walk
+        (the delta overlay's affected-check); the host path is always
+        correct, so any predicate preserves parity."""
+        if self._compiled is None or self.stale:
             self.rebuild()
+        arrays, tables, salt, _, step = self._compiled
         b = len(topics)
         # pad the batch to a multiple of the batch axis
         pad = (-b) % self.n_batch
         padded = topics + [""] * pad
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
-            padded, self.max_levels, self.shard_salts[0]
+            padded, self.max_levels, salt
         )
         batch_sharding = NamedSharding(self.mesh, P("batch"))
-        out, totals, overflow = self._step(
-            *self._arrays,
+        out, totals, overflow = step(
+            *arrays,
             *(
                 jax.device_put(np.asarray(a), batch_sharding)
                 for a in (tok1, tok2, lengths, is_dollar)
@@ -203,23 +386,28 @@ class ShardedTpuMatcher:
         out = np.asarray(out)  # [S, B, K]
         overflow = np.asarray(overflow).any(axis=0) | len_overflow  # [B]
         results = []
+        stats = self.stats
+        stats.batches += 1
+        stats.topics += b
         for i, topic in enumerate(topics):
             if not topic:
                 results.append(Subscribers())
-            elif overflow[i]:
+            elif overflow[i] or (route_to_host is not None and route_to_host(topic)):
+                stats.host_fallbacks += 1
+                stats.overflows += int(overflow[i])
                 results.append(self.topics.subscribers(topic))
             else:
-                results.append(self._expand(out[:, i, :]))
+                results.append(self._expand(tables, out[:, i, :]))
         return results
 
     def subscribers(self, topic: str) -> Subscribers:
         return self.match_topics([topic])[0]
 
-    def _expand(self, shard_sids: np.ndarray) -> Subscribers:
+    def _expand(self, tables, shard_sids: np.ndarray) -> Subscribers:
         """Union per-shard local sub ids into one Subscribers set."""
         subs = Subscribers()
         for s in range(self.n_shards):
-            expand_sids(self.shard_tables[s], shard_sids[s], subs, seen=set())
+            expand_sids(tables[s], shard_sids[s], subs, seen=set())
         return subs
 
 
@@ -318,11 +506,22 @@ def _dryrun_body(n_devices: int) -> None:
     for i, flt in enumerate(filters * 4):
         index.subscribe(f"cl{i}", Subscription(filter=flt, qos=i % 3))
     matcher = ShardedTpuMatcher(index, mesh=mesh, max_levels=4, frontier=8, out_slots=32)
-    topics = ["a/b/c", "d/e", "x/y/z", "q/w/e", "nope", "a/z/c", "e", "a/b"]
-    results = matcher.match_topics(topics)
-    # verify against the host oracle — the dryrun must not just compile
-    for topic, dev in zip(topics, results):
-        host = index.subscribers(topic)
-        assert set(dev.subscriptions) == set(host.subscriptions), (
-            topic, set(dev.subscriptions), set(host.subscriptions)
-        )
+    try:
+        topics = ["a/b/c", "d/e", "x/y/z", "q/w/e", "nope", "a/z/c", "e", "a/b"]
+        results = matcher.match_topics(topics)
+        # verify against the host oracle — the dryrun must not just compile
+        for topic, dev in zip(topics, results):
+            host = index.subscribers(topic)
+            assert set(dev.subscriptions) == set(host.subscriptions), (
+                topic, set(dev.subscriptions), set(host.subscriptions)
+            )
+        # exercise the incremental path: one mutation must dirty exactly one
+        # shard and still produce oracle-identical results after rebuild
+        index.subscribe("late", Subscription(filter="a/b/c", qos=1))
+        index.unsubscribe("d/e", "cl3")
+        for topic in topics:
+            dev = matcher.subscribers(topic)
+            host = index.subscribers(topic)
+            assert set(dev.subscriptions) == set(host.subscriptions), topic
+    finally:
+        matcher.close()

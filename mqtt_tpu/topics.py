@@ -35,6 +35,23 @@ SHARE_PREFIX = "$SHARE"  # prefix indicating a shared-subscription filter
 SYS_PREFIX = "$SYS"  # prefix indicating a system info topic
 
 
+@dataclass(frozen=True)
+class Mutation:
+    """One subscription mutation, delivered to trie observers.
+
+    Device-index consumers (``mqtt_tpu.ops.delta``, ``mqtt_tpu.parallel``)
+    use it to maintain delta overlays and per-shard subscription replicas
+    without re-walking the trie.
+    """
+
+    filter: str
+    kind: str  # "sub" (client/shared subscription) or "inline"
+    op: str  # "add" or "del"
+    client: str = ""  # client id for kind="sub"; "" for inline
+    subscription: Optional[object] = None  # the added Subscription / InlineSubscription
+    identifier: int = 0  # inline subscription identifier (kind="inline")
+
+
 def isolate_particle(filter: str, d: int) -> tuple[str, bool]:
     """Extract the topic level at depth ``d`` and whether more levels follow.
 
@@ -270,26 +287,27 @@ class TopicsIndex:
         # bumped on every subscription mutation; device indexes (mqtt_tpu.ops)
         # compare against it to detect staleness
         self.version = 0
-        # mutation observers: called with (filter, kind) under the trie lock,
-        # after the version bump; kind is "sub" (client/shared subscription)
-        # or "inline". The delta-staged device matcher (mqtt_tpu.ops.delta)
-        # uses this to route affected topics to the host walk while a stale
-        # device snapshot keeps serving everything else.
-        self._observers: list[Callable[[str, str], None]] = []
+        # mutation observers: called with a Mutation under the trie lock,
+        # after the version bump. The delta-staged device matcher
+        # (mqtt_tpu.ops.delta) uses this to route affected topics to the
+        # host walk while a stale device snapshot keeps serving everything
+        # else; the mesh-sharded matcher (mqtt_tpu.parallel) additionally
+        # applies the mutation to the owning shard's replica trie.
+        self._observers: list[Callable[[Mutation], None]] = []
 
-    def add_observer(self, fn: Callable[[str, str], None]) -> None:
+    def add_observer(self, fn: Callable[[Mutation], None]) -> None:
         """Register a subscription-mutation observer (delta stream consumer)."""
         with self._lock:
             self._observers.append(fn)
 
-    def remove_observer(self, fn: Callable[[str, str], None]) -> None:
+    def remove_observer(self, fn: Callable[[Mutation], None]) -> None:
         with self._lock:
             if fn in self._observers:
                 self._observers.remove(fn)
 
-    def _notify(self, filter: str, kind: str) -> None:
+    def _notify(self, mutation: Mutation) -> None:
         for fn in self._observers:
-            fn(filter, kind)
+            fn(mutation)
 
     # -- mutation ----------------------------------------------------------
 
@@ -308,7 +326,9 @@ class TopicsIndex:
                 n = self._set(subscription.filter, 0)
                 existed = n.subscriptions.get(client) is not None
                 n.subscriptions.add(client, subscription)
-            self._notify(subscription.filter, "sub")
+            self._notify(
+                Mutation(subscription.filter, "sub", "add", client, subscription)
+            )
             return not existed
 
     def unsubscribe(self, filter: str, client: str) -> bool:
@@ -330,7 +350,7 @@ class TopicsIndex:
             else:
                 particle.subscriptions.delete(client)
             self._trim(particle)
-            self._notify(filter, "sub")
+            self._notify(Mutation(filter, "sub", "del", client))
             return True
 
     def inline_subscribe(self, subscription: InlineSubscription) -> bool:
@@ -341,7 +361,15 @@ class TopicsIndex:
             n = self._set(subscription.filter, 0)
             existed = n.inline_subscriptions.get(subscription.identifier) is not None
             n.inline_subscriptions.add_inline(subscription)
-            self._notify(subscription.filter, "inline")
+            self._notify(
+                Mutation(
+                    subscription.filter,
+                    "inline",
+                    "add",
+                    subscription=subscription,
+                    identifier=subscription.identifier,
+                )
+            )
             return not existed
 
     def inline_unsubscribe(self, id_: int, filter: str) -> bool:
@@ -353,7 +381,7 @@ class TopicsIndex:
             particle.inline_subscriptions.delete(id_)
             if len(particle.inline_subscriptions) == 0:
                 self._trim(particle)
-            self._notify(filter, "inline")
+            self._notify(Mutation(filter, "inline", "del", identifier=id_))
             return True
 
     def retain_message(self, pk: Packet) -> int:

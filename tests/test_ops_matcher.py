@@ -144,3 +144,24 @@ def test_frontier_overflow_falls_back():
     matcher = TpuMatcher(index, frontier=2)
     subs = matcher.subscribers("a/a/a/a")
     assert len(subs.subscriptions) == 5
+
+
+def test_transfer_slots_prefix_routes_deep_topics_to_host():
+    """A transfer prefix smaller than out_slots must stay bit-identical:
+    topics matching more subs than the prefix carries re-walk on host."""
+    index = TopicsIndex()
+    # 12 subs all matching 'hot/x'; 1 sub matching 'cold/y'
+    for i in range(6):
+        index.subscribe(f"e{i}", Subscription(filter="hot/x", qos=1))
+        index.subscribe(f"w{i}", Subscription(filter="hot/+", qos=2))
+    index.subscribe("solo", Subscription(filter="cold/y"))
+    matcher = TpuMatcher(index, max_levels=4, out_slots=32, transfer_slots=4)
+    hot = matcher.subscribers("hot/x")
+    cold = matcher.subscribers("cold/y")
+    assert canon(hot) == canon(index.subscribers("hot/x"))
+    assert canon(cold) == canon(index.subscribers("cold/y"))
+    # the hot topic exceeded the prefix -> host fallback, NOT device overflow
+    assert matcher.stats.host_fallbacks == 1
+    assert matcher.stats.overflows == 0
+    # the cold topic fit in the prefix -> served from the device result
+    assert matcher.stats.topics == 2
