@@ -213,8 +213,14 @@ class DeltaMatcher:
         concurrent structural mutations can tear the walk (RuntimeError from
         a mutated dict iteration, KeyError from a node inserted mid-walk),
         in which case retry — every mutation racing the walk is in the delta
-        overlay, so a successful walk is always safe to serve. The sharded
-        snapshot handles tears internally, so its rebuild succeeds first try."""
+        overlay, so a successful walk is always safe to serve."""
+        if getattr(self._snap, "handles_tears", False):
+            # the sharded snapshot retries tears (and quiesces) internally;
+            # its rebuild takes its rebuild mutex BEFORE the trie lock, so
+            # wrapping it in `with self.topics._lock` here would invert
+            # that order and deadlock against a concurrent rebuild
+            self._snap.rebuild()
+            return
         for _ in range(8):
             try:
                 self._snap.rebuild()

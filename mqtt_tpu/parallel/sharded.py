@@ -90,6 +90,11 @@ class ShardedTpuMatcher:
     whose subscriptions changed; call :meth:`close` to detach the observer.
     """
 
+    # rebuild() retries torn walks and quiesces internally — callers (the
+    # delta overlay) must NOT wrap it in `with topics._lock`, which would
+    # invert this class's rebuild-mutex -> trie-lock order and deadlock
+    handles_tears = True
+
     def __init__(
         self,
         topics: TopicsIndex,
@@ -116,6 +121,11 @@ class ShardedTpuMatcher:
         # guarded by _state_lock (held briefly — the observer runs under the
         # main trie's lock, so installs must never block on slow work)
         self._state_lock = threading.Lock()
+        # serializes whole rebuilds: without it, a concurrent rebuild can
+        # observe the storm path's intermediate state (fresh replicas,
+        # cleared dirty flags, old compiled arrays) and stamp the stale
+        # snapshot as current via the empty-dirty early return
+        self._rebuild_mutex = threading.Lock()
         self._replicas: Optional[list[TopicsIndex]] = None
         self._csrs: Optional[list] = None
         self._dirty = [False] * self.n_shards
@@ -166,10 +176,23 @@ class ShardedTpuMatcher:
         shards. Incremental path: recompile only dirty shards' replicas and
         restack — cost bounded by the dirty shards, not the index."""
         t0 = time.perf_counter()
-        if self._replicas is None or not self.incremental:
-            self._full_rebuild()
-        else:
-            self._incremental_rebuild()
+        with self._rebuild_mutex:
+            # the except runs INSIDE the mutex: re-marking dirty after
+            # release would leave a gap where a concurrent rebuild sees
+            # empty dirty flags and stamps the stale snapshot as current
+            try:
+                if self._replicas is None or not self.incremental:
+                    self._full_rebuild()
+                else:
+                    self._incremental_rebuild()
+            except BaseException:
+                # exception safety: a rebuild that dies after clearing dirty
+                # flags (e.g. device_put fault in _assemble) must not let the
+                # next rebuild's empty-dirty early-return pass off the stale
+                # snapshot as current — over-mark everything dirty instead
+                with self._state_lock:
+                    self._dirty = [True] * self.n_shards
+                raise
         self.stats.rebuilds += 1
         self.stats.rebuild_seconds += time.perf_counter() - t0
 
@@ -201,77 +224,146 @@ class ShardedTpuMatcher:
                 continue  # concurrent mutation tore the walk; retry
             replicas = self._partition(full)
             csrs = self._compile_all(replicas)
+            if self.topics.version != v0:
+                continue  # doomed: skip the H2D transfer, retry the walk
+            # device placement happens OUTSIDE _state_lock: the observer
+            # runs under the broker trie's lock and blocks on _state_lock,
+            # so holding it across an H2D transfer (65ms+ on tunneled
+            # links) would stall every subscribe for the transfer time
+            compiled = self._assemble(csrs)
             with self._state_lock:
                 if self.topics.version == v0:
                     self._replicas = replicas
                     self._csrs = csrs
                     self._dirty = [False] * self.n_shards
                     self._salt = csrs[0].salt
-                    self._assemble(csrs)
+                    self._compiled = compiled
                     self._built_version = v0
                     return
             # a mutation landed while we walked: the fresh replicas may miss
             # it (the observer was still feeding the OLD replicas) — retry
-        # mutation storm: quiesce the trie and build consistent state
+        # mutation storm: quiesce the trie ONLY long enough to walk it and
+        # swap fresh replicas in (pure host work, no device transfers) —
+        # subscribes resume while we compile; every mutation from the swap
+        # onward feeds the new replicas and marks its shard dirty, and
+        # _built_version = v0 keeps `stale` true until they are folded
         with self.topics._lock:
             v0 = self.topics.version
             full = build_csr(self.topics, salt=self._salt)
             replicas = self._partition(full)
-            csrs = self._compile_all(replicas)
             with self._state_lock:
                 self._replicas = replicas
-                self._csrs = csrs
                 self._dirty = [False] * self.n_shards
+        csrs = self._compile_all(replicas, retry_tears=True)
+        compiled = self._assemble(csrs)
+        with self._state_lock:
+            fault = self._replicas is not replicas
+            if not fault:
+                self._csrs = csrs
                 self._salt = csrs[0].salt
-                self._assemble(csrs)
+                self._compiled = compiled
                 self._built_version = v0
+        if fault:
+            # the observer's fault path nulled the replicas mid-compile;
+            # returning now would report success for a rebuild that folded
+            # nothing (DeltaMatcher would drop its overlay) — redo in full
+            self._full_rebuild()
 
     def _incremental_rebuild(self) -> None:
-        version = self.topics.version
-        dirty = [s for s in range(self.n_shards) if self._dirty[s]]
+        # read the version under the trie lock: the trie bumps it BEFORE
+        # notifying observers, so a bare read could adopt a version whose
+        # mutation hasn't marked its shard dirty yet — stamping that
+        # version as built would hide the unfolded shard from `stale`.
+        # Holding the trie lock waits out any in-flight notify.
+        with self.topics._lock:
+            version = self.topics.version
+        with self._state_lock:
+            # snapshot under the lock: the observer's exception path sets
+            # _replicas = None concurrently, and reading a torn
+            # replicas/csrs/dirty trio would crash the rebuild thread with
+            # an exception type no caller retries (TypeError)
+            replicas = self._replicas
+            if replicas is None or self._csrs is None:
+                replicas = None  # fall through to a full rebuild below
+            else:
+                dirty = [s for s in range(self.n_shards) if self._dirty[s]]
+                # clear BEFORE compiling: a mutation racing the compile
+                # re-marks the shard, so it is recompiled next round even
+                # if this walk already included it
+                for s in dirty:
+                    self._dirty[s] = False
+                csrs = list(self._csrs)
+        if replicas is None:
+            self._full_rebuild()
+            return
         if not dirty and self._compiled is not None:
             self._built_version = version
             return
-        csrs = list(self._csrs)
         for s in dirty:
-            # clear BEFORE compiling: a mutation racing the compile re-marks
-            # the shard, so it is recompiled next round even if this walk
-            # already included it
-            self._dirty[s] = False
-            csrs[s] = self._compile_shard(s)
+            csrs[s] = self._compile_shard(s, replicas)
         salts = {c.salt for c in csrs}
         if len(salts) > 1:
             # a shard compile hit a hash collision and bumped its salt:
             # topic hashing must be uniform, recompile everything on max
             self._salt = max(salts)
-            for s in range(self.n_shards):
-                csrs[s] = self._compile_shard(s)
-        self._csrs = csrs
-        self._assemble(csrs)
-        self._built_version = version
+            csrs = self._compile_all(replicas, retry_tears=True)
+        compiled = self._assemble(csrs)
+        with self._state_lock:
+            fault = self._replicas is not replicas
+            if not fault:
+                self._csrs = csrs
+                self._salt = csrs[0].salt  # keep in sync: a bump here must
+                # not force the next incremental round to recompile the world
+                self._compiled = compiled
+                self._built_version = version
+        if fault:
+            # observer fault nulled the replicas mid-compile; a bare return
+            # would report success without folding anything (DeltaMatcher
+            # would drop overlay entries the snapshot never absorbed)
+            self._full_rebuild()
 
-    def _compile_shard(self, s: int):
-        rep = self._replicas[s]
+    def _compile_shard(self, s: int, replicas, salt: Optional[int] = None):
+        rep = replicas[s]
+        salt = self._salt if salt is None else salt
         for _ in range(8):
             try:
-                return build_csr(rep, salt=self._salt)
+                return build_csr(rep, salt=salt)
             except (RuntimeError, KeyError):
                 continue  # replica mutated mid-walk; retry
         with rep._lock:  # mutation storm on this shard: build quiesced
-            return build_csr(rep, salt=self._salt)
+            return build_csr(rep, salt=salt)
 
-    def _compile_all(self, replicas: list[TopicsIndex]) -> list:
-        csrs = [build_csr(ix, salt=self._salt) for ix in replicas]
-        salts = {c.salt for c in csrs}
-        if len(salts) > 1:  # per-shard salt bump: re-unify on the highest
+    def _compile_all(self, replicas: list[TopicsIndex], retry_tears: bool = False) -> list:
+        """Compile every shard at a uniform salt. With ``retry_tears`` the
+        per-shard compile retries walks torn by concurrent replica
+        mutations (live replicas); without it a tear propagates to the
+        caller (fresh, unpublished replicas can't tear)."""
+
+        def compile_one(s: int, salt: int):
+            if retry_tears:
+                return self._compile_shard(s, replicas, salt=salt)
+            return build_csr(replicas[s], salt=salt)
+
+        csrs = [compile_one(s, self._salt) for s in range(len(replicas))]
+        # re-unify until every shard agrees: a shard can collide again at
+        # the bumped salt, and serving mixed-salt CSRs would silently drop
+        # that shard's subscribers (topics tokenize at one salt)
+        for _ in range(8):
+            salts = {c.salt for c in csrs}
+            if len(salts) == 1:
+                return csrs
             salt = max(salts)
-            csrs = [build_csr(ix, salt=salt) for ix in replicas]
-        return csrs
+            csrs = [compile_one(s, salt) for s in range(len(replicas))]
+        if len({c.salt for c in csrs}) == 1:  # the final recompile counts too
+            return csrs
+        raise RuntimeError("shard salt unification failed; persistent hash collisions")
 
-    def _assemble(self, csrs) -> None:
-        """Stack per-shard CSRs into mesh-placed device arrays and swap the
-        compiled generation atomically. Shapes are power-of-two bucketed so
-        churn rebuilds reuse the jitted executable."""
+    def _assemble(self, csrs) -> tuple:
+        """Stack per-shard CSRs into mesh-placed device arrays and return
+        the compiled generation (the caller swaps it in under _state_lock —
+        device placement itself must happen lock-free). Shapes are
+        power-of-two bucketed so churn rebuilds reuse the jitted
+        executable."""
 
         def stack(get, fill=0, min_len=1):
             arrs = [np.asarray(get(c)) for c in csrs]
@@ -307,7 +399,7 @@ class ShardedTpuMatcher:
         )
         tables = [c.subs for c in csrs]
         step = self._get_step(search_iters)
-        self._compiled = (arrays, tables, csrs[0].salt, search_iters, step)
+        return (arrays, tables, csrs[0].salt, search_iters, step)
 
     def _get_step(self, search_iters: int):
         """The jitted SPMD step for a given binary-search depth. Cached so
@@ -358,13 +450,14 @@ class ShardedTpuMatcher:
 
     # -- matching ----------------------------------------------------------
 
-    def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
-        """Match a batch of topics; every result is bit-identical to the
-        host trie (overflowing topics are re-walked on host).
+    def match_topics_async(self, topics: list[str], route_to_host=None):
+        """Issue one SPMD match step and return a zero-arg resolver.
 
-        ``route_to_host`` optionally forces extra topics onto the host walk
-        (the delta overlay's affected-check); the host path is always
-        correct, so any predicate preserves parity."""
+        Mirrors ``TpuMatcher.match_topics_async`` (ops/matcher.py): the
+        step is dispatched asynchronously; the resolver performs the D2H
+        sync plus host-side expansion and returns ``list[Subscribers]``.
+        The delta overlay (ops/delta.py) relies on this API existing on
+        every snapshot kind."""
         if self._compiled is None or self.stale:
             self.rebuild()
         arrays, tables, salt, _, step = self._compiled
@@ -376,29 +469,44 @@ class ShardedTpuMatcher:
             padded, self.max_levels, salt
         )
         batch_sharding = NamedSharding(self.mesh, P("batch"))
-        out, totals, overflow = step(
+        out_dev, totals_dev, overflow_dev = step(
             *arrays,
             *(
                 jax.device_put(np.asarray(a), batch_sharding)
                 for a in (tok1, tok2, lengths, is_dollar)
             ),
         )
-        out = np.asarray(out)  # [S, B, K]
-        overflow = np.asarray(overflow).any(axis=0) | len_overflow  # [B]
-        results = []
-        stats = self.stats
-        stats.batches += 1
-        stats.topics += b
-        for i, topic in enumerate(topics):
-            if not topic:
-                results.append(Subscribers())
-            elif overflow[i] or (route_to_host is not None and route_to_host(topic)):
-                stats.host_fallbacks += 1
-                stats.overflows += int(overflow[i])
-                results.append(self.topics.subscribers(topic))
-            else:
-                results.append(self._expand(tables, out[:, i, :]))
-        return results
+
+        def resolve() -> list[Subscribers]:
+            out = np.asarray(out_dev)  # [S, B, K]
+            overflow = np.asarray(overflow_dev).any(axis=0) | len_overflow  # [B]
+            results = []
+            stats = self.stats
+            stats.batches += 1
+            stats.topics += b
+            for i, topic in enumerate(topics):
+                if not topic:
+                    results.append(Subscribers())
+                elif overflow[i] or (
+                    route_to_host is not None and route_to_host(topic)
+                ):
+                    stats.host_fallbacks += 1
+                    stats.overflows += int(overflow[i])
+                    results.append(self.topics.subscribers(topic))
+                else:
+                    results.append(self._expand(tables, out[:, i, :]))
+            return results
+
+        return resolve
+
+    def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
+        """Match a batch of topics; every result is bit-identical to the
+        host trie (overflowing topics are re-walked on host).
+
+        ``route_to_host`` optionally forces extra topics onto the host walk
+        (the delta overlay's affected-check); the host path is always
+        correct, so any predicate preserves parity."""
+        return self.match_topics_async(topics, route_to_host)()
 
     def subscribers(self, topic: str) -> Subscribers:
         return self.match_topics([topic])[0]
@@ -525,3 +633,23 @@ def _dryrun_body(n_devices: int) -> None:
             assert set(dev.subscriptions) == set(host.subscriptions), topic
     finally:
         matcher.close()
+    # the live-broker configuration: DeltaMatcher folding trie churn over a
+    # mesh-sharded snapshot (the round-2 regression shipped because no
+    # driver check covered this combination)
+    from ..ops.delta import DeltaMatcher
+
+    dm = DeltaMatcher(index, mesh=mesh, max_levels=4, background=False)
+    try:
+        index.subscribe("churn", Subscription(filter="a/+/c", qos=1))
+        for topic in topics:
+            dev = dm.subscribers(topic)  # overlay: churned topics host-route
+            host = index.subscribers(topic)
+            assert set(dev.subscriptions) == set(host.subscriptions), topic
+        dm.flush()  # fold the overlay into a fresh per-shard snapshot
+        assert dm.pending_deltas == 0
+        for topic in topics:
+            dev = dm.subscribers(topic)
+            host = index.subscribers(topic)
+            assert set(dev.subscriptions) == set(host.subscriptions), topic
+    finally:
+        dm.close()
