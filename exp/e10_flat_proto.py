@@ -1,0 +1,328 @@
+"""E10: prototype flat-hash matcher — correctness vs host trie + kernel rate.
+
+Design: filters become entries keyed by whole-path hash (levels hashed with
+'+' -> sentinel, '#' patterns keyed by (depth, mask, HASH kind)). The build
+enumerates the globally-distinct wildcard shapes; matching probes one bucket
+row per shape + one id-window row per hit. No trie walk on device.
+"""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from functools import partial
+
+from mqtt_tpu.topics import TopicsIndex, Subscribers, SHARE_PREFIX
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.ops.hashing import hash_token, tokenize_topics
+from mqtt_tpu.ops.csr import SubEntry, KIND_CLIENT, KIND_SHARED, KIND_INLINE
+from mqtt_tpu.ops.matcher import expand_sids
+
+M1 = np.uint32(0x9E3779B1)
+M2 = np.uint32(0x85EBCA77)
+PLUS1 = np.uint32(0x9E3779B9)   # sentinel level-hash for '+' (h1 lane)
+PLUS2 = np.uint32(0xC2B2AE3D)
+KIND_EXACT = np.uint32(0x165667B1)
+KIND_HASH = np.uint32(0x27D4EB2F)
+
+def rotl(x, r):
+    x = np.uint32(x) if np.isscalar(x) else x
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+def mix_np(h, t):
+    return (rotl(h ^ t, 13) * M1).astype(np.uint32)
+
+def path_hash_np(toks1, toks2, kind, depth):
+    """toks*: arrays [n] of level hashes ('+' already sentineled)."""
+    h1 = np.uint32(depth) * M2 ^ kind
+    h2 = np.uint32(depth) * M1 ^ kind
+    for d in range(len(toks1)):
+        h1 = mix_np(h1, np.uint32(toks1[d]))
+        h2 = mix_np(h2, np.uint32(toks2[d]))
+    return np.uint32(h1), np.uint32(h2)
+
+# ---------------- build ----------------
+
+def walk_filters(index: TopicsIndex):
+    """Yield (levels, node) for every terminal trie node with subs."""
+    stack = [(index.root, [])]
+    while stack:
+        p, path = stack.pop()
+        if p.subscriptions.get_all() or p.shared.get_all() or p.inline_subscriptions.get_all():
+            yield path, p
+        for key, child in p.particles.items():
+            stack.append((child, path + [key]))
+
+def build_flat(index: TopicsIndex, max_levels=8, salt=0, window=16):
+    t0 = time.perf_counter()
+    entries = []   # (h1, h2, kind, depth, mask, ids: list[(sid, exempt)], n_reg, top_wild, last_plus)
+    subs = []
+    pat_set = set()  # (kind, depth, mask)
+    skipped_deep = 0
+    for path, node in walk_filters(index):
+        is_hash = bool(path) and path[-1] == "#"
+        levels = path[:-1] if is_hash else path
+        depth = len(levels)
+        if depth > max_levels:
+            skipped_deep += 1
+            continue
+        mask = 0
+        t1s, t2s = [], []
+        for d, tok in enumerate(levels):
+            if tok == "+":
+                mask |= 1 << d
+                t1s.append(PLUS1); t2s.append(PLUS2)
+            else:
+                a, b = hash_token(tok, salt)
+                t1s.append(np.uint32(a)); t2s.append(np.uint32(b))
+        kind = KIND_HASH if is_hash else KIND_EXACT
+        h1, h2 = path_hash_np(t1s, t2s, kind, depth)
+        reg_ids, inl_ids = [], []
+        top_wild = bool(path) and path[0] in ("+", "#")
+        for client, sub in node.subscriptions.get_all().items():
+            sid = len(subs); subs.append(SubEntry(KIND_CLIENT, client, "", sub))
+            reg_ids.append((sid, False))
+        for gf in node.shared.get_all().values():
+            for client, sub in gf.items():
+                sid = len(subs); subs.append(SubEntry(KIND_SHARED, client, sub.filter, sub))
+                reg_ids.append((sid, True))
+        for ident, isub in node.inline_subscriptions.get_all().items():
+            sid = len(subs); subs.append(SubEntry(KIND_INLINE, "", "", isub))
+            inl_ids.append((sid, True))
+        last_plus = is_hash and depth > 0 and (mask >> (depth - 1)) & 1
+        entries.append((h1, h2, kind, depth, mask, reg_ids, inl_ids, top_wild, last_plus))
+        pat_set.add((int(kind), depth, mask))
+
+    # global key-collision check
+    keys = sorted((int(e[0]) << 32 | int(e[1])) for e in entries)
+    for i in range(1, len(keys)):
+        if keys[i] == keys[i-1]:
+            return build_flat(index, max_levels, salt + 1, window)
+
+    # place into buckets: 4 entries/bucket, saturate flag
+    n = len(entries)
+    S = 1024
+    while S * 2 < n:  # target load <= 0.5 entries/slot -> lambda 2/bucket... tune
+        S *= 2
+    S *= 2
+    for attempt in range(3):
+        occ = np.zeros(S, dtype=np.int32)
+        slot_of = np.empty(n, dtype=np.int64)
+        sat = np.zeros(S, dtype=bool)
+        for i, e in enumerate(entries):
+            s = int(e[0]) & (S - 1)
+            slot_of[i] = s
+            occ[s] += 1
+        sat = occ > 4
+        if sat.sum() * 8 < S * 0.004 or attempt == 2:  # accept tiny saturation
+            break
+        S *= 2
+    # all_ids + table
+    all_ids = []
+    HDR = 4  # k1,k2,meta,start per entry
+    ROW = 4 * HDR
+    table = np.zeros((S, ROW), dtype=np.uint32)
+    fill = np.zeros(S, dtype=np.int32)
+    n_spill = 0
+    for s in np.nonzero(sat)[0]:
+        table[s, 2] = np.uint32(1 << 19)  # SAT marker in entry0 meta
+    for i, (h1, h2, kind, depth, mask, reg, inl, top_wild, last_plus) in enumerate(entries):
+        s = int(slot_of[i])
+        if sat[s]:
+            continue  # saturated: device routes these probes to host
+        ids = reg + inl
+        start = len(all_ids)
+        if len(ids) > window:
+            n_spill += 1
+            spill = 1
+            nreg, ninl = 0, 0
+        else:
+            spill = 0
+            for sid, ex in ids:
+                all_ids.append(np.uint32(sid | (0x40000000 if ex else 0)))
+            nreg, ninl = len(reg), len(inl)
+        j = fill[s]; fill[s] += 1
+        meta = (nreg & 0x3FF) | ((ninl & 0x3F) << 10) | (int(top_wild) << 16) | (int(last_plus) << 17) | (spill << 18)
+        table[s, j*HDR:(j+1)*HDR] = [h1, h2, np.uint32(meta), np.uint32(start)]
+    all_ids = np.asarray(all_ids + [0]*window, dtype=np.uint32)
+    # patterns
+    pats = sorted(pat_set)
+    pat_kind = np.asarray([p[0] for p in pats], dtype=np.uint32)
+    pat_depth = np.asarray([p[1] for p in pats], dtype=np.int32)
+    pat_mask = np.asarray([p[2] for p in pats], dtype=np.uint32)
+    sat_frac = float(sat.mean())
+    print(f"build: {n} entries, S={S}, P={len(pats)} patterns, sat={sat.sum()} buckets ({sat_frac:.5f}), "
+          f"spill={n_spill}, skipped_deep={skipped_deep}, {time.perf_counter()-t0:.1f}s", flush=True)
+    return dict(table=table, all_ids=all_ids, subs=subs, salt=salt,
+                pat_kind=pat_kind, pat_depth=pat_depth, pat_mask=pat_mask,
+                sat=sat, S=S, window=window, max_levels=max_levels)
+
+# ---------------- device kernel ----------------
+
+def rotl_j(x, r):
+    return (x << np.uint32(r)) | (x >> np.uint32(32 - r))
+
+@partial(jax.jit, static_argnames=("window", "max_levels", "out_slots"))
+def flat_match(table, all_ids, pat_kind, pat_depth, pat_mask,
+               tok1, tok2, lengths, is_dollar, *, window, max_levels, out_slots):
+    B, L = tok1.shape
+    P = pat_kind.shape[0]
+    S = table.shape[0]
+    m1 = jnp.uint32(0x9E3779B1); m2 = jnp.uint32(0x85EBCA77)
+    # pattern path hashes: [B, P]
+    h1 = pat_depth.astype(jnp.uint32) * m2 ^ pat_kind
+    h2 = pat_depth.astype(jnp.uint32) * m1 ^ pat_kind
+    h1 = jnp.broadcast_to(h1[None, :], (B, P))
+    h2 = jnp.broadcast_to(h2[None, :], (B, P))
+    for d in range(max_levels):
+        use = d < pat_depth  # [P]
+        plus = (pat_mask >> np.uint32(d)) & 1  # [P]
+        t1 = jnp.where(plus[None, :] == 1, jnp.uint32(0x9E3779B9), tok1[:, d][:, None])
+        t2 = jnp.where(plus[None, :] == 1, jnp.uint32(0xC2B2AE3D), tok2[:, d][:, None])
+        nh1 = (rotl_j(h1 ^ t1, 13) * m1)
+        nh2 = (rotl_j(h2 ^ t2, 13) * m1)
+        h1 = jnp.where(use[None, :], nh1, h1)
+        h2 = jnp.where(use[None, :], nh2, h2)
+    # active: exact: depth == n; hash: depth <= n
+    n = lengths[:, None]
+    is_hash = pat_kind == jnp.uint32(0x27D4EB2F)
+    active = jnp.where(is_hash[None, :], pat_depth[None, :] <= n, pat_depth[None, :] == n)
+    slot = (h1 & jnp.uint32(S - 1)).astype(jnp.int32)
+    rows = table[jnp.where(active, slot, 0)]  # [B, P, 16] row gather
+    # entry select: 4 entries
+    ent = rows.reshape(B, P, 4, 4)
+    hit = (ent[..., 0] == h1[..., None]) & (ent[..., 1] == h2[..., None])  # [B,P,4]
+    hit = hit & active[..., None]
+    meta = jnp.where(hit, ent[..., 2], 0).max(axis=-1)   # at most one hit
+    start = jnp.where(hit, ent[..., 3], 0).max(axis=-1)
+    hit_any = hit.any(axis=-1)
+    nreg = (meta & 0x3FF).astype(jnp.int32)
+    ninl = ((meta >> 10) & 0x3F).astype(jnp.int32)
+    top_wild = (meta >> 16) & 1
+    last_plus = (meta >> 17) & 1
+    spill = (meta >> 18) & 1
+    sat_probe = ((rows.reshape(B, P, 4, 4)[:, :, 0, 2] >> 19) & 1) == 1
+    sat_probe = sat_probe & active
+    exact_len = n == pat_depth[None, :]
+    # '#' exact-length quirk: no match if filter's last level is '+'
+    valid_hit = hit_any & ~(is_hash[None, :] & exact_len & (last_plus == 1))
+    count = jnp.where(is_hash[None, :] & exact_len, nreg, nreg + ninl)
+    count = jnp.where(valid_hit, count, 0)
+    # id windows: [B, P, W] via slice-gather
+    idx = jnp.where(valid_hit, start.astype(jnp.int32), 0)
+    wins = jax.lax.gather(
+        all_ids, idx.reshape(B, P, 1),
+        jax.lax.GatherDimensionNumbers(offset_dims=(2,), collapsed_slice_dims=(),
+                                       start_index_map=(0,), operand_batching_dims=()),
+        slice_sizes=(window,), mode="clip",
+    ).reshape(B, P, window)
+    ks = jnp.arange(window, dtype=jnp.int32)
+    validk = ks[None, None, :] < count[..., None]
+    exempt = (wins >> np.uint32(30)) & 1
+    dollar_drop = is_dollar[:, None, None] & (top_wild[..., None] == 1) & (exempt == 0)
+    validk = validk & ~dollar_drop
+    sid = (wins & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+    flat_sid = jnp.where(validk, sid, -1).reshape(B, P * window)
+    totals = validk.reshape(B, P * window).sum(axis=1)
+    overflow = ((spill == 1) & valid_hit).any(axis=1) | sat_probe.any(axis=1)
+    # saturation: a probe hitting a saturated bucket must host-route; encode:
+    # saturated buckets have meta==0 rows but that's also "miss"... handled by
+    # passing sat bitmap: (prototype: table rows for saturated buckets are all
+    # zero; we mark via separate bitmap gather folded into table col?) --
+    # prototype: sat bitmap folded as bit 19 of every entry meta in that bucket.
+    return flat_sid, totals, overflow
+
+# ---------------- harness ----------------
+
+def subscribers_flat(built, topics, index):
+    tok1, tok2, lengths, is_dollar, len_ovf = tokenize_topics(topics, built["max_levels"], built["salt"])
+    dev = built["dev"]
+    out, totals, ovf = flat_match(*dev, jnp.asarray(tok1), jnp.asarray(tok2),
+                                  jnp.asarray(lengths), jnp.asarray(is_dollar),
+                                  window=built["window"], max_levels=built["max_levels"], out_slots=64)
+    out = np.asarray(out); ovf = np.asarray(ovf)
+    res = []
+    sat = built["sat"]
+    for i, t in enumerate(topics):
+        if not t:
+            res.append(Subscribers()); continue
+        if ovf[i] or len_ovf[i] or _probes_saturated(built, t):
+            res.append(index.subscribers(t)); continue
+        row = out[i]
+        res.append(expand_sids(built["subs"], row[row >= 0], Subscribers()))
+    return res
+
+def _probes_saturated(built, topic):
+    # host-side conservative check (prototype only; real impl device-side)
+    if not built["sat"].any():
+        return False
+    return False  # skip in prototype when sat==0
+
+def canon(s):
+    return ({c: (sub.qos, tuple(sorted(sub.identifiers.items()))) for c, sub in s.subscriptions.items()},
+            {f: set(m) for f, m in s.shared.items()},
+            set(s.inline_subscriptions))
+
+# correctness corpus: reference corner cases
+def test_correctness():
+    idx = TopicsIndex()
+    subs = [
+        ("c1", "a/b/c"), ("c2", "a/+/c"), ("c3", "a/b/#"), ("c4", "#"),
+        ("c5", "+/b/c"), ("c6", "a/b"), ("c7", "a/b/c/d"), ("c8", "zen/#"),
+        ("c9", "+"), ("c10", "/a"), ("c11", "+/a"), ("c12", "$SYS/+"),
+        ("c13", "a/+/#"), ("c14", "+/+/c"), ("c15", ""),
+        ("c16", f"{SHARE_PREFIX}/g1/a/b/c"), ("c17", f"{SHARE_PREFIX}/g1/+/b/c"),
+    ]
+    for c, f in subs:
+        if f:
+            idx.subscribe(c, Subscription(filter=f, qos=1))
+    from mqtt_tpu.topics import InlineSubscription
+    idx.inline_subscribe(InlineSubscription(filter="a/b/#", qos=0, identifier=7, handler=lambda *a: None))
+    idx.inline_subscribe(InlineSubscription(filter="a/b", qos=0, identifier=8, handler=lambda *a: None))
+    built = build_flat(idx, max_levels=6)
+    built["dev"] = tuple(jnp.asarray(a) for a in
+                         (built["table"], built["all_ids"], built["pat_kind"], built["pat_depth"], built["pat_mask"]))
+    topics = ["a/b/c", "a/b", "a/x/c", "zen", "zen/x", "a", "b", "$SYS/x", "$SYS/broker",
+              "/a", "a/b/c/d", "a/b/c/d/e", "x/b/c", "a/x", "", "a/b/x"]
+    got = subscribers_flat(built, topics, idx)
+    ok = True
+    for t, g in zip(topics, got):
+        h = idx.subscribers(t) if t else Subscribers()
+        if canon(g) != canon(h):
+            ok = False
+            print(f"MISMATCH {t!r}:\n  dev  {canon(g)}\n  host {canon(h)}", flush=True)
+    print("corner-case parity:", "OK" if ok else "FAIL", flush=True)
+    return ok
+
+def test_random(n_subs=3000, n_topics=512, seed=11):
+    rng = random.Random(seed)
+    v = [f"s{i}" for i in range(12)] + ["+"]
+    idx = TopicsIndex()
+    for i in range(n_subs):
+        depth = rng.randint(1, 5)
+        parts = [rng.choice(v) for _ in range(depth)]
+        if rng.random() < 0.2:
+            parts = parts[:rng.randint(0, depth-1)] + ["#"]
+        f = "/".join(parts)
+        try:
+            idx.subscribe(f"cl{i%700}", Subscription(filter=f, qos=i % 3, identifier=i % 5))
+        except Exception:
+            pass
+    built = build_flat(idx, max_levels=6)
+    built["dev"] = tuple(jnp.asarray(a) for a in
+                         (built["table"], built["all_ids"], built["pat_kind"], built["pat_depth"], built["pat_mask"]))
+    vt = [f"s{i}" for i in range(12)]
+    topics = ["/".join(rng.choice(vt) for _ in range(rng.randint(1, 6))) for _ in range(n_topics)]
+    got = subscribers_flat(built, topics, idx)
+    bad = 0
+    for t, g in zip(topics, got):
+        if canon(g) != canon(idx.subscribers(t)):
+            bad += 1
+            if bad <= 3:
+                print(f"MISMATCH {t!r}", flush=True)
+    print(f"random parity: {n_topics-bad}/{n_topics} OK", flush=True)
+    return bad == 0
+
+if __name__ == "__main__":
+    ok1 = test_correctness()
+    ok2 = test_random()
+    print("ALL OK" if (ok1 and ok2) else "FAILURES", flush=True)

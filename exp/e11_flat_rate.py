@@ -1,0 +1,66 @@
+"""E11: flat matcher kernel rate at cfg2 scale (1M subs)."""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+sys.path.insert(0, "/root/repo/exp")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from e10_flat_proto import build_flat, flat_match, subscribers_flat, canon
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+N = int(os.environ.get("NSUBS", "1000000"))
+for i in range(N):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+def topic():
+    return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+
+t0 = time.perf_counter()
+built = build_flat(index, max_levels=4, window=16)
+print(f"total build {time.perf_counter()-t0:.1f}s", flush=True)
+built["dev"] = tuple(jnp.asarray(a) for a in
+                     (built["table"], built["all_ids"], built["pat_kind"], built["pat_depth"], built["pat_mask"]))
+jax.block_until_ready(built["dev"])
+
+# parity spot-check
+topics = [topic() for _ in range(64)]
+got = subscribers_flat(built, topics, index)
+bad = sum(1 for t, g in zip(topics, got) if canon(g) != canon(index.subscribers(t)))
+print(f"parity: {64-bad}/64", flush=True)
+
+salt = built["salt"]
+for B in (16384, 65536, 131072):
+    batches = [[topic() for _ in range(B)] for _ in range(4)]
+    resident = [tuple(jnp.asarray(a) for a in tokenize_topics(bt, 4, salt)[:4]) for bt in batches]
+    jax.block_until_ready(resident)
+    args = built["dev"]
+    def run(i):
+        return flat_match(*args, *resident[i % 4], window=16, max_levels=4, out_slots=64)
+    np.asarray(run(0)[0].ravel()[0])  # compile+complete
+    iters = 10
+    t0 = time.perf_counter()
+    outs = [run(i) for i in range(iters)]
+    np.asarray(outs[-1][0].ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    print(f"B={B}: {dt*1e3:7.2f} ms/batch -> {B/dt:,.0f} topics/s", flush=True)
+
+# profile one batch
+os.makedirs("/root/repo/exp/trace2", exist_ok=True)
+B = 16384
+batch = [[topic() for _ in range(B)]]
+res = tuple(jnp.asarray(a) for a in tokenize_topics(batch[0], 4, salt)[:4])
+jax.block_until_ready(res)
+args = built["dev"]
+np.asarray(flat_match(*args, *res, window=16, max_levels=4, out_slots=64)[0].ravel()[0])
+with jax.profiler.trace("/root/repo/exp/trace2"):
+    out = flat_match(*args, *res, window=16, max_levels=4, out_slots=64)
+    np.asarray(out[0].ravel()[0])
+print("trace2 written", flush=True)

@@ -1,0 +1,35 @@
+import sys, time, os
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/root/repo/exp")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from e10_flat_proto import build_flat, flat_match
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]; v1 = [f"device{i}" for i in range(100)]; v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+print("subscribing", flush=True)
+for i in range(1_000_000):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+print("building", flush=True)
+built = build_flat(index, max_levels=4, window=16)
+dev = tuple(jnp.asarray(a) for a in (built["table"], built["all_ids"], built["pat_kind"], built["pat_depth"], built["pat_mask"]))
+jax.block_until_ready(dev)
+salt = built["salt"]
+B = 16384
+res = tuple(jnp.asarray(a) for a in tokenize_topics(
+    [f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}" for _ in range(B)], 4, salt)[:4])
+jax.block_until_ready(res)
+print("compiling", flush=True)
+np.asarray(flat_match(*dev, *res, window=16, max_levels=4, out_slots=64)[0].ravel()[0])
+print("compiled", flush=True)
+os.makedirs("/root/repo/exp/trace2", exist_ok=True)
+with jax.profiler.trace("/root/repo/exp/trace2"):
+    out = flat_match(*dev, *res, window=16, max_levels=4, out_slots=64)
+    np.asarray(out[0].ravel()[0])
+print("done", flush=True)

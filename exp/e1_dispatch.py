@@ -1,0 +1,63 @@
+"""E1: dispatch-floor + batch scaling on the real device.
+
+Q1: what does an async-dispatched trivial kernel cost per call (tunnel floor)?
+Q2: does match kernel time scale with B (compute-bound) or stay flat (dispatch-bound)?
+"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+
+dev = jax.devices()[0]
+print("device:", dev, dev.platform)
+
+# Q1: trivial kernel async-dispatch floor
+x = jnp.zeros((8,), jnp.int32)
+f = jax.jit(lambda v, i: v + i)
+f(x, 0).block_until_ready()
+for n in (10, 50):
+    t0 = time.perf_counter()
+    outs = [f(x, i) for i in range(n)]
+    outs[-1].block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"trivial async x{n}: {dt*1e3:.1f}ms total, {dt/n*1e3:.2f}ms/call")
+
+# Q2: match kernel scaling with B
+from mqtt_tpu.ops import TpuMatcher
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+N = int(os.environ.get("NSUBS", "200000"))
+for i in range(N):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+
+matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+t0 = time.perf_counter(); matcher.rebuild(); print(f"rebuild {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
+salt = matcher.csr.salt
+
+def topic():
+    return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+
+for B in (1024, 4096, 16384, 65536):
+    topics = [topic() for _ in range(B)]
+    res = tuple(jnp.asarray(a) for a in tokenize_topics(topics, 4, salt)[:4])
+    jax.block_until_ready(res)
+    t0 = time.perf_counter()
+    matcher.match_tokens(*res)[0].block_until_ready()
+    compile_dt = time.perf_counter() - t0
+    # async pipelined
+    iters = 8
+    t0 = time.perf_counter()
+    outs = [matcher.match_tokens(*res)[0] for _ in range(iters)]
+    outs[-1].block_until_ready()
+    dt = time.perf_counter() - t0
+    print(f"B={B}: first={compile_dt*1e3:.0f}ms, {dt/iters*1e3:.1f}ms/batch, {B*iters/dt:,.0f} topics/s")

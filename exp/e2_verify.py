@@ -1,0 +1,58 @@
+"""E2: does the 0.1ms/batch result survive (a) forced completion via scalar
+D2H, (b) 1M subs, (c) distinct batch buffers per call — the bench's exact
+kernel-measurement shape?"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from mqtt_tpu.ops import TpuMatcher
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+N = int(os.environ.get("NSUBS", "1000000"))
+for i in range(N):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+
+matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+t0 = time.perf_counter(); matcher.rebuild(); print(f"rebuild {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}", flush=True)
+salt = matcher.csr.salt
+
+def topic():
+    return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+
+B = 16384
+batches = [[topic() for _ in range(B)] for _ in range(4)]
+resident = [tuple(jnp.asarray(a) for a in tokenize_topics(bt, 4, salt)[:4]) for bt in batches]
+jax.block_until_ready(resident)
+
+red = jax.jit(lambda o: o.sum())
+# warmup/compile
+out = matcher.match_tokens(*resident[0])[0]
+s = red(out); print("warm sum:", int(np.asarray(s)), flush=True)
+
+for iters in (8, 20):
+    t0 = time.perf_counter()
+    outs = [matcher.match_tokens(*resident[i % 4])[0] for i in range(iters)]
+    val = int(np.asarray(red(outs[-1])))  # scalar D2H forces full completion of last
+    dt = time.perf_counter() - t0
+    print(f"iters={iters} distinct-batches: {dt:.3f}s, {dt/iters*1e3:.1f}ms/batch, {B*iters/dt:,.0f} topics/s (sum={val})", flush=True)
+
+# force completion of EVERY batch via scalar chain
+t0 = time.perf_counter()
+acc = None
+outs = []
+for i in range(20):
+    o = matcher.match_tokens(*resident[i % 4])[0]
+    outs.append(red(o))
+vals = [int(np.asarray(x)) for x in outs]
+dt = time.perf_counter() - t0
+print(f"per-batch scalar D2H x20: {dt:.3f}s, {dt/20*1e3:.1f}ms/batch, {B*20/dt:,.0f} topics/s", flush=True)

@@ -1,0 +1,60 @@
+"""E3: sync-method / table-size / buffer-identity matrix for kernel timing."""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from mqtt_tpu.ops import TpuMatcher
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+red = jax.jit(lambda o: o.sum())
+
+def build(N):
+    rng = random.Random(7)
+    v0 = [f"region{i}" for i in range(100)]
+    v1 = [f"device{i}" for i in range(100)]
+    v2 = [f"metric{i}" for i in range(100)]
+    index = TopicsIndex()
+    for i in range(N):
+        parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+        if rng.random() < 0.10:
+            parts[rng.randrange(3)] = "+"
+        index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+    def topic():
+        return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+    return index, topic
+
+B = 16384
+for N in (200_000, 1_000_000):
+    index, topic = build(N)
+    m = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+    m.rebuild()
+    print(f"N={N} nodes={m.csr.num_nodes} iters_search={m.search_iters}", flush=True)
+    salt = m.csr.salt
+    batches = [[topic() for _ in range(B)] for _ in range(4)]
+    resident = [tuple(jnp.asarray(a) for a in tokenize_topics(bt, 4, salt)[:4]) for bt in batches]
+    jax.block_until_ready(resident)
+    int(np.asarray(red(m.match_tokens(*resident[0])[0])))  # compile+warm
+
+    iters = 12
+    # A: same buffer, block_until_ready
+    t0 = time.perf_counter()
+    outs = [m.match_tokens(*resident[0])[0] for _ in range(iters)]
+    outs[-1].block_until_ready()
+    print(f"  same+bur:      {(time.perf_counter()-t0)/iters*1e3:8.1f} ms/batch", flush=True)
+    # B: same buffer, scalar D2H on last
+    t0 = time.perf_counter()
+    outs = [m.match_tokens(*resident[0])[0] for _ in range(iters)]
+    int(np.asarray(red(outs[-1])))
+    print(f"  same+d2h:      {(time.perf_counter()-t0)/iters*1e3:8.1f} ms/batch", flush=True)
+    # C: distinct buffers, block_until_ready
+    t0 = time.perf_counter()
+    outs = [m.match_tokens(*resident[i % 4])[0] for i in range(iters)]
+    outs[-1].block_until_ready()
+    print(f"  distinct+bur:  {(time.perf_counter()-t0)/iters*1e3:8.1f} ms/batch", flush=True)
+    # D: distinct buffers, scalar D2H on last
+    t0 = time.perf_counter()
+    outs = [m.match_tokens(*resident[i % 4])[0] for i in range(iters)]
+    int(np.asarray(red(outs[-1])))
+    print(f"  distinct+d2h:  {(time.perf_counter()-t0)/iters*1e3:8.1f} ms/batch", flush=True)

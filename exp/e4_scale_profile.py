@@ -1,0 +1,53 @@
+"""E4: B-scaling at fixed table + jax.profiler attempt + ablations."""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from mqtt_tpu.ops import TpuMatcher
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+red = jax.jit(lambda o: o.sum())
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+for i in range(200_000):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+def topic():
+    return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
+
+m = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+m.rebuild()
+salt = m.csr.salt
+print("nodes", m.csr.num_nodes, flush=True)
+
+def timeit(B, iters=6):
+    topics = [topic() for _ in range(B)]
+    res = tuple(jnp.asarray(a) for a in tokenize_topics(topics, 4, salt)[:4])
+    jax.block_until_ready(res)
+    int(np.asarray(red(m.match_tokens(*res)[0])))  # compile+complete
+    t0 = time.perf_counter()
+    outs = [m.match_tokens(*res)[0] for _ in range(iters)]
+    int(np.asarray(red(outs[-1])))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"B={B}: {dt*1e3:.1f} ms/batch -> {B/dt:,.0f} topics/s", flush=True)
+    return res
+
+for B in (512, 2048, 8192, 16384):
+    res = timeit(B)
+
+# profiler attempt
+try:
+    os.makedirs("/root/repo/exp/trace", exist_ok=True)
+    with jax.profiler.trace("/root/repo/exp/trace"):
+        out = m.match_tokens(*res)[0]
+        int(np.asarray(red(out)))
+    print("profiler trace written", flush=True)
+except Exception as e:
+    print("profiler failed:", repr(e), flush=True)

@@ -1,0 +1,30 @@
+import sys
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from mqtt_tpu.ops import TpuMatcher
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.ops.matcher import match_batch
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+for i in range(200_000):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+m = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+m.rebuild()
+salt = m.csr.salt
+topics = [f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}" for _ in range(16384)]
+res = tuple(jnp.asarray(a) for a in tokenize_topics(topics, 4, salt)[:4])
+lowered = match_batch.lower(*m.device_arrays, *res, frontier=8, out_slots=64, search_iters=8)
+comp = lowered.compile()
+txt = comp.as_text()
+open("/root/repo/exp/match.hlo.txt", "w").write(txt)
+print("bytes:", len(txt))

@@ -1,0 +1,42 @@
+"""E6: raw gather-primitive microbenchmarks on this TPU."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from functools import partial
+
+B = 16384 * 8  # 131072 indices, matches [16384,8]
+
+def bench(fn, *args, iters=5, warm=2):
+    f = jax.jit(fn)
+    red = jax.jit(lambda o: o.sum())
+    for _ in range(warm):
+        r = f(*args)
+    int(np.asarray(red(f(*args))))
+    t0 = time.perf_counter()
+    outs = [f(*args) for _ in range(iters)]
+    int(np.asarray(red(outs[-1])))
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+key = jax.random.PRNGKey(0)
+for N in (1024, 16384, 262144, 1<<20, 1<<22):
+    table = jnp.arange(N, dtype=jnp.int32)
+    idx = jax.random.randint(key, (B,), 0, N, dtype=jnp.int32)
+    idx2d = idx.reshape(16384, 8)
+    jax.block_until_ready((table, idx, idx2d))
+    t = bench(lambda T, I: T[I], table, idx)
+    print(f"N={N:>8}: 1D take [{B}]          {t*1e3:7.2f} ms  {B/t/1e6:8.1f} M elem/s", flush=True)
+    t = bench(lambda T, I: T[I], table, idx2d)
+    print(f"N={N:>8}: 2D take [16384,8]      {t*1e3:7.2f} ms  {B/t/1e6:8.1f} M elem/s", flush=True)
+    sidx = jnp.sort(idx)
+    t = bench(lambda T, I: T[I], table, sidx)
+    print(f"N={N:>8}: sorted 1D take         {t*1e3:7.2f} ms  {B/t/1e6:8.1f} M elem/s", flush=True)
+    if N <= 16384:
+        # one-hot matmul gather (f32 exact to 2^24)
+        tf = table.astype(jnp.float32)
+        def onehot_gather(T, I):
+            oh = (I[:, None] == jnp.arange(N)[None, :]).astype(jnp.float32)
+            return oh @ T
+        t = bench(onehot_gather, tf, idx)
+        print(f"N={N:>8}: one-hot matmul         {t*1e3:7.2f} ms  {B/t/1e6:8.1f} M elem/s", flush=True)

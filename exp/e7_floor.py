@@ -1,0 +1,35 @@
+"""E7: what costs 15ms? Isolate dispatch floor vs output buffers vs compute."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+B = 131072
+key = jax.random.PRNGKey(0)
+N = 1 << 20
+table = jnp.arange(N, dtype=jnp.int32)
+idx = jax.random.randint(key, (B,), 0, N, dtype=jnp.int32)
+jax.block_until_ready((table, idx))
+
+def bench(name, fn, *args, iters=20):
+    f = jax.jit(fn)
+    red = jax.jit(lambda o: o.sum())
+    int(np.asarray(red(f(*args))))  # compile + warm
+    t0 = time.perf_counter()
+    outs = [f(*args) for _ in range(iters)]
+    int(np.asarray(red(outs[-1])))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:34s} {dt*1e3:8.2f} ms/call", flush=True)
+
+bench("elementwise I+1 -> [131072]", lambda T, I: I + 1, table, idx)
+bench("elementwise I+1 -> scalar sum", lambda T, I: (I + 1).sum(), table, idx)
+bench("gather T[I] -> [131072]", lambda T, I: T[I], table, idx)
+bench("gather T[I] -> scalar sum", lambda T, I: T[I].sum(), table, idx)
+a = jax.random.normal(key, (512, 512), jnp.bfloat16)
+jax.block_until_ready(a)
+bench("matmul 512x512 bf16", lambda A, _: A @ A, a, idx)
+bench("matmul+sum 512x512", lambda A, _: (A @ A).sum(), a, idx)
+# big elementwise: 128MB traffic
+big = jnp.zeros((1 << 25,), jnp.float32)
+jax.block_until_ready(big)
+bench("elementwise on 128MB", lambda X, _: X * 2 + 1, big, idx)

@@ -1,0 +1,60 @@
+"""E8: (a) dispatch cost vs #args / arg bytes; (b) in-dispatch primitive
+rates via fori_loop chaining (no per-op dispatch)."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from functools import partial
+
+def bench_call(name, f, args, iters=20):
+    red = jax.jit(lambda o: jnp.asarray(o).ravel()[:1].sum() if hasattr(o, 'ravel') else o)
+    out = f(*args)
+    first = out[0] if isinstance(out, (tuple, list)) else out
+    first.block_until_ready()
+    np.asarray(first.ravel()[0] if first.ndim else first)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+        first = out[0] if isinstance(out, (tuple, list)) else out
+    np.asarray(first.ravel()[0] if first.ndim else first)
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:44s} {dt*1e3:8.2f} ms/call", flush=True)
+
+# (a) dispatch floor vs arg count / size
+tiny = [jnp.zeros((8,), jnp.int32) for _ in range(16)]
+jax.block_until_ready(tiny)
+f1 = jax.jit(lambda *a: a[0] + 1)
+bench_call("1 tiny arg", f1, tiny[:1])
+f16 = jax.jit(lambda *a: sum(a) + 1)
+bench_call("16 tiny args", f16, tiny)
+big1 = [jnp.zeros((1 << 20,), jnp.int32)]  # 4MB
+big4 = [jnp.zeros((1 << 20,), jnp.int32) for _ in range(4)]  # 4x4MB
+jax.block_until_ready(big1 + big4)
+bench_call("1 x 4MB arg", jax.jit(lambda *a: a[0][:8] + 1), big1)
+bench_call("4 x 4MB args", jax.jit(lambda *a: a[0][:8] + a[1][:8] + a[2][:8] + a[3][:8]), big4)
+big32 = [jnp.zeros((1 << 23,), jnp.int32)]  # 32MB
+jax.block_until_ready(big32)
+bench_call("1 x 32MB arg", jax.jit(lambda *a: a[0][:8] + 1), big32)
+
+# (b) in-dispatch rates: chain K dependent ops inside one jit
+B = 131072
+key = jax.random.PRNGKey(0)
+K = 50
+for N in (1 << 14, 1 << 20, 1 << 22):
+    table = jnp.arange(N, dtype=jnp.int32)
+    idx0 = jax.random.randint(key, (B,), 0, N, dtype=jnp.int32)
+    jax.block_until_ready((table, idx0))
+    @jax.jit
+    def chain_gather(T, I):
+        def body(k, I):
+            return (T[I] + k) % N   # dependent gather chain
+        return jax.lax.fori_loop(0, K, body, I)
+    bench_call(f"{K}x chained gather[{B}] N={N}", chain_gather, (table, idx0), iters=3)
+
+# elementwise chain for reference
+x0 = jnp.zeros((B,), jnp.float32)
+jax.block_until_ready(x0)
+@jax.jit
+def chain_ew(X):
+    return jax.lax.fori_loop(0, K, lambda k, X: X * 1.000001 + k, X)
+bench_call(f"{K}x chained elementwise[{B}]", chain_ew, (x0,), iters=3)

@@ -1,0 +1,56 @@
+"""E9: per-index cost of ROW gathers vs row width; one-hot matmul compaction cost."""
+import sys, time
+sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+
+def bench1(name, f, args, iters=4):
+    out = f(*args)
+    first = out[0] if isinstance(out, (tuple, list)) else out
+    np.asarray(first.ravel()[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(*args)
+        first = out[0] if isinstance(out, (tuple, list)) else out
+    np.asarray(first.ravel()[0])
+    dt = (time.perf_counter() - t0) / iters
+    return dt
+
+key = jax.random.PRNGKey(0)
+S = 1 << 20  # 1M buckets
+BI = 131072  # number of row indices (B*P)
+K = 20  # chained reps inside one dispatch
+
+for W in (1, 8, 16, 32, 64, 128):
+    table = jnp.arange(S * W, dtype=jnp.int32).reshape(S, W) % 65536
+    idx0 = jax.random.randint(key, (BI,), 0, S, dtype=jnp.int32)
+    jax.block_until_ready((table, idx0))
+    @jax.jit
+    def chain(T, I):
+        def body(k, I):
+            rows = T[I]            # [BI, W] row gather
+            return (I + rows[:, 0] + k) % S  # dependency
+        return jax.lax.fori_loop(0, K, body, I)
+    dt = bench1(f"W={W}", chain, (table, idx0))
+    per = dt / K
+    print(f"row width {W:4d} ints: {per*1e3:7.2f} ms per {BI} row-gathers"
+          f" -> {BI/per/1e6:7.1f} M rows/s, {BI*W*4/per/1e9:7.1f} GB/s", flush=True)
+
+# one-hot matmul compaction: [B, J] -> [B, Kc] with positions
+B, J, Kc = 16384, 104, 64
+ids = jax.random.randint(key, (B, J), 0, 65536, dtype=jnp.int32)
+valid = jax.random.bernoulli(key, 0.2, (B, J))
+jax.block_until_ready((ids, valid))
+@jax.jit
+def compact(ids, valid):
+    pos = jnp.cumsum(valid.astype(jnp.int32), axis=1) - 1
+    oh = (valid[:, :, None] & (pos[:, :, None] == jnp.arange(Kc)[None, None, :])).astype(jnp.float32)
+    out = jnp.einsum("bj,bjk->bk", ids.astype(jnp.float32), oh)
+    return out.astype(jnp.int32)
+@jax.jit
+def chain_compact(ids, valid):
+    def body(k, acc):
+        return acc + compact(ids, valid)
+    return jax.lax.fori_loop(0, 10, body, jnp.zeros((B, Kc), jnp.int32))
+dt = bench1("compact", chain_compact, (ids, valid)) / 10
+print(f"one-hot compaction [16384,104]->[.,64]: {dt*1e3:.2f} ms per call", flush=True)

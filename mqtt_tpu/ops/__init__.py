@@ -2,34 +2,45 @@
 
 This package lifts the reference's hot loop — ``TopicsIndex.Subscribers()``
 (reference topics.go:583-628), the wildcard trie walk executed once per
-PUBLISH — onto the TPU as a batched NFA-over-CSR kernel:
+PUBLISH — onto the TPU as a multi-probe flat-hash join (PROFILE.md):
 
-- ``csr``      — compiles the host trie into device-resident CSR arrays
+- ``flat``     — compiles the host trie into a device-resident flat hash
+                 table keyed by whole-path hashes; the jitted match kernel
 - ``hashing``  — host-side topic-level tokenization and dual u32 hashing
-- ``matcher``  — the jitted batched match kernel + the broker-facing
-                 ``TpuMatcher`` (drop-in for ``TopicsIndex.subscribers``)
+- ``matcher``  — the broker-facing ``TpuMatcher`` (drop-in for
+                 ``TopicsIndex.subscribers``)
 - ``delta``    — ``DeltaMatcher``: snapshot + host delta overlay +
-                 background CSR rebuild, for live brokers under churn
+                 background rebuild, for live brokers under churn
 
 The host trie in ``mqtt_tpu.topics`` remains the bit-identical oracle and
-the fallback path (frontier/output overflow, in-flight delta windows).
+the fallback path (spill/saturation routes, in-flight delta windows).
 """
 
-from .csr import CsrIndex, SubEntry, KIND_CLIENT, KIND_INLINE, KIND_SHARED
 from .delta import DeltaMatcher
+from .flat import (
+    FlatIndex,
+    KIND_CLIENT,
+    KIND_INLINE,
+    KIND_SHARED,
+    SubEntry,
+    build_flat_index,
+    flat_match_core,
+)
 from .hashing import hash_token, tokenize_topics
-from .matcher import MatchResult, TpuMatcher, match_batch
+from .matcher import MatcherStats, TpuMatcher, expand_sids
 
 __all__ = [
-    "CsrIndex",
     "DeltaMatcher",
+    "FlatIndex",
     "KIND_CLIENT",
     "KIND_INLINE",
     "KIND_SHARED",
-    "MatchResult",
+    "MatcherStats",
     "SubEntry",
     "TpuMatcher",
+    "build_flat_index",
+    "expand_sids",
+    "flat_match_core",
     "hash_token",
-    "match_batch",
     "tokenize_topics",
 ]

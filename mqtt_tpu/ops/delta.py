@@ -148,11 +148,13 @@ class DeltaMatcher:
         background: bool = True,
         mesh=None,
         transfer_slots: Optional[int] = None,
+        window: int = 16,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
         self.frontier = frontier
         self.out_slots = out_slots
+        self.window = window
         self.rebuild_after = rebuild_after
         self.rebuild_interval = rebuild_interval
         self.background = background
@@ -169,12 +171,17 @@ class DeltaMatcher:
                 topics,
                 mesh=mesh,
                 max_levels=max_levels,
-                frontier=frontier,
                 out_slots=out_slots,
+                window=window,
             )
         else:
             snap = _Snapshot(
-                topics, max_levels, frontier, out_slots, transfer_slots=transfer_slots
+                topics,
+                max_levels,
+                frontier,
+                out_slots,
+                transfer_slots=transfer_slots,
+                window=window,
             )
         snap.rebuild()
         self._snap = snap

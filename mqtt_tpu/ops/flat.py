@@ -1,0 +1,609 @@
+"""The flat-hash device matcher index: wildcard matching as a multi-probe
+hash join instead of a trie walk.
+
+Why not a trie walk on device: TPU random gathers serialize at ~15-27ns per
+index regardless of table size, while each index can fetch a 512-byte row
+for free (PROFILE.md §2). A per-level NFA walk costs O(levels x frontier x
+search) gathered elements per topic (~1,300 for the retired CSR kernel —
+65K topics/s); a whole-path hash join costs O(P) row fetches, where P is
+the number of *globally distinct wildcard shapes* in the filter set — a
+property of the workload that real MQTT subscription sets keep tiny (a
+handful of `+` layouts and `#` depths).
+
+Encoding (reference semantics: topics.go:583-628):
+
+- Every terminal trie path becomes one entry keyed by a 2x u32 whole-path
+  hash; `+` levels hash as a sentinel constant, `#` filters are keyed by
+  (levels-before-#, kind=HASH).
+- The build enumerates the distinct (kind, depth, plus-mask) shapes; a
+  topic of n levels probes each EXACT shape with depth == n and each HASH
+  shape with depth <= n, substituting the sentinel at the shape's `+`
+  positions. Probes are independent -> fully vectorized, one dispatch.
+- The wildcard-walk corner cases are properties of entries, not control
+  flow: `filter/#` matches `filter` itself only when the filter's LAST
+  level is literal (the partKey != "+" rule, topics.go:612) — a per-entry
+  `last_plus` flag; that match excludes inline subscriptions (the
+  parent-inline quirk, topics.go:615) — reg ids ordered before inl ids;
+  `$`-topics never match client subscriptions whose filter starts with a
+  top-level wildcard [MQTT-4.7.1-1/2] but shared/inline subscriptions are
+  exempt (topics.go:637) — a per-entry top_wild flag plus a per-id exempt
+  bit.
+- Anything the device cannot prove is routed to the bit-identical host
+  trie: probes of saturated buckets, entries whose id list exceeds the
+  window, topics deeper than the compiled level cap, and (for the packed
+  transfer path) topics matching more ids than the transfer prefix.
+
+Table layout: `table[S, 16]` u32 = 4 entries/bucket x [key1, key2, meta,
+start]; `all_ids[A]` u32 holds each entry's ids contiguously (reg then
+inl), bit 30 = $-exempt. One probe = one 64-byte bucket row gather + one
+id-window slice gather.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import numpy as np
+
+from ..topics import TopicsIndex
+from .hashing import hash_token
+
+KIND_CLIENT = 0  # a normal client subscription
+KIND_SHARED = 1  # a $SHARE group member
+KIND_INLINE = 2  # an in-process inline subscription
+
+# path-hash domain constants (u32 wraparound arithmetic throughout)
+_M1 = 0x9E3779B1
+_M2 = 0x85EBCA77
+PLUS1 = 0x9E3779B9  # sentinel level-hash for '+' (lane 1)
+PLUS2 = 0xC2B2AE3D  # sentinel level-hash for '+' (lane 2)
+KIND_EXACT = 0x165667B1
+KIND_HASH = 0x27D4EB2F
+
+# meta word bit layout (one per entry)
+_NREG_BITS = 10
+_NINL_SHIFT = 10
+_NINL_BITS = 6
+_TOPWILD_SHIFT = 16
+_LASTPLUS_SHIFT = 17
+_SPILL_SHIFT = 18
+_SAT_SHIFT = 19  # entry-0 meta only: whole bucket saturated at build
+_EXEMPT_BIT = 0x40000000  # in all_ids: shared/inline, exempt from $-mask
+
+ENTRY_INTS = 4
+BUCKET_ENTRIES = 4
+ROW_INTS = ENTRY_INTS * BUCKET_ENTRIES
+
+
+def _bucket(n: int, minimum: int = 16) -> int:
+    """Smallest power-of-two >= n (at least ``minimum``) — the shape bucket
+    that keeps XLA executables reusable across index rebuilds."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _pad_to(a: np.ndarray, size: int, fill) -> np.ndarray:
+    if len(a) >= size:
+        return a
+    return np.concatenate([a, np.full(size - len(a), fill, dtype=a.dtype)])
+
+
+@dataclass
+class SubEntry:
+    """Host-side metadata for one device sub id."""
+
+    kind: int
+    client: str  # client id (CLIENT/SHARED) or "" (INLINE)
+    group_filter: str  # full $SHARE filter (SHARED only)
+    subscription: Any  # packets.Subscription or topics.InlineSubscription
+
+
+@dataclass
+class FlatIndex:
+    """The device-side flat-hash encoding of the subscription set."""
+
+    table: np.ndarray  # u32[S, 16] — 4 x [k1, k2, meta, start] per bucket
+    all_ids: np.ndarray  # u32[A+window] — per-entry id runs, bit30 = exempt
+    pat_kind: np.ndarray  # u32[P] — KIND_EXACT / KIND_HASH
+    pat_depth: np.ndarray  # i32[P]
+    pat_mask: np.ndarray  # u32[P] — '+' level bitmask
+    subs: list[SubEntry] = field(default_factory=list)
+    salt: int = 0
+    window: int = 16
+    max_levels: int = 8
+    n_entries: int = 0
+    n_sat: int = 0  # build-saturated buckets (probes host-route)
+    n_spill: int = 0  # entries with more ids than the window (host-route)
+
+    @property
+    def num_nodes(self) -> int:
+        """Entry count (named for continuity with the retired CSR index)."""
+        return self.n_entries
+
+    @property
+    def num_subs(self) -> int:
+        return len(self.subs)
+
+    @property
+    def num_patterns(self) -> int:
+        return int(self.pat_depth.shape[0])
+
+
+def _mix_np(h: np.ndarray, t: np.ndarray) -> np.ndarray:
+    h = (h ^ t).astype(np.uint32)
+    h = ((h << np.uint32(13)) | (h >> np.uint32(19))).astype(np.uint32)
+    return (h * np.uint32(_M1)).astype(np.uint32)
+
+
+def _walk_terminals(index: TopicsIndex):
+    """Yield (path_levels, particle) for every trie node carrying
+    subscriptions. Iterative: deep tries must not recurse."""
+    stack = [(index.root, [])]
+    while stack:
+        p, path = stack.pop()
+        if (
+            p.subscriptions.get_all()
+            or p.shared.get_all()
+            or p.inline_subscriptions.get_all()
+        ):
+            yield path, p
+        for key, child in p.particles.items():
+            stack.append((child, path + [key]))
+
+
+def build_flat_index(
+    index: TopicsIndex,
+    max_levels: int = 8,
+    salt: int = 0,
+    window: int = 16,
+    min_buckets: int = 1024,
+    _retries: int = 6,
+) -> FlatIndex:
+    """Compile the host trie into a :class:`FlatIndex`.
+
+    Retries with a fresh salt when (a) two distinct paths collide on the
+    64-bit key or (b) a real token hashes to the `+` sentinel pair
+    (probability ~2^-64 each). Filters deeper than ``max_levels`` are
+    omitted: every topic they could match is deeper than ``max_levels``
+    too and therefore host-routed before probing.
+    """
+    paths: list[list[str]] = []
+    nodes = []
+    for path, p in _walk_terminals(index):
+        paths.append(path)
+        nodes.append(p)
+    n_all = len(paths)
+
+    # per-entry shape + level strings
+    is_hash = np.zeros(n_all, dtype=bool)
+    keep = np.ones(n_all, dtype=bool)
+    depths = np.zeros(n_all, dtype=np.int32)
+    masks = np.zeros(n_all, dtype=np.uint32)
+    level_strs: list[list[str]] = []
+    for i, path in enumerate(paths):
+        hsh = bool(path) and path[-1] == "#"
+        levels = path[:-1] if hsh else path
+        if len(levels) > max_levels:
+            keep[i] = False
+            level_strs.append([])
+            continue
+        is_hash[i] = hsh
+        depths[i] = len(levels)
+        m = 0
+        for d, tok in enumerate(levels):
+            if tok == "+":
+                m |= 1 << d
+        masks[i] = m
+        level_strs.append(levels)
+
+    # level token hashes, vectorized via the cached per-token hasher
+    tok1 = np.zeros((n_all, max_levels), dtype=np.uint32)
+    tok2 = np.zeros((n_all, max_levels), dtype=np.uint32)
+    for i, levels in enumerate(level_strs):
+        m = int(masks[i])
+        for d, tok in enumerate(levels):
+            if (m >> d) & 1:
+                tok1[i, d] = PLUS1
+                tok2[i, d] = PLUS2
+            else:
+                a, b = hash_token(tok, salt)
+                tok1[i, d] = a
+                tok2[i, d] = b
+                if a == PLUS1 and b == PLUS2:  # sentinel collision
+                    if _retries <= 0:
+                        raise RuntimeError("persistent '+' sentinel collision")
+                    return build_flat_index(
+                        index, max_levels, salt + 1, window, min_buckets, _retries - 1
+                    )
+
+    # whole-path hashes (vectorized over entries, looped over levels)
+    kind_w = np.where(is_hash, np.uint32(KIND_HASH), np.uint32(KIND_EXACT))
+    with np.errstate(over="ignore"):
+        h1 = (depths.astype(np.uint32) * np.uint32(_M2)) ^ kind_w
+        h2 = (depths.astype(np.uint32) * np.uint32(_M1)) ^ kind_w
+        for d in range(max_levels):
+            use = d < depths
+            h1 = np.where(use, _mix_np(h1, tok1[:, d]), h1)
+            h2 = np.where(use, _mix_np(h2, tok2[:, d]), h2)
+
+    sel = np.nonzero(keep)[0]
+    key64 = (h1[sel].astype(np.uint64) << np.uint64(32)) | h2[sel].astype(np.uint64)
+    if len(np.unique(key64)) != len(key64):  # distinct paths collided
+        if _retries <= 0:
+            raise RuntimeError("persistent path-key collision")
+        return build_flat_index(
+            index, max_levels, salt + 1, window, min_buckets, _retries - 1
+        )
+
+    # sub-id table + per-entry id runs (reg = client+shared first, then inl)
+    subs: list[SubEntry] = []
+    ids_flat: list[int] = []
+    starts = np.zeros(n_all, dtype=np.uint32)
+    nregs = np.zeros(n_all, dtype=np.uint32)
+    ninls = np.zeros(n_all, dtype=np.uint32)
+    spills = np.zeros(n_all, dtype=bool)
+    top_wilds = np.zeros(n_all, dtype=bool)
+    n_spill = 0
+    for i in sel:
+        node = nodes[i]
+        path = paths[i]
+        top_wilds[i] = bool(path) and path[0] in ("+", "#")
+        reg: list[int] = []
+        inl: list[int] = []
+        for client, sub in node.subscriptions.get_all().items():
+            sid = len(subs)
+            subs.append(SubEntry(KIND_CLIENT, client, "", sub))
+            reg.append(sid)
+        for group in node.shared.get_all().values():
+            for client, sub in group.items():
+                sid = len(subs)
+                subs.append(SubEntry(KIND_SHARED, client, sub.filter, sub))
+                reg.append(sid | _EXEMPT_BIT)  # shared: $-mask exempt
+        for isub in node.inline_subscriptions.get_all().values():
+            sid = len(subs)
+            subs.append(SubEntry(KIND_INLINE, "", "", isub))
+            inl.append(sid | _EXEMPT_BIT)  # inline: $-mask exempt
+        total = len(reg) + len(inl)
+        if total > window or len(reg) >= (1 << _NREG_BITS) or len(inl) >= (
+            1 << _NINL_BITS
+        ):
+            spills[i] = True  # device hits host-route these entries
+            n_spill += 1
+            continue
+        starts[i] = len(ids_flat)
+        nregs[i] = len(reg)
+        ninls[i] = len(inl)
+        ids_flat.extend(reg)
+        ids_flat.extend(inl)
+    if len(subs) >= 1 << 24:
+        # the kernel's f32 one-hot compaction is exact only below 2^24; a
+        # silent rounding there would corrupt sub ids — fail loudly instead
+        raise RuntimeError(
+            f"flat index supports < {1 << 24} subscription entries, got {len(subs)}"
+        )
+    # power-of-two bucket the id pool so rebuilds under churn reuse the
+    # jitted executable (padding sits beyond every entry's window)
+    all_ids = _pad_to(
+        np.asarray(ids_flat + [0] * window, dtype=np.uint32),
+        _bucket(len(ids_flat) + window, minimum=max(16, window)),
+        0,
+    )
+
+    # bucket placement: slot = h1 & (S-1), 4 entries/bucket; a bucket the
+    # placement overfills is marked saturated — the device host-routes any
+    # probe touching it, so dropped entries cannot cause false negatives
+    # size for ~0.6 entries per 4-slot bucket: P(bucket > 4 | Poisson 0.6)
+    # ~ 3e-4, so saturation host-routes a negligible probe fraction
+    n = len(sel)
+    S = _bucket(max(min_buckets, int(n / 0.6) + 1), minimum=1024)
+    slot = (h1[sel] & np.uint32(S - 1)).astype(np.int64)
+    order = np.argsort(slot, kind="stable")
+    sslot = slot[order]
+    first = np.searchsorted(sslot, sslot, side="left")
+    rank = np.arange(n) - first  # occupancy rank within each bucket
+    counts = np.bincount(slot, minlength=S)
+    sat = counts > BUCKET_ENTRIES
+    n_sat = int(sat.sum())
+
+    meta = (
+        nregs[sel]
+        | (ninls[sel] << np.uint32(_NINL_SHIFT))
+        | (top_wilds[sel].astype(np.uint32) << np.uint32(_TOPWILD_SHIFT))
+        | (
+            (is_hash[sel] & (depths[sel] > 0) & (((masks[sel] >> (depths[sel] - 1).astype(np.uint32)) & 1) == 1)).astype(np.uint32)
+            << np.uint32(_LASTPLUS_SHIFT)
+        )
+        | (spills[sel].astype(np.uint32) << np.uint32(_SPILL_SHIFT))
+    )
+    table = np.zeros((S, BUCKET_ENTRIES, ENTRY_INTS), dtype=np.uint32)
+    ok = ~sat[slot[order]]
+    o = order[ok]
+    cols = np.stack([h1[sel][o], h2[sel][o], meta[o], starts[sel][o]], axis=1)
+    table[slot[o], rank[ok]] = cols
+    table[np.nonzero(sat)[0], 0, 2] = np.uint32(1 << _SAT_SHIFT)
+    table = table.reshape(S, ROW_INTS)
+
+    # distinct probe shapes, power-of-two padded (pads have depth -1 and are
+    # never active) so churn rebuilds keep the jit signature stable
+    shape_keys = np.stack(
+        [kind_w[sel], depths[sel].astype(np.uint32), masks[sel]], axis=1
+    )
+    if len(shape_keys):
+        uniq = np.unique(shape_keys, axis=0)
+    else:
+        uniq = np.zeros((0, 3), dtype=np.uint32)
+    pat_kind = uniq[:, 0].astype(np.uint32)
+    pat_depth = uniq[:, 1].astype(np.int32)
+    pat_mask = uniq[:, 2].astype(np.uint32)
+    if len(uniq):
+        pb = _bucket(len(uniq), minimum=2)
+        pat_kind = _pad_to(pat_kind, pb, np.uint32(KIND_EXACT))
+        pat_depth = _pad_to(pat_depth, pb, np.int32(-1))
+        pat_mask = _pad_to(pat_mask, pb, np.uint32(0))
+
+    return FlatIndex(
+        table=table,
+        all_ids=all_ids,
+        pat_kind=pat_kind,
+        pat_depth=pat_depth,
+        pat_mask=pat_mask,
+        subs=subs,
+        salt=salt,
+        window=window,
+        max_levels=max_levels,
+        n_entries=n,
+        n_sat=n_sat,
+        n_spill=n_spill,
+    )
+
+
+# ---------------------------------------------------------------------------
+# device kernel
+# ---------------------------------------------------------------------------
+
+
+def flat_match_core(
+    table,
+    all_ids,
+    pat_kind,
+    pat_depth,
+    pat_mask,
+    tok1,
+    tok2,
+    lengths,
+    is_dollar,
+    *,
+    window: int,
+    max_levels: int,
+    out_slots: int,
+):
+    """Match ``B`` topics against the flat index in one dispatch.
+
+    Returns ``(sub_ids[B, out_slots] int32 (-1 padded), totals[B] int32,
+    overflow[B] bool)`` — ``overflow`` marks topics the host must re-walk
+    (saturated-bucket probe, spilled entry hit, or more matches than
+    ``out_slots``). Pure jnp; jit/shard_map-able (mqtt_tpu.parallel shards
+    the table's bucket axis across a device mesh).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    B, L = tok1.shape
+    P = pat_depth.shape[0]
+    S = table.shape[0]
+    m1 = jnp.uint32(_M1)
+    m2 = jnp.uint32(_M2)
+    if P == 0:  # empty index: nothing matches, nothing overflows
+        return (
+            jnp.full((B, out_slots), -1, jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), bool),
+        )
+
+    def rotl13(x):
+        return (x << jnp.uint32(13)) | (x >> jnp.uint32(19))
+
+    # whole-path pattern hashes [B, P], sentinel at each pattern's '+' levels
+    kd = pat_depth.astype(jnp.uint32)
+    h1 = jnp.broadcast_to((kd * m2 ^ pat_kind)[None, :], (B, P))
+    h2 = jnp.broadcast_to((kd * m1 ^ pat_kind)[None, :], (B, P))
+    for d in range(max_levels):
+        use = (d < pat_depth)[None, :]
+        plus = ((pat_mask >> np.uint32(d)) & 1)[None, :] == 1
+        t1 = jnp.where(plus, jnp.uint32(PLUS1), tok1[:, d][:, None])
+        t2 = jnp.where(plus, jnp.uint32(PLUS2), tok2[:, d][:, None])
+        h1 = jnp.where(use, rotl13(h1 ^ t1) * m1, h1)
+        h2 = jnp.where(use, rotl13(h2 ^ t2) * m1, h2)
+
+    n = lengths[:, None]  # [B, 1]
+    hash_pat = (pat_kind == jnp.uint32(KIND_HASH))[None, :]
+    active = jnp.where(hash_pat, pat_depth[None, :] <= n, pat_depth[None, :] == n)
+
+    # ONE bucket row per probe: [B, P, 16]
+    slot = jnp.where(active, (h1 & jnp.uint32(S - 1)).astype(jnp.int32), 0)
+    rows = table[slot].reshape(B, P, BUCKET_ENTRIES, ENTRY_INTS)
+
+    hit = (rows[..., 0] == h1[..., None]) & (rows[..., 1] == h2[..., None])
+    hit = hit & active[..., None]  # [B, P, 4]; at most one per probe
+    meta = jnp.where(hit, rows[..., 2], 0).max(axis=-1)
+    start = jnp.where(hit, rows[..., 3], 0).max(axis=-1)
+    hit_any = hit.any(axis=-1)
+    sat_probe = ((rows[:, :, 0, 2] >> _SAT_SHIFT) & 1) == 1
+
+    nreg = (meta & ((1 << _NREG_BITS) - 1)).astype(jnp.int32)
+    ninl = ((meta >> _NINL_SHIFT) & ((1 << _NINL_BITS) - 1)).astype(jnp.int32)
+    top_wild = (meta >> _TOPWILD_SHIFT) & 1
+    last_plus = (meta >> _LASTPLUS_SHIFT) & 1
+    spill = ((meta >> _SPILL_SHIFT) & 1) == 1
+
+    # 'filter/#' matching the exact-length topic: only via a literal last
+    # level (topics.go:612), and without inline subs (topics.go:615)
+    exact_len = pat_depth[None, :] == n
+    valid_hit = hit_any & ~(hash_pat & exact_len & (last_plus == 1))
+    count = jnp.where(hash_pat & exact_len, nreg, nreg + ninl)
+    count = jnp.where(valid_hit, count, 0)
+
+    # ONE id-window slice per probe: [B, P, W]
+    idx = jnp.where(valid_hit, start.astype(jnp.int32), 0)
+    wins = jax.lax.gather(
+        all_ids,
+        idx.reshape(B, P, 1),
+        jax.lax.GatherDimensionNumbers(
+            offset_dims=(2,), collapsed_slice_dims=(), start_index_map=(0,)
+        ),
+        slice_sizes=(window,),
+        mode="clip",
+    ).reshape(B, P, window)
+
+    ks = jnp.arange(window, dtype=jnp.int32)
+    validk = ks[None, None, :] < count[..., None]
+    exempt = (wins >> np.uint32(30)) & 1
+    dollar_drop = (
+        is_dollar[:, None, None] & (top_wild[..., None] == 1) & (exempt == 0)
+    )
+    validk = validk & ~dollar_drop
+    sid = (wins & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+
+    flat_sid = jnp.where(validk, sid, -1).reshape(B, P * window)
+    flat_valid = validk.reshape(B, P * window)
+    totals = flat_valid.sum(axis=1).astype(jnp.int32)
+
+    # compact valid ids to the front via a one-hot matmul (MXU work is
+    # free where gathers are not — PROFILE.md §2); f32 is exact for ids
+    # < 2^24, and bit 30 was stripped above
+    pos = jnp.cumsum(flat_valid.astype(jnp.int32), axis=1) - 1
+    oh = (
+        flat_valid[:, :, None]
+        & (pos[:, :, None] == jnp.arange(out_slots, dtype=jnp.int32)[None, None, :])
+    )
+    out = jnp.einsum(
+        "bj,bjk->bk",
+        (flat_sid + 1).astype(jnp.float32),
+        oh.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32) - 1
+
+    overflow = (
+        (sat_probe & active).any(axis=1)
+        | (spill & valid_hit).any(axis=1)
+        | (totals > out_slots)
+    )
+    return out, totals, overflow
+
+
+def _jit_core():
+    import jax
+
+    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots"))(
+        flat_match_core
+    )
+
+
+class _LazyJit:
+    """Defer the jax.jit wrapping until first call (keeps `import
+    mqtt_tpu.ops` light and CPU-only test processes fast)."""
+
+    def __init__(self):
+        self._fn = None
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kwargs):
+        if self._fn is None:
+            with self._lock:
+                if self._fn is None:
+                    self._fn = _jit_core()
+        return self._fn(*args, **kwargs)
+
+
+flat_match = _LazyJit()
+
+
+def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
+    """Pack a tokenized batch into ONE int32 host array ``[B, 2L+2]`` so a
+    match call performs a single H2D transfer (the tunneled link charges
+    per transfer: 65ms+ RTT each — PROFILE.md §2)."""
+    return np.concatenate(
+        [
+            tok1.view(np.int32),
+            tok2.view(np.int32),
+            lengths[:, None].astype(np.int32),
+            is_dollar[:, None].astype(np.int32),
+        ],
+        axis=1,
+    )
+
+
+def _packed_core(
+    table,
+    all_ids,
+    pat_kind,
+    pat_depth,
+    pat_mask,
+    packed_tokens,
+    *,
+    window,
+    max_levels,
+    out_slots,
+    transfer_slots,
+):
+    """flat_match_core with ONE packed input and ONE packed output transfer:
+    in ``[B, 2L+2]`` i32, out ``[B, transfer_slots+2]`` i32 = (sid prefix |
+    total | overflow). Topics matching more ids than the prefix re-walk on
+    host, so any ``transfer_slots`` stays bit-identical."""
+    import jax
+    import jax.numpy as jnp
+
+    L = (packed_tokens.shape[1] - 2) // 2
+    tok1 = jax.lax.bitcast_convert_type(packed_tokens[:, :L], jnp.uint32)
+    tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
+    lengths = packed_tokens[:, 2 * L]
+    is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
+    out, totals, overflow = flat_match_core(
+        table,
+        all_ids,
+        pat_kind,
+        pat_depth,
+        pat_mask,
+        tok1,
+        tok2,
+        lengths,
+        is_dollar,
+        window=window,
+        max_levels=max_levels,
+        out_slots=out_slots,
+    )
+    return jnp.concatenate(
+        [
+            out[:, :transfer_slots],
+            totals[:, None],
+            overflow[:, None].astype(jnp.int32),
+        ],
+        axis=1,
+    )
+
+
+class _LazyJitPacked(_LazyJit):
+    def __call__(self, *args, **kwargs):
+        if self._fn is None:
+            with self._lock:
+                if self._fn is None:
+                    import jax
+
+                    self._fn = partial(
+                        jax.jit,
+                        static_argnames=(
+                            "window",
+                            "max_levels",
+                            "out_slots",
+                            "transfer_slots",
+                        ),
+                    )(_packed_core)
+        return self._fn(*args, **kwargs)
+
+
+flat_match_packed = _LazyJitPacked()

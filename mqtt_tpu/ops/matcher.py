@@ -1,62 +1,37 @@
-"""The batched TPU topic matcher: an NFA frontier walk over the CSR trie.
+"""The broker-facing device matcher.
 
-One jitted call matches a batch of PUBLISH topics against the device-resident
-subscription index (reference hot loop: topics.go:593-628). Per level the
-frontier advances through sorted-literal binary search and the ``+`` edge,
-``#`` children are gathered at every level, and terminal gathers replicate
-the reference's corner cases exactly:
+``TpuMatcher`` compiles the host trie into a :mod:`flat-hash index
+<mqtt_tpu.ops.flat>`, matches PUBLISH-topic batches in one device dispatch,
+and merges results host-side — bit-identical to
+``TopicsIndex.subscribers`` (reference walk: topics.go:583-628) because
+every case the device cannot prove is re-walked on the host trie.
 
-- ``filter/#`` matches ``filter`` itself only via the literal terminal child
-  (the ``partKey != "+"`` rule, topics.go:612)
-- the terminal child-``#`` gather excludes inline subscriptions (the
-  parent-inline quirk, topics.go:615)
-- client subscriptions with a top-level wildcard never match ``$``-topics
-  [MQTT-4.7.1-1/2]; shared and inline subscriptions are exempt
-  (topics.go:637)
-
-Shapes are fully static (XLA-friendly): ``L`` padded levels, ``F`` frontier
-slots, ``K`` output sub-id slots; frontier or output overflow routes the
-topic to the host trie, so results stay bit-identical at any parameter
-choice.
+The previous CSR/NFA trie-walk kernel was retired in round 4: it was
+gather-bound at ~65K topics/s on hardware whose random-gather rate caps
+any per-level walk two orders of magnitude below the 10M/s target; see
+PROFILE.md for the trace-backed analysis and the flat design's budget.
 """
 
 from __future__ import annotations
 
-import math
 import time
 from dataclasses import dataclass
-from functools import partial
 from typing import Optional
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..topics import Subscribers, TopicsIndex
-from .csr import KIND_CLIENT, KIND_INLINE, KIND_SHARED, CsrIndex, build_csr
+from .flat import (
+    KIND_CLIENT,
+    KIND_INLINE,
+    KIND_SHARED,
+    FlatIndex,
+    build_flat_index,
+    flat_match,
+    flat_match_packed,
+    pack_tokens,
+)
 from .hashing import tokenize_topics
-
-
-def _bucket(n: int, minimum: int = 16) -> int:
-    """The smallest power-of-two >= n (at least ``minimum``) — the shape
-    bucket that keeps XLA executables reusable across index rebuilds."""
-    size = minimum
-    while size < n:
-        size *= 2
-    return size
-
-
-def _pad_to(a: np.ndarray, size: int, fill) -> np.ndarray:
-    if len(a) >= size:
-        return a
-    return np.concatenate([a, np.full(size - len(a), fill, dtype=a.dtype)])
-
-
-def _pad_ptr(ptr: np.ndarray, extra: int) -> np.ndarray:
-    """Extend a CSR pointer array by ``extra`` empty trailing ranges."""
-    if extra == 0:
-        return ptr
-    return np.concatenate([ptr, np.full(extra, ptr[-1], dtype=ptr.dtype)])
 
 
 def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None) -> Subscribers:
@@ -83,22 +58,15 @@ def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None
 
 
 @dataclass
-class MatchResult:
-    """Raw device output for one batch."""
-
-    sub_ids: np.ndarray  # int32[B,K], -1 padded / $-masked
-    counts: np.ndarray  # int32[B] — total gathered (pre-$-mask)
-    overflow: np.ndarray  # bool[B] — frontier/output/level overflow
-
-
-@dataclass
 class MatcherStats:
-    """Observability counters for a device matcher (SURVEY §5 tracing note).
+    """Observability counters for a device matcher (SURVEY §5 tracing
+    note). Exported as retained ``$SYS/broker/matcher/...`` topics by the
+    server's $SYS loop when a device matcher is active (server.py).
 
     ``host_fallbacks`` counts topics re-walked on the host for any reason;
-    ``overflows`` counts the subset caused by frontier/output/level overflow
-    (the rest are delta-overlay routes). Exported as ``$SYS/broker/matcher``
-    values by the server when a device matcher is active.
+    ``overflows`` counts the subset caused by device-side routing (spilled
+    entries, saturated buckets, over-deep topics) rather than delta-overlay
+    or transfer-prefix routes.
     """
 
     batches: int = 0
@@ -123,329 +91,104 @@ class MatcherStats:
         return out
 
 
-def match_core(
-    edge_ptr,
-    edge_tok1,
-    edge_tok2,
-    edge_dest,
-    plus_child,
-    hash_child,
-    reg_ptr,
-    inl_ptr,
-    all_ids,
-    inl_offset,
-    top_wild,
-    tok1,
-    tok2,
-    lengths,
-    is_dollar,
-    *,
-    frontier: int = 16,
-    out_slots: int = 64,
-    search_iters: int = 16,
-):
-    """Match ``B`` topics (``tok1/tok2[B,L]``) against the CSR index.
-
-    Returns ``(sub_ids[B,K], counts[B], overflow[B])``.
-    """
-    b, max_levels = tok1.shape
-    f = frontier
-
-    ev_starts = []
-    ev_lens = []
-
-    def emit(nodes, ptr, id_offset):
-        """Queue a gather event per frontier slot for ``nodes`` (or -1)."""
-        valid = nodes >= 0
-        safe = jnp.where(valid, nodes, 0)
-        start = jnp.where(valid, ptr[safe] + id_offset, 0)
-        length = jnp.where(valid, ptr[safe + 1] - ptr[safe], 0)
-        ev_starts.append(start)
-        ev_lens.append(length)
-
-    def literal_children(nodes, t1, t2):
-        """Binary search each node's sorted literal edges for the level
-        token; -1 when absent. Fixed ``search_iters`` iterations."""
-        valid = nodes >= 0
-        safe = jnp.where(valid, nodes, 0)
-        lo = edge_ptr[safe]
-        hi = edge_ptr[safe + 1]
-        hi0 = hi
-        n_edges = edge_tok1.shape[0]
-        for _ in range(search_iters):
-            cont = lo < hi
-            mid = (lo + hi) // 2
-            mid_safe = jnp.clip(mid, 0, n_edges - 1)
-            go_right = cont & (edge_tok1[mid_safe] < t1)
-            new_lo = jnp.where(go_right, mid + 1, lo)
-            new_hi = jnp.where(cont & ~go_right, mid, hi)
-            lo, hi = new_lo, new_hi
-        pos = lo
-        pos_safe = jnp.where(pos < hi0, pos, jnp.maximum(hi0 - 1, 0))
-        hit = (
-            valid
-            & (pos < hi0)
-            & (edge_tok1[pos_safe] == t1)
-            & (edge_tok2[pos_safe] == t2)
-        )
-        return jnp.where(hit, edge_dest[pos_safe], -1)
-
-    nodes = jnp.full((b, f), -1, dtype=jnp.int32)
-    has_topic = lengths > 0
-    nodes = nodes.at[:, 0].set(jnp.where(has_topic, 0, -1))
-    frontier_overflow = jnp.zeros(b, dtype=bool)
-
-    for d in range(max_levels):
-        active = (d < lengths)[:, None]  # [B,1]
-        is_term = (d == lengths - 1)[:, None]
-        cur = jnp.where(active, nodes, -1)
-        valid = cur >= 0
-        safe = jnp.where(valid, cur, 0)
-
-        # any-level '#' gather: subs + shared + inline (topics.go:621-625)
-        hc = jnp.where(valid, hash_child[safe], -1)
-        emit(hc, reg_ptr, 0)
-        emit(hc, inl_ptr, inl_offset)
-
-        t1 = tok1[:, d][:, None]
-        t2 = tok2[:, d][:, None]
-        lit = literal_children(cur, t1, t2)
-        plus = jnp.where(valid, plus_child[safe], -1)
-
-        # terminal gathers (topics.go:603-617)
-        lit_t = jnp.where(is_term, lit, -1)
-        plus_t = jnp.where(is_term, plus, -1)
-        emit(lit_t, reg_ptr, 0)
-        emit(lit_t, inl_ptr, inl_offset)
-        emit(plus_t, reg_ptr, 0)
-        emit(plus_t, inl_ptr, inl_offset)
-        # filter/# matches filter via the LITERAL terminal child only, and
-        # gathers no inline subs (the partKey != "+" + parent-inline quirks)
-        lit_t_safe = jnp.where(lit_t >= 0, lit_t, 0)
-        wild_t = jnp.where(lit_t >= 0, hash_child[lit_t_safe], -1)
-        emit(wild_t, reg_ptr, 0)
-
-        # advance the frontier for non-terminal topics
-        adv = active & ~is_term
-        cand = jnp.concatenate(
-            [jnp.where(adv, lit, -1), jnp.where(adv, plus, -1)], axis=1
-        )  # [B,2F]
-        n_valid = (cand >= 0).sum(axis=1)
-        frontier_overflow = frontier_overflow | (n_valid > f)
-        order = jnp.argsort(cand < 0, axis=1, stable=True)  # valid first
-        nodes = jnp.take_along_axis(cand, order, axis=1)[:, :f]
-
-    # expand gather events into K output slots
-    ev_start = jnp.stack(ev_starts, axis=1).reshape(b, -1)  # [B,E*F]
-    ev_len = jnp.stack(ev_lens, axis=1).reshape(b, -1)
-    offsets = jnp.cumsum(ev_len, axis=1)
-    totals = offsets[:, -1]
-
-    ks = jnp.arange(out_slots)
-    ev_idx = jax.vmap(lambda off: jnp.searchsorted(off, ks, side="right"))(offsets)
-    ev_idx = jnp.minimum(ev_idx, offsets.shape[1] - 1)
-    prev = jnp.where(
-        ev_idx > 0,
-        jnp.take_along_axis(offsets, jnp.maximum(ev_idx - 1, 0), axis=1),
-        0,
-    )
-    base = jnp.take_along_axis(ev_start, ev_idx, axis=1)
-    pos = base + (ks[None, :] - prev)
-    pos_safe = jnp.clip(pos, 0, all_ids.shape[0] - 1)
-    sids = all_ids[pos_safe]
-
-    in_range = ks[None, :] < totals[:, None]
-    sid_safe = jnp.where(in_range, sids, 0)
-    dollar_masked = is_dollar[:, None] & top_wild[sid_safe]
-    out = jnp.where(in_range & ~dollar_masked, sids, -1)
-    overflow = frontier_overflow | (totals > out_slots)
-    return out, totals, overflow
-
-
-# The jitted entry point; match_core stays un-jitted so mqtt_tpu.parallel can
-# shard_map it over a device mesh.
-match_batch = partial(
-    jax.jit, static_argnames=("frontier", "out_slots", "search_iters")
-)(match_core)
-
-
-def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
-    """Pack a tokenized batch into ONE int32 host array ``[B, 2L+2]`` so a
-    match call performs a single H2D transfer. Every individual transfer
-    pays the link round trip (65ms+ on tunneled devices), so four small
-    arrays per call would quadruple the e2e wall."""
-    return np.concatenate(
-        [
-            tok1.view(np.int32),
-            tok2.view(np.int32),
-            lengths[:, None].astype(np.int32),
-            is_dollar[:, None].astype(np.int32),
-        ],
-        axis=1,
-    )
-
-
-@partial(
-    jax.jit,
-    static_argnames=("frontier", "out_slots", "search_iters", "transfer_slots"),
-)
-def match_batch_packed(*args, frontier, out_slots, search_iters, transfer_slots):
-    """match_core with ONE packed input transfer and ONE packed output
-    transfer per batch.
-
-    Input: the CSR arrays plus a single ``[B, 2L+2]`` int32 token block
-    from :func:`pack_tokens` (bitcast back to uint32 device-side). Output:
-    ``[B, transfer_slots+2]`` int32 = (sid prefix | total | overflow).
-    Host↔device links with high per-transfer cost (PCIe round trips;
-    worse, tunneled devices) make per-array transfers the dominant e2e
-    cost; topics whose match count exceeds the transferred prefix are
-    re-walked on host, so any ``transfer_slots`` preserves bit-identical
-    results."""
-    *csr_args, packed_tokens = args
-    L = (packed_tokens.shape[1] - 2) // 2
-    tok1 = jax.lax.bitcast_convert_type(packed_tokens[:, :L], jnp.uint32)
-    tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
-    lengths = packed_tokens[:, 2 * L]
-    is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
-    out, totals, overflow = match_core(
-        *csr_args,
-        tok1,
-        tok2,
-        lengths,
-        is_dollar,
-        frontier=frontier,
-        out_slots=out_slots,
-        search_iters=search_iters,
-    )
-    return jnp.concatenate(
-        [
-            out[:, :transfer_slots],
-            totals[:, None].astype(jnp.int32),
-            overflow[:, None].astype(jnp.int32),
-        ],
-        axis=1,
-    )
-
-
 class TpuMatcher:
-    """Broker-facing device matcher: compiles the host trie to CSR, matches
-    batches on device, merges results host-side, and falls back to the host
-    trie on overflow or staleness — results are always bit-identical to
-    ``TopicsIndex.subscribers``."""
+    """Broker-facing device matcher over the flat-hash index.
+
+    ``frontier`` is accepted for API continuity with the retired NFA
+    kernel and ignored — the flat matcher has no frontier; wildcard-shape
+    fan-out is a build-time property of the filter set (ops/flat.py).
+    ``out_slots`` caps the per-topic device result (larger sets host-route);
+    ``window`` caps ids per filter path; ``transfer_slots`` sizes the D2H
+    prefix of the packed transfer path.
+    """
 
     def __init__(
         self,
         topics: TopicsIndex,
         max_levels: int = 8,
-        frontier: int = 16,
+        frontier: int = 16,  # ignored (flat matcher); kept for API compat
         out_slots: int = 64,
         transfer_slots: Optional[int] = None,
+        window: int = 16,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
         self.frontier = frontier
         self.out_slots = out_slots
+        self.window = window
         # how many sid slots come back per topic in the single packed D2H;
         # topics with more matches (but no device overflow) re-walk on host.
         # Smaller values trade rare host walks for less D2H traffic — the
         # dominant e2e cost on high-latency host<->device links.
         self.transfer_slots = min(transfer_slots or out_slots, out_slots)
         self.stats = MatcherStats()
-        # one (csr, device_arrays, search_iters, built_version) tuple,
-        # swapped atomically by rebuild() so a concurrent match never mixes
+        # one (flat_index, device_arrays, built_version) tuple, swapped
+        # atomically by rebuild() so a concurrent match never mixes
         # arrays and salt from different generations
         self._state: Optional[tuple] = None
 
     # -- index lifecycle ---------------------------------------------------
 
     def rebuild(self) -> None:
-        """Recompile the host trie into device arrays.
+        """Recompile the host trie into device arrays. Shapes are
+        power-of-two bucketed (ops/flat.py) so successive rebuilds under
+        churn reuse the jitted executable."""
+        import jax.numpy as jnp
 
-        Every array is padded to a power-of-two bucket so that successive
-        rebuilds under churn reuse the jitted executable — shapes (and
-        therefore XLA compilations) only change when a bucket doubles.
-        Padding is semantically inert: padded nodes are unreachable (their
-        CSR ranges are empty and no edge points at them) and padded edge /
-        id slots sit beyond every node's pointer range.
-        """
         t0 = time.perf_counter()
         version = self.topics.version
-        csr = build_csr(self.topics)
-        n = csr.num_nodes
-        nb = _bucket(n)
-        pad_n = nb - n
-        edge_ptr = _pad_ptr(csr.edge_ptr, pad_n)
-        reg_ptr = _pad_ptr(csr.reg_ptr, pad_n)
-        inl_ptr = _pad_ptr(csr.inl_ptr, pad_n)
-        plus_child = _pad_to(csr.plus_child, nb, -1)
-        hash_child = _pad_to(csr.hash_child, nb, -1)
-        eb = _bucket(len(csr.edge_dest))
-        edge_tok1 = _pad_to(csr.edge_tok1, eb, 0)
-        edge_tok2 = _pad_to(csr.edge_tok2, eb, 0)
-        edge_dest = _pad_to(csr.edge_dest, eb, -1)
-        all_ids = np.concatenate([csr.reg_ids, csr.inl_ids]).astype(np.int32)
-        all_ids = _pad_to(all_ids, _bucket(len(all_ids)), 0)
-        top_wild = _pad_to(csr.top_wild, _bucket(len(csr.subs)), False)
-        # round the binary-search depth up so it, too, changes rarely
-        iters = max(1, math.ceil(math.log2(max(2, csr.max_degree + 1))) + 1)
-        search_iters = min(32, math.ceil(iters / 4) * 4)
+        flat = build_flat_index(
+            self.topics, max_levels=self.max_levels, window=self.window
+        )
         device_arrays = tuple(
             jnp.asarray(a)
             for a in (
-                edge_ptr,
-                edge_tok1,
-                edge_tok2,
-                edge_dest,
-                plus_child,
-                hash_child,
-                reg_ptr,
-                inl_ptr,
-                all_ids,
-                np.int32(len(csr.reg_ids)),
-                top_wild,
+                flat.table,
+                flat.all_ids,
+                flat.pat_kind,
+                flat.pat_depth,
+                flat.pat_mask,
             )
         )
-        self._state = (csr, device_arrays, search_iters, version)
+        self._state = (flat, device_arrays, version)
         self.stats.rebuilds += 1
         self.stats.rebuild_seconds += time.perf_counter() - t0
 
     @property
-    def csr(self) -> Optional[CsrIndex]:
+    def csr(self) -> Optional[FlatIndex]:
+        """The compiled index (named for continuity with the CSR era)."""
         st = self._state
         return st[0] if st is not None else None
+
+    index = csr
 
     @property
     def stale(self) -> bool:
         st = self._state
-        return st is None or st[3] != self.topics.version
+        return st is None or st[2] != self.topics.version
 
     @property
     def device_arrays(self) -> tuple:
-        """The CSR index as device arrays (built on demand)."""
+        """The flat index as device arrays (built on demand)."""
         if self._state is None or self.stale:
             self.rebuild()
         return self._state[1]
-
-    @property
-    def search_iters(self) -> int:
-        st = self._state
-        return st[2] if st is not None else 1
 
     def match_tokens(self, tok1, tok2, lengths, is_dollar):
         """Raw device match over pre-tokenized topics; returns device
         ``(sub_ids[B,K], totals[B], overflow[B])``. The benchmark path."""
         if self._state is None or self.stale:
             self.rebuild()
-        _, arrays, search_iters, _ = self._state
-        return match_batch(
+        flat, arrays, _ = self._state
+        return flat_match(
             *arrays,
             tok1,
             tok2,
             lengths,
             is_dollar,
-            frontier=self.frontier,
+            window=flat.window,
+            max_levels=flat.max_levels,
             out_slots=self.out_slots,
-            search_iters=search_iters,
         )
 
     # -- matching ----------------------------------------------------------
@@ -459,19 +202,21 @@ class TpuMatcher:
         in flight while the first resolves hides the host<->device round
         trip — the broker's staging loop and the benchmark both rely on it.
         """
+        import jax.numpy as jnp
+
         if self._state is None or self.stale:
             self.rebuild()
-        csr, arrays, search_iters, _ = self._state
+        flat, arrays, _ = self._state
         ts = self.transfer_slots
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
-            topics, self.max_levels, csr.salt
+            topics, flat.max_levels, flat.salt
         )
-        packed_dev = match_batch_packed(
+        packed_dev = flat_match_packed(
             *arrays,
             jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
-            frontier=self.frontier,
+            window=flat.window,
+            max_levels=flat.max_levels,
             out_slots=self.out_slots,
-            search_iters=search_iters,
             transfer_slots=ts,
         )
 
@@ -499,7 +244,7 @@ class TpuMatcher:
                 else:
                     row = out[i]
                     results.append(
-                        expand_sids(csr.subs, row[row >= 0], Subscribers())
+                        expand_sids(flat.subs, row[row >= 0], Subscribers())
                     )
             return results
 

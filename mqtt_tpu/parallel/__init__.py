@@ -3,7 +3,7 @@
 The reference broker has no distributed backend (clustering is a roadmap
 item, reference README.md:59-62); this package is the TPU-native scaling
 layer the rebuild adds: subscriptions shard across mesh devices (each shard
-holds its own CSR sub-trie), PUBLISH batches shard across the batch axis,
+holds its own flat-hash index), PUBLISH batches shard across the batch axis,
 and per-shard match results union through an ``all_gather`` over ICI —
 XLA collectives via ``shard_map`` over a ``jax.sharding.Mesh``, never
 host-side gathers.

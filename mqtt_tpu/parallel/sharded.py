@@ -4,7 +4,8 @@ Mesh axes:
 
 - ``batch`` — data parallelism over the PUBLISH topic batch
 - ``subs``  — model-style parallelism over the subscription set: each device
-  along this axis holds the CSR trie of its subscription shard
+  along this axis holds the flat-hash index (ops/flat.py) of its
+  subscription shard
 
 One jitted step matches every (topic-shard, sub-shard) tile locally and
 ``all_gather``s the per-shard match lists over the ``subs`` axis (ICI), so
@@ -49,11 +50,20 @@ def shard_map(*args, disable_rep_check=False, **kwargs):
         kwargs[_REP_KWARG] = False
     return _shard_map(*args, **kwargs)
 
-from ..packets import Subscription
 from ..topics import Mutation, Subscribers, TopicsIndex
-from ..ops.csr import KIND_CLIENT, KIND_INLINE, KIND_SHARED, build_csr
+from ..ops.flat import (
+    KIND_CLIENT,
+    KIND_INLINE,
+    KIND_SHARED,
+    SubEntry,
+    _bucket,
+    _pad_to,
+    _walk_terminals,
+    build_flat_index,
+    flat_match_core,
+)
 from ..ops.hashing import tokenize_topics
-from ..ops.matcher import MatcherStats, _bucket, _pad_to, expand_sids, match_core
+from ..ops.matcher import MatcherStats, expand_sids
 
 _log = logging.getLogger("mqtt_tpu.parallel")
 
@@ -88,6 +98,8 @@ class ShardedTpuMatcher:
     With ``incremental=True`` (default) the matcher subscribes to the
     trie's mutation stream and ``rebuild()`` recompiles only the shards
     whose subscriptions changed; call :meth:`close` to detach the observer.
+    ``frontier`` is accepted for API continuity and ignored (the flat
+    matcher has no frontier).
     """
 
     # rebuild() retries torn walks and quiesces internally — callers (the
@@ -100,8 +112,9 @@ class ShardedTpuMatcher:
         topics: TopicsIndex,
         mesh: Optional[Mesh] = None,
         max_levels: int = 8,
-        frontier: int = 16,
+        frontier: int = 16,  # ignored (flat matcher); kept for API compat
         out_slots: int = 64,
+        window: int = 16,
         incremental: bool = True,
     ) -> None:
         self.topics = topics
@@ -109,17 +122,18 @@ class ShardedTpuMatcher:
         self.max_levels = max_levels
         self.frontier = frontier
         self.out_slots = out_slots
+        self.window = window
         self.n_shards = self.mesh.shape["subs"]
         self.n_batch = self.mesh.shape["batch"]
         self.incremental = incremental
         self.stats = MatcherStats()
-        # one (arrays, tables, salt, search_iters, step) tuple swapped
-        # atomically so a concurrent match never mixes generations
+        # one (arrays, tables, salt, step) tuple swapped atomically so a
+        # concurrent match never mixes generations
         self._compiled: Optional[tuple] = None
         self._built_version = -1
-        # per-shard replica tries + their last compiled CSRs + dirty flags;
-        # guarded by _state_lock (held briefly — the observer runs under the
-        # main trie's lock, so installs must never block on slow work)
+        # per-shard replica tries + their last compiled flat indexes +
+        # dirty flags; guarded by _state_lock (held briefly — the observer
+        # runs under the main trie's lock, so installs must never block)
         self._state_lock = threading.Lock()
         # serializes whole rebuilds: without it, a concurrent rebuild can
         # observe the storm path's intermediate state (fresh replicas,
@@ -127,10 +141,10 @@ class ShardedTpuMatcher:
         # snapshot as current via the empty-dirty early return
         self._rebuild_mutex = threading.Lock()
         self._replicas: Optional[list[TopicsIndex]] = None
-        self._csrs: Optional[list] = None
+        self._flats: Optional[list] = None
         self._dirty = [False] * self.n_shards
         self._salt = 0
-        self._step_cache: dict[int, Callable] = {}
+        self._step: Optional[Callable] = None
         if incremental:
             topics.add_observer(self._on_mutation)
 
@@ -174,17 +188,28 @@ class ShardedTpuMatcher:
         Full path (first build, or after a replica fault): walk the live
         trie, partition by stable hash into fresh replicas, compile all
         shards. Incremental path: recompile only dirty shards' replicas and
-        restack — cost bounded by the dirty shards, not the index."""
+        restack — cost bounded by the dirty shards, not the index.
+
+        The observer's fault path can null the replicas mid-compile; each
+        attempt would then fold nothing, so retry a bounded number of
+        times instead of recursing unboundedly under a persistent fault."""
         t0 = time.perf_counter()
         with self._rebuild_mutex:
             # the except runs INSIDE the mutex: re-marking dirty after
             # release would leave a gap where a concurrent rebuild sees
             # empty dirty flags and stamps the stale snapshot as current
             try:
-                if self._replicas is None or not self.incremental:
-                    self._full_rebuild()
+                for attempt in range(4):
+                    if self._replicas is None or not self.incremental:
+                        done = self._full_rebuild()
+                    else:
+                        done = self._incremental_rebuild()
+                    if done:
+                        break
                 else:
-                    self._incremental_rebuild()
+                    raise RuntimeError(
+                        "rebuild could not complete: persistent replica faults"
+                    )
             except BaseException:
                 # exception safety: a rebuild that dies after clearing dirty
                 # flags (e.g. device_put fault in _assemble) must not let the
@@ -196,50 +221,50 @@ class ShardedTpuMatcher:
         self.stats.rebuilds += 1
         self.stats.rebuild_seconds += time.perf_counter() - t0
 
-    def _partition(self, full) -> list[TopicsIndex]:
+    def _partition_live(self) -> list[TopicsIndex]:
+        """Walk the live trie and split its subscriptions into fresh
+        per-shard replicas. Concurrent structural mutations can tear the
+        walk (RuntimeError/KeyError from dict iteration) — callers retry."""
         replicas = [TopicsIndex() for _ in range(self.n_shards)]
-        for entry in full.subs:
-            if entry.kind in (KIND_CLIENT, KIND_SHARED):
+        for _path, node in _walk_terminals(self.topics):
+            for client, sub in node.subscriptions.get_all().items():
+                s = shard_of(KIND_CLIENT, client, sub.filter, 0, self.n_shards)
+                replicas[s].subscribe(client, sub)
+            for group in node.shared.get_all().values():
+                for client, sub in group.items():
+                    s = shard_of(KIND_SHARED, client, sub.filter, 0, self.n_shards)
+                    replicas[s].subscribe(client, sub)
+            for isub in node.inline_subscriptions.get_all().values():
                 s = shard_of(
-                    entry.kind, entry.client, entry.subscription.filter, 0, self.n_shards
+                    KIND_INLINE, "", isub.filter, isub.identifier, self.n_shards
                 )
-                replicas[s].subscribe(entry.client, entry.subscription)
-            else:
-                s = shard_of(
-                    entry.kind,
-                    "",
-                    entry.subscription.filter,
-                    entry.subscription.identifier,
-                    self.n_shards,
-                )
-                replicas[s].inline_subscribe(entry.subscription)
+                replicas[s].inline_subscribe(isub)
         return replicas
 
-    def _full_rebuild(self) -> None:
+    def _full_rebuild(self) -> bool:
         for attempt in range(8):
             v0 = self.topics.version
             try:
-                full = build_csr(self.topics, salt=self._salt)
+                replicas = self._partition_live()
             except (RuntimeError, KeyError):
                 continue  # concurrent mutation tore the walk; retry
-            replicas = self._partition(full)
-            csrs = self._compile_all(replicas)
+            flats = self._compile_all(replicas)
             if self.topics.version != v0:
                 continue  # doomed: skip the H2D transfer, retry the walk
             # device placement happens OUTSIDE _state_lock: the observer
             # runs under the broker trie's lock and blocks on _state_lock,
             # so holding it across an H2D transfer (65ms+ on tunneled
             # links) would stall every subscribe for the transfer time
-            compiled = self._assemble(csrs)
+            compiled = self._assemble(flats)
             with self._state_lock:
                 if self.topics.version == v0:
                     self._replicas = replicas
-                    self._csrs = csrs
+                    self._flats = flats
                     self._dirty = [False] * self.n_shards
-                    self._salt = csrs[0].salt
+                    self._salt = flats[0].salt
                     self._compiled = compiled
                     self._built_version = v0
-                    return
+                    return True
             # a mutation landed while we walked: the fresh replicas may miss
             # it (the observer was still feeding the OLD replicas) — retry
         # mutation storm: quiesce the trie ONLY long enough to walk it and
@@ -249,27 +274,25 @@ class ShardedTpuMatcher:
         # _built_version = v0 keeps `stale` true until they are folded
         with self.topics._lock:
             v0 = self.topics.version
-            full = build_csr(self.topics, salt=self._salt)
-            replicas = self._partition(full)
+            replicas = self._partition_live()
             with self._state_lock:
                 self._replicas = replicas
                 self._dirty = [False] * self.n_shards
-        csrs = self._compile_all(replicas, retry_tears=True)
-        compiled = self._assemble(csrs)
+        flats = self._compile_all(replicas, retry_tears=True)
+        compiled = self._assemble(flats)
         with self._state_lock:
             fault = self._replicas is not replicas
             if not fault:
-                self._csrs = csrs
-                self._salt = csrs[0].salt
+                self._flats = flats
+                self._salt = flats[0].salt
                 self._compiled = compiled
                 self._built_version = v0
-        if fault:
-            # the observer's fault path nulled the replicas mid-compile;
-            # returning now would report success for a rebuild that folded
-            # nothing (DeltaMatcher would drop its overlay) — redo in full
-            self._full_rebuild()
+        # on fault the observer nulled the replicas mid-compile; returning
+        # success would report a rebuild that folded nothing (DeltaMatcher
+        # would drop its overlay) — the caller retries, boundedly
+        return not fault
 
-    def _incremental_rebuild(self) -> None:
+    def _incremental_rebuild(self) -> bool:
         # read the version under the trie lock: the trie bumps it BEFORE
         # notifying observers, so a bare read could adopt a version whose
         # mutation hasn't marked its shard dirty yet — stamping that
@@ -280,10 +303,10 @@ class ShardedTpuMatcher:
         with self._state_lock:
             # snapshot under the lock: the observer's exception path sets
             # _replicas = None concurrently, and reading a torn
-            # replicas/csrs/dirty trio would crash the rebuild thread with
+            # replicas/flats/dirty trio would crash the rebuild thread with
             # an exception type no caller retries (TypeError)
             replicas = self._replicas
-            if replicas is None or self._csrs is None:
+            if replicas is None or self._flats is None:
                 replicas = None  # fall through to a full rebuild below
             else:
                 dirty = [s for s in range(self.n_shards) if self._dirty[s]]
@@ -292,136 +315,155 @@ class ShardedTpuMatcher:
                 # if this walk already included it
                 for s in dirty:
                     self._dirty[s] = False
-                csrs = list(self._csrs)
+                flats = list(self._flats)
+                if not dirty and self._compiled is not None:
+                    # nothing to fold: stamp INSIDE the lock — outside it, a
+                    # mutation between the dirty check and the stamp could
+                    # publish a version whose shard was never folded
+                    self._built_version = version
+                    return True
         if replicas is None:
-            self._full_rebuild()
-            return
-        if not dirty and self._compiled is not None:
-            self._built_version = version
-            return
+            return self._full_rebuild()
         for s in dirty:
-            csrs[s] = self._compile_shard(s, replicas)
-        salts = {c.salt for c in csrs}
-        if len(salts) > 1:
-            # a shard compile hit a hash collision and bumped its salt:
-            # topic hashing must be uniform, recompile everything on max
-            self._salt = max(salts)
-            csrs = self._compile_all(replicas, retry_tears=True)
-        compiled = self._assemble(csrs)
+            # compile at the generation's bucket count up front: defaulting
+            # to the minimum would make _unify recompile the shard again
+            flats[s] = self._compile_shard(
+                s, replicas, min_buckets=flats[s].table.shape[0]
+            )
+        flats = self._unify(flats, replicas)
+        compiled = self._assemble(flats)
         with self._state_lock:
             fault = self._replicas is not replicas
             if not fault:
-                self._csrs = csrs
-                self._salt = csrs[0].salt  # keep in sync: a bump here must
+                self._flats = flats
+                self._salt = flats[0].salt  # keep in sync: a bump here must
                 # not force the next incremental round to recompile the world
                 self._compiled = compiled
                 self._built_version = version
-        if fault:
-            # observer fault nulled the replicas mid-compile; a bare return
-            # would report success without folding anything (DeltaMatcher
-            # would drop overlay entries the snapshot never absorbed)
-            self._full_rebuild()
+        # on fault: see _full_rebuild — the caller retries, boundedly
+        return not fault
 
-    def _compile_shard(self, s: int, replicas, salt: Optional[int] = None):
+    def _compile_shard(
+        self, s: int, replicas, salt: Optional[int] = None, min_buckets: int = 1024
+    ):
         rep = replicas[s]
         salt = self._salt if salt is None else salt
         for _ in range(8):
             try:
-                return build_csr(rep, salt=salt)
+                return build_flat_index(
+                    rep,
+                    max_levels=self.max_levels,
+                    salt=salt,
+                    window=self.window,
+                    min_buckets=min_buckets,
+                )
             except (RuntimeError, KeyError):
                 continue  # replica mutated mid-walk; retry
         with rep._lock:  # mutation storm on this shard: build quiesced
-            return build_csr(rep, salt=salt)
+            return build_flat_index(
+                rep,
+                max_levels=self.max_levels,
+                salt=salt,
+                window=self.window,
+                min_buckets=min_buckets,
+            )
 
-    def _compile_all(self, replicas: list[TopicsIndex], retry_tears: bool = False) -> list:
-        """Compile every shard at a uniform salt. With ``retry_tears`` the
-        per-shard compile retries walks torn by concurrent replica
-        mutations (live replicas); without it a tear propagates to the
-        caller (fresh, unpublished replicas can't tear)."""
+    def _compile_all(self, replicas: list[TopicsIndex], retry_tears: bool = False):
+        """Compile every shard at a uniform salt and bucket count. With
+        ``retry_tears`` the per-shard compile retries walks torn by
+        concurrent replica mutations (live replicas); without it a tear
+        propagates to the caller (fresh, unpublished replicas can't tear)."""
 
-        def compile_one(s: int, salt: int):
+        def compile_one(s: int, salt: int, min_buckets: int = 1024):
             if retry_tears:
-                return self._compile_shard(s, replicas, salt=salt)
-            return build_csr(replicas[s], salt=salt)
+                return self._compile_shard(s, replicas, salt=salt, min_buckets=min_buckets)
+            return build_flat_index(
+                replicas[s],
+                max_levels=self.max_levels,
+                salt=salt,
+                window=self.window,
+                min_buckets=min_buckets,
+            )
 
-        csrs = [compile_one(s, self._salt) for s in range(len(replicas))]
-        # re-unify until every shard agrees: a shard can collide again at
-        # the bumped salt, and serving mixed-salt CSRs would silently drop
-        # that shard's subscribers (topics tokenize at one salt)
+        flats = [compile_one(s, self._salt) for s in range(len(replicas))]
+        return self._unify(flats, replicas, compile_one)
+
+    def _unify(self, flats, replicas, compile_one=None):
+        """Recompile shards until all
+
+        - agree on the hash salt (topics tokenize at ONE salt: serving
+          mixed-salt shards would silently drop subscribers), and
+        - agree on the bucket count (the stacked table is one array; each
+          shard's ``slot = h1 & (S-1)`` must use the stacked S).
+        """
+        if compile_one is None:
+
+            def compile_one(s, salt, min_buckets=1024):
+                return self._compile_shard(s, replicas, salt=salt, min_buckets=min_buckets)
+
         for _ in range(8):
-            salts = {c.salt for c in csrs}
-            if len(salts) == 1:
-                return csrs
+            salts = {f.salt for f in flats}
+            sizes = {f.table.shape[0] for f in flats}
+            if len(salts) == 1 and len(sizes) == 1:
+                return flats
             salt = max(salts)
-            csrs = [compile_one(s, salt) for s in range(len(replicas))]
-        if len({c.salt for c in csrs}) == 1:  # the final recompile counts too
-            return csrs
-        raise RuntimeError("shard salt unification failed; persistent hash collisions")
+            S = max(sizes)
+            flats = [
+                f
+                if f.salt == salt and f.table.shape[0] == S
+                else compile_one(s, salt, min_buckets=S)
+                for s, f in enumerate(flats)
+            ]
+        if len({(f.salt, f.table.shape[0]) for f in flats}) == 1:
+            return flats
+        raise RuntimeError("shard salt/size unification failed")
 
-    def _assemble(self, csrs) -> tuple:
-        """Stack per-shard CSRs into mesh-placed device arrays and return
-        the compiled generation (the caller swaps it in under _state_lock —
-        device placement itself must happen lock-free). Shapes are
-        power-of-two bucketed so churn rebuilds reuse the jitted
-        executable."""
+    def _assemble(self, flats) -> tuple:
+        """Stack per-shard flat indexes into mesh-placed device arrays and
+        return the compiled generation (the caller swaps it in under
+        _state_lock — device placement itself must happen lock-free).
+        Shapes are power-of-two bucketed so churn rebuilds reuse the jitted
+        executable. Padding is inert: pad patterns have depth -1 (never
+        active) and pad id slots sit beyond every entry's window."""
 
-        def stack(get, fill=0, min_len=1):
-            arrs = [np.asarray(get(c)) for c in csrs]
-            n = _bucket(max(min_len, max(len(a) for a in arrs)), minimum=max(2, min_len))
+        def stack(get, fill=0, min_len=2):
+            arrs = [np.asarray(get(f)) for f in flats]
+            n = _bucket(max(min_len, max(len(a) for a in arrs)), minimum=min_len)
             return np.stack([_pad_to(a, n, fill) for a in arrs])
 
-        max_degree = max(c.max_degree for c in csrs)
-        iters = max(1, int(np.ceil(np.log2(max(2, max_degree + 1)))) + 1)
-        search_iters = min(32, int(np.ceil(iters / 4)) * 4)
-        # place every stacked array on the mesh ONCE, leading (shard) dim
-        # split over the ``subs`` axis — an explicit NamedSharding, NOT a
-        # default-device jnp.asarray, so no other backend (e.g. a real TPU
-        # when the mesh is a virtual CPU one) is ever touched
+        # table bucket counts are unified by _unify; stack directly
+        table = np.stack([f.table for f in flats])
         shard_sharding = NamedSharding(self.mesh, P("subs"))
         arrays = tuple(
             jax.device_put(np.asarray(a), shard_sharding)
             for a in (
-                stack(lambda c: c.edge_ptr, min_len=2),
-                stack(lambda c: c.edge_tok1.astype(np.uint32)),
-                stack(lambda c: c.edge_tok2.astype(np.uint32)),
-                stack(lambda c: c.edge_dest, fill=-1),
-                stack(lambda c: c.plus_child, fill=-1),
-                stack(lambda c: c.hash_child, fill=-1),
-                stack(lambda c: c.reg_ptr, min_len=2),
-                stack(lambda c: c.inl_ptr, min_len=2),
-                stack(
-                    lambda c: np.concatenate([c.reg_ids, c.inl_ids]).astype(np.int32),
-                    fill=-1,
-                ),
-                np.asarray([np.int32(len(c.reg_ids)) for c in csrs]),
-                stack(lambda c: c.top_wild.astype(bool)),
+                table,
+                stack(lambda f: f.all_ids, min_len=max(2, self.window)),
+                stack(lambda f: f.pat_kind, fill=np.uint32(0)),
+                stack(lambda f: f.pat_depth, fill=np.int32(-1)),
+                stack(lambda f: f.pat_mask, fill=np.uint32(0)),
             )
         )
-        tables = [c.subs for c in csrs]
-        step = self._get_step(search_iters)
-        return (arrays, tables, csrs[0].salt, search_iters, step)
+        tables = [f.subs for f in flats]
+        step = self._get_step()
+        return (arrays, tables, flats[0].salt, step)
 
-    def _get_step(self, search_iters: int):
-        """The jitted SPMD step for a given binary-search depth. Cached so
-        churn rebuilds with unchanged shapes reuse the XLA executable."""
-        step = self._step_cache.get(search_iters)
-        if step is not None:
-            return step
+    def _get_step(self):
+        """The jitted SPMD step (cached; jax re-traces per shape)."""
+        if self._step is not None:
+            return self._step
         mesh = self.mesh
-        frontier, out_slots, iters = self.frontier, self.out_slots, search_iters
+        window, max_levels, out_slots = self.window, self.max_levels, self.out_slots
 
         def step_fn(
-            edge_ptr, edge_tok1, edge_tok2, edge_dest, plus_child, hash_child,
-            reg_ptr, inl_ptr, all_ids, inl_offset, top_wild,
+            table, all_ids, pat_kind, pat_depth, pat_mask,
             tok1, tok2, lengths, is_dollar,
         ):
             # each device: its sub shard (leading dim 1) x its batch tile
-            out, totals, overflow = match_core(
-                edge_ptr[0], edge_tok1[0], edge_tok2[0], edge_dest[0],
-                plus_child[0], hash_child[0], reg_ptr[0], inl_ptr[0],
-                all_ids[0], inl_offset[0], top_wild[0],
+            out, totals, overflow = flat_match_core(
+                table[0], all_ids[0], pat_kind[0], pat_depth[0], pat_mask[0],
                 tok1, tok2, lengths, is_dollar,
-                frontier=frontier, out_slots=out_slots, search_iters=iters,
+                window=window, max_levels=max_levels, out_slots=out_slots,
             )
             # union across the subs axis rides ICI
             out_g = jax.lax.all_gather(out, "subs")  # [S, b_local, K]
@@ -435,13 +477,12 @@ class ShardedTpuMatcher:
             shard_map(
                 step_fn,
                 mesh=mesh,
-                in_specs=(shard_spec,) * 9 + (P("subs"), shard_spec)
-                + (batch_spec,) * 4,
+                in_specs=(shard_spec,) * 5 + (batch_spec,) * 4,
                 out_specs=(P(None, "batch", None), P(None, "batch"), P(None, "batch")),
                 disable_rep_check=True,
             )
         )
-        self._step_cache[search_iters] = step
+        self._step = step
         return step
 
     @property
@@ -460,7 +501,7 @@ class ShardedTpuMatcher:
         every snapshot kind."""
         if self._compiled is None or self.stale:
             self.rebuild()
-        arrays, tables, salt, _, step = self._compiled
+        arrays, tables, salt, step = self._compiled
         b = len(topics)
         # pad the batch to a multiple of the batch axis
         pad = (-b) % self.n_batch
@@ -609,11 +650,13 @@ def _dryrun_body(n_devices: int) -> None:
         )
     devices = devices[:n_devices]
     mesh = make_mesh(devices)
+    from ..packets import Subscription
+
     index = TopicsIndex()
     filters = ["a/b/c", "a/+/c", "a/#", "d/e", "+/e", "x/y/z", "q/+/+", "#"]
     for i, flt in enumerate(filters * 4):
         index.subscribe(f"cl{i}", Subscription(filter=flt, qos=i % 3))
-    matcher = ShardedTpuMatcher(index, mesh=mesh, max_levels=4, frontier=8, out_slots=32)
+    matcher = ShardedTpuMatcher(index, mesh=mesh, max_levels=4, out_slots=32)
     try:
         topics = ["a/b/c", "d/e", "x/y/z", "q/w/e", "nope", "a/z/c", "e", "a/b"]
         results = matcher.match_topics(topics)

@@ -165,3 +165,49 @@ def test_transfer_slots_prefix_routes_deep_topics_to_host():
     assert matcher.stats.overflows == 0
     # the cold topic fit in the prefix -> served from the device result
     assert matcher.stats.topics == 2
+
+
+def test_saturated_bucket_routes_to_host():
+    """Entries dropped from a build-saturated bucket must never produce
+    false negatives: the kernel flags any probe touching the bucket and the
+    topic re-walks the host trie (ops/flat.py SAT marker)."""
+    import numpy as np
+
+    from mqtt_tpu.ops.flat import _M2, KIND_EXACT, _mix_np, hash_token
+
+    S = 1024  # build_flat_index's minimum bucket count
+
+    def slot_of(token: str) -> int:
+        a, _ = hash_token(token, 0)
+        with np.errstate(over="ignore"):
+            h1 = np.uint32(np.uint64(1) * np.uint64(_M2) & np.uint64(0xFFFFFFFF)) ^ np.uint32(KIND_EXACT)
+            h1 = _mix_np(h1, np.uint32(a))
+        return int(h1) & (S - 1)
+
+    by_slot = {}
+    colliding = None
+    for i in range(200_000):
+        tok = f"sat{i}"
+        s = slot_of(tok)
+        by_slot.setdefault(s, []).append(tok)
+        if len(by_slot[s]) == 6:
+            colliding = by_slot[s]
+            break
+    assert colliding, "no 6-way bucket collision found in 200k tokens"
+
+    index = TopicsIndex()
+    for i, tok in enumerate(colliding):
+        index.subscribe(f"cl{i}", Subscription(filter=tok, qos=1))
+    index.subscribe("solo", Subscription(filter="plain/topic", qos=0))
+    matcher = TpuMatcher(index, max_levels=4)
+    matcher.rebuild()
+    assert matcher.csr.n_sat >= 1  # the build really saturated a bucket
+    # every dropped filter still matches, via the host route
+    for i, tok in enumerate(colliding):
+        subs = matcher.subscribers(tok)
+        assert list(subs.subscriptions) == [f"cl{i}"], tok
+    assert matcher.stats.overflows >= len(colliding)
+    # untouched buckets still serve from the device
+    before = matcher.stats.host_fallbacks
+    assert list(matcher.subscribers("plain/topic").subscriptions) == ["solo"]
+    assert matcher.stats.host_fallbacks == before
