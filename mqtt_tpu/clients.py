@@ -258,6 +258,7 @@ class Client:
 
         caps = self.ops.options.capabilities
         rbuf = bytearray()
+        deferred: Optional[list] = None
         self.refresh_deadline(self.state.keepalive)
         while True:
             if self.closed:
@@ -278,9 +279,28 @@ class Client:
                 pk = self._decode_body(fh, body)
                 result = packet_handler(self, pk)
                 if asyncio.iscoroutine(result):
-                    await result
+                    # deferred (staged-publish) completions: schedule now,
+                    # await after the whole scan — every publish in this
+                    # socket read reaches the staging batch before we block
+                    # on any of them, so one pipelining client still fills
+                    # device batches instead of paying a round trip each
+                    if deferred is None:
+                        deferred = []
+                    deferred.append(asyncio.get_running_loop().create_task(result))
                 if self.closed:
-                    return
+                    break
+            if deferred is not None:
+                err0: Optional[BaseException] = None
+                for t in deferred:
+                    try:
+                        await t
+                    except BaseException as e:
+                        err0 = err0 or e
+                deferred = None
+                if err0 is not None:
+                    raise err0
+            if self.closed:
+                return
             del rbuf[:consumed]
             if err == -2:
                 raise ERR_PACKET_TOO_LARGE()  # [MQTT-3.2.2-15]

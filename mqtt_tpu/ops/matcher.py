@@ -26,6 +26,7 @@ from .flat import (
     KIND_INLINE,
     KIND_SHARED,
     FlatIndex,
+    _bucket,
     build_flat_index,
     flat_match,
     flat_match_packed,
@@ -208,8 +209,13 @@ class TpuMatcher:
             self.rebuild()
         flat, arrays, _ = self._state
         ts = self.transfer_slots
+        # pad ragged batches (the staging loop's windows) to a power-of-two
+        # bucket so every batch size reuses one jitted executable; padded
+        # rows are ignored at resolve time
+        b = len(topics)
+        padded = topics + [""] * (_bucket(max(1, b), minimum=16) - b)
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
-            topics, flat.max_levels, flat.salt
+            padded, flat.max_levels, flat.salt
         )
         packed_dev = flat_match_packed(
             *arrays,

@@ -503,9 +503,12 @@ class ShardedTpuMatcher:
             self.rebuild()
         arrays, tables, salt, step = self._compiled
         b = len(topics)
-        # pad the batch to a multiple of the batch axis
-        pad = (-b) % self.n_batch
-        padded = topics + [""] * pad
+        # pad ragged batches to a power-of-two bucket (one jitted executable
+        # across the staging loop's window sizes), rounded up to a multiple
+        # of the batch axis for even sharding
+        target = _bucket(max(1, b), minimum=max(2, self.n_batch))
+        target += (-target) % self.n_batch
+        padded = topics + [""] * (target - b)
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
             padded, self.max_levels, salt
         )

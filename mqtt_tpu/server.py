@@ -155,6 +155,14 @@ class Options:
     # (mqtt_tpu.ops.delta.DeltaMatcher) instead of the host trie walk; results
     # are bit-identical, the index lives on the TPU (SURVEY.md north star)
     device_matcher: bool = False
+    # kwargs forwarded to DeltaMatcher (max_levels, out_slots, window,
+    # transfer_slots, rebuild_after, rebuild_interval, mesh, ...)
+    matcher_opts: Optional[dict] = None
+    # publish staging loop (mqtt_tpu.staging): accumulation window and batch
+    # cap for device match batches; pipeline depth for in-flight batches
+    matcher_stage_window_ms: float = 2.0
+    matcher_stage_max_batch: int = 4096
+    matcher_stage_max_inflight: int = 4
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -201,10 +209,11 @@ class Server:
         self.inline_client: Optional[Client] = None
         self._ops = _Ops(opts, self.info, self.hooks, self.log)
         self.matcher = None  # device matcher; None = host trie walk
+        self._stage = None  # publish staging loop (started in serve())
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
-            self.matcher = DeltaMatcher(self.topics)
+            self.matcher = DeltaMatcher(self.topics, **(opts.matcher_opts or {}))
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -289,6 +298,18 @@ class Server:
             STORED_SYS_INFO,
         ):
             self.read_store()
+
+        if self.matcher is not None:
+            from .staging import MatchStage
+
+            self._stage = MatchStage(
+                self.matcher,
+                host_fallback=self.topics.subscribers,
+                window_s=self.options.matcher_stage_window_ms / 1e3,
+                max_batch=self.options.matcher_stage_max_batch,
+                max_inflight=self.options.matcher_stage_max_inflight,
+            )
+            self._stage.start()
 
         for listener in list(self.listeners.internal.values()):
             await listener.init(self.log)
@@ -415,22 +436,38 @@ class Server:
             raise ERR_PROTOCOL_VIOLATION_REQUIRE_FIRST_CONNECT()
         return await cl.read_packet(fh)
 
-    def receive_packet(self, cl: Client, pk: Packet) -> None:
+    def receive_packet(self, cl: Client, pk: Packet):
         """Process one inbound packet; a v5 error code disconnects the client
-        (server.go:519-534)."""
+        (server.go:519-534). Returns a coroutine when processing defers to
+        the publish staging loop — the caller's read loop awaits it, so the
+        publishing client blocks on its own fan-out (the reference's
+        per-connection-goroutine semantics) while other clients proceed."""
         try:
-            self.process_packet(cl, pk)
+            result = self.process_packet(cl, pk)
         except Code as code:
-            if cl.properties.protocol_version == 5 and code.code >= ERR_UNSPECIFIED_ERROR.code:
-                try:
-                    self.disconnect_client(cl, code)
-                except Exception:
-                    pass
-            self.log.warning(
-                "error processing packet: error=%s client=%s listener=%s",
-                code, cl.id, cl.net.listener,
-            )
+            self._packet_error(cl, code)
             raise
+        if asyncio.iscoroutine(result):
+            return self._receive_deferred(cl, result)
+        return None
+
+    async def _receive_deferred(self, cl: Client, coro) -> None:
+        try:
+            await coro
+        except Code as code:
+            self._packet_error(cl, code)
+            raise
+
+    def _packet_error(self, cl: Client, code: Code) -> None:
+        if cl.properties.protocol_version == 5 and code.code >= ERR_UNSPECIFIED_ERROR.code:
+            try:
+                self.disconnect_client(cl, code)
+            except Exception:
+                pass
+        self.log.warning(
+            "error processing packet: error=%s client=%s listener=%s",
+            code, cl.id, cl.net.listener,
+        )
 
     def validate_connect(self, cl: Client, pk: Packet) -> Code:
         """Connect compliance checks beyond the codec's (server.go:537-556)."""
@@ -548,10 +585,18 @@ class Server:
 
     # -- packet processing -------------------------------------------------
 
-    def process_packet(self, cl: Client, pk: Packet) -> None:
+    def process_packet(self, cl: Client, pk: Packet):
         """Dispatch one inbound packet by type (server.go:667-730); raises a
-        Code on protocol errors."""
+        Code on protocol errors. A staged PUBLISH returns a coroutine whose
+        await completes the fan-out (hook order — on_published before
+        on_packet_processed — is preserved inside it)."""
         t = pk.fixed_header.type
+        if (
+            t == pkts.PUBLISH
+            and self._stage is not None
+            and not cl.net.inline
+        ):
+            return self._process_publish_deferred(cl, pk)
         err: Optional[Exception] = None
         try:
             if t == pkts.CONNECT:
@@ -561,10 +606,7 @@ class Server:
             elif t == pkts.PINGREQ:
                 self.process_pingreq(cl, pk)
             elif t == pkts.PUBLISH:
-                code = pk.publish_validate(self.options.capabilities.topic_alias_maximum)
-                if code != CODE_SUCCESS:
-                    raise code()
-                self.process_publish(cl, pk)
+                self._dispatch_publish(cl, pk)
             elif t == pkts.PUBACK:
                 self.process_puback(cl, pk)
             elif t == pkts.PUBREC:
@@ -596,6 +638,33 @@ class Server:
         finally:
             self.hooks.on_packet_processed(cl, pk, err)
 
+        self._drain_quota_starved(cl)
+
+    def _dispatch_publish(self, cl: Client, pk: Packet):
+        """Validate + process one PUBLISH — the single dispatch point shared
+        by the sync and staged paths; returns a coroutine when staged."""
+        code = pk.publish_validate(self.options.capabilities.topic_alias_maximum)
+        if code != CODE_SUCCESS:
+            raise code()
+        return self.process_publish(cl, pk)
+
+    async def _process_publish_deferred(self, cl: Client, pk: Packet) -> None:
+        """The staged PUBLISH path: identical dispatch semantics to the sync
+        path (validate, process, on_packet_processed with the error, quota
+        drain) with the fan-out awaited through the staging loop."""
+        err: Optional[Exception] = None
+        try:
+            deferred = self._dispatch_publish(cl, pk)
+            if deferred is not None:
+                await deferred
+        except Exception as e:
+            err = e
+            raise
+        finally:
+            self.hooks.on_packet_processed(cl, pk, err)
+        self._drain_quota_starved(cl)
+
+    def _drain_quota_starved(self, cl: Client) -> None:
         # post-process: drain one quota-starved inflight if quota freed up
         if len(cl.state.inflight) > 0 and cl.state.inflight.send_quota > 0:
             nxt = cl.state.inflight.next_immediate()
@@ -677,17 +746,25 @@ class Server:
 
     def inject_packet(self, cl: Client, pk: Packet) -> None:
         """Process a packet as if sent by ``cl``, bypassing the network
-        (server.go:840-854)."""
+        (server.go:840-854). A staged PUBLISH completes its fan-out as a
+        scheduled task (or synchronously when no loop is running)."""
         pk.protocol_version = cl.properties.protocol_version
-        self.process_packet(cl, pk)
+        result = self.process_packet(cl, pk)
+        if asyncio.iscoroutine(result):
+            try:
+                asyncio.get_running_loop().create_task(result)
+            except RuntimeError:
+                asyncio.run(result)
         self.info.packets_received += 1
         if pk.fixed_header.type == pkts.PUBLISH:
             self.info.messages_received += 1
 
     # -- publish flow ------------------------------------------------------
 
-    def process_publish(self, cl: Client, pk: Packet) -> None:
-        """The publish hot path (server.go:857-968)."""
+    def process_publish(self, cl: Client, pk: Packet):
+        """The publish hot path (server.go:857-968). With the staging loop
+        active, returns a coroutine completing the fan-out (QoS acks are
+        already written synchronously before it is returned)."""
         if not cl.net.inline and not is_valid_filter(pk.topic_name, True):
             return
 
@@ -753,9 +830,11 @@ class Server:
 
         # inline clients can't handle PUBREC/PUBREL: treat as qos 0 inbound
         if pk.fixed_header.qos == 0 or cl.net.inline:
+            if self._stage is not None and not cl.net.inline:
+                return self._staged_fan_out(cl, pk)
             self.publish_to_subscribers(pk)
             self.hooks.on_published(cl, pk)
-            return
+            return None
 
         cl.state.inflight.decrease_receive_quota()
         ack = self.build_ack(
@@ -778,7 +857,20 @@ class Server:
             cl.state.inflight.increase_receive_quota()
             self.hooks.on_qos_complete(cl, ack)
 
+        if self._stage is not None and not cl.net.inline:
+            return self._staged_fan_out(cl, pk)
         self.publish_to_subscribers(pk)
+        self.hooks.on_published(cl, pk)
+        return None
+
+    async def _staged_fan_out(self, cl: Client, pk: Packet) -> None:
+        """Fan out one publish through the staging loop: the device match
+        batch resolves off the event loop and this client awaits only its
+        own result (SURVEY.md §7 stage 4; seam: server.go:984-1021)."""
+        if not pk.ignore:
+            self._stamp_publish_expiry(pk)
+            subscribers = await self._stage.submit(pk.topic_name)
+            self._fan_out(pk, subscribers)
         self.hooks.on_published(cl, pk)
 
     def retain_message(self, cl: Client, pk: Packet) -> None:
@@ -791,10 +883,19 @@ class Server:
         self.info.retained = len(self.topics.retained)
 
     def publish_to_subscribers(self, pk: Packet) -> None:
-        """Match subscribers (host trie or device matcher via the
-        on_select_subscribers seam) and fan out (server.go:984-1021)."""
+        """Match subscribers and fan out (server.go:984-1021).
+
+        The synchronous path always walks the host trie: its callers are
+        the housekeeping flows ($SYS ticks, LWT, retained delivery, inline
+        publishes), which must never pay a device round trip on the event
+        loop. Client PUBLISH traffic takes ``_staged_fan_out`` instead when
+        the device matcher is active (mqtt_tpu.staging)."""
         if pk.ignore:
             return
+        self._stamp_publish_expiry(pk)
+        self._fan_out(pk, self.topics.subscribers(pk.topic_name))
+
+    def _stamp_publish_expiry(self, pk: Packet) -> None:
         if pk.created == 0:
             pk.created = int(time.time())
         if pk.expiry == 0:
@@ -805,10 +906,9 @@ class Server:
             if expiry > 0:
                 pk.expiry = pk.created + expiry
 
-        if self.matcher is not None:
-            subscribers = self.matcher.subscribers(pk.topic_name)
-        else:
-            subscribers = self.topics.subscribers(pk.topic_name)
+    def _fan_out(self, pk: Packet, subscribers) -> None:
+        """Deliver one matched publish: shared-group selection, inline
+        handlers, per-subscriber delivery (server.go:1000-1021)."""
         if subscribers.shared:
             subscribers = self.hooks.on_select_subscribers(subscribers, pk)
             if not subscribers.shared_selected:
@@ -1187,6 +1287,11 @@ class Server:
             SYS_PREFIX + "/broker/system/memory": str(info.memory_alloc),
             SYS_PREFIX + "/broker/system/threads": str(info.threads),
         }
+        if self.matcher is not None:
+            # device-matcher observability (MatcherStats.as_dict): batches,
+            # topics, host_fallbacks, overflows, rebuilds, fallback_ratio
+            for key, val in self.matcher.stats.as_dict().items():
+                topics[SYS_PREFIX + "/broker/matcher/" + key] = str(val)
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,
@@ -1204,8 +1309,12 @@ class Server:
         self.done.set()
         self.log.info("gracefully stopping server")
         await self.listeners.close_all(self._close_listener_clients)
-        # after client teardown: shutdown LWT publishes and clean-session
+        # stage first (parked publishes resolve via the host walk), then
+        # the matcher; shutdown LWT publishes and clean-session
         # unsubscribes must still flow through the live delta overlay
+        if self._stage is not None:
+            await self._stage.stop()
+            self._stage = None
         if self.matcher is not None:
             self.matcher.close()
         self.hooks.on_stopped()

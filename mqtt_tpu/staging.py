@@ -1,0 +1,165 @@
+"""The broker's publish staging loop: micro-batch concurrent PUBLISHes into
+device match batches (SURVEY.md §7 stage 4).
+
+The reference matches synchronously inside ``processPublish``
+(server.go:984-1021) — free when the walk is an in-process trie, ruinous
+when it is a device round trip. The stage turns the device matcher into a
+pipelined batch engine:
+
+- ``submit(topic)`` parks the publish on a future and returns immediately;
+  the caller (one asyncio task per client, mirroring the reference's
+  goroutine-per-connection) awaits it, so *that* client blocks while every
+  other client keeps being served.
+- A collector task gathers everything submitted within ``window_s`` (or up
+  to ``max_batch``) and issues ONE ``match_topics_async`` dispatch — the
+  issue side runs on the event loop (host tokenization is native C and the
+  device dispatch is asynchronous), so batches are dispatched ahead while
+  earlier ones are still resolving (the depth-``max_inflight`` pipeline
+  that hides the host<->device round trip).
+- A drainer task resolves batches IN ORDER off the event loop (the D2H
+  sync blocks, so it runs in the executor) and completes the futures in
+  submission order — per-publish fan-out order is exactly submission
+  order, as in the reference.
+- A matcher failure degrades, never drops: the affected futures fall back
+  to the bit-identical host trie walk.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Callable, Optional
+
+from .topics import Subscribers
+
+_log = logging.getLogger("mqtt_tpu.staging")
+
+
+class MatchStage:
+    """Micro-batching pipeline between ``process_publish`` and a device
+    matcher (``DeltaMatcher`` or any object with ``match_topics_async``)."""
+
+    def __init__(
+        self,
+        matcher,
+        host_fallback: Callable[[str], Subscribers],
+        window_s: float = 0.002,
+        max_batch: int = 4096,
+        max_inflight: int = 4,
+    ) -> None:
+        self.matcher = matcher
+        self.host_fallback = host_fallback
+        self.window_s = window_s
+        self.max_batch = max_batch
+        self.max_inflight = max_inflight
+        self._pending: list[tuple[str, asyncio.Future]] = []
+        self._wake: Optional[asyncio.Event] = None
+        self._queue: Optional[asyncio.Queue] = None
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Create the collector/drainer tasks on the running loop."""
+        loop = asyncio.get_running_loop()
+        self._wake = asyncio.Event()
+        # bounded: if resolution falls behind, collection backpressures
+        # instead of queueing unbounded device batches
+        self._queue = asyncio.Queue(maxsize=self.max_inflight)
+        self._tasks = [
+            loop.create_task(self._collect_loop(), name="mqtt-tpu-stage-collect"),
+            loop.create_task(self._drain_loop(), name="mqtt-tpu-stage-drain"),
+        ]
+
+    async def stop(self) -> None:
+        """Stop the pipeline; anything still parked resolves via the host
+        walk so no publish is ever lost."""
+        self._stopping = True
+        if self._wake is not None:
+            self._wake.set()
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        self._fallback_all(self._pending)
+        self._pending = []
+        if self._queue is not None:
+            while not self._queue.empty():
+                _resolver, futs, topics = self._queue.get_nowait()
+                self._fallback_all(list(zip(topics, futs)))
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, topic: str) -> "asyncio.Future[Subscribers]":
+        """Park one publish; the future resolves with its Subscribers."""
+        fut = asyncio.get_running_loop().create_future()
+        if self._stopping or self._wake is None:
+            fut.set_result(self.host_fallback(topic))
+            return fut
+        self._pending.append((topic, fut))
+        self._wake.set()
+        return fut
+
+    # -- pipeline ----------------------------------------------------------
+
+    async def _collect_loop(self) -> None:
+        while True:
+            await self._wake.wait()
+            self._wake.clear()
+            if not self._pending:
+                continue
+            # the accumulation window: give concurrent publishers a beat to
+            # land in this batch (latency cost) so the device sees real
+            # batches (throughput win); capped by max_batch
+            if len(self._pending) < self.max_batch and self.window_s > 0:
+                await asyncio.sleep(self.window_s)
+            batch, self._pending = (
+                self._pending[: self.max_batch],
+                self._pending[self.max_batch :],
+            )
+            if self._pending:
+                self._wake.set()  # leftovers start the next window now
+            topics = [t for t, _ in batch]
+            futs = [f for _, f in batch]
+            try:
+                resolver = self.matcher.match_topics_async(topics)
+            except Exception:
+                _log.exception("stage issue failed; host fallback for batch")
+                self._fallback_all(batch)
+                continue
+            try:
+                await self._queue.put((resolver, futs, topics))
+            except asyncio.CancelledError:
+                # stop() cancelled us with this batch in hand (in neither
+                # _pending nor the queue): resolve it before going down
+                self._fallback_all(batch)
+                raise
+
+    async def _drain_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            resolver, futs, topics = await self._queue.get()
+            try:
+                # the D2H sync blocks — run it off the loop
+                results = await loop.run_in_executor(None, resolver)
+            except asyncio.CancelledError:
+                # stop() cancelled us with this batch already popped: it is
+                # invisible to stop()'s queue drain, so resolve it here
+                self._fallback_all(list(zip(topics, futs)))
+                raise
+            except Exception:
+                _log.exception("stage resolve failed; host fallback for batch")
+                self._fallback_all(list(zip(topics, futs)))
+                continue
+            for fut, subs in zip(futs, results):
+                if not fut.done():
+                    fut.set_result(subs)
+
+    def _fallback_all(self, items) -> None:
+        for topic, fut in items:
+            if fut.done():
+                continue
+            try:
+                fut.set_result(self.host_fallback(topic))
+            except Exception as e:  # pragma: no cover - host walk is total
+                fut.set_exception(e)
