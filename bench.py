@@ -260,9 +260,13 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         lat.append(time.perf_counter() - t1)
 
     # device-compute only: resident pre-uploaded inputs, async dispatch
-    # with one final sync — the kernel's sustained rate, transfers excluded
+    # with one final sync — the kernel's sustained rate, transfers excluded.
+    # Completion is forced by a dependent scalar reduce + D2H: on this
+    # platform block_until_ready can return before execution completes
+    # (PROFILE.md §2 — the source of the bogus r01 27.4M reading).
     kernel_rate = None
     if hasattr(matcher, "match_tokens"):
+        red = jax.jit(lambda o: o.sum())
         salt = matcher.csr.salt
         resident = [
             tuple(
@@ -272,13 +276,13 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
             for bt in batches
         ]
         jax.block_until_ready(resident)  # H2D outside the timed loop
-        matcher.match_tokens(*resident[0])[0].block_until_ready()
+        np.asarray(red(matcher.match_tokens(*resident[0])[0]))
         t0 = time.perf_counter()
         outs = [
             matcher.match_tokens(*resident[i % len(resident)])[0]
             for i in range(iters)
         ]
-        outs[-1].block_until_ready()
+        np.asarray(red(outs[-1]))  # dependent scalar D2H = true completion
         kernel_rate = (iters * batch) / (time.perf_counter() - t0)
 
     return {
@@ -438,6 +442,53 @@ def run_cfg5(n_subs, batch, iters, rng):
     return out
 
 
+def run_broker_bench(fast: bool) -> dict:
+    """The mqtt-stresser analog over real TCP against a broker subprocess
+    (reference README.md:474-508): N clients x M QoS0 msgs on own topics,
+    per-client publish/receive medians + aggregate. The broker runs in its
+    own process (no jax); the load generator runs here. CPU count is
+    reported because both timeshare this machine's cores."""
+    import subprocess
+
+    from mqtt_tpu.stress import run_stress
+
+    port = 18831
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
+         f"127.0.0.1:{port}"],
+        stdin=subprocess.PIPE,
+        stdout=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+    )
+    out = {"cpus": os.cpu_count()}
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        scenarios = [(2, 1000), (10, 500)] if fast else [(2, 10000), (10, 5000), (100, 1000)]
+        for n, m in scenarios:
+            import asyncio
+
+            r = asyncio.run(run_stress("127.0.0.1", port, n, m))
+            out[f"{n}_clients"] = r
+            log(f"broker {n}x{m}: {r}")
+        # the reference table's 100-client medians (mochi v2.2.10, M2):
+        # publish 4,425 / receive 7,274 msg/s (README.md:500-503)
+        hundred = out.get("100_clients")
+        if hundred:
+            out["vs_mochi_100c_receive"] = round(
+                hundred["receive_median_per_sec"] / 7274, 4
+            )
+            out["vs_mochi_100c_publish"] = round(
+                hundred["publish_median_per_sec"] / 4425, 4
+            )
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+    return out
+
+
 def main() -> None:
     fast = os.environ.get("BENCH_FAST") == "1"
     n_subs = int(os.environ.get("BENCH_SUBS", 50_000 if fast else 1_000_000))
@@ -445,18 +496,20 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", 5 if fast else 20))
     which = {
         int(c)
-        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5").split(",")
+        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
         if c.strip()
     }
     rng = random.Random(7)
 
-    import jax
+    link = None
+    if which & {1, 2, 3, 4, 5}:  # device configs selected: touch the chip
+        import jax
 
-    link = probe_link()
-    log(
-        f"device={jax.devices()[0].platform} fast={fast} subs={n_subs} "
-        f"batch={batch} link={link}"
-    )
+        link = probe_link()
+        log(
+            f"device={jax.devices()[0].platform} fast={fast} subs={n_subs} "
+            f"batch={batch} link={link}"
+        )
     configs = {}
     t_all = time.perf_counter()
     if 1 in which:
@@ -469,9 +522,9 @@ def main() -> None:
         log(f"cfg2 {configs['2_1m_plus']} ({time.perf_counter()-t0:.0f}s)")
     if 3 in which:
         t0 = time.perf_counter()
-        # deep 8-level tries grow ~6 nodes/sub; cap so the CSR compile stays
-        # within the bench budget (the count is reported with the result)
-        n3 = min(n_subs, int(os.environ.get("BENCH_SUBS3", 200_000)))
+        # full 1M for the deep/# config (round-3 VERDICT item 7); the flat
+        # build walks terminals once, so deep tries no longer need a cap
+        n3 = min(n_subs, int(os.environ.get("BENCH_SUBS3", n_subs)))
         configs["3_deep_hash"] = run_cfg3(n3, batch, iters, rng)
         configs["3_deep_hash"]["n_subs"] = n3
         log(f"cfg3 {configs['3_deep_hash']} ({time.perf_counter()-t0:.0f}s)")
@@ -485,10 +538,16 @@ def main() -> None:
         n5 = min(n_subs, 20_000 if fast else 200_000)
         configs["5_churn_ids_retained"] = run_cfg5(n5, batch, iters, rng)
         log(f"cfg5 {configs['5_churn_ids_retained']} ({time.perf_counter()-t0:.0f}s)")
+    if 6 in which:
+        t0 = time.perf_counter()
+        configs["broker"] = run_broker_bench(fast)
+        log(f"broker bench done ({time.perf_counter()-t0:.0f}s)")
     log(f"total bench wall time {time.perf_counter()-t_all:.0f}s")
 
-    headline = configs.get("2_1m_plus") or next(iter(configs.values()))
-    value = headline["e2e_matches_per_sec"]
+    headline = configs.get("2_1m_plus") or next(
+        (c for c in configs.values() if "e2e_matches_per_sec" in c), None
+    )
+    value = headline["e2e_matches_per_sec"] if headline else 0
     print(
         json.dumps(
             {

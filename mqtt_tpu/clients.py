@@ -129,10 +129,27 @@ class Client:
         while True:
             pk = await self.state.outbound.get()
             try:
-                self.write_packet(pk)
+                if type(pk) is bytes:  # pre-encoded qos0 fan-out frame
+                    self.write_frame(pk)
+                else:
+                    self.write_packet(pk)
             except Exception as e:
                 self.ops.log.debug("failed publishing packet to %s: %s", self.id, e)
             self.state.outbound_qty -= 1
+
+    def write_frame(self, data: bytes) -> None:
+        """Write a pre-encoded PUBLISH frame (the server's qos0 fan-out
+        fast path — shared bytes, one encode per publish). The fast path
+        is disabled whenever on_packet_encode/on_packet_sent hooks are
+        attached, so skipping them here never hides a hook call."""
+        if self.closed:
+            raise ConnectionClosedError()
+        if self.net.writer is None:
+            return
+        self.net.writer.write(data)
+        self.ops.info.bytes_sent += len(data)
+        self.ops.info.packets_sent += 1
+        self.ops.info.messages_sent += 1
 
     def parse_connect(self, lid: str, pk: Packet) -> None:
         """Absorb CONNECT parameters into client state (clients.go:208-257)."""

@@ -20,6 +20,8 @@ from typing import Any, Optional
 from . import packets as pkts
 from .clients import Client, Clients, ConnectionClosedError, Will
 from .hooks import (
+    ON_PACKET_ENCODE,
+    ON_PACKET_SENT,
     STORED_CLIENTS,
     STORED_INFLIGHT_MESSAGES,
     STORED_RETAINED_MESSAGES,
@@ -79,6 +81,7 @@ from .packets import (
     Subscription,
 )
 from .system import Info
+from .utils.mempool import get_buffer, put_buffer
 from .utils.proc import rss_bytes
 from .topics import (
     SYS_PREFIX,
@@ -177,6 +180,43 @@ class Options:
             self.client_net_read_buffer_size = 1024 * 2
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
+
+
+class _FrameCache:
+    """One-encode-per-publish outbound frames for the QoS0 fan-out fast
+    path: every eligible subscriber of a publish shares the same wire
+    bytes, keyed by (protocol version, effective retain flag). The copy
+    drops inbound topic aliases exactly like the per-subscriber slow path
+    ([MQTT-3.3.2-7] via ``Packet.copy``)."""
+
+    __slots__ = ("pk", "frames")
+
+    def __init__(self, pk: "Packet") -> None:
+        self.pk = pk
+        self.frames: dict = {}
+
+    def get(self, version: int, retain: bool) -> bytes:
+        key = (version, bool(retain))
+        data = self.frames.get(key)
+        if data is None:
+            out = self.pk.copy(False)
+            out.fixed_header.retain = bool(retain)
+            out.protocol_version = version
+            if out.expiry > 0:
+                # the send-time expiry rewrite [MQTT-3.3.2-6], computed once
+                # per publish instead of per subscriber write (the queue
+                # drains within the same tick)
+                out.properties.message_expiry_interval = max(
+                    1, out.expiry - int(time.time())
+                )
+            buf = get_buffer()
+            try:
+                pkts.ENCODERS[pkts.PUBLISH](out, buf)
+                data = bytes(buf)
+            finally:
+                put_buffer(buf)
+            self.frames[key] = data
+        return data
 
 
 class _Ops:
@@ -918,20 +958,60 @@ class Server:
         for inline_sub in subscribers.inline_subscriptions.values():
             inline_sub.handler(self.inline_client, inline_sub, pk)
 
+        # QoS0 fast path: encode the outbound frame ONCE per publish and
+        # enqueue the shared bytes per subscriber. Eligible only when no
+        # per-subscriber state can differ (effective QoS is 0 for every
+        # subscriber, no encode/sent hooks attached); clients with
+        # aliases/identifiers/size limits fall back per subscriber inside
+        # publish_to_client.
+        fast = None
+        if pk.fixed_header.qos == 0 and not self.hooks.provides(
+            ON_PACKET_ENCODE, ON_PACKET_SENT
+        ):
+            fast = _FrameCache(pk)
+
         for id_, subs in subscribers.subscriptions.items():
             cl = self.clients.get(id_)
             if cl is not None:
                 try:
-                    self.publish_to_client(cl, subs, pk)
+                    self.publish_to_client(cl, subs, pk, fast)
                 except Exception as e:
                     self.log.debug(
                         "failed publishing packet: error=%s client=%s", e, id_
                     )
 
-    def publish_to_client(self, cl: Client, sub: Subscription, pk: Packet) -> Packet:
+    def publish_to_client(
+        self, cl: Client, sub: Subscription, pk: Packet, fast: "_FrameCache" = None
+    ) -> Packet:
         """Deliver one publish to one subscriber (server.go:1023-1113)."""
         if sub.no_local and pk.origin == cl.id:
             return pk  # [MQTT-3.8.3-3]
+
+        if (
+            fast is not None
+            # zero-valued identifiers never reach the wire (properties.py
+            # encodes only v > 0), so they don't disqualify the shared frame
+            and not any(v > 0 for v in sub.identifiers.values())
+            and cl.properties.props.topic_alias_maximum == 0
+            and cl.properties.props.maximum_packet_size == 0
+        ):
+            if not self.hooks.on_acl_check(cl, pk.topic_name, False):
+                raise ERR_NOT_AUTHORIZED()
+            retain = pk.fixed_header.retain and (
+                sub.fwd_retained_flag
+                or (cl.properties.protocol_version == 5 and sub.retain_as_published)
+            )
+            data = fast.get(cl.properties.protocol_version, retain)
+            if cl.net.writer is None or cl.closed:
+                raise CODE_DISCONNECT()
+            try:
+                cl.state.outbound.put_nowait(data)
+                cl.state.outbound_qty += 1
+            except asyncio.QueueFull:
+                self.info.messages_dropped += 1
+                self.hooks.on_publish_dropped(cl, pk)
+                raise ERR_PENDING_CLIENT_WRITES_EXCEEDED() from None
+            return pk
 
         out = pk.copy(False)
         if not self.hooks.on_acl_check(cl, pk.topic_name, False):

@@ -1,0 +1,215 @@
+"""An in-repo mqtt-stresser analog: broker-level publish/receive throughput.
+
+The reference's headline broker benchmark is mqtt-stresser (reference
+README.md:474-508): N concurrent clients, each subscribed to its own topic,
+publishing M QoS0 messages and receiving them back; per-client publish and
+receive rates are aggregated as min/median/max. This module reproduces that
+workload over real TCP sockets using this package's own codec, so the
+numbers exercise the full data plane: framing, decode, ACL hook, trie
+match, per-subscriber copy/encode, bounded outbound queue, write coalescing.
+
+Usage:
+    python -m mqtt_tpu.stress --broker 127.0.0.1:1883 -c 10 -m 1000
+or from bench.py, which spawns a broker subprocess and runs the workload.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import statistics
+import time
+
+from .packets import (
+    CONNACK,
+    CONNECT,
+    PUBLISH,
+    SUBACK,
+    SUBSCRIBE,
+    ConnectParams,
+    FixedHeader,
+    Packet,
+    Subscription,
+    encode_packet,
+)
+
+
+def _connect_bytes(client_id: str) -> bytes:
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=120,
+                client_identifier=client_id,
+            ),
+        )
+    )
+
+
+def _subscribe_bytes(pid: int, topic: str) -> bytes:
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+            protocol_version=4,
+            packet_id=pid,
+            filters=[Subscription(filter=topic, qos=0)],
+        )
+    )
+
+
+def _publish_bytes(topic: str, payload: bytes) -> bytes:
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=PUBLISH),
+            protocol_version=4,
+            topic_name=topic,
+            payload=payload,
+        )
+    )
+
+
+async def _read_packet_type(reader) -> int:
+    """Read one packet off the wire, return its type (frames discarded)."""
+    first = (await reader.readexactly(1))[0]
+    remaining = 0
+    shift = 0
+    while True:
+        b = (await reader.readexactly(1))[0]
+        remaining |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    if remaining:
+        await reader.readexactly(remaining)
+    return first >> 4
+
+
+async def _count_publishes(reader, want: int) -> None:
+    """Count inbound PUBLISH frames (bulk reads, minimal parsing)."""
+    got = 0
+    while got < want:
+        if await _read_packet_type(reader) == PUBLISH:
+            got += 1
+
+
+async def _worker(
+    host: str, port: int, cid: str, n_msgs: int, payload: bytes, write_chunk: int
+) -> dict:
+    reader, writer = await asyncio.open_connection(host, port)
+    try:
+        writer.write(_connect_bytes(cid))
+        await writer.drain()
+        assert await _read_packet_type(reader) == CONNACK
+        topic = f"stress/{cid}"
+        writer.write(_subscribe_bytes(1, topic))
+        await writer.drain()
+        assert await _read_packet_type(reader) == SUBACK
+
+        recv_task = asyncio.ensure_future(_count_publishes(reader, n_msgs))
+        msg = _publish_bytes(topic, payload)
+        t0 = time.perf_counter()
+        for i in range(0, n_msgs, write_chunk):
+            writer.write(msg * min(write_chunk, n_msgs - i))
+            await writer.drain()
+        pub_s = time.perf_counter() - t0
+        await recv_task
+        recv_s = time.perf_counter() - t0
+        return {
+            "publish_per_sec": n_msgs / max(1e-9, pub_s),
+            "receive_per_sec": n_msgs / max(1e-9, recv_s),
+        }
+    finally:
+        writer.close()
+        try:
+            await writer.wait_closed()
+        except Exception:
+            pass
+
+
+async def run_stress(
+    host: str,
+    port: int,
+    n_clients: int,
+    n_msgs: int,
+    payload_size: int = 64,
+    write_chunk: int = 64,
+    timeout: float = 300.0,
+) -> dict:
+    """Run the N-client workload; returns mqtt-stresser-style aggregates."""
+    payload = b"x" * payload_size
+    t0 = time.perf_counter()
+    results = await asyncio.wait_for(
+        asyncio.gather(
+            *(
+                _worker(host, port, f"w{i}", n_msgs, payload, write_chunk)
+                for i in range(n_clients)
+            )
+        ),
+        timeout,
+    )
+    wall = time.perf_counter() - t0
+    pub = sorted(r["publish_per_sec"] for r in results)
+    recv = sorted(r["receive_per_sec"] for r in results)
+    return {
+        "clients": n_clients,
+        "msgs_per_client": n_msgs,
+        "publish_median_per_sec": round(statistics.median(pub)),
+        "publish_min_per_sec": round(pub[0]),
+        "publish_max_per_sec": round(pub[-1]),
+        "receive_median_per_sec": round(statistics.median(recv)),
+        "receive_min_per_sec": round(recv[0]),
+        "receive_max_per_sec": round(recv[-1]),
+        "aggregate_msgs_per_sec": round(n_clients * n_msgs / wall),
+        "wall_s": round(wall, 2),
+    }
+
+
+def broker_main(address: str, device_matcher: bool = False) -> None:
+    """Run a bench broker on ``address`` until stdin closes (the bench
+    driver's subprocess entry; prints READY once serving)."""
+    import sys
+
+    from .hooks.auth.allow_all import AllowHook
+    from .listeners import Config
+    from .listeners.tcp import TCP
+    from .server import Options, Server
+
+    async def main() -> None:
+        srv = Server(Options(device_matcher=device_matcher))
+        srv.add_hook(AllowHook())
+        srv.add_listener(TCP(Config(type="tcp", id="bench", address=address)))
+        await srv.serve()
+        print("READY", flush=True)
+        loop = asyncio.get_running_loop()
+        # exit when the parent closes our stdin (robust to parent death)
+        await loop.run_in_executor(None, sys.stdin.read)
+        await srv.close()
+
+    asyncio.run(main())
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--broker", default="127.0.0.1:1883", help="host:port")
+    p.add_argument("-c", "--clients", type=int, default=10)
+    p.add_argument("-m", "--messages", type=int, default=1000)
+    p.add_argument("--payload-size", type=int, default=64)
+    p.add_argument("--serve", action="store_true", help="run the bench broker instead")
+    p.add_argument("--device-matcher", action="store_true")
+    args = p.parse_args()
+    host, port = args.broker.rsplit(":", 1)
+    if args.serve:
+        broker_main(args.broker, device_matcher=args.device_matcher)
+        return
+    out = asyncio.run(
+        run_stress(host, int(port), args.clients, args.messages, args.payload_size)
+    )
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()
