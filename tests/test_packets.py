@@ -11,6 +11,7 @@ from tests.tpackets import CASES, fhdr
 
 from mqtt_tpu.packets import (
     AUTH,
+    CONNECT,
     PUBLISH,
     PUBREC,
     PUBREL,
@@ -245,3 +246,26 @@ class TestCopyAndMerge:
         t = Subscription()
         t.decode_options(b)
         assert (t.qos, t.no_local, t.retain_as_published, t.retain_handling) == (2, True, True, 2)
+
+
+def _validate_cases():
+    return [c for c in CASES if c.validate_err is not None]
+
+
+@pytest.mark.parametrize("case", _validate_cases(), ids=lambda c: c.desc)
+def test_validate_catalogue(case):
+    """Decode (when wire-expressible) then run the packet type's validate;
+    the reference's Invalid*/Spec* conformance tier (tpackets.go)."""
+    pk = case.packet if not case.raw else decode_packet(case.raw, case.version)
+    t = pk.fixed_header.type
+    if t == PUBLISH:
+        code = pk.publish_validate(case.validate_arg)
+    elif t == SUBSCRIBE:
+        code = pk.subscribe_validate()
+    elif t == UNSUBSCRIBE:
+        code = pk.unsubscribe_validate()
+    elif t == AUTH:
+        code = pk.auth_validate()
+    else:
+        code = pk.connect_validate()
+    assert code == case.validate_err, f"{case.desc}: got {code!r}"

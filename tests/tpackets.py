@@ -45,7 +45,12 @@ class Case:
     version: int = 4
     decode_err: Code | None = None  # expected decode failure
     fail_first: Code | None = None  # expected fixed-header decode failure
-    group: str = ""  # "decode", "encode", or "" for both directions
+    group: str = ""  # "decode", "encode", "validate", or "" for both
+    # expected <type>_validate() result (decode must succeed first); cases
+    # with raw=b"" validate the given packet struct directly — the analog
+    # of the reference's Packet-only TPacketCases (tpackets.go Invalid*)
+    validate_err: Code | None = None
+    validate_arg: int = 0  # publish_validate's topic_alias_maximum
 
 
 def fhdr(type_, qos=0, dup=False, retain=False, remaining=0):
@@ -1377,6 +1382,428 @@ CASES: list[Case] = [
         "reserved packet type zero",
         hx("0000"),
         decode_err=ERR_NO_VALID_PACKET_AVAILABLE,
+        group="decode",
+    ),
+]
+
+# ---- validate-level conformance (tpackets.go Invalid*/Spec* cases) --------
+# Wire-expressible violations decode first, then <type>_validate() must
+# return the pinned reason code; raw=b"" cases validate a Packet struct the
+# wire cannot express (flag/field combinations the decoder derives away).
+CASES += [
+    # CONNECT validate
+    Case(
+        "connect invalid protocol name",
+        hx("1010 0004 4d515443 04 02 003c 0004 7a656e33"),  # "MQTC"
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_PROTOCOL_NAME,
+        group="validate",
+    ),
+    Case(
+        "connect invalid protocol version 2",
+        hx("1010 0004 4d515454 02 02 003c 0004 7a656e33"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_PROTOCOL_VERSION,
+        group="validate",
+    ),
+    Case(
+        "connect reserved bit set",
+        hx("1010 0004 4d515454 04 03 003c 0004 7a656e33"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_RESERVED_BIT,
+        group="validate",
+    ),
+    Case(
+        # the reference validates only the password side of [MQTT-3.1.2-19]
+        # (packets.go ConnectValidate); username flag + empty username is
+        # accepted, matching TConnectZeroByteUsername
+        "connect password flag with empty password",
+        hx("1015 0004 4d515454 04 c2 003c 0004 7a656e33 0001 75 0000"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_FLAG_NO_PASSWORD,
+        group="validate",
+    ),
+    Case(
+        "connect will flag with empty will payload",
+        hx("1015 0004 4d515454 04 06 003c 0004 7a656e33 0001 74 0000"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_WILL_FLAG_NO_PAYLOAD,
+        group="validate",
+    ),
+    Case(
+        "connect will qos out of range",
+        hx("1017 0004 4d515454 04 1e 003c 0004 7a656e33 0001 74 0002 6f6b"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_QOS_OUT_OF_RANGE,
+        group="validate",
+    ),
+    Case(
+        "connect will retain without will flag",
+        hx("1010 0004 4d515454 04 22 003c 0004 7a656e33"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_WILL_FLAG_SURPLUS_RETAIN,
+        group="validate",
+    ),
+    Case(
+        "connect username without flag (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="zen",
+                username=b"u",
+            ),
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_USERNAME_NO_FLAG,
+        group="validate",
+    ),
+    Case(
+        "connect password without flag (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="zen",
+                password=b"p",
+            ),
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_PASSWORD_NO_FLAG,
+        group="validate",
+    ),
+    Case(
+        "connect username too long (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="zen",
+                username_flag=True,
+                username=b"u" * 65536,
+            ),
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_USERNAME_TOO_LONG,
+        group="validate",
+    ),
+    Case(
+        "connect password too long (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="zen",
+                password_flag=True,
+                password=b"p" * 65536,
+            ),
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_PASSWORD_TOO_LONG,
+        group="validate",
+    ),
+    Case(
+        "connect client id too long (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="c" * 65536,
+            ),
+        ),
+        validate_err=codes.ERR_CLIENT_IDENTIFIER_NOT_VALID,
+        group="validate",
+    ),
+    # PUBLISH validate
+    Case(
+        "publish wildcard plus in topic",
+        hx("3009 0005 612f2b2f62 6f6b"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_SURPLUS_WILDCARD,
+        group="validate",
+    ),
+    Case(
+        "publish wildcard hash in topic",
+        hx("3007 0003 612f23 6f6b"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_SURPLUS_WILDCARD,
+        group="validate",
+    ),
+    Case(
+        "publish v5 subscription identifier from client",
+        hx("3008 0001 74 02 0b05 6f6b"),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_SURPLUS_SUB_ID,
+        group="validate",
+    ),
+    Case(
+        "publish v5 empty topic without alias",
+        hx("3007 0000 00 6f6b6179"),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_TOPIC,
+        group="validate",
+    ),
+    Case(
+        "publish v5 topic alias zero",
+        hx("3008 0001 74 03 2300 00 6f6b"),
+        version=5,
+        validate_err=codes.ERR_TOPIC_ALIAS_INVALID,
+        validate_arg=8,
+        group="validate",
+    ),
+    Case(
+        "publish v5 topic alias above maximum",
+        hx("3008 0001 74 03 2300 07 6f6b"),
+        version=5,
+        validate_err=codes.ERR_TOPIC_ALIAS_INVALID,
+        validate_arg=3,
+        group="validate",
+    ),
+    Case(
+        "publish qos1 packet id zero",
+        hx("3207 0001 74 0000 6f6b"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_PACKET_ID,
+        group="validate",
+    ),
+    Case(
+        "publish qos0 surplus packet id (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(PUBLISH),
+            protocol_version=4,
+            topic_name="t",
+            packet_id=5,
+            payload=b"ok",
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_SURPLUS_PACKET_ID,
+        group="validate",
+    ),
+    # SUBSCRIBE validate
+    Case(
+        "subscribe packet id zero",
+        hx("820a 0000 0005 612f622f63 00"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_PACKET_ID,
+        group="validate",
+    ),
+    Case(
+        "subscribe no filters",
+        hx("8202 0015"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_FILTERS,
+        group="validate",
+    ),
+    Case(
+        "subscribe oversize identifier (struct)",
+        b"",
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1),
+            protocol_version=5,
+            packet_id=15,
+            filters=[
+                Subscription(filter="a/b", qos=0, identifier=268435456)
+            ],
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_OVERSIZE_SUB_ID,
+        group="validate",
+    ),
+    # UNSUBSCRIBE validate
+    Case(
+        "unsubscribe packet id zero",
+        hx("a207 0000 0003 612f62"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_PACKET_ID,
+        group="validate",
+    ),
+    Case(
+        "unsubscribe no filters",
+        hx("a202 0015"),
+        version=4,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_FILTERS,
+        group="validate",
+    ),
+    # AUTH validate
+    Case(
+        "auth invalid reason code",
+        hx("f002 8100"),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_INVALID_REASON,
+        group="validate",
+    ),
+    Case(
+        "auth invalid reason code success-ignore",
+        hx("f002 0100"),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_INVALID_REASON,
+        group="validate",
+    ),
+]
+
+# more wire-level decode/roundtrip coverage mirroring tpackets.go
+CASES += [
+    Case(
+        "connack v5 adjusted session expiry interval",
+        hx("2008 00 00 05 11 00000078"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=8),
+            protocol_version=5,
+            session_present=False,
+            reason_code=0,
+            properties=Properties(
+                session_expiry_interval=120, session_expiry_interval_flag=True
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 broker subscription identifier",
+        hx("3008 0001 74 02 0b05 6f6b"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=8),
+            protocol_version=5,
+            topic_name="t",
+            properties=Properties(subscription_identifier=[5]),
+            payload=b"ok",
+        ),
+        version=5,
+    ),
+    Case(
+        "pubrec v5 remaining longer than body",
+        hx("5003 0015"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 disconnect with will message",
+        hx("e002 0400"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x04,
+            properties=Properties(),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 receive maximum exceeded",
+        hx("e002 9300"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x93,
+            properties=Properties(),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 session expiry property",
+        hx("e007 00 05 11 0000003c"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=7),
+            protocol_version=5,
+            reason_code=0,
+            properties=Properties(
+                session_expiry_interval=60, session_expiry_interval_flag=True
+            ),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "suback v5 shared subscriptions not supported",
+        hx("9004 0015 00 9e"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=0x15,
+            properties=Properties(),
+            reason_codes=b"\x9e",
+        ),
+        version=5,
+    ),
+    Case(
+        "unsuback v5 no subscription existed",
+        hx("b005 0015 00 00 11"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=5),
+            protocol_version=5,
+            packet_id=0x15,
+            properties=Properties(),
+            reason_codes=b"\x00\x11",
+        ),
+        version=5,
+    ),
+]
+
+CASES += [
+    Case(
+        "suback v5 packet identifier in use",
+        hx("9004 0015 00 91"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=0x15,
+            properties=Properties(),
+            reason_codes=b"\x91",
+        ),
+        version=5,
+    ),
+    Case(
+        "puback v5 quota exceeded",
+        hx("4004 0015 97 00"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=0x15,
+            reason_code=0x97,
+            properties=Properties(),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "unsuback v5 packet identifier in use",
+        hx("b004 0015 00 91"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=0x15,
+            properties=Properties(),
+            reason_codes=b"\x91",
+        ),
+        version=5,
+    ),
+    Case(
+        "pubcomp v5 invalid reason decodes (validity checked at server)",
+        hx("7004 0015 99 00"),
+        Packet(
+            fixed_header=fhdr(PUBCOMP, remaining=4),
+            protocol_version=5,
+            packet_id=0x15,
+            reason_code=0x99,
+            properties=Properties(),
+        ),
+        version=5,
         group="decode",
     ),
 ]
