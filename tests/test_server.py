@@ -19,6 +19,7 @@ from mqtt_tpu.hooks import (
 )
 from mqtt_tpu.hooks.auth import AllowHook
 from mqtt_tpu.packets import (
+    AUTH,
     CONNACK,
     CONNECT,
     DISCONNECT,
@@ -1126,6 +1127,980 @@ class TestServerAPIs:
             )
             m = await read_wire_packet(reader)
             assert m.payload == b"injected"
+            await h.shutdown()
+
+        run(scenario())
+
+
+def unsub_packet(pid, filters, version=4):
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=UNSUBSCRIBE, qos=1),
+            protocol_version=version,
+            packet_id=pid,
+            filters=[Subscription(filter=f) for f in filters],
+        )
+    )
+
+
+class TestCompatibilities:
+    """The reference's compatibility-mode flags (server.go:86-93)."""
+
+    def test_obscure_not_authorized_masks_suback_code(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.compatibilities.obscure_not_authorized = True
+            h = Harness(opts, allow=False)
+
+            class DenyACL(Hook):
+                def id(self):
+                    return "deny-acl"
+
+                def provides(self, b):
+                    return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+                def on_connect_authenticate(self, cl, pk):
+                    return True
+
+                def on_acl_check(self, cl, topic, write):
+                    return False
+
+            h.server.add_hook(DenyACL())
+            r, w, _ = await h.connect("obsc")
+            w.write(sub_packet(1, [Subscription(filter="a/b", qos=0)]))
+            await w.drain()
+            ack = await read_wire_packet(r)
+            assert ack.fixed_header.type == SUBACK
+            assert ack.reason_codes == b"\x80"  # unspecified, NOT 0x87
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_not_authorized_suback_code_without_flag(self):
+        async def scenario():
+            h = Harness(allow=False)
+
+            class DenyACL(Hook):
+                def id(self):
+                    return "deny-acl"
+
+                def provides(self, b):
+                    return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+                def on_connect_authenticate(self, cl, pk):
+                    return True
+
+                def on_acl_check(self, cl, topic, write):
+                    return False
+
+            h.server.add_hook(DenyACL())
+            r, w, _ = await h.connect("noobsc", version=5)
+            w.write(sub_packet(1, [Subscription(filter="a/b", qos=0)], version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.reason_codes == b"\x87"  # not authorized, unmasked
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_passive_client_disconnect_keeps_connection(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.compatibilities.passive_client_disconnect = True
+            h = Harness(opts)
+            r, w, _ = await h.connect("passive", version=5)
+            cl = h.server.clients.get("passive")
+            # an error-class disconnect writes DISCONNECT but must NOT stop
+            # the client nor raise (server.go:1413-1437 passive mode)
+            h.server.disconnect_client(cl, codes.ERR_KEEP_ALIVE_TIMEOUT)
+            pk = await read_wire_packet(r, 5)
+            assert pk.fixed_header.type == DISCONNECT
+            assert not cl.closed
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_always_return_response_info(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.compatibilities.always_return_response_info = True
+            h = Harness(opts)
+            reader, writer, task = await h.attach()
+            pk = Packet(
+                fixed_header=FixedHeader(type=CONNECT),
+                protocol_version=5,
+                connect=ConnectParams(
+                    protocol_name=b"MQTT",
+                    clean=True,
+                    keepalive=30,
+                    client_identifier="ri",
+                ),
+            )
+            pk.properties.request_response_info = 1
+            writer.write(encode_packet(pk))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_no_inherited_properties_on_ack(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.compatibilities.no_inherited_properties_on_ack = True
+            h = Harness(opts)
+            r, w, _ = await h.connect("noinherit", version=5)
+            pk = Packet(
+                fixed_header=FixedHeader(type=PUBLISH, qos=1),
+                protocol_version=5,
+                topic_name="n/i",
+                packet_id=3,
+                payload=b"x",
+            )
+            from mqtt_tpu.packets import UserProperty
+            pk.properties.user = [UserProperty("k", "v")]
+            w.write(encode_packet(pk))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == PUBACK
+            assert not ack.properties.user  # properties NOT inherited
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_restore_sys_info_on_restart(self):
+        from mqtt_tpu.hooks.storage import SystemInfo as StoredSysInfo
+        from mqtt_tpu.hooks import STORED_SYS_INFO as _SSI
+
+        class SysStore(Hook):
+            def id(self):
+                return "sys-store"
+
+            def provides(self, b):
+                return b == _SSI
+
+            def stored_sys_info(self):
+                info = StoredSysInfo()
+                info.info.version = "2.7.9"  # first NON-EMPTY wins (hooks.go:644)
+                info.info.bytes_received = 777
+                info.info.messages_received = 42
+                return info
+
+        async def scenario():
+            opts = Options()
+            opts.capabilities.compatibilities.restore_sys_info_on_restart = True
+            h = Harness(opts)
+            h.server.add_hook(SysStore())
+            h.server.read_store()
+            assert h.server.info.bytes_received == 777
+            assert h.server.info.messages_received == 42
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestSubscribeEdges:
+    def test_shared_no_local_violation_code(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("snl", version=5)
+            w.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="$share/g/a", qos=0, no_local=True)],
+                    version=5,
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.reason_codes[0] == 0x82  # protocol error [MQTT-3.8.3-4]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_invalid_filter_reason_code(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("badf", version=5)
+            w.write(sub_packet(1, [Subscription(filter="a/#/b", qos=0)], version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.reason_codes[0] == codes.ERR_TOPIC_FILTER_INVALID.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_packet_id_in_use_suback(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("piu", version=5)
+            cl = h.server.clients.get("piu")
+            cl.state.inflight.set(
+                Packet(fixed_header=FixedHeader(type=PUBLISH, qos=1), packet_id=9)
+            )
+            w.write(sub_packet(9, [Subscription(filter="a/b", qos=0)], version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.reason_codes[0] == codes.ERR_PACKET_IDENTIFIER_IN_USE.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_granted_qos_capped_by_server_maximum(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.maximum_qos = 1
+            h = Harness(opts)
+            r, w, _ = await h.connect("qcap")
+            w.write(sub_packet(1, [Subscription(filter="a/b", qos=2)]))
+            await w.drain()
+            ack = await read_wire_packet(r)
+            assert ack.reason_codes == b"\x01"  # granted qos1, not qos2
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_subscription_counter_tracks_new_and_existing(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("cnt")
+            w.write(sub_packet(1, [Subscription(filter="c/1", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            n1 = h.server.info.subscriptions
+            w.write(sub_packet(2, [Subscription(filter="c/1", qos=1)]))  # resubscribe
+            await w.drain()
+            await read_wire_packet(r)
+            assert h.server.info.subscriptions == n1  # not double counted
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_decrements_counter_and_acks(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("uns", version=5)
+            w.write(sub_packet(1, [Subscription(filter="u/1", qos=0)], version=5))
+            await w.drain()
+            await read_wire_packet(r, 5)
+            n1 = h.server.info.subscriptions
+            w.write(unsub_packet(2, ["u/1", "u/nope"], version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == UNSUBACK
+            assert ack.reason_codes == b"\x00\x11"  # success, no sub existed
+            assert h.server.info.subscriptions == n1 - 1
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestInflightQuotaEdges:
+    def test_maximum_inflight_gate_drops_qos_publish(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.maximum_inflight = 1
+            h = Harness(opts)
+            sub_r, sub_w, _ = await h.connect("slow")
+            sub_w.write(sub_packet(1, [Subscription(filter="g/#", qos=1)]))
+            await sub_w.drain()
+            await read_wire_packet(sub_r)
+            cl = h.server.clients.get("slow")
+            # occupy the single inflight slot
+            cl.state.inflight.set(
+                Packet(fixed_header=FixedHeader(type=PUBLISH, qos=1), packet_id=60000)
+            )
+            dropped0 = h.server.info.inflight_dropped
+            pub_r, pub_w, _ = await h.connect("fast")
+            pub_w.write(pub_packet("g/1", b"x", qos=1, pid=5))
+            await pub_w.drain()
+            await read_wire_packet(pub_r)  # publisher still gets PUBACK
+            await asyncio.sleep(0.05)
+            assert h.server.info.inflight_dropped == dropped0 + 1
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_packet_id_exhaustion_counts_and_hook(self):
+        async def scenario():
+            h = Harness()
+            seen = []
+
+            class IdHook(Hook):
+                def id(self):
+                    return "ids"
+
+                def provides(self, b):
+                    from mqtt_tpu.hooks import ON_PACKET_ID_EXHAUSTED
+
+                    return b == ON_PACKET_ID_EXHAUSTED
+
+                def on_packet_id_exhausted(self, cl, pk):
+                    seen.append(cl.id)
+
+            h.server.add_hook(IdHook())
+            sub_r, sub_w, _ = await h.connect("exhaust")
+            sub_w.write(sub_packet(1, [Subscription(filter="e/#", qos=1)]))
+            await sub_w.drain()
+            await read_wire_packet(sub_r)
+            cl = h.server.clients.get("exhaust")
+            # fill the entire id space
+            caps_max = h.server.options.capabilities.maximum_packet_id
+            for i in range(1, caps_max + 1):
+                cl.state.inflight.set(
+                    Packet(fixed_header=FixedHeader(type=PUBLISH, qos=1), packet_id=i)
+                )
+            # bypass the inflight-count gate so next_packet_id is reached
+            h.server.options.capabilities.maximum_inflight = caps_max + 10
+            pub_r, pub_w, _ = await h.connect("src")
+            pub_w.write(pub_packet("e/1", b"x", qos=1, pid=5))
+            await pub_w.drain()
+            await read_wire_packet(pub_r)
+            await asyncio.sleep(0.05)
+            assert seen == ["exhaust"]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_send_quota_zero_marks_immediate_resend(self):
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.attach()
+            pk = Packet(
+                fixed_header=FixedHeader(type=CONNECT),
+                protocol_version=5,
+                connect=ConnectParams(
+                    protocol_name=b"MQTT",
+                    clean=True,
+                    keepalive=30,
+                    client_identifier="quota1",
+                ),
+            )
+            pk.properties.receive_maximum = 1  # client accepts 1 inflight
+            writer.write(encode_packet(pk))
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+            writer.write(sub_packet(1, [Subscription(filter="q/#", qos=1)], version=5))
+            await writer.drain()
+            await read_wire_packet(reader, 5)
+
+            pub_r, pub_w, _ = await h.connect("qsrc")
+            pub_w.write(pub_packet("q/a", b"1", qos=1, pid=2))
+            pub_w.write(pub_packet("q/b", b"2", qos=1, pid=3))
+            await pub_w.drain()
+            await read_wire_packet(pub_r)
+            await read_wire_packet(pub_r)
+            # first delivery consumed the quota; second is parked immediate
+            out1 = await read_wire_packet(reader, 5)
+            assert out1.fixed_header.type == PUBLISH
+            cl = h.server.clients.get("quota1")
+            await asyncio.sleep(0.05)
+            assert cl.state.inflight.next_immediate() is not None
+            # PUBACK frees quota -> the parked publish drains
+            writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBACK),
+                        protocol_version=5,
+                        packet_id=out1.packet_id,
+                    )
+                )
+            )
+            await writer.drain()
+            out2 = await read_wire_packet(reader, 5)
+            assert out2.fixed_header.type == PUBLISH
+            assert bytes(out2.payload) == b"2"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_pubrel_unknown_id_gets_pubcomp_not_found(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("rel5", version=5)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBREL, qos=1),
+                        protocol_version=5,
+                        packet_id=77,
+                    )
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == PUBCOMP
+            assert ack.reason_code == 0x92  # packet identifier not found
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_puback_unknown_id_is_ignored(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("ack4")
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBACK),
+                        protocol_version=4,
+                        packet_id=555,
+                    )
+                )
+            )
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            pk = await read_wire_packet(r)
+            assert pk.fixed_header.type == PINGRESP  # connection healthy
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_receive_quota_restored_after_qos2_complete(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("q2q")
+            cl = h.server.clients.get("q2q")
+            quota0 = cl.state.inflight.receive_quota
+            w.write(pub_packet("t/2", b"x", qos=2, pid=9))
+            await w.drain()
+            rec = await read_wire_packet(r)
+            assert rec.fixed_header.type == PUBREC
+            assert cl.state.inflight.receive_quota == quota0 - 1
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBREL, qos=1),
+                        protocol_version=4,
+                        packet_id=9,
+                    )
+                )
+            )
+            await w.drain()
+            comp = await read_wire_packet(r)
+            assert comp.fixed_header.type == PUBCOMP
+            assert cl.state.inflight.receive_quota == quota0
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestTakeoverEdges:
+    def test_clean_takeover_discards_session(self):
+        async def scenario():
+            h = Harness()
+            r1, w1, _ = await h.connect("td", clean=False)
+            w1.write(sub_packet(1, [Subscription(filter="t/d", qos=1)]))
+            await w1.drain()
+            await read_wire_packet(r1)
+            # reconnect CLEAN: subscriptions must be discarded
+            r2, w2, _ = await h.connect("td", clean=True)
+            await asyncio.sleep(0.05)
+            subs = h.server.topics.subscribers("t/d")
+            assert "td" not in subs.subscriptions
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_dirty_takeover_keeps_subscriptions_and_session_present(self):
+        async def scenario():
+            h = Harness()
+            r1, w1, _ = await h.connect("tk", clean=False)
+            w1.write(sub_packet(1, [Subscription(filter="t/k", qos=1)]))
+            await w1.drain()
+            await read_wire_packet(r1)
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("tk", 4, clean=False))
+            await writer.drain()
+            raw = await asyncio.wait_for(reader.readexactly(4), TIMEOUT)
+            assert raw == bytes.fromhex("20020100")  # session present = 1
+            subs = h.server.topics.subscribers("t/k")
+            assert "tk" in subs.subscriptions
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_takeover_of_disconnected_session(self):
+        async def scenario():
+            h = Harness()
+            r1, w1, t1 = await h.connect("gone", clean=False)
+            w1.close()  # abnormal drop; session survives (non-clean)
+            await asyncio.sleep(0.05)
+            r2, w2, _ = await h.connect("gone", clean=False)
+            await asyncio.sleep(0.05)
+            cl = h.server.clients.get("gone")
+            assert cl is not None and not cl.closed
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestRetainEdges:
+    def test_empty_payload_deletes_retained(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("ret")
+            w.write(pub_packet("r/1", b"keep", retain=True))
+            await w.drain()
+            await asyncio.sleep(0.05)
+            assert h.server.topics.retained.get("r/1") is not None
+            assert h.server.info.retained == 1
+            w.write(pub_packet("r/1", b"", retain=True))  # delete [MQTT-3.3.1-6]
+            await w.drain()
+            await asyncio.sleep(0.05)
+            assert h.server.topics.retained.get("r/1") is None
+            assert h.server.info.retained == 0
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retain_available_zero_ignores_retain(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.retain_available = 0
+            h = Harness(opts)
+            r, w, _ = await h.connect("noret")
+            w.write(pub_packet("r/2", b"x", retain=True))
+            await w.drain()
+            await asyncio.sleep(0.05)
+            assert h.server.topics.retained.get("r/2") is None
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retain_handling_1_skips_existing_subscription(self):
+        async def scenario():
+            h = Harness()
+            pub_r, pub_w, _ = await h.connect("rp")
+            pub_w.write(pub_packet("rh/1", b"x", retain=True))
+            await pub_w.drain()
+            r, w, _ = await h.connect("rh1", version=5)
+            # retain_handling=1: send retained only if subscription is NEW
+            w.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="rh/1", qos=0, retain_handling=1)],
+                    version=5,
+                )
+            )
+            await w.drain()
+            await read_wire_packet(r, 5)  # suback
+            pk = await read_wire_packet(r, 5)
+            assert pk.fixed_header.type == PUBLISH  # new sub -> retained sent
+            # resubscribe: filter exists -> retained NOT sent again
+            w.write(
+                sub_packet(
+                    2,
+                    [Subscription(filter="rh/1", qos=0, retain_handling=1)],
+                    version=5,
+                )
+            )
+            await w.drain()
+            ack2 = await read_wire_packet(r, 5)
+            assert ack2.fixed_header.type == SUBACK
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            nxt = await read_wire_packet(r, 5)
+            assert nxt.fixed_header.type == PINGRESP  # no second retained
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retain_handling_2_never_sends_retained(self):
+        async def scenario():
+            h = Harness()
+            pub_r, pub_w, _ = await h.connect("rp2")
+            pub_w.write(pub_packet("rh/2", b"x", retain=True))
+            await pub_w.drain()
+            r, w, _ = await h.connect("rh2c", version=5)
+            w.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="rh/2", qos=0, retain_handling=2)],
+                    version=5,
+                )
+            )
+            await w.drain()
+            await read_wire_packet(r, 5)  # suback
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            nxt = await read_wire_packet(r, 5)
+            assert nxt.fixed_header.type == PINGRESP  # nothing retained sent
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retain_as_published_preserves_flag(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("rap", version=5)
+            w.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="rap/#", qos=0, retain_as_published=True)],
+                    version=5,
+                )
+            )
+            await w.drain()
+            await read_wire_packet(r, 5)
+            pub_r, pub_w, _ = await h.connect("rapsrc")
+            pub_w.write(pub_packet("rap/t", b"x", retain=True))
+            await pub_w.drain()
+            pk = await read_wire_packet(r, 5)
+            assert pk.fixed_header.retain is True  # RAP keeps the flag
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_retained_qos_downgraded_to_subscription(self):
+        async def scenario():
+            h = Harness()
+            pub_r, pub_w, _ = await h.connect("rqsrc")
+            pub_w.write(pub_packet("rq/1", b"x", qos=1, pid=4, retain=True))
+            await pub_w.drain()
+            await read_wire_packet(pub_r)
+            r, w, _ = await h.connect("rqsub")
+            w.write(sub_packet(1, [Subscription(filter="rq/1", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            pk = await read_wire_packet(r)
+            assert pk.fixed_header.type == PUBLISH
+            assert pk.fixed_header.qos == 0  # min(sub 0, msg 1)
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_sys_topics_not_matched_by_top_level_wildcard(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("wild")
+            w.write(sub_packet(1, [Subscription(filter="#", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            h.server.publish_sys_topics()
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            pk = await read_wire_packet(r)
+            assert pk.fixed_header.type == PINGRESP  # no $SYS leaked to '#'
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_sys_topics_delivered_to_explicit_subscriber(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("sysw")
+            w.write(sub_packet(1, [Subscription(filter="$SYS/broker/uptime", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            h.server.publish_sys_topics()
+            pk = await read_wire_packet(r)
+            assert pk.topic_name == "$SYS/broker/uptime"
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestPublishEdges:
+    def test_publish_to_sys_topic_is_dropped(self):
+        async def scenario():
+            h = Harness()
+            spy_r, spy_w, _ = await h.connect("spy")
+            spy_w.write(sub_packet(1, [Subscription(filter="$SYS/#", qos=0)]))
+            await spy_w.drain()
+            await read_wire_packet(spy_r)
+            r, w, _ = await h.connect("evil")
+            w.write(pub_packet("$SYS/broker/uptime", b"hax"))
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            await read_wire_packet(r)  # pingresp: publisher not disconnected
+            spy_w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await spy_w.drain()
+            pk = await read_wire_packet(spy_r)
+            assert pk.fixed_header.type == PINGRESP  # $SYS publish dropped
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inbound_alias_above_maximum_disconnects(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.topic_alias_maximum = 2
+            h = Harness(opts)
+            r, w, _ = await h.connect("alias5", version=5)
+            pk = Packet(
+                fixed_header=FixedHeader(type=PUBLISH),
+                protocol_version=5,
+                topic_name="a/t",
+                payload=b"x",
+            )
+            pk.properties.topic_alias = 9  # above server maximum
+            pk.properties.topic_alias_flag = True
+            w.write(encode_packet(pk))
+            await w.drain()
+            out = await read_wire_packet(r, 5)
+            assert out.fixed_header.type == DISCONNECT
+            assert out.reason_code == codes.ERR_TOPIC_ALIAS_INVALID.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_v3_acl_deny_publish_disconnects(self):
+        async def scenario():
+            h = Harness(allow=False)
+
+            class WriteDeny(Hook):
+                def id(self):
+                    return "write-deny"
+
+                def provides(self, b):
+                    return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+                def on_connect_authenticate(self, cl, pk):
+                    return True
+
+                def on_acl_check(self, cl, topic, write):
+                    return not write  # deny writes only
+
+            h.server.add_hook(WriteDeny())
+            r, w, task = await h.connect("v3deny")
+            w.write(pub_packet("x/y", b"no", qos=1, pid=3))
+            await w.drain()
+            await asyncio.wait_for(task, TIMEOUT)  # v3: connection dropped
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_v5_acl_deny_qos1_acks_not_authorized(self):
+        async def scenario():
+            h = Harness(allow=False)
+
+            class WriteDeny(Hook):
+                def id(self):
+                    return "write-deny"
+
+                def provides(self, b):
+                    return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+                def on_connect_authenticate(self, cl, pk):
+                    return True
+
+                def on_acl_check(self, cl, topic, write):
+                    return not write
+
+            h.server.add_hook(WriteDeny())
+            r, w, _ = await h.connect("v5deny", version=5)
+            w.write(pub_packet("x/y", b"no", qos=1, pid=3, version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == PUBACK
+            assert ack.reason_code == codes.ERR_NOT_AUTHORIZED.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_qos2_duplicate_publish_acks_in_use(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("dup2", version=5)
+            w.write(pub_packet("d/2", b"x", qos=2, pid=8, version=5))
+            await w.drain()
+            rec1 = await read_wire_packet(r, 5)
+            assert rec1.fixed_header.type == PUBREC
+            w.write(pub_packet("d/2", b"x", qos=2, pid=8, version=5))
+            await w.drain()
+            rec2 = await read_wire_packet(r, 5)
+            assert rec2.fixed_header.type == PUBREC
+            assert rec2.reason_code == codes.ERR_PACKET_IDENTIFIER_IN_USE.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_message_expiry_interval_rewritten_on_delivery(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("exp5", version=5)
+            w.write(sub_packet(1, [Subscription(filter="ex/#", qos=0)], version=5))
+            await w.drain()
+            await read_wire_packet(r, 5)
+            pub_r, pub_w, _ = await h.connect("expsrc", version=5)
+            pk = Packet(
+                fixed_header=FixedHeader(type=PUBLISH),
+                protocol_version=5,
+                topic_name="ex/1",
+                payload=b"x",
+            )
+            pk.properties.message_expiry_interval = 300
+            pub_w.write(encode_packet(pk))
+            await pub_w.drain()
+            out = await read_wire_packet(r, 5)
+            # [MQTT-3.3.2-6]: remaining lifetime, <= original interval
+            assert 0 < out.properties.message_expiry_interval <= 300
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestDisconnectAndSessionEdges:
+    def test_disconnect_with_will_message_sends_lwt(self):
+        async def scenario():
+            h = Harness()
+            sub_r, sub_w, _ = await h.connect("lwtwatch")
+            sub_w.write(sub_packet(1, [Subscription(filter="will/#", qos=0)]))
+            await sub_w.drain()
+            await read_wire_packet(sub_r)
+            r, w, task = await h.connect(
+                "willer", version=5, will=("will/us", b"bye")
+            )
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=DISCONNECT),
+                        protocol_version=5,
+                        reason_code=0x04,  # disconnect WITH will message
+                    )
+                )
+            )
+            await w.drain()
+            pk = await read_wire_packet(sub_r)
+            assert pk.topic_name == "will/us"
+            assert bytes(pk.payload) == b"bye"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_disconnect_zero_to_nonzero_expiry_violation(self):
+        async def scenario():
+            h = Harness()
+            r, w, task = await h.connect("zexp", version=5)
+            pk = Packet(
+                fixed_header=FixedHeader(type=DISCONNECT),
+                protocol_version=5,
+                reason_code=0,
+            )
+            pk.properties.session_expiry_interval = 60
+            pk.properties.session_expiry_interval_flag = True
+            w.write(encode_packet(pk))
+            await w.drain()
+            out = await read_wire_packet(r, 5)
+            assert out.fixed_header.type == DISCONNECT  # [MQTT-3.1.2-23]
+            assert out.reason_code == codes.ERR_PROTOCOL_VIOLATION_ZERO_NON_ZERO_EXPIRY.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_session_expiry_clamped_to_server_maximum(self):
+        async def scenario():
+            opts = Options()
+            opts.capabilities.maximum_session_expiry_interval = 100
+            h = Harness(opts)
+            reader, writer, task = await h.attach()
+            pk = Packet(
+                fixed_header=FixedHeader(type=CONNECT),
+                protocol_version=5,
+                connect=ConnectParams(
+                    protocol_name=b"MQTT",
+                    clean=True,
+                    keepalive=30,
+                    client_identifier="clamp",
+                ),
+            )
+            pk.properties.session_expiry_interval = 99999
+            pk.properties.session_expiry_interval_flag = True
+            writer.write(encode_packet(pk))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            cl = h.server.clients.get("clamp")
+            assert cl.properties.props.session_expiry_interval == 100
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_auth_packet_dispatches_hook(self):
+        async def scenario():
+            h = Harness()
+            seen = []
+
+            class AuthHook(Hook):
+                def id(self):
+                    return "auth-watch"
+
+                def provides(self, b):
+                    from mqtt_tpu.hooks import ON_AUTH_PACKET
+
+                    return b == ON_AUTH_PACKET
+
+                def on_auth_packet(self, cl, pk):
+                    seen.append(pk.reason_code)
+                    return pk
+
+            h.server.add_hook(AuthHook())
+            r, w, _ = await h.connect("auth5", version=5)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=AUTH),
+                        protocol_version=5,
+                        reason_code=0x19,  # re-authenticate
+                    )
+                )
+            )
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            pk = await read_wire_packet(r, 5)
+            assert pk.fixed_header.type == PINGRESP
+            assert seen == [0x19]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_clears_shared_group_membership(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("shm", version=5)
+            w.write(
+                sub_packet(
+                    1, [Subscription(filter="$share/g1/s/t", qos=0)], version=5
+                )
+            )
+            await w.drain()
+            await read_wire_packet(r, 5)
+            assert h.server.topics.subscribers("s/t").shared
+            w.write(unsub_packet(2, ["$share/g1/s/t"], version=5))
+            await w.drain()
+            await read_wire_packet(r, 5)
+            assert not h.server.topics.subscribers("s/t").shared
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_shared_subscription_delivers_to_one_member(self):
+        async def scenario():
+            h = Harness()
+            members = []
+            for i in range(3):
+                r, w, _ = await h.connect(f"gm{i}")
+                w.write(
+                    sub_packet(1, [Subscription(filter="$share/gg/x/y", qos=0)])
+                )
+                await w.drain()
+                await read_wire_packet(r)
+                members.append((r, w))
+            pub_r, pub_w, _ = await h.connect("gpub")
+            pub_w.write(pub_packet("x/y", b"once"))
+            await pub_w.drain()
+            await asyncio.sleep(0.1)
+            got = 0
+            for r, w in members:
+                w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+                await w.drain()
+                pk = await read_wire_packet(r)
+                if pk.fixed_header.type == PUBLISH:
+                    got += 1
+                    await read_wire_packet(r)  # trailing pingresp
+            assert got == 1  # exactly one group member receives it
             await h.shutdown()
 
         run(scenario())
