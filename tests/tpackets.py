@@ -1561,7 +1561,7 @@ CASES += [
     ),
     Case(
         "publish v5 topic alias zero",
-        hx("3008 0001 74 03 2300 00 6f6b"),
+        hx("3009 0001 74 03 230000 6f6b"),
         version=5,
         validate_err=codes.ERR_TOPIC_ALIAS_INVALID,
         validate_arg=8,
@@ -1569,7 +1569,7 @@ CASES += [
     ),
     Case(
         "publish v5 topic alias above maximum",
-        hx("3008 0001 74 03 2300 07 6f6b"),
+        hx("3009 0001 74 03 230007 6f6b"),
         version=5,
         validate_err=codes.ERR_TOPIC_ALIAS_INVALID,
         validate_arg=3,
