@@ -20,12 +20,17 @@ class RedisOptions:
         password: str = "",
         database: int = 0,
         h_prefix: str = DEFAULT_HPREFIX,
+        client: Any = None,
     ) -> None:
         self.address = address
         self.username = username
         self.password = password
         self.database = database
         self.h_prefix = h_prefix
+        # injectable client implementing set/get/delete/scan_iter/ping/close
+        # — the test seam, mirroring the reference's miniredis-backed suite
+        # (hooks/storage/redis/redis_test.go:19,116)
+        self.client = client
 
 
 class RedisStore(StorageHook):
@@ -43,6 +48,10 @@ class RedisStore(StorageHook):
         if config is not None and not isinstance(config, RedisOptions):
             raise TypeError("invalid config type provided")
         self.config = config or RedisOptions()
+        if self.config.client is not None:
+            self._client = self.config.client
+            self._client.ping()
+            return
         try:
             import redis  # type: ignore
         except ImportError as e:

@@ -1,0 +1,61 @@
+"""An in-repo fake of the redis client verbs the storage hook uses — the
+miniredis analog (reference hooks/storage/redis/redis_test.go runs the real
+go-redis client against an embedded miniredis server; this environment has
+neither the redis library nor a server, so the fake sits one layer up, at
+the client API: set/get/delete/scan_iter/ping/close)."""
+
+import fnmatch
+import threading
+
+
+class FakeRedis:
+    """Dict-backed, thread-safe, bytes-valued."""
+
+    def __init__(self, server: dict | None = None):
+        # share `server` between instances to model one redis process
+        # surviving broker restarts
+        self._data = server if server is not None else {}
+        self._lock = threading.Lock()
+        self.closed = False
+        self.pings = 0
+
+    def ping(self):
+        self.pings += 1
+        return True
+
+    def set(self, key, value):
+        if isinstance(key, str):
+            key = key.encode()
+        if isinstance(value, str):
+            value = value.encode()
+        with self._lock:
+            self._data[key] = bytes(value)
+
+    def get(self, key):
+        if isinstance(key, str):
+            key = key.encode()
+        with self._lock:
+            return self._data.get(key)
+
+    def delete(self, *keys):
+        n = 0
+        with self._lock:
+            for key in keys:
+                if isinstance(key, str):
+                    key = key.encode()
+                if key in self._data:
+                    del self._data[key]
+                    n += 1
+        return n
+
+    def scan_iter(self, match="*", count=None):
+        if isinstance(match, bytes):
+            match = match.decode()
+        with self._lock:
+            keys = list(self._data)
+        for key in keys:
+            if fnmatch.fnmatchcase(key.decode(), match):
+                yield key
+
+    def close(self):
+        self.closed = True

@@ -251,6 +251,52 @@ class TestStorageHooks:
         with pytest.raises((RuntimeError, Exception)):
             store.init(None)  # redis lib absent or server unreachable
 
+    def test_redis_store_roundtrip(self):
+        from mqtt_tpu.hooks.storage.redis import RedisOptions, RedisStore
+
+        from tests.fake_redis import FakeRedis
+
+        def make():
+            store = RedisStore()
+            store._test_config = RedisOptions(client=FakeRedis())
+            return store
+
+        _roundtrip_store(make)
+
+    def test_redis_persists_across_instances(self):
+        from mqtt_tpu.hooks.storage.redis import RedisOptions, RedisStore
+
+        from tests.fake_redis import FakeRedis
+
+        server = {}  # one fake redis process, two hook lifetimes
+        s1 = RedisStore()
+        s1.init(RedisOptions(client=FakeRedis(server)))
+        s1._set("CL_x", b'{"id": "x"}')
+        s1.stop()
+        s2 = RedisStore()
+        s2.init(RedisOptions(client=FakeRedis(server)))
+        assert s2._get("CL_x") == b'{"id": "x"}'
+        assert list(s2._iter("CL")) == [b'{"id": "x"}']
+        s2._del("CL_x")
+        assert s2._get("CL_x") is None
+        s2.stop()
+
+    def test_redis_prefix_isolation(self):
+        from mqtt_tpu.hooks.storage.redis import RedisOptions, RedisStore
+
+        from tests.fake_redis import FakeRedis
+
+        server = {}
+        a = RedisStore()
+        a.init(RedisOptions(client=FakeRedis(server), h_prefix="a-"))
+        b = RedisStore()
+        b.init(RedisOptions(client=FakeRedis(server), h_prefix="b-"))
+        a._set("CL_x", b"1")
+        b._set("CL_x", b"2")
+        assert a._get("CL_x") == b"1"
+        assert b._get("CL_x") == b"2"
+        assert list(a._iter("CL")) == [b"1"]
+
 
 # -- debug hook ------------------------------------------------------------
 
