@@ -1,0 +1,243 @@
+"""Interoperability smoke test with an INDEPENDENT MQTT v5 client.
+
+The reference validates against the external Paho suite
+(examples/paho.testing/main.go:29-31, README.md:468-471); neither paho nor
+any third-party MQTT client ships in this image, so this file carries a
+minimal v5 client written directly from the OASIS MQTT 5.0 spec — it
+deliberately imports NOTHING from mqtt_tpu.packets, so any codec asymmetry
+between our broker and the wire spec fails here instead of cancelling out.
+
+The broker runs with the same configuration the reference's paho harness
+uses: ObscureNotAuthorized + PassiveClientDisconnect +
+NoInheritedPropertiesOnAck compat flags and an ACL denying
+'test/nosubscribe' (examples/paho.testing/main.go:29-31,77).
+"""
+
+import asyncio
+import struct
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks import ON_ACL_CHECK, ON_CONNECT_AUTHENTICATE, Hook
+from mqtt_tpu.listeners import Config as ListenerConfig
+from mqtt_tpu.listeners.tcp import TCP
+
+from tests.test_server import run
+
+PORT = 18871
+
+
+# --------------------------------------------------------------------------
+# the independent client: every byte below is derived from the MQTT 5.0
+# spec (packet type table 2-1, variable byte integer 1.5.5, UTF-8 string
+# 1.5.4, property ids 2.2.2.2) — NOT from mqtt_tpu's codec
+# --------------------------------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def _frame(first_byte: int, body: bytes) -> bytes:
+    return bytes([first_byte]) + _varint(len(body)) + body
+
+
+class MiniV5Client:
+    """connect / subscribe / publish QoS0+1 / receive, MQTT 5.0 only."""
+
+    def __init__(self):
+        self.reader = None
+        self.writer = None
+
+    async def connect(self, host: str, port: int, client_id: str) -> int:
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        body = (
+            _utf8("MQTT")  # 3.1.2.1 protocol name
+            + b"\x05"  # 3.1.2.2 version 5
+            + b"\x02"  # 3.1.2.3 flags: clean start
+            + struct.pack(">H", 60)  # 3.1.2.10 keep alive
+            + b"\x00"  # 3.1.2.11 no properties
+            + _utf8(client_id)  # 3.1.3.1
+        )
+        self.writer.write(_frame(0x10, body))
+        await self.writer.drain()
+        t, body = await self._read_frame()
+        assert t == 0x20, f"expected CONNACK, got {t:#x}"
+        return body[1]  # 3.2.2.2 connect reason code
+
+    async def subscribe(self, pid: int, topic: str, qos: int) -> int:
+        body = struct.pack(">H", pid) + b"\x00" + _utf8(topic) + bytes([qos])
+        self.writer.write(_frame(0x82, body))  # 3.8.1 flags 0b0010
+        await self.writer.drain()
+        t, body = await self._read_frame()
+        assert t == 0x90, f"expected SUBACK, got {t:#x}"
+        assert struct.unpack(">H", body[:2])[0] == pid
+        # packet id (2) + property length varint + properties, then codes
+        plen, off = self._read_varint(body, 2)
+        return body[off + plen]  # first reason code
+
+    async def publish(
+        self, topic: str, payload: bytes, qos: int = 0, pid: int = 0, retain=False
+    ) -> None:
+        first = 0x30 | (qos << 1) | (1 if retain else 0)
+        body = _utf8(topic)
+        if qos:
+            body += struct.pack(">H", pid)
+        body += b"\x00" + payload  # no properties
+        self.writer.write(_frame(first, body))
+        await self.writer.drain()
+        if qos == 1:
+            t, ab = await self._read_frame()
+            assert t == 0x40, f"expected PUBACK, got {t:#x}"
+            assert struct.unpack(">H", ab[:2])[0] == pid
+            if len(ab) > 2:  # 3.4.2.1 reason code present
+                assert ab[2] == 0x00
+
+    async def recv_publish(self) -> tuple[str, bytes, int, bool]:
+        t, body = await self._read_frame()
+        assert (t & 0xF0) == 0x30, f"expected PUBLISH, got {t:#x}"
+        qos = (t >> 1) & 0x3
+        retain = bool(t & 0x1)
+        tlen = struct.unpack(">H", body[:2])[0]
+        topic = body[2 : 2 + tlen].decode("utf-8")
+        off = 2 + tlen
+        pid = 0
+        if qos:
+            pid = struct.unpack(">H", body[off : off + 2])[0]
+            off += 2
+        plen, off = self._read_varint(body, off)
+        payload = body[off + plen :]
+        if qos == 1:  # ack it
+            self.writer.write(_frame(0x40, struct.pack(">H", pid)))
+            await self.writer.drain()
+        return topic, payload, qos, retain
+
+    async def disconnect(self) -> None:
+        self.writer.write(_frame(0xE0, b"\x00\x00"))  # reason 0, no props
+        await self.writer.drain()
+        self.writer.close()
+
+    async def _read_frame(self) -> tuple[int, bytes]:
+        first = (await asyncio.wait_for(self.reader.readexactly(1), 5))[0]
+        remaining = 0
+        shift = 0
+        while True:
+            b = (await asyncio.wait_for(self.reader.readexactly(1), 5))[0]
+            remaining |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        body = (
+            await asyncio.wait_for(self.reader.readexactly(remaining), 5)
+            if remaining
+            else b""
+        )
+        return first, body
+
+    @staticmethod
+    def _read_varint(buf: bytes, off: int) -> tuple[int, int]:
+        val = 0
+        shift = 0
+        while True:
+            b = buf[off]
+            off += 1
+            val |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                return val, off
+            shift += 7
+
+
+# --------------------------------------------------------------------------
+
+
+class PahoTestingACL(Hook):
+    """The reference paho-harness auth: allow everything except subscribing
+    to test/nosubscribe (examples/paho.testing/main.go:77)."""
+
+    def id(self):
+        return "paho-acl"
+
+    def provides(self, b):
+        return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+    def on_connect_authenticate(self, cl, pk):
+        return True
+
+    def on_acl_check(self, cl, topic, write):
+        return not (not write and topic == "test/nosubscribe")
+
+
+async def _broker():
+    opts = Options()
+    opts.capabilities.compatibilities.obscure_not_authorized = True
+    opts.capabilities.compatibilities.passive_client_disconnect = True
+    opts.capabilities.compatibilities.no_inherited_properties_on_ack = True
+    srv = Server(opts)
+    srv.add_hook(PahoTestingACL())
+    srv.add_listener(
+        TCP(ListenerConfig(type="tcp", id="interop", address=f"127.0.0.1:{PORT}"))
+    )
+    await srv.serve()
+    return srv
+
+
+class TestInterop:
+    def test_connect_sub_pub_qos1_retain(self):
+        async def scenario():
+            srv = await _broker()
+            try:
+                sub = MiniV5Client()
+                assert await sub.connect("127.0.0.1", PORT, "interop-sub") == 0
+                assert await sub.subscribe(1, "test/topic/+", qos=1) == 1
+
+                pub = MiniV5Client()
+                assert await pub.connect("127.0.0.1", PORT, "interop-pub") == 0
+                # QoS0
+                await pub.publish("test/topic/a", b"zero")
+                topic, payload, qos, _ = await sub.recv_publish()
+                assert (topic, payload, qos) == ("test/topic/a", b"zero", 0)
+                # QoS1 with PUBACK both directions
+                await pub.publish("test/topic/b", b"one", qos=1, pid=7)
+                topic, payload, qos, _ = await sub.recv_publish()
+                assert (topic, payload, qos) == ("test/topic/b", b"one", 1)
+                # retained: delivered to a later subscriber with retain set
+                await pub.publish("test/retained", b"sticky", retain=True)
+                late = MiniV5Client()
+                assert await late.connect("127.0.0.1", PORT, "interop-late") == 0
+                assert await late.subscribe(1, "test/retained", qos=0) == 0
+                topic, payload, _, retain = await late.recv_publish()
+                assert (topic, payload, retain) == ("test/retained", b"sticky", True)
+                await sub.disconnect()
+                await pub.disconnect()
+                await late.disconnect()
+            finally:
+                await srv.close()
+
+        run(scenario())
+
+    def test_acl_denied_subscribe_is_obscured(self):
+        async def scenario():
+            srv = await _broker()
+            try:
+                c = MiniV5Client()
+                assert await c.connect("127.0.0.1", PORT, "interop-deny") == 0
+                code = await c.subscribe(1, "test/nosubscribe", qos=0)
+                # ObscureNotAuthorized: 0x80 unspecified, not 0x87
+                assert code == 0x80
+                await c.disconnect()
+            finally:
+                await srv.close()
+
+        run(scenario())
