@@ -182,6 +182,9 @@ class DeltaMatcher:
                 out_slots,
                 transfer_slots=transfer_slots,
                 window=window,
+                # background rebuilds must not starve the serving thread's
+                # match latency for the build duration (churn p99)
+                cooperative=background,
             )
         snap.rebuild()
         self._snap = snap

@@ -112,7 +112,7 @@ class FlatIndex:
     pat_kind: np.ndarray  # u32[P] — KIND_EXACT / KIND_HASH
     pat_depth: np.ndarray  # i32[P]
     pat_mask: np.ndarray  # u32[P] — '+' level bitmask
-    subs: list[SubEntry] = field(default_factory=list)
+    subs: Any = field(default_factory=list)  # _LazySubTable (sid -> SubEntry)
     salt: int = 0
     window: int = 16
     max_levels: int = 8
@@ -140,16 +140,61 @@ def _mix_np(h: np.ndarray, t: np.ndarray) -> np.ndarray:
     return (h * np.uint32(_M1)).astype(np.uint32)
 
 
+class _LazySubTable:
+    """sid -> SubEntry, materialized on demand from per-entry snapshot
+    tuples (clients, shared, inline) captured at build time. Sub ids are
+    their all_ids slots, so the lookup is a binary search over the entry
+    run starts plus an offset into the snapshot. Memoized: hot topics
+    resolve to dict hits."""
+
+    __slots__ = ("_starts", "_totals", "_ncli", "_nshr", "_snaps", "_n", "_memo")
+
+    def __init__(self, starts, totals, ncli, nshr, snaps, n) -> None:
+        self._starts = np.asarray(starts, dtype=np.int64)
+        self._totals = np.asarray(totals, dtype=np.int64)
+        self._ncli = np.asarray(ncli, dtype=np.int64)
+        self._nshr = np.asarray(nshr, dtype=np.int64)
+        self._snaps = snaps
+        self._n = n
+        self._memo: dict = {}
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, sid: int) -> SubEntry:
+        entry = self._memo.get(sid)
+        if entry is not None:
+            return entry
+        e = int(np.searchsorted(self._starts, sid, side="right")) - 1
+        local = sid - int(self._starts[e])
+        cli, shr, inl = self._snaps[e]
+        ncli = int(self._ncli[e])
+        nshr = int(self._nshr[e])
+        if local < ncli:
+            client, sub = cli[local]
+            entry = SubEntry(KIND_CLIENT, client, "", sub)
+        elif local < ncli + nshr:
+            client, sub = shr[local - ncli]
+            entry = SubEntry(KIND_SHARED, client, sub.filter, sub)
+        else:
+            entry = SubEntry(KIND_INLINE, "", "", inl[local - ncli - nshr])
+        self._memo[sid] = entry
+        return entry
+
+
 def _walk_terminals(index: TopicsIndex):
     """Yield (path_levels, particle) for every trie node carrying
-    subscriptions. Iterative: deep tries must not recurse."""
+    subscriptions. Iterative (deep tries must not recurse) and lock-free:
+    it reads the live maps without copying, so a concurrent structural
+    mutation can tear the walk with RuntimeError/KeyError — callers retry
+    (the same contract the sharded rebuild documents)."""
     stack = [(index.root, [])]
     while stack:
         p, path = stack.pop()
         if (
-            p.subscriptions.get_all()
-            or p.shared.get_all()
-            or p.inline_subscriptions.get_all()
+            p.subscriptions.internal
+            or p.shared.internal
+            or p.inline_subscriptions.internal
         ):
             yield path, p
         for key, child in p.particles.items():
@@ -162,6 +207,7 @@ def build_flat_index(
     salt: int = 0,
     window: int = 16,
     min_buckets: int = 1024,
+    cooperative: bool = False,
     _retries: int = 6,
 ) -> FlatIndex:
     """Compile the host trie into a :class:`FlatIndex`.
@@ -172,11 +218,19 @@ def build_flat_index(
     omitted: every topic they could match is deeper than ``max_levels``
     too and therefore host-routed before probing.
     """
+    import time as _time
+
+    # cooperative mode (background rebuilds): yield the GIL periodically so
+    # the serving thread's match latency stays flat during multi-second
+    # builds — this is what keeps the churn benchmark's p99 honest
+    yield_every = 4096 if cooperative else 0
     paths: list[list[str]] = []
     nodes = []
     for path, p in _walk_terminals(index):
         paths.append(path)
         nodes.append(p)
+        if yield_every and len(paths) % yield_every == 0:
+            _time.sleep(0)
     n_all = len(paths)
 
     # per-entry shape + level strings
@@ -201,25 +255,35 @@ def build_flat_index(
         masks[i] = m
         level_strs.append(levels)
 
-    # level token hashes, vectorized via the cached per-token hasher
-    tok1 = np.zeros((n_all, max_levels), dtype=np.uint32)
-    tok2 = np.zeros((n_all, max_levels), dtype=np.uint32)
-    for i, levels in enumerate(level_strs):
-        m = int(masks[i])
-        for d, tok in enumerate(levels):
-            if (m >> d) & 1:
-                tok1[i, d] = PLUS1
-                tok2[i, d] = PLUS2
-            else:
-                a, b = hash_token(tok, salt)
-                tok1[i, d] = a
-                tok2[i, d] = b
-                if a == PLUS1 and b == PLUS2:  # sentinel collision
-                    if _retries <= 0:
-                        raise RuntimeError("persistent '+' sentinel collision")
-                    return build_flat_index(
-                        index, max_levels, salt + 1, window, min_buckets, _retries - 1
-                    )
+    # level token hashes via the native batch tokenizer (tokens never
+    # contain '/', so the '/'-joined path re-tokenizes losslessly); '+'
+    # levels are overwritten with the sentinel pair afterwards
+    from .hashing import tokenize_topics
+
+    tok1, tok2, _lens, _dollar, _ovf = tokenize_topics(
+        ["/".join(levels) if levels else "" for levels in level_strs],
+        max_levels,
+        salt,
+    )
+    tok1 = tok1.copy()
+    tok2 = tok2.copy()
+    level_idx = np.arange(max_levels)[None, :]
+    in_depth = level_idx < depths[:, None]
+    plus_at = ((masks[:, None] >> level_idx.astype(np.uint32)) & 1) == 1
+    # a real token hashing to the sentinel pair would fake a '+' match
+    if bool(np.any(in_depth & ~plus_at & (tok1 == PLUS1) & (tok2 == PLUS2))):
+        if _retries <= 0:
+            raise RuntimeError("persistent '+' sentinel collision")
+        return build_flat_index(
+            index, max_levels, salt + 1, window, min_buckets, cooperative,
+            _retries - 1
+        )
+    tok1[plus_at & in_depth] = PLUS1
+    tok2[plus_at & in_depth] = PLUS2
+    # zero out beyond-depth lanes so the mix loop's `use` mask semantics
+    # match the per-entry construction exactly
+    tok1[~in_depth] = 0
+    tok2[~in_depth] = 0
 
     # whole-path hashes (vectorized over entries, looped over levels)
     kind_w = np.where(is_hash, np.uint32(KIND_HASH), np.uint32(KIND_EXACT))
@@ -237,61 +301,89 @@ def build_flat_index(
         if _retries <= 0:
             raise RuntimeError("persistent path-key collision")
         return build_flat_index(
-            index, max_levels, salt + 1, window, min_buckets, _retries - 1
+            index, max_levels, salt + 1, window, min_buckets, cooperative,
+            _retries - 1
         )
 
-    # sub-id table + per-entry id runs (reg = client+shared first, then inl)
-    subs: list[SubEntry] = []
-    ids_flat: list[int] = []
-    starts = np.zeros(n_all, dtype=np.uint32)
-    nregs = np.zeros(n_all, dtype=np.uint32)
-    ninls = np.zeros(n_all, dtype=np.uint32)
+    # per-entry subscription snapshots. A sub id IS its slot in the
+    # all_ids run (entries laid out consecutively: clients, then shared,
+    # then inline), so all_ids is a pure arange + exempt-bit mask — no
+    # per-subscription Python work at build time. SubEntry metadata
+    # materializes lazily at expand time from the snapshot tuples
+    # (:class:`_LazySubTable`), preserving build-time snapshot semantics.
+    snaps: list = [None] * n_all
+    n_cli = np.zeros(n_all, dtype=np.int64)
+    n_shr = np.zeros(n_all, dtype=np.int64)
+    n_inl = np.zeros(n_all, dtype=np.int64)
     spills = np.zeros(n_all, dtype=bool)
     top_wilds = np.zeros(n_all, dtype=bool)
-    n_spill = 0
-    for i in sel:
+    for k, i in enumerate(sel):
         node = nodes[i]
         path = paths[i]
+        if yield_every and k % yield_every == 0:
+            _time.sleep(0)
         top_wilds[i] = bool(path) and path[0] in ("+", "#")
-        reg: list[int] = []
-        inl: list[int] = []
-        for client, sub in node.subscriptions.get_all().items():
-            sid = len(subs)
-            subs.append(SubEntry(KIND_CLIENT, client, "", sub))
-            reg.append(sid)
-        for group in node.shared.get_all().values():
-            for client, sub in group.items():
-                sid = len(subs)
-                subs.append(SubEntry(KIND_SHARED, client, sub.filter, sub))
-                reg.append(sid | _EXEMPT_BIT)  # shared: $-mask exempt
-        for isub in node.inline_subscriptions.get_all().values():
-            sid = len(subs)
-            subs.append(SubEntry(KIND_INLINE, "", "", isub))
-            inl.append(sid | _EXEMPT_BIT)  # inline: $-mask exempt
-        total = len(reg) + len(inl)
-        if total > window or len(reg) >= (1 << _NREG_BITS) or len(inl) >= (
-            1 << _NINL_BITS
-        ):
-            spills[i] = True  # device hits host-route these entries
-            n_spill += 1
-            continue
-        starts[i] = len(ids_flat)
-        nregs[i] = len(reg)
-        ninls[i] = len(inl)
-        ids_flat.extend(reg)
-        ids_flat.extend(inl)
-    if len(subs) >= 1 << 24:
+        # .internal (no locked copy): tears retry, see _walk_terminals
+        cli = tuple(node.subscriptions.internal.items())
+        shr = (
+            tuple(
+                (c, s)
+                for group in node.shared.internal.values()
+                for c, s in group.items()
+            )
+            if node.shared.internal
+            else ()
+        )
+        inl = tuple(node.inline_subscriptions.internal.values())
+        snaps[i] = (cli, shr, inl)
+        n_cli[i] = len(cli)
+        n_shr[i] = len(shr)
+        n_inl[i] = len(inl)
+    total_ids = n_cli + n_shr + n_inl
+    spills = (
+        (total_ids > window)
+        | ((n_cli + n_shr) >= (1 << _NREG_BITS))
+        | (n_inl >= (1 << _NINL_BITS))
+    )
+    n_spill = int(spills[sel].sum())
+    run_len = np.where(spills, 0, total_ids)
+    run_len[~keep] = 0
+    starts64 = np.concatenate([[0], np.cumsum(run_len)])[:-1]
+    total = int(run_len.sum())
+    if total >= 1 << 24:
         # the kernel's f32 one-hot compaction is exact only below 2^24; a
         # silent rounding there would corrupt sub ids — fail loudly instead
         raise RuntimeError(
-            f"flat index supports < {1 << 24} subscription entries, got {len(subs)}"
+            f"flat index supports < {1 << 24} subscription entries, got {total}"
+        )
+    starts = starts64.astype(np.uint32)
+    # spilled entries carry zero counts: the kernel's overflow flag routes
+    # their topics to the host before any id slot is interpreted
+    nregs = np.where(spills, 0, np.minimum(n_cli + n_shr, (1 << _NREG_BITS) - 1)).astype(np.uint32)
+    ninls = np.where(spills, 0, np.minimum(n_inl, (1 << _NINL_BITS) - 1)).astype(np.uint32)
+    # exempt bit 30 on shared + inline slots ($-mask exemption): a slot is
+    # exempt iff its offset within the run is >= the entry's client count
+    all_ids = np.arange(total, dtype=np.uint32)
+    if total:
+        entry_of = np.repeat(np.arange(n_all)[run_len > 0], run_len[run_len > 0])
+        local = all_ids - starts64[entry_of].astype(np.uint32)
+        all_ids = all_ids | (
+            (local >= n_cli[entry_of]).astype(np.uint32) << np.uint32(30)
         )
     # power-of-two bucket the id pool so rebuilds under churn reuse the
     # jitted executable (padding sits beyond every entry's window)
     all_ids = _pad_to(
-        np.asarray(ids_flat + [0] * window, dtype=np.uint32),
-        _bucket(len(ids_flat) + window, minimum=max(16, window)),
+        np.concatenate([all_ids, np.zeros(window, dtype=np.uint32)]),
+        _bucket(total + window, minimum=max(16, window)),
         0,
+    )
+    subs = _LazySubTable(
+        starts64[sel][~spills[sel]],
+        total_ids[sel][~spills[sel]],
+        n_cli[sel][~spills[sel]],
+        n_shr[sel][~spills[sel]],
+        [snaps[i] for i in sel if not spills[i]],
+        total,
     )
 
     # bucket placement: slot = h1 & (S-1), 4 entries/bucket; a bucket the

@@ -111,12 +111,16 @@ class TpuMatcher:
         out_slots: int = 64,
         transfer_slots: Optional[int] = None,
         window: int = 16,
+        cooperative: bool = False,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
         self.frontier = frontier
         self.out_slots = out_slots
         self.window = window
+        # cooperative rebuilds yield the GIL periodically — set by owners
+        # that rebuild on a background thread while another thread serves
+        self.cooperative = cooperative
         # how many sid slots come back per topic in the single packed D2H;
         # topics with more matches (but no device overflow) re-walk on host.
         # Smaller values trade rare host walks for less D2H traffic — the
@@ -139,7 +143,10 @@ class TpuMatcher:
         t0 = time.perf_counter()
         version = self.topics.version
         flat = build_flat_index(
-            self.topics, max_levels=self.max_levels, window=self.window
+            self.topics,
+            max_levels=self.max_levels,
+            window=self.window,
+            cooperative=self.cooperative,
         )
         device_arrays = tuple(
             jnp.asarray(a)
