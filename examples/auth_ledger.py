@@ -1,0 +1,58 @@
+"""Rule-based auth + ACL via the ledger hook (reference
+examples/auth/basic/main.go)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth.auth import AuthHook, AuthOptions
+from mqtt_tpu.hooks.auth.ledger import (
+    ACCESS_READ_ONLY,
+    ACCESS_READ_WRITE,
+    ACLRule,
+    AuthRule,
+    Ledger,
+    RString,
+)
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+
+def build_ledger() -> Ledger:
+    return Ledger(
+        auth=[
+            AuthRule(username=RString("peach"), password=RString("password1"), allow=True),
+            AuthRule(remote=RString("127.0.0.1"), allow=True),
+        ],
+        acl=[
+            # melon may read everything but write only to melon/#
+            ACLRule(
+                username=RString("melon"),
+                filters={
+                    RString("melon/#"): ACCESS_READ_WRITE,
+                    RString("#"): ACCESS_READ_ONLY,
+                },
+            ),
+            ACLRule(filters={RString("#"): ACCESS_READ_WRITE}),
+        ],
+    )
+
+
+async def main() -> None:
+    server = Server(Options())
+    hook = AuthHook()
+    server.add_hook(hook, AuthOptions(ledger=build_ledger()))
+    server.add_listener(TCP(Config(type="tcp", id="t1", address=":1883")))
+    await server.serve()
+    print("ledger-auth broker on :1883")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,35 @@
+"""A broker tuned for the in-repo stresser (reference
+examples/benchmark/main.go: MaximumClientWritesPending=16K). Run:
+
+    python examples/benchmark_broker.py &
+    python -m mqtt_tpu.stress --broker 127.0.0.1:1883 -c 10 -m 10000
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+
+async def main() -> None:
+    options = Options()
+    options.capabilities.maximum_client_writes_pending = 16 * 1024
+    server = Server(options)
+    server.add_hook(AllowHook())
+    server.add_listener(TCP(Config(type="tcp", id="bench", address=":1883")))
+    await server.serve()
+    print("benchmark broker up on :1883")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

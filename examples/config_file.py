@@ -1,0 +1,27 @@
+"""File-driven broker: YAML/JSON config -> Options -> Server
+(reference examples/config/main.go, cmd/docker/main.go)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Server
+from mqtt_tpu.config import from_file
+
+
+async def main() -> None:
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "config.yaml")
+    options = from_file(path)
+    server = Server(options)
+    await server.serve()
+    print("config-driven broker up (tcp :1883, ws :1882, health :1880)")
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,29 @@
+"""Packet-flow visualisation with the debug hook
+(reference examples/debug/main.go)."""
+
+import asyncio
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.hooks.debug import DebugHook, DebugOptions
+
+
+async def main() -> None:
+    logging.basicConfig(level=logging.DEBUG, format="%(message)s")
+    server = Server(Options(inline_client=True))
+    server.add_hook(AllowHook())
+    server.add_hook(DebugHook(), DebugOptions(show_packet_data=True))
+    await server.serve()
+    server.subscribe("debug/#", 1, lambda cl, sub, pk: None)
+    server.publish("debug/demo", b"watch the log", False, 0)
+    await asyncio.sleep(0.1)
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,39 @@
+"""The TPU-native path: flat-hash device matcher + publish staging loop.
+
+No analog in the reference — this is the rebuild's north-star component
+(SURVEY.md §7): PUBLISH topics match against a device-resident flat-hash
+index in micro-batches, bit-identical to the host trie.
+"""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+
+async def main() -> None:
+    options = Options(
+        device_matcher=True,  # DeltaMatcher snapshot + host delta overlay
+        matcher_stage_window_ms=2.0,  # publish micro-batch window
+        matcher_opts={"max_levels": 8, "window": 16},
+    )
+    server = Server(options)
+    server.add_hook(AllowHook())
+    server.add_listener(TCP(Config(type="tcp", id="t1", address=":1883")))
+    await server.serve()
+    print("device-matcher broker up on :1883")
+    print("matcher stats:", server.matcher.stats.as_dict())
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

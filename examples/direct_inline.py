@@ -1,0 +1,35 @@
+"""The inline client: publish and subscribe in-process, no sockets
+(reference examples/direct/main.go)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+
+
+async def main() -> None:
+    server = Server(Options(inline_client=True))
+    server.add_hook(AllowHook())
+    await server.serve()
+
+    got = []
+
+    def on_message(cl, sub, pk):
+        got.append((pk.topic_name, bytes(pk.payload)))
+        print(f"inline handler: {pk.topic_name} -> {bytes(pk.payload)!r}")
+
+    server.subscribe("direct/#", 1, on_message)
+    server.publish("direct/hello", b"from the embedding app", False, 0)
+    server.publish("direct/retained", b"sticky", True, 0)
+    await asyncio.sleep(0.1)
+    assert got, "inline delivery failed"
+    server.unsubscribe("direct/#", 1)
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

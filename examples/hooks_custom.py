@@ -1,0 +1,60 @@
+"""Writing a custom hook: observe connects, modify publishes, veto topics
+(reference examples/hooks/main.go)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks import (
+    ON_CONNECT,
+    ON_DISCONNECT,
+    ON_PUBLISH,
+    ON_SUBSCRIBED,
+    Hook,
+)
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.packets import ERR_REJECT_PACKET
+
+
+class ExampleHook(Hook):
+    def id(self):
+        return "events-example"
+
+    def provides(self, b):
+        return b in (ON_CONNECT, ON_DISCONNECT, ON_PUBLISH, ON_SUBSCRIBED)
+
+    def on_connect(self, cl, pk):
+        print(f"client connected: {cl.id}")
+
+    def on_disconnect(self, cl, err, expire):
+        print(f"client disconnected: {cl.id} expire={expire}")
+
+    def on_subscribed(self, cl, pk, reason_codes):
+        print(f"subscribed: {cl.id} {[s.filter for s in pk.filters]}")
+
+    def on_publish(self, cl, pk):
+        if pk.topic_name == "forbidden/topic":
+            raise ERR_REJECT_PACKET()  # silently dropped
+        if pk.topic_name == "rewrite/me":
+            pk.payload = b"[modified] " + bytes(pk.payload)
+        return pk
+
+
+async def main() -> None:
+    server = Server(Options(inline_client=True))
+    server.add_hook(AllowHook())
+    server.add_hook(ExampleHook())
+    await server.serve()
+
+    server.subscribe("#", 1, lambda cl, sub, pk: print(f"seen: {pk.topic_name} {bytes(pk.payload)!r}"))
+    server.publish("rewrite/me", b"hello", False, 0)
+    server.publish("forbidden/topic", b"nope", False, 0)
+    await asyncio.sleep(0.1)
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,41 @@
+"""Write-through persistence + restart restore, using the sqlite store
+(reference examples/persistence/*; badger/bolt/pebble analogs are the
+logkv and sqlite stores, redis via hooks.storage.redis)."""
+
+import asyncio
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.hooks.storage.sqlite import SqliteOptions, SqliteStore
+
+
+async def main() -> None:
+    path = os.path.join(tempfile.mkdtemp(), "broker.db")
+
+    # first life: accept state
+    server = Server(Options(inline_client=True))
+    server.add_hook(AllowHook())
+    server.add_hook(SqliteStore(), SqliteOptions(path=path))
+    await server.serve()
+    server.publish("persist/retained", b"still here", True, 0)
+    await asyncio.sleep(0.1)
+    await server.close()
+
+    # second life: restore on boot
+    server2 = Server(Options(inline_client=True))
+    server2.add_hook(AllowHook())
+    server2.add_hook(SqliteStore(), SqliteOptions(path=path))
+    await server2.serve()
+    msgs = server2.topics.messages("persist/#")
+    print(f"restored retained: {[(p.topic_name, bytes(p.payload)) for p in msgs]}")
+    assert msgs, "restore failed"
+    await server2.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

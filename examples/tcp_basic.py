@@ -1,0 +1,31 @@
+"""Minimal embeddable broker: one TCP listener, allow-all auth
+(reference examples/tcp/main.go)."""
+
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+
+async def main() -> None:
+    server = Server(Options())
+    server.add_hook(AllowHook())
+    server.add_listener(TCP(Config(type="tcp", id="t1", address=":1883")))
+    await server.serve()
+    print("broker up on :1883 — ctrl-c to stop")
+    try:
+        await asyncio.Event().wait()
+    except asyncio.CancelledError:
+        pass
+    finally:
+        await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())
