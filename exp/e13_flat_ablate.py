@@ -1,0 +1,156 @@
+"""E13: ablate the flat kernel's stages on the real device.
+
+e11 measured the full flat kernel at ~197K topics/s while e9's raw row
+gathers run at ~60M rows/s — a ~40x gap. Variants isolate which stage
+eats it: bucket gather (2-D vs flattened indices), window slice-gather,
+hash-mix loop, one-hot compaction.
+"""
+import sys, time, os
+sys.path.insert(0, "/root/repo")
+import numpy as np, random
+import jax, jax.numpy as jnp
+from functools import partial
+
+from mqtt_tpu.ops.flat import (
+    BUCKET_ENTRIES, ENTRY_INTS, KIND_HASH, PLUS1, PLUS2, _M1, _M2,
+    build_flat_index, _NREG_BITS, _NINL_SHIFT, _NINL_BITS,
+    _TOPWILD_SHIFT, _LASTPLUS_SHIFT, _SPILL_SHIFT, _SAT_SHIFT,
+)
+from mqtt_tpu.ops.hashing import tokenize_topics
+from mqtt_tpu.packets import Subscription
+from mqtt_tpu.topics import TopicsIndex
+
+N = int(os.environ.get("NSUBS", "200000"))
+B = int(os.environ.get("B", "16384"))
+rng = random.Random(7)
+v0 = [f"region{i}" for i in range(100)]
+v1 = [f"device{i}" for i in range(100)]
+v2 = [f"metric{i}" for i in range(100)]
+index = TopicsIndex()
+for i in range(N):
+    parts = [rng.choice(v0), rng.choice(v1), rng.choice(v2)]
+    if rng.random() < 0.10:
+        parts[rng.randrange(3)] = "+"
+    index.subscribe(f"cl{i}", Subscription(filter="/".join(parts), qos=i % 3))
+flat = build_flat_index(index, max_levels=4)
+print(f"built: entries={flat.n_entries} S={flat.table.shape[0]} P={flat.num_patterns}", flush=True)
+
+table = jnp.asarray(flat.table)
+all_ids = jnp.asarray(flat.all_ids)
+pat_kind = jnp.asarray(flat.pat_kind)
+pat_depth = jnp.asarray(flat.pat_depth)
+pat_mask = jnp.asarray(flat.pat_mask)
+topics = [f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}" for _ in range(B)]
+tok1, tok2, lengths, is_dollar, _ = tokenize_topics(topics, 4, flat.salt)
+tok1 = jnp.asarray(tok1); tok2 = jnp.asarray(tok2)
+lengths = jnp.asarray(lengths); is_dollar = jnp.asarray(is_dollar)
+jax.block_until_ready((table, all_ids, tok1, tok2))
+W = flat.window
+L = 4
+P = int(pat_depth.shape[0])
+S = int(flat.table.shape[0])
+
+
+def hashes(tok1, tok2, lengths):
+    m1 = jnp.uint32(_M1); m2 = jnp.uint32(_M2)
+    kd = pat_depth.astype(jnp.uint32)
+    h1 = jnp.broadcast_to((kd * m2 ^ pat_kind)[None, :], (B, P))
+    h2 = jnp.broadcast_to((kd * m1 ^ pat_kind)[None, :], (B, P))
+    def rotl13(x):
+        return (x << jnp.uint32(13)) | (x >> jnp.uint32(19))
+    for d in range(L):
+        use = (d < pat_depth)[None, :]
+        plus = ((pat_mask >> np.uint32(d)) & 1)[None, :] == 1
+        t1 = jnp.where(plus, jnp.uint32(PLUS1), tok1[:, d][:, None])
+        t2 = jnp.where(plus, jnp.uint32(PLUS2), tok2[:, d][:, None])
+        h1 = jnp.where(use, rotl13(h1 ^ t1) * m1, h1)
+        h2 = jnp.where(use, rotl13(h2 ^ t2) * m1, h2)
+    n = lengths[:, None]
+    hash_pat = (pat_kind == jnp.uint32(KIND_HASH))[None, :]
+    active = jnp.where(hash_pat, pat_depth[None, :] <= n, pat_depth[None, :] == n)
+    return h1, h2, active
+
+
+@jax.jit
+def v_hash_only(tok1, tok2, lengths, is_dollar):
+    h1, h2, active = hashes(tok1, tok2, lengths)
+    return h1.sum() + h2.sum()
+
+
+@jax.jit
+def v_bucket_2d(tok1, tok2, lengths, is_dollar):
+    h1, h2, active = hashes(tok1, tok2, lengths)
+    slot = jnp.where(active, (h1 & jnp.uint32(S - 1)).astype(jnp.int32), 0)
+    rows = table[slot]  # [B, P, 16]
+    return rows.sum()
+
+
+@jax.jit
+def v_bucket_1d(tok1, tok2, lengths, is_dollar):
+    h1, h2, active = hashes(tok1, tok2, lengths)
+    slot = jnp.where(active, (h1 & jnp.uint32(S - 1)).astype(jnp.int32), 0)
+    rows = table[slot.reshape(-1)].reshape(B, P, ENTRY_INTS * BUCKET_ENTRIES)
+    return rows.sum()
+
+
+@jax.jit
+def v_through_window(tok1, tok2, lengths, is_dollar):
+    h1, h2, active = hashes(tok1, tok2, lengths)
+    slot = jnp.where(active, (h1 & jnp.uint32(S - 1)).astype(jnp.int32), 0)
+    rows = table[slot].reshape(B, P, BUCKET_ENTRIES, ENTRY_INTS)
+    hit = (rows[..., 0] == h1[..., None]) & (rows[..., 1] == h2[..., None])
+    hit = hit & active[..., None]
+    start = jnp.where(hit, rows[..., 3], 0).max(axis=-1)
+    idx = start.astype(jnp.int32)
+    wins = jax.lax.gather(
+        all_ids, idx.reshape(B, P, 1),
+        jax.lax.GatherDimensionNumbers(offset_dims=(2,), collapsed_slice_dims=(), start_index_map=(0,)),
+        slice_sizes=(W,), mode="clip",
+    )
+    return wins.sum()
+
+
+@jax.jit
+def v_full_no_compact(tok1, tok2, lengths, is_dollar):
+    from mqtt_tpu.ops.flat import flat_match_core
+    out, totals, ovf = flat_match_core(
+        table, all_ids, pat_kind, pat_depth, pat_mask,
+        tok1, tok2, lengths, is_dollar,
+        window=W, max_levels=L, out_slots=64,
+    )
+    return totals.sum()  # compaction still traced; see v_full
+
+
+def v_full(tok1, tok2, lengths, is_dollar):
+    from mqtt_tpu.ops.flat import flat_match
+    out, totals, ovf = flat_match(
+        table, all_ids, pat_kind, pat_depth, pat_mask,
+        tok1, tok2, lengths, is_dollar,
+        window=W, max_levels=L, out_slots=64,
+    )
+    return out
+
+
+def bench(name, f, iters=8):
+    red = jax.jit(lambda o: o.sum() if hasattr(o, 'ndim') and o.ndim else o)
+    r = f(tok1, tok2, lengths, is_dollar)
+    np.asarray(red(r))  # compile + complete
+    t0 = time.perf_counter()
+    outs = [f(tok1, tok2, lengths, is_dollar) for _ in range(iters)]
+    np.asarray(red(outs[-1]))
+    dt = (time.perf_counter() - t0) / iters
+    print(f"{name:22s} {dt*1e3:8.2f} ms/batch -> {B/dt:>12,.0f} topics/s", flush=True)
+
+
+bench("hash only", v_hash_only)
+bench("+bucket gather 2d", v_bucket_2d)
+bench("+bucket gather 1d", v_bucket_1d)
+bench("+window gather", v_through_window)
+bench("full kernel", v_full)
+
+# profile the full kernel
+os.makedirs("/root/repo/exp/trace3", exist_ok=True)
+with jax.profiler.trace("/root/repo/exp/trace3"):
+    out = v_full(tok1, tok2, lengths, is_dollar)
+    np.asarray(out[:1, :1].sum())
+print("trace3 written", flush=True)
