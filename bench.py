@@ -453,12 +453,16 @@ def run_broker_bench(fast: bool) -> dict:
     from mqtt_tpu.stress import run_stress
 
     port = 18831
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
     proc = subprocess.Popen(
         [sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
          f"127.0.0.1:{port}"],
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
-        cwd=os.path.dirname(os.path.abspath(__file__)),
+        cwd=repo,
+        env=env,
     )
     out = {"cpus": os.cpu_count()}
     try:
@@ -470,15 +474,14 @@ def run_broker_bench(fast: bool) -> dict:
             r = asyncio.run(run_stress("127.0.0.1", port, n, m))
             out[f"{n}_clients"] = r
             log(f"broker {n}x{m}: {r}")
-        # the reference table's 100-client medians (mochi v2.2.10, M2):
-        # publish 4,425 / receive 7,274 msg/s (README.md:500-503)
+        # the reference table's 100-client receive median (mochi v2.2.10,
+        # M2, 8 cores): 7,274 msg/s (README.md:500-503). Receive is the
+        # honest end-to-end rate; QoS0 publish rates on both sides mostly
+        # measure socket-buffer writes, so no publish ratio is reported.
         hundred = out.get("100_clients")
         if hundred:
             out["vs_mochi_100c_receive"] = round(
                 hundred["receive_median_per_sec"] / 7274, 4
-            )
-            out["vs_mochi_100c_publish"] = round(
-                hundred["publish_median_per_sec"] / 4425, 4
             )
     finally:
         try:
