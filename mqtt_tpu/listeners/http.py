@@ -19,7 +19,7 @@ import time
 from html import escape
 
 from ..system import Info
-from ..utils.proc import rss_bytes
+from ..utils.proc import cpu_seconds, rss_bytes
 from . import Config, EstablishFn, StreamListener, split_host_port
 
 
@@ -146,6 +146,7 @@ class Dashboard(_HttpListener):
             {
                 "time": int(now),
                 "rss_bytes": rss_bytes(),
+                "cpu_seconds": round(cpu_seconds(), 3),
                 "threads": threading.active_count(),
                 "clients_connected": self.sys_info.clients_connected,
                 "messages_received": self.sys_info.messages_received,

@@ -17,3 +17,14 @@ def rss_bytes() -> int:
         return 0
     usage = resource.getrusage(resource.RUSAGE_SELF)
     return usage.ru_maxrss * (1 if sys.platform == "darwin" else 1024)
+
+
+def cpu_seconds() -> float:
+    """Total user+system CPU seconds consumed by this process (the
+    dashboard process recorder derives CPU%% from consecutive samples)."""
+    try:
+        import resource
+    except ImportError:  # pragma: no cover - Windows
+        return 0.0
+    usage = resource.getrusage(resource.RUSAGE_SELF)
+    return usage.ru_utime + usage.ru_stime
