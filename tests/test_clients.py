@@ -1,0 +1,123 @@
+"""Client-level unit tests: packet-id allocation, connect parsing clamps,
+inflight resend/clear — the behavioral core of the reference's
+clients_test.go (47 funcs; structure-trivial map tests live in the
+LockedMap coverage)."""
+
+import pytest
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.packets import (
+    PUBLISH,
+    ConnectParams,
+    FixedHeader,
+    Packet,
+    codes,
+)
+
+
+def make_client(**opt_kw):
+    srv = Server(Options(**opt_kw))
+    cl = srv.new_client(None, None, "t1", "cl1", False)
+    return srv, cl
+
+
+def inflight_pk(pid, qos=1):
+    return Packet(fixed_header=FixedHeader(type=PUBLISH, qos=qos), packet_id=pid)
+
+
+class TestNextPacketID:
+    def test_sequential(self):
+        srv, cl = make_client()
+        assert cl.next_packet_id() == 1
+        assert cl.next_packet_id() == 2
+
+    def test_skips_ids_in_use(self):
+        srv, cl = make_client()
+        cl.state.inflight.set(inflight_pk(1))
+        cl.state.inflight.set(inflight_pk(2))
+        assert cl.next_packet_id() == 3
+
+    def test_wraps_after_maximum(self):
+        srv, cl = make_client()
+        srv.options.capabilities.maximum_packet_id = 5
+        cl.state.packet_id = 5
+        assert cl.next_packet_id() == 1  # wrapped past the cap
+
+    def test_exhaustion_raises_quota_exceeded(self):
+        srv, cl = make_client()
+        srv.options.capabilities.maximum_packet_id = 4
+        for i in range(1, 5):
+            cl.state.inflight.set(inflight_pk(i))
+        with pytest.raises(codes.Code) as e:
+            cl.next_packet_id()
+        assert e.value.code == codes.ERR_QUOTA_EXCEEDED.code
+
+
+class TestParseConnect:
+    def _connect_pk(self, version=5, client_id="zen", keepalive=30, **props):
+        pk = Packet(
+            fixed_header=FixedHeader(type=codes and 1),
+            protocol_version=version,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=keepalive,
+                client_identifier=client_id,
+            ),
+        )
+        for k, v in props.items():
+            setattr(pk.properties, k, v)
+        return pk
+
+    def test_receive_maximum_clamped_to_server_inflight(self):
+        srv, cl = make_client()
+        srv.options.capabilities.maximum_inflight = 8
+        pk = self._connect_pk(receive_maximum=1000)
+        cl.parse_connect("t1", pk)
+        # [3.3.4 non-normative] client's receive max caps at server inflight
+        assert cl.properties.props.receive_maximum == 8
+        assert cl.state.inflight.maximum_send_quota == 8
+
+    def test_empty_client_id_gets_generated_id(self):
+        srv, cl = make_client()
+        cl.id = ""
+        pk = self._connect_pk(client_id="")
+        cl.parse_connect("t1", pk)
+        assert cl.id != ""  # xid-style assignment (clients.go:235-238)
+
+    def test_keepalive_absorbed(self):
+        srv, cl = make_client()
+        pk = self._connect_pk(keepalive=77)
+        cl.parse_connect("t1", pk)
+        assert cl.state.keepalive == 77
+
+
+class TestInflightLifecycle:
+    def test_clear_inflights_returns_cleared_ids(self):
+        srv, cl = make_client()
+        for i in (3, 1, 2):
+            cl.state.inflight.set(inflight_pk(i))
+        cl.clear_inflights()
+        assert len(cl.state.inflight) == 0
+        assert srv.info.inflight == -3  # decremented per drop
+
+    def test_clear_expired_inflights_honors_created(self):
+        srv, cl = make_client()
+        old = inflight_pk(1)
+        old.created = 100
+        new = inflight_pk(2)
+        new.created = 10_000
+        cl.state.inflight.set(old)
+        cl.state.inflight.set(new)
+        # expire everything created before t=5000
+        cleared = cl.clear_expired_inflights(10_000, 5_000)
+        assert cleared == [1]
+        assert cl.state.inflight.get(2) is not None
+
+    def test_stop_is_idempotent(self):
+        srv, cl = make_client()
+        cl.stop(codes.CODE_DISCONNECT())
+        first = cl.stop_cause
+        cl.stop(codes.ERR_SERVER_SHUTTING_DOWN())
+        assert cl.stop_cause is first  # sync.Once semantics
+        assert cl.closed

@@ -424,3 +424,63 @@ class TestDifferentialFuzz:
                 f"cl{i}" for i in range(1, 300, 2) if reference_match(filters[f"cl{i}"], topic)
             }
             assert got == want
+
+
+class TestRetainAndTrim:
+    """Trie retain bookkeeping + pruning (topics.go:453-522)."""
+
+    def _pub(self, topic, payload=b"x", retain=True):
+        return Packet(
+            fixed_header=FixedHeader(type=PUBLISH, retain=retain),
+            topic_name=topic,
+            payload=payload,
+        )
+
+    def test_retain_message_return_codes(self):
+        idx = TopicsIndex()
+        assert idx.retain_message(self._pub("a/b")) == 1  # new
+        assert idx.retain_message(self._pub("a/b", b"y")) == 1  # replace
+        assert idx.retain_message(self._pub("a/b", b"")) == -1  # clear
+        assert idx.retain_message(self._pub("a/b", b"")) == 0  # nothing
+        assert idx.retained.get("a/b") is None
+
+    def test_unsubscribe_trims_empty_particles(self):
+        idx = TopicsIndex()
+        idx.subscribe("c1", Subscription(filter="deep/ly/nested/leaf"))
+        assert "deep" in idx.root.particles
+        assert idx.unsubscribe("deep/ly/nested/leaf", "c1")
+        assert "deep" not in idx.root.particles  # chain pruned to root
+
+    def test_trim_stops_at_retained_path(self):
+        idx = TopicsIndex()
+        idx.retain_message(self._pub("keep/me"))
+        idx.subscribe("c1", Subscription(filter="keep/me/deeper"))
+        idx.unsubscribe("keep/me/deeper", "c1")
+        # 'keep/me' survives (it anchors a retained message) but 'deeper'
+        # is pruned
+        assert "keep" in idx.root.particles
+        assert "deeper" not in idx.root.particles["keep"].particles["me"].particles
+        assert len(list(idx.messages("keep/#"))) == 1
+
+    def test_trim_stops_at_shared_subscription(self):
+        idx = TopicsIndex()
+        idx.subscribe("m1", Subscription(filter="$share/g/t/x"))
+        idx.subscribe("c1", Subscription(filter="t/x/y"))
+        idx.unsubscribe("t/x/y", "c1")
+        assert idx.subscribers("t/x").shared  # shared branch untouched
+
+    def test_clear_retained_under_subscription_keeps_node(self):
+        idx = TopicsIndex()
+        idx.subscribe("c1", Subscription(filter="r/t"))
+        idx.retain_message(self._pub("r/t"))
+        idx.retain_message(self._pub("r/t", b""))  # clear
+        assert "r" in idx.root.particles  # subscription anchors the node
+        assert len(idx.subscribers("r/t").subscriptions) == 1
+
+    def test_messages_skips_sys_for_top_level_wildcards(self):
+        idx = TopicsIndex()
+        idx.retain_message(self._pub("$SYS/broker/uptime", b"1"))
+        idx.retain_message(self._pub("normal/topic", b"2"))
+        assert [p.topic_name for p in idx.messages("#")] == ["normal/topic"]
+        assert [p.topic_name for p in idx.messages("+/broker/uptime")] == []
+        assert [p.topic_name for p in idx.messages("$SYS/#")] == ["$SYS/broker/uptime"]
