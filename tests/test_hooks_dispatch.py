@@ -1,0 +1,141 @@
+"""Hook dispatcher semantics beyond the broker e2e tests: chain order,
+first-non-empty-wins for Stored*, provides filtering, init failure, stop
+propagation, and the error-isolation contract (hooks.go:123-680)."""
+
+import pytest
+
+from mqtt_tpu.hooks import (
+    ON_CONNECT,
+    ON_PACKET_READ,
+    ON_SELECT_SUBSCRIBERS,
+    ON_SYS_INFO_TICK,
+    STORED_CLIENTS,
+    STORED_RETAINED_MESSAGES,
+    Hook,
+    Hooks,
+)
+from mqtt_tpu.hooks.storage import Client as StoredClient
+from mqtt_tpu.packets import PINGREQ, FixedHeader, Packet
+from mqtt_tpu.topics import Subscribers
+
+
+class Recorder(Hook):
+    def __init__(self, name, provides=(), clients=None):
+        super().__init__()
+        self.name = name
+        self._provides = set(provides)
+        self._clients = clients
+        self.calls = []
+        self.stopped = False
+        self.inited = None
+
+    def id(self):
+        return self.name
+
+    def provides(self, b):
+        return b in self._provides
+
+    def init(self, config):
+        self.inited = config
+
+    def stop(self):
+        self.stopped = True
+
+    def on_connect(self, cl, pk):
+        self.calls.append("on_connect")
+
+    def on_packet_read(self, cl, pk):
+        self.calls.append("on_packet_read")
+        pk.payload = bytes(pk.payload) + self.name.encode()
+        return pk
+
+    def stored_clients(self):
+        self.calls.append("stored_clients")
+        return self._clients
+
+    def on_select_subscribers(self, subs, pk):
+        self.calls.append("select")
+        subs.subscriptions[self.name] = None
+        return subs
+
+
+class Boom(Hook):
+    def id(self):
+        return "boom"
+
+    def init(self, config):
+        raise ValueError("no init for you")
+
+
+class TestDispatcher:
+    def test_add_init_failure_raises_and_excludes(self):
+        hooks = Hooks()
+        with pytest.raises(RuntimeError):
+            hooks.add(Boom(), None)
+        assert len(hooks) == 0
+
+    def test_provides_filters_dispatch(self):
+        hooks = Hooks()
+        a = Recorder("a", provides=(ON_CONNECT,))
+        b = Recorder("b", provides=())  # provides nothing
+        hooks.add(a, None)
+        hooks.add(b, None)
+        hooks.on_connect(None, None)
+        assert a.calls == ["on_connect"]
+        assert b.calls == []
+
+    def test_modifier_chain_runs_in_attach_order(self):
+        hooks = Hooks()
+        a = Recorder("a", provides=(ON_PACKET_READ,))
+        b = Recorder("b", provides=(ON_PACKET_READ,))
+        hooks.add(a, None)
+        hooks.add(b, None)
+        pk = Packet(fixed_header=FixedHeader(type=PINGREQ), payload=b"x")
+        out = hooks.on_packet_read(None, pk)
+        assert bytes(out.payload) == b"xab"  # a then b, chained
+
+    def test_stored_first_non_empty_wins(self):
+        hooks = Hooks()
+        empty = Recorder("empty", provides=(STORED_CLIENTS,), clients=[])
+        full = Recorder(
+            "full", provides=(STORED_CLIENTS,), clients=[StoredClient(id="x")]
+        )
+        later = Recorder(
+            "later", provides=(STORED_CLIENTS,), clients=[StoredClient(id="y")]
+        )
+        hooks.add(empty, None)
+        hooks.add(full, None)
+        hooks.add(later, None)
+        got = hooks.stored_clients()
+        assert [c.id for c in got] == ["x"]  # first NON-EMPTY wins
+        assert later.calls == []  # never consulted
+
+    def test_stop_propagates_to_all(self):
+        hooks = Hooks()
+        a, b = Recorder("a"), Recorder("b")
+        hooks.add(a, None)
+        hooks.add(b, None)
+        hooks.stop()
+        assert a.stopped and b.stopped
+
+    def test_select_subscribers_chains(self):
+        hooks = Hooks()
+        a = Recorder("a", provides=(ON_SELECT_SUBSCRIBERS,))
+        b = Recorder("b", provides=(ON_SELECT_SUBSCRIBERS,))
+        hooks.add(a, None)
+        hooks.add(b, None)
+        subs = hooks.on_select_subscribers(Subscribers(), None)
+        assert set(subs.subscriptions) == {"a", "b"}
+
+    def test_init_receives_config(self):
+        hooks = Hooks()
+        a = Recorder("a")
+        hooks.add(a, {"k": 1})
+        assert a.inited == {"k": 1}
+
+    def test_len_and_provides_aggregate(self):
+        hooks = Hooks()
+        hooks.add(Recorder("a", provides=(ON_SYS_INFO_TICK,)), None)
+        assert len(hooks) == 1
+        assert hooks.provides(ON_SYS_INFO_TICK)
+        assert not hooks.provides(STORED_RETAINED_MESSAGES)
