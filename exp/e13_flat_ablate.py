@@ -13,8 +13,7 @@ from functools import partial
 
 from mqtt_tpu.ops.flat import (
     BUCKET_ENTRIES, ENTRY_INTS, KIND_HASH, PLUS1, PLUS2, _M1, _M2,
-    build_flat_index, _NREG_BITS, _NINL_SHIFT, _NINL_BITS,
-    _TOPWILD_SHIFT, _LASTPLUS_SHIFT, _SPILL_SHIFT, _SAT_SHIFT,
+    build_flat_index,
 )
 from mqtt_tpu.ops.hashing import tokenize_topics
 from mqtt_tpu.packets import Subscription
@@ -36,7 +35,6 @@ flat = build_flat_index(index, max_levels=4)
 print(f"built: entries={flat.n_entries} S={flat.table.shape[0]} P={flat.num_patterns}", flush=True)
 
 table = jnp.asarray(flat.table)
-all_ids = jnp.asarray(flat.all_ids)
 pat_kind = jnp.asarray(flat.pat_kind)
 pat_depth = jnp.asarray(flat.pat_depth)
 pat_mask = jnp.asarray(flat.pat_mask)
@@ -44,7 +42,7 @@ topics = [f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}" for _ in range(B
 tok1, tok2, lengths, is_dollar, _ = tokenize_topics(topics, 4, flat.salt)
 tok1 = jnp.asarray(tok1); tok2 = jnp.asarray(tok2)
 lengths = jnp.asarray(lengths); is_dollar = jnp.asarray(is_dollar)
-jax.block_until_ready((table, all_ids, tok1, tok2))
+jax.block_until_ready((table, tok1, tok2))
 W = flat.window
 L = 4
 P = int(pat_depth.shape[0])
@@ -94,37 +92,20 @@ def v_bucket_1d(tok1, tok2, lengths, is_dollar):
 
 
 @jax.jit
-def v_through_window(tok1, tok2, lengths, is_dollar):
-    h1, h2, active = hashes(tok1, tok2, lengths)
-    slot = jnp.where(active, (h1 & jnp.uint32(S - 1)).astype(jnp.int32), 0)
-    rows = table[slot].reshape(B, P, BUCKET_ENTRIES, ENTRY_INTS)
-    hit = (rows[..., 0] == h1[..., None]) & (rows[..., 1] == h2[..., None])
-    hit = hit & active[..., None]
-    start = jnp.where(hit, rows[..., 3], 0).max(axis=-1)
-    idx = start.astype(jnp.int32)
-    wins = jax.lax.gather(
-        all_ids, idx.reshape(B, P, 1),
-        jax.lax.GatherDimensionNumbers(offset_dims=(2,), collapsed_slice_dims=(), start_index_map=(0,)),
-        slice_sizes=(W,), mode="clip",
-    )
-    return wins.sum()
-
-
-@jax.jit
 def v_full_no_compact(tok1, tok2, lengths, is_dollar):
     from mqtt_tpu.ops.flat import flat_match_core
     out, totals, ovf = flat_match_core(
-        table, all_ids, pat_kind, pat_depth, pat_mask,
+        table, pat_kind, pat_depth, pat_mask,
         tok1, tok2, lengths, is_dollar,
         window=W, max_levels=L, out_slots=64,
     )
-    return totals.sum()  # compaction still traced; see v_full
+    return totals.sum()  # compaction may be DCE'd; see v_full
 
 
 def v_full(tok1, tok2, lengths, is_dollar):
     from mqtt_tpu.ops.flat import flat_match
     out, totals, ovf = flat_match(
-        table, all_ids, pat_kind, pat_depth, pat_mask,
+        table, pat_kind, pat_depth, pat_mask,
         tok1, tok2, lengths, is_dollar,
         window=W, max_levels=L, out_slots=64,
     )
@@ -145,7 +126,6 @@ def bench(name, f, iters=8):
 bench("hash only", v_hash_only)
 bench("+bucket gather 2d", v_bucket_2d)
 bench("+bucket gather 1d", v_bucket_1d)
-bench("+window gather", v_through_window)
 bench("full kernel", v_full)
 
 # profile the full kernel

@@ -34,9 +34,10 @@ Encoding (reference semantics: topics.go:583-628):
   transfer path) topics matching more ids than the transfer prefix.
 
 Table layout: `table[S, 16]` u32 = 4 entries/bucket x [key1, key2, meta,
-start]; `all_ids[A]` u32 holds each entry's ids contiguously (reg then
-inl), bit 30 = $-exempt. One probe = one 64-byte bucket row gather + one
-id-window slice gather.
+base]. Sub ids are SYNTHETIC — entry ordinal x window + slot — so the
+kernel computes them from the bucket row alone: matching costs exactly ONE
+64-byte row gather per probe shape, and the host maps ids back to
+subscriptions lazily (sid // window -> entry snapshot).
 """
 
 from __future__ import annotations
@@ -63,15 +64,19 @@ PLUS2 = 0xC2B2AE3D  # sentinel level-hash for '+' (lane 2)
 KIND_EXACT = 0x165667B1
 KIND_HASH = 0x27D4EB2F
 
-# meta word bit layout (one per entry)
-_NREG_BITS = 10
-_NINL_SHIFT = 10
-_NINL_BITS = 6
-_TOPWILD_SHIFT = 16
-_LASTPLUS_SHIFT = 17
-_SPILL_SHIFT = 18
-_SAT_SHIFT = 19  # entry-0 meta only: whole bucket saturated at build
-_EXEMPT_BIT = 0x40000000  # in all_ids: shared/inline, exempt from $-mask
+# meta word bit layout (one per entry). Counts are window-bounded, so six
+# bits each: ncli (the $-exempt boundary: slots >= ncli are shared/inline),
+# nreg (clients+shared — the id count when a '#' entry matches its exact
+# depth, which excludes inline), ninl (inline tail).
+_CNT_BITS = 6
+_NCLI_SHIFT = 0
+_NREG_SHIFT = 6
+_NINL_SHIFT = 12
+_TOPWILD_SHIFT = 18
+_LASTPLUS_SHIFT = 19
+_SPILL_SHIFT = 20
+_SAT_SHIFT = 21  # entry-0 meta only: whole bucket saturated at build
+MAX_WINDOW = (1 << _CNT_BITS) - 1
 
 ENTRY_INTS = 4
 BUCKET_ENTRIES = 4
@@ -107,8 +112,7 @@ class SubEntry:
 class FlatIndex:
     """The device-side flat-hash encoding of the subscription set."""
 
-    table: np.ndarray  # u32[S, 16] — 4 x [k1, k2, meta, start] per bucket
-    all_ids: np.ndarray  # u32[A+window] — per-entry id runs, bit30 = exempt
+    table: np.ndarray  # u32[S, 16] — 4 x [k1, k2, meta, base] per bucket
     pat_kind: np.ndarray  # u32[P] — KIND_EXACT / KIND_HASH
     pat_depth: np.ndarray  # i32[P]
     pat_mask: np.ndarray  # u32[P] — '+' level bitmask
@@ -117,6 +121,8 @@ class FlatIndex:
     window: int = 16
     max_levels: int = 8
     n_entries: int = 0
+    n_subs: int = 0  # actual subscriptions indexed (sid space is larger)
+    wide_sids: bool = False  # sid space >= 2^24: two-plane compaction
     n_sat: int = 0  # build-saturated buckets (probes host-route)
     n_spill: int = 0  # entries with more ids than the window (host-route)
 
@@ -127,7 +133,7 @@ class FlatIndex:
 
     @property
     def num_subs(self) -> int:
-        return len(self.subs)
+        return self.n_subs
 
     @property
     def num_patterns(self) -> int:
@@ -143,17 +149,13 @@ def _mix_np(h: np.ndarray, t: np.ndarray) -> np.ndarray:
 class _LazySubTable:
     """sid -> SubEntry, materialized on demand from per-entry snapshot
     tuples (clients, shared, inline) captured at build time. Sub ids are
-    their all_ids slots, so the lookup is a binary search over the entry
-    run starts plus an offset into the snapshot. Memoized: hot topics
-    resolve to dict hits."""
+    synthetic — entry ordinal x window + slot — so the mapping is two
+    integer ops. Memoized: hot topics resolve to dict hits."""
 
-    __slots__ = ("_starts", "_totals", "_ncli", "_nshr", "_snaps", "_n", "_memo")
+    __slots__ = ("_window", "_snaps", "_n", "_memo")
 
-    def __init__(self, starts, totals, ncli, nshr, snaps, n) -> None:
-        self._starts = np.asarray(starts, dtype=np.int64)
-        self._totals = np.asarray(totals, dtype=np.int64)
-        self._ncli = np.asarray(ncli, dtype=np.int64)
-        self._nshr = np.asarray(nshr, dtype=np.int64)
+    def __init__(self, window, snaps, n) -> None:
+        self._window = window
         self._snaps = snaps
         self._n = n
         self._memo: dict = {}
@@ -165,19 +167,16 @@ class _LazySubTable:
         entry = self._memo.get(sid)
         if entry is not None:
             return entry
-        e = int(np.searchsorted(self._starts, sid, side="right")) - 1
-        local = sid - int(self._starts[e])
-        cli, shr, inl = self._snaps[e]
-        ncli = int(self._ncli[e])
-        nshr = int(self._nshr[e])
-        if local < ncli:
+        cli, shr, inl = self._snaps[sid // self._window]
+        local = sid % self._window
+        if local < len(cli):
             client, sub = cli[local]
             entry = SubEntry(KIND_CLIENT, client, "", sub)
-        elif local < ncli + nshr:
-            client, sub = shr[local - ncli]
+        elif local < len(cli) + len(shr):
+            client, sub = shr[local - len(cli)]
             entry = SubEntry(KIND_SHARED, client, sub.filter, sub)
         else:
-            entry = SubEntry(KIND_INLINE, "", "", inl[local - ncli - nshr])
+            entry = SubEntry(KIND_INLINE, "", "", inl[local - len(cli) - len(shr)])
         self._memo[sid] = entry
         return entry
 
@@ -305,10 +304,9 @@ def build_flat_index(
             _retries - 1
         )
 
-    # per-entry subscription snapshots. A sub id IS its slot in the
-    # all_ids run (entries laid out consecutively: clients, then shared,
-    # then inline), so all_ids is a pure arange + exempt-bit mask — no
-    # per-subscription Python work at build time. SubEntry metadata
+    # per-entry subscription snapshots. A sub id is SYNTHETIC — entry
+    # ordinal x window + slot (clients first, then shared, then inline) —
+    # so nothing per-subscription is built or stored. SubEntry metadata
     # materializes lazily at expand time from the snapshot tuples
     # (:class:`_LazySubTable`), preserving build-time snapshot semantics.
     snaps: list = [None] * n_all
@@ -340,55 +338,44 @@ def build_flat_index(
         n_shr[i] = len(shr)
         n_inl[i] = len(inl)
     total_ids = n_cli + n_shr + n_inl
+    if window > MAX_WINDOW:
+        raise ValueError(
+            f"window must be <= {MAX_WINDOW} (meta packs counts in "
+            f"{_CNT_BITS}-bit fields); got {window}"
+        )
     spills = (
         (total_ids > window)
-        | ((n_cli + n_shr) >= (1 << _NREG_BITS))
-        | (n_inl >= (1 << _NINL_BITS))
+        | ((n_cli + n_shr) > MAX_WINDOW)
+        | (n_inl > MAX_WINDOW)
     )
     n_spill = int(spills[sel].sum())
-    run_len = np.where(spills, 0, total_ids)
-    run_len[~keep] = 0
-    starts64 = np.concatenate([[0], np.cumsum(run_len)])[:-1]
-    total = int(run_len.sum())
-    if total >= 1 << 24:
-        # the kernel's f32 one-hot compaction is exact only below 2^24; a
-        # silent rounding there would corrupt sub ids — fail loudly instead
+    # synthetic sid space: entry ordinal (over kept, non-spill entries) x
+    # window + slot; nothing is stored — the kernel computes ids from the
+    # bucket row and the host divides them back out
+    ordinal = np.full(n_all, -1, dtype=np.int64)
+    alive = np.zeros(n_all, dtype=bool)
+    alive[sel] = True
+    alive &= ~spills
+    ordinal[alive] = np.arange(int(alive.sum()))
+    n_sids = int(alive.sum()) * window
+    if n_sids >= 1 << 30:
+        # int32 sid space (the kernel compacts 16-bit planes exactly in
+        # f32, so the practical cliff is the sign bit, not f32 mantissa)
         raise RuntimeError(
-            f"flat index supports < {1 << 24} subscription entries, got {total}"
+            f"flat index sid space must stay < {1 << 30}, got {n_sids}"
         )
-    starts = starts64.astype(np.uint32)
-    # spilled entries carry zero counts: the kernel's overflow flag routes
-    # their topics to the host before any id slot is interpreted
-    nregs = np.where(spills, 0, np.minimum(n_cli + n_shr, (1 << _NREG_BITS) - 1)).astype(np.uint32)
-    ninls = np.where(spills, 0, np.minimum(n_inl, (1 << _NINL_BITS) - 1)).astype(np.uint32)
-    # exempt bit 30 on shared + inline slots ($-mask exemption): a slot is
-    # exempt iff its offset within the run is >= the entry's client count
-    all_ids = np.arange(total, dtype=np.uint32)
-    if total:
-        entry_of = np.repeat(np.arange(n_all)[run_len > 0], run_len[run_len > 0])
-        local = all_ids - starts64[entry_of].astype(np.uint32)
-        all_ids = all_ids | (
-            (local >= n_cli[entry_of]).astype(np.uint32) << np.uint32(30)
-        )
-    # power-of-two bucket the id pool so rebuilds under churn reuse the
-    # jitted executable (padding sits beyond every entry's window)
-    all_ids = _pad_to(
-        np.concatenate([all_ids, np.zeros(window, dtype=np.uint32)]),
-        _bucket(total + window, minimum=max(16, window)),
-        0,
-    )
+    bases = np.where(alive, ordinal * window, 0).astype(np.uint32)
+    starts = bases  # the table's per-entry 4th word
+    nclis = np.where(spills, 0, np.minimum(n_cli, MAX_WINDOW)).astype(np.uint32)
+    nregs = np.where(spills, 0, np.minimum(n_cli + n_shr, MAX_WINDOW)).astype(np.uint32)
+    ninls = np.where(spills, 0, np.minimum(n_inl, MAX_WINDOW)).astype(np.uint32)
+    n_subs_total = int(total_ids[alive].sum())
     subs = _LazySubTable(
-        starts64[sel][~spills[sel]],
-        total_ids[sel][~spills[sel]],
-        n_cli[sel][~spills[sel]],
-        n_shr[sel][~spills[sel]],
-        [snaps[i] for i in sel if not spills[i]],
-        total,
+        window,
+        [snaps[i] for i in range(n_all) if alive[i]],
+        n_sids,
     )
 
-    # bucket placement: slot = h1 & (S-1), 4 entries/bucket; a bucket the
-    # placement overfills is marked saturated — the device host-routes any
-    # probe touching it, so dropped entries cannot cause false negatives
     # size for ~0.6 entries per 4-slot bucket: P(bucket > 4 | Poisson 0.6)
     # ~ 3e-4, so saturation host-routes a negligible probe fraction
     n = len(sel)
@@ -403,7 +390,8 @@ def build_flat_index(
     n_sat = int(sat.sum())
 
     meta = (
-        nregs[sel]
+        (nclis[sel] << np.uint32(_NCLI_SHIFT))
+        | (nregs[sel] << np.uint32(_NREG_SHIFT))
         | (ninls[sel] << np.uint32(_NINL_SHIFT))
         | (top_wilds[sel].astype(np.uint32) << np.uint32(_TOPWILD_SHIFT))
         | (
@@ -440,7 +428,6 @@ def build_flat_index(
 
     return FlatIndex(
         table=table,
-        all_ids=all_ids,
         pat_kind=pat_kind,
         pat_depth=pat_depth,
         pat_mask=pat_mask,
@@ -449,6 +436,8 @@ def build_flat_index(
         window=window,
         max_levels=max_levels,
         n_entries=n,
+        n_subs=n_subs_total,
+        wide_sids=n_sids >= 1 << 24,
         n_sat=n_sat,
         n_spill=n_spill,
     )
@@ -461,7 +450,6 @@ def build_flat_index(
 
 def flat_match_core(
     table,
-    all_ids,
     pat_kind,
     pat_depth,
     pat_mask,
@@ -473,6 +461,7 @@ def flat_match_core(
     window: int,
     max_levels: int,
     out_slots: int,
+    wide_sids: bool = False,
 ):
     """Match ``B`` topics against the flat index in one dispatch.
 
@@ -523,12 +512,14 @@ def flat_match_core(
     hit = (rows[..., 0] == h1[..., None]) & (rows[..., 1] == h2[..., None])
     hit = hit & active[..., None]  # [B, P, 4]; at most one per probe
     meta = jnp.where(hit, rows[..., 2], 0).max(axis=-1)
-    start = jnp.where(hit, rows[..., 3], 0).max(axis=-1)
+    base = jnp.where(hit, rows[..., 3], 0).max(axis=-1)
     hit_any = hit.any(axis=-1)
     sat_probe = ((rows[:, :, 0, 2] >> _SAT_SHIFT) & 1) == 1
 
-    nreg = (meta & ((1 << _NREG_BITS) - 1)).astype(jnp.int32)
-    ninl = ((meta >> _NINL_SHIFT) & ((1 << _NINL_BITS) - 1)).astype(jnp.int32)
+    cnt_mask = (1 << _CNT_BITS) - 1
+    ncli = ((meta >> _NCLI_SHIFT) & cnt_mask).astype(jnp.int32)
+    nreg = ((meta >> _NREG_SHIFT) & cnt_mask).astype(jnp.int32)
+    ninl = ((meta >> _NINL_SHIFT) & cnt_mask).astype(jnp.int32)
     top_wild = (meta >> _TOPWILD_SHIFT) & 1
     last_plus = (meta >> _LASTPLUS_SHIFT) & 1
     spill = ((meta >> _SPILL_SHIFT) & 1) == 1
@@ -540,45 +531,47 @@ def flat_match_core(
     count = jnp.where(hash_pat & exact_len, nreg, nreg + ninl)
     count = jnp.where(valid_hit, count, 0)
 
-    # ONE id-window slice per probe: [B, P, W]
-    idx = jnp.where(valid_hit, start.astype(jnp.int32), 0)
-    wins = jax.lax.gather(
-        all_ids,
-        idx.reshape(B, P, 1),
-        jax.lax.GatherDimensionNumbers(
-            offset_dims=(2,), collapsed_slice_dims=(), start_index_map=(0,)
-        ),
-        slice_sizes=(window,),
-        mode="clip",
-    ).reshape(B, P, window)
-
+    # ids are synthetic (base + slot): no second gather — the exempt
+    # boundary (ncli) and the counts came with the bucket row
     ks = jnp.arange(window, dtype=jnp.int32)
     validk = ks[None, None, :] < count[..., None]
-    exempt = (wins >> np.uint32(30)) & 1
+    exempt = ks[None, None, :] >= ncli[..., None]
     dollar_drop = (
-        is_dollar[:, None, None] & (top_wild[..., None] == 1) & (exempt == 0)
+        is_dollar[:, None, None] & (top_wild[..., None] == 1) & ~exempt
     )
     validk = validk & ~dollar_drop
-    sid = (wins & jnp.uint32(0x3FFFFFFF)).astype(jnp.int32)
+    sid = base.astype(jnp.int32)[..., None] + ks[None, None, :]
 
     flat_sid = jnp.where(validk, sid, -1).reshape(B, P * window)
     flat_valid = validk.reshape(B, P * window)
     totals = flat_valid.sum(axis=1).astype(jnp.int32)
 
     # compact valid ids to the front via a one-hot matmul (MXU work is
-    # free where gathers are not — PROFILE.md §2); f32 is exact for ids
-    # < 2^24, and bit 30 was stripped above
+    # free where gathers are not — PROFILE.md §2). f32 is exact below
+    # 2^24; larger sid spaces compact two 16-bit planes (each exact)
     pos = jnp.cumsum(flat_valid.astype(jnp.int32), axis=1) - 1
     oh = (
         flat_valid[:, :, None]
         & (pos[:, :, None] == jnp.arange(out_slots, dtype=jnp.int32)[None, None, :])
-    )
-    out = jnp.einsum(
-        "bj,bjk->bk",
-        (flat_sid + 1).astype(jnp.float32),
-        oh.astype(jnp.float32),
-        preferred_element_type=jnp.float32,
-    ).astype(jnp.int32) - 1
+    ).astype(jnp.float32)
+    if wide_sids:
+        v = flat_sid + 1
+        lo = jnp.einsum(
+            "bj,bjk->bk", (v & 0xFFFF).astype(jnp.float32), oh,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        hi = jnp.einsum(
+            "bj,bjk->bk", (v >> 16).astype(jnp.float32), oh,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        out = ((hi << 16) | lo) - 1
+    else:
+        out = jnp.einsum(
+            "bj,bjk->bk",
+            (flat_sid + 1).astype(jnp.float32),
+            oh,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32) - 1
 
     overflow = (
         (sat_probe & active).any(axis=1)
@@ -591,7 +584,7 @@ def flat_match_core(
 def _jit_core():
     import jax
 
-    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots"))(
+    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots", "wide_sids"))(
         flat_match_core
     )
 
@@ -632,7 +625,6 @@ def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
 
 def _packed_core(
     table,
-    all_ids,
     pat_kind,
     pat_depth,
     pat_mask,
@@ -642,6 +634,7 @@ def _packed_core(
     max_levels,
     out_slots,
     transfer_slots,
+    wide_sids=False,
 ):
     """flat_match_core with ONE packed input and ONE packed output transfer:
     in ``[B, 2L+2]`` i32, out ``[B, transfer_slots+2]`` i32 = (sid prefix |
@@ -657,7 +650,6 @@ def _packed_core(
     is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
     out, totals, overflow = flat_match_core(
         table,
-        all_ids,
         pat_kind,
         pat_depth,
         pat_mask,
@@ -668,6 +660,7 @@ def _packed_core(
         window=window,
         max_levels=max_levels,
         out_slots=out_slots,
+        wide_sids=wide_sids,
     )
     return jnp.concatenate(
         [
@@ -693,6 +686,7 @@ class _LazyJitPacked(_LazyJit):
                             "max_levels",
                             "out_slots",
                             "transfer_slots",
+                            "wide_sids",
                         ),
                     )(_packed_core)
         return self._fn(*args, **kwargs)

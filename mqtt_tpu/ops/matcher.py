@@ -152,7 +152,6 @@ class TpuMatcher:
             jnp.asarray(a)
             for a in (
                 flat.table,
-                flat.all_ids,
                 flat.pat_kind,
                 flat.pat_depth,
                 flat.pat_mask,
@@ -197,6 +196,7 @@ class TpuMatcher:
             window=flat.window,
             max_levels=flat.max_levels,
             out_slots=self.out_slots,
+            wide_sids=flat.wide_sids,
         )
 
     # -- matching ----------------------------------------------------------
@@ -231,6 +231,7 @@ class TpuMatcher:
             max_levels=flat.max_levels,
             out_slots=self.out_slots,
             transfer_slots=ts,
+            wide_sids=flat.wide_sids,
         )
 
         def resolve() -> list[Subscribers]:

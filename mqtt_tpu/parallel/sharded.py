@@ -438,32 +438,33 @@ class ShardedTpuMatcher:
             jax.device_put(np.asarray(a), shard_sharding)
             for a in (
                 table,
-                stack(lambda f: f.all_ids, min_len=max(2, self.window)),
                 stack(lambda f: f.pat_kind, fill=np.uint32(0)),
                 stack(lambda f: f.pat_depth, fill=np.int32(-1)),
                 stack(lambda f: f.pat_mask, fill=np.uint32(0)),
             )
         )
         tables = [f.subs for f in flats]
-        step = self._get_step()
+        step = self._get_step(any(f.wide_sids for f in flats))
         return (arrays, tables, flats[0].salt, step)
 
-    def _get_step(self):
-        """The jitted SPMD step (cached; jax re-traces per shape)."""
-        if self._step is not None:
-            return self._step
+    def _get_step(self, wide_sids: bool = False):
+        """The jitted SPMD step (cached per wide-sid mode; jax re-traces
+        per shape)."""
+        if self._step is not None and self._step[0] == wide_sids:
+            return self._step[1]
         mesh = self.mesh
         window, max_levels, out_slots = self.window, self.max_levels, self.out_slots
 
         def step_fn(
-            table, all_ids, pat_kind, pat_depth, pat_mask,
+            table, pat_kind, pat_depth, pat_mask,
             tok1, tok2, lengths, is_dollar,
         ):
             # each device: its sub shard (leading dim 1) x its batch tile
             out, totals, overflow = flat_match_core(
-                table[0], all_ids[0], pat_kind[0], pat_depth[0], pat_mask[0],
+                table[0], pat_kind[0], pat_depth[0], pat_mask[0],
                 tok1, tok2, lengths, is_dollar,
                 window=window, max_levels=max_levels, out_slots=out_slots,
+                wide_sids=wide_sids,
             )
             # union across the subs axis rides ICI
             out_g = jax.lax.all_gather(out, "subs")  # [S, b_local, K]
@@ -477,12 +478,12 @@ class ShardedTpuMatcher:
             shard_map(
                 step_fn,
                 mesh=mesh,
-                in_specs=(shard_spec,) * 5 + (batch_spec,) * 4,
+                in_specs=(shard_spec,) * 4 + (batch_spec,) * 4,
                 out_specs=(P(None, "batch", None), P(None, "batch"), P(None, "batch")),
                 disable_rep_check=True,
             )
         )
-        self._step = step
+        self._step = (wide_sids, step)
         return step
 
     @property

@@ -77,7 +77,7 @@ def test_incremental_rebuild_touches_one_shard():
     try:
         matcher.rebuild()
         assert matcher._dirty == [False] * matcher.n_shards
-        sizes_before = [len(c.subs) for c in matcher._flats]
+        sizes_before = [c.num_subs for c in matcher._flats]
 
         sub = Subscription(filter="t/3/fresh", qos=1)
         index.subscribe("fresh", sub)
@@ -86,7 +86,7 @@ def test_incremental_rebuild_touches_one_shard():
         assert dirty == [owner]
 
         matcher.rebuild()
-        sizes_after = [len(c.subs) for c in matcher._flats]
+        sizes_after = [c.num_subs for c in matcher._flats]
         for s in range(matcher.n_shards):
             expected = sizes_before[s] + (1 if s == owner else 0)
             assert sizes_after[s] == expected
@@ -96,7 +96,7 @@ def test_incremental_rebuild_touches_one_shard():
         index.unsubscribe("t/3/fresh", "fresh")
         assert [s for s in range(matcher.n_shards) if matcher._dirty[s]] == [owner]
         matcher.rebuild()
-        assert [len(c.subs) for c in matcher._flats] == sizes_before
+        assert [c.num_subs for c in matcher._flats] == sizes_before
     finally:
         matcher.close()
 
