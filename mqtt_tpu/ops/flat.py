@@ -462,8 +462,15 @@ def flat_match_core(
     max_levels: int,
     out_slots: int,
     wide_sids: bool = False,
+    overflow_slots: int = 0,
 ):
     """Match ``B`` topics against the flat index in one dispatch.
+
+    ``overflow_slots`` (default: ``out_slots``) sets the totals threshold
+    for the overflow flag separately from the compaction width — the
+    packed path compacts only the transfer prefix while keeping the
+    overflow flag's meaning (a genuine device-capacity route, distinct
+    from a transfer-prefix route).
 
     Returns ``(sub_ids[B, out_slots] int32 (-1 padded), totals[B] int32,
     overflow[B] bool)`` — ``overflow`` marks topics the host must re-walk
@@ -576,7 +583,7 @@ def flat_match_core(
     overflow = (
         (sat_probe & active).any(axis=1)
         | (spill & valid_hit).any(axis=1)
-        | (totals > out_slots)
+        | (totals > (overflow_slots or out_slots))
     )
     return out, totals, overflow
 
@@ -584,7 +591,7 @@ def flat_match_core(
 def _jit_core():
     import jax
 
-    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots", "wide_sids"))(
+    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots", "wide_sids", "overflow_slots"))(
         flat_match_core
     )
 
@@ -648,6 +655,11 @@ def _packed_core(
     tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
     lengths = packed_tokens[:, 2 * L]
     is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
+    # compact only to the transfer prefix: slots beyond it are discarded,
+    # and the resolver host-routes on totals > transfer_slots regardless of
+    # the kernel's own overflow threshold, so narrowing out_slots here is
+    # semantics-free and shrinks the one-hot matmul proportionally
+    k = min(out_slots, transfer_slots)
     out, totals, overflow = flat_match_core(
         table,
         pat_kind,
@@ -659,8 +671,9 @@ def _packed_core(
         is_dollar,
         window=window,
         max_levels=max_levels,
-        out_slots=out_slots,
+        out_slots=k,
         wide_sids=wide_sids,
+        overflow_slots=out_slots,
     )
     return jnp.concatenate(
         [
