@@ -505,7 +505,33 @@ def main() -> None:
     rng = random.Random(7)
 
     link = None
+    device_ok = True
     if which & {1, 2, 3, 4, 5}:  # device configs selected: touch the chip
+        # probe device liveness in a SUBPROCESS first: a dead tunnel hangs
+        # jax backend init indefinitely (no timeout in the client), which
+        # would otherwise wedge the whole bench run and produce nothing
+        import subprocess
+
+        probe = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "import faulthandler; faulthandler.dump_traceback_later(90, exit=True)\n"
+                "import jax, numpy, jax.numpy as jnp\n"
+                "print(jax.devices()); print(int(numpy.asarray((jnp.ones((8,))*2).sum())))",
+            ],
+            timeout=150,
+            capture_output=True,
+        )
+        device_ok = probe.returncode == 0
+        if not device_ok:
+            log(
+                "DEVICE UNREACHABLE (backend init hung or failed); skipping "
+                "device configs — broker bench still runs. probe stderr tail: "
+                + probe.stderr.decode(errors="replace")[-300:].replace("\n", " | ")
+            )
+            which -= {1, 2, 3, 4, 5}
+    if device_ok and which & {1, 2, 3, 4, 5}:
         import jax
 
         link = probe_link()
