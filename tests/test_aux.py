@@ -798,3 +798,66 @@ class TestLogKVStore:
         s2.init(LogKVOptions(path=path, gc_interval=0))
         assert s2._get("CL_49") == b"x" * 64
         s2.stop()
+
+
+class TestTLSListener:
+    def test_mqtt_over_tls(self, tmp_path, monkeypatch):
+        """Full MQTT connect/sub/pub over a real TLS socket, using certs
+        from the CLI's genecc generator (cmd/main.go:155-185 analog)."""
+        import ssl
+
+        from mqtt_tpu.__main__ import cmd_genecc
+        from mqtt_tpu.listeners import Config as LConfig
+        from mqtt_tpu.listeners.tcp import TCP
+        from tests.test_server import (
+            Harness,
+            connect_packet,
+            pub_packet,
+            read_wire_packet,
+            run,
+            sub_packet,
+        )
+
+        monkeypatch.chdir(tmp_path)
+        assert cmd_genecc(None) == 0
+        server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        server_ctx.load_cert_chain(
+            str(tmp_path / "cert.ec.pem"), str(tmp_path / "cert-key.ec.pem")
+        )
+        client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+        client_ctx.load_verify_locations(str(tmp_path / "root.ec.pem"))
+
+        async def scenario():
+            h = Harness()
+            h.server.add_listener(
+                TCP(
+                    LConfig(
+                        type="tcp",
+                        id="tls1",
+                        address="127.0.0.1:18877",
+                        tls_config=server_ctx,
+                    )
+                )
+            )
+            await h.server.serve()
+            try:
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", 18877, ssl=client_ctx, server_hostname="localhost"
+                )
+                writer.write(connect_packet("tls-client", 4))
+                await writer.drain()
+                raw = await asyncio.wait_for(reader.readexactly(4), 5)
+                assert raw == bytes.fromhex("20020000")
+                writer.write(sub_packet(1, [Subscription(filter="tls/#", qos=0)]))
+                await writer.drain()
+                await read_wire_packet(reader)
+                writer.write(pub_packet("tls/x", b"secure"))
+                await writer.drain()
+                pk = await read_wire_packet(reader)
+                assert pk.topic_name == "tls/x" and bytes(pk.payload) == b"secure"
+                writer.close()
+            finally:
+                await h.server.close()
+                await h.shutdown()
+
+        run(scenario())
