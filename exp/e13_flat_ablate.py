@@ -97,7 +97,7 @@ def v_full_no_compact(tok1, tok2, lengths, is_dollar):
     out, totals, ovf = flat_match_core(
         table, pat_kind, pat_depth, pat_mask,
         tok1, tok2, lengths, is_dollar,
-        window=W, max_levels=L, out_slots=64,
+        max_levels=L, out_slots=64,
     )
     return totals.sum()  # compaction may be DCE'd; see v_full
 
@@ -107,7 +107,7 @@ def v_full(tok1, tok2, lengths, is_dollar):
     out, totals, ovf = flat_match(
         table, pat_kind, pat_depth, pat_mask,
         tok1, tok2, lengths, is_dollar,
-        window=W, max_levels=L, out_slots=64,
+        max_levels=L, out_slots=64,
     )
     return out
 

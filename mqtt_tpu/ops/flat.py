@@ -122,7 +122,6 @@ class FlatIndex:
     max_levels: int = 8
     n_entries: int = 0
     n_subs: int = 0  # actual subscriptions indexed (sid space is larger)
-    wide_sids: bool = False  # sid space >= 2^24: two-plane compaction
     n_sat: int = 0  # build-saturated buckets (probes host-route)
     n_spill: int = 0  # entries with more ids than the window (host-route)
 
@@ -359,8 +358,7 @@ def build_flat_index(
     ordinal[alive] = np.arange(int(alive.sum()))
     n_sids = int(alive.sum()) * window
     if n_sids >= 1 << 30:
-        # int32 sid space (the kernel compacts 16-bit planes exactly in
-        # f32, so the practical cliff is the sign bit, not f32 mantissa)
+        # sid arithmetic is int32 end to end; leave sign-bit headroom
         raise RuntimeError(
             f"flat index sid space must stay < {1 << 30}, got {n_sids}"
         )
@@ -437,7 +435,6 @@ def build_flat_index(
         max_levels=max_levels,
         n_entries=n,
         n_subs=n_subs_total,
-        wide_sids=n_sids >= 1 << 24,
         n_sat=n_sat,
         n_spill=n_spill,
     )
@@ -458,19 +455,17 @@ def flat_match_core(
     lengths,
     is_dollar,
     *,
-    window: int,
     max_levels: int,
     out_slots: int,
-    wide_sids: bool = False,
     overflow_slots: int = 0,
 ):
     """Match ``B`` topics against the flat index in one dispatch.
 
     ``overflow_slots`` (default: ``out_slots``) sets the totals threshold
-    for the overflow flag separately from the compaction width — the
-    packed path compacts only the transfer prefix while keeping the
-    overflow flag's meaning (a genuine device-capacity route, distinct
-    from a transfer-prefix route).
+    for the overflow flag separately from the output width — the packed
+    path emits only the transfer prefix while keeping the overflow flag's
+    meaning (a genuine device-capacity route, distinct from a
+    transfer-prefix route).
 
     Returns ``(sub_ids[B, out_slots] int32 (-1 padded), totals[B] int32,
     overflow[B] bool)`` — ``overflow`` marks topics the host must re-walk
@@ -538,47 +533,28 @@ def flat_match_core(
     count = jnp.where(hash_pat & exact_len, nreg, nreg + ninl)
     count = jnp.where(valid_hit, count, 0)
 
-    # ids are synthetic (base + slot): no second gather — the exempt
-    # boundary (ncli) and the counts came with the bucket row
-    ks = jnp.arange(window, dtype=jnp.int32)
-    validk = ks[None, None, :] < count[..., None]
-    exempt = ks[None, None, :] >= ncli[..., None]
-    dollar_drop = (
-        is_dollar[:, None, None] & (top_wild[..., None] == 1) & ~exempt
-    )
-    validk = validk & ~dollar_drop
-    sid = base.astype(jnp.int32)[..., None] + ks[None, None, :]
-
-    flat_sid = jnp.where(validk, sid, -1).reshape(B, P * window)
-    flat_valid = validk.reshape(B, P * window)
-    totals = flat_valid.sum(axis=1).astype(jnp.int32)
-
-    # compact valid ids to the front via a one-hot matmul (MXU work is
-    # free where gathers are not — PROFILE.md §2). f32 is exact below
-    # 2^24; larger sid spaces compact two 16-bit planes (each exact)
-    pos = jnp.cumsum(flat_valid.astype(jnp.int32), axis=1) - 1
-    oh = (
-        flat_valid[:, :, None]
-        & (pos[:, :, None] == jnp.arange(out_slots, dtype=jnp.int32)[None, None, :])
-    ).astype(jnp.float32)
-    if wide_sids:
-        v = flat_sid + 1
-        lo = jnp.einsum(
-            "bj,bjk->bk", (v & 0xFFFF).astype(jnp.float32), oh,
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)
-        hi = jnp.einsum(
-            "bj,bjk->bk", (v >> 16).astype(jnp.float32), oh,
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32)
-        out = ((hi << 16) | lo) - 1
-    else:
-        out = jnp.einsum(
-            "bj,bjk->bk",
-            (flat_sid + 1).astype(jnp.float32),
-            oh,
-            preferred_element_type=jnp.float32,
-        ).astype(jnp.int32) - 1
+    # ids are synthetic (base + slot) and, after the $-mask, each probe's
+    # surviving ids form ONE contiguous range: clients occupy the window's
+    # prefix [0, ncli) and are exactly what the $-mask drops, so a probe
+    # contributes [lo, count) with lo in {0, ncli}. Compaction is therefore
+    # pure range concatenation — a [B, K, P] one-hot over the (tiny) probe
+    # axis — with no gathers and no O(P*window) one-hot matmul.
+    dollar = is_dollar[:, None] & (top_wild == 1)
+    lo = jnp.where(dollar, jnp.minimum(ncli, count), 0)  # [B, P]
+    cnt = count - lo
+    offs = jnp.cumsum(cnt, axis=1)  # inclusive [B, P]
+    totals = offs[:, -1]
+    prev = offs - cnt  # exclusive
+    ks = jnp.arange(out_slots, dtype=jnp.int32)  # [K]
+    # which probe supplies out slot k: the first p with offs[p] > k
+    sel_onehot = (prev[:, None, :] <= ks[None, :, None]) & (
+        ks[None, :, None] < offs[:, None, :]
+    )  # [B, K, P]
+    sel = sel_onehot.astype(jnp.int32)
+    # out slot k = base + lo + (k - prev) of its probe: one fused reduction
+    comb = (base.astype(jnp.int32) + lo - prev)[:, None, :]
+    in_range = ks[None, :] < totals[:, None]
+    out = jnp.where(in_range, ks[None, :] + (sel * comb).sum(axis=2), -1)
 
     overflow = (
         (sat_probe & active).any(axis=1)
@@ -591,7 +567,7 @@ def flat_match_core(
 def _jit_core():
     import jax
 
-    return partial(jax.jit, static_argnames=("window", "max_levels", "out_slots", "wide_sids", "overflow_slots"))(
+    return partial(jax.jit, static_argnames=("max_levels", "out_slots", "overflow_slots"))(
         flat_match_core
     )
 
@@ -637,11 +613,9 @@ def _packed_core(
     pat_mask,
     packed_tokens,
     *,
-    window,
     max_levels,
     out_slots,
     transfer_slots,
-    wide_sids=False,
 ):
     """flat_match_core with ONE packed input and ONE packed output transfer:
     in ``[B, 2L+2]`` i32, out ``[B, transfer_slots+2]`` i32 = (sid prefix |
@@ -669,10 +643,8 @@ def _packed_core(
         tok2,
         lengths,
         is_dollar,
-        window=window,
         max_levels=max_levels,
         out_slots=k,
-        wide_sids=wide_sids,
         overflow_slots=out_slots,
     )
     return jnp.concatenate(
@@ -695,11 +667,9 @@ class _LazyJitPacked(_LazyJit):
                     self._fn = partial(
                         jax.jit,
                         static_argnames=(
-                            "window",
                             "max_levels",
                             "out_slots",
                             "transfer_slots",
-                            "wide_sids",
                         ),
                     )(_packed_core)
         return self._fn(*args, **kwargs)

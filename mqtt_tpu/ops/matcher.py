@@ -193,10 +193,8 @@ class TpuMatcher:
             tok2,
             lengths,
             is_dollar,
-            window=flat.window,
             max_levels=flat.max_levels,
             out_slots=self.out_slots,
-            wide_sids=flat.wide_sids,
         )
 
     # -- matching ----------------------------------------------------------
@@ -227,11 +225,9 @@ class TpuMatcher:
         packed_dev = flat_match_packed(
             *arrays,
             jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
-            window=flat.window,
             max_levels=flat.max_levels,
             out_slots=self.out_slots,
             transfer_slots=ts,
-            wide_sids=flat.wide_sids,
         )
 
         def resolve() -> list[Subscribers]:

@@ -444,16 +444,15 @@ class ShardedTpuMatcher:
             )
         )
         tables = [f.subs for f in flats]
-        step = self._get_step(any(f.wide_sids for f in flats))
+        step = self._get_step()
         return (arrays, tables, flats[0].salt, step)
 
-    def _get_step(self, wide_sids: bool = False):
-        """The jitted SPMD step (cached per wide-sid mode; jax re-traces
-        per shape)."""
-        if self._step is not None and self._step[0] == wide_sids:
-            return self._step[1]
+    def _get_step(self):
+        """The jitted SPMD step (cached; jax re-traces per shape)."""
+        if self._step is not None:
+            return self._step
         mesh = self.mesh
-        window, max_levels, out_slots = self.window, self.max_levels, self.out_slots
+        max_levels, out_slots = self.max_levels, self.out_slots
 
         def step_fn(
             table, pat_kind, pat_depth, pat_mask,
@@ -463,8 +462,7 @@ class ShardedTpuMatcher:
             out, totals, overflow = flat_match_core(
                 table[0], pat_kind[0], pat_depth[0], pat_mask[0],
                 tok1, tok2, lengths, is_dollar,
-                window=window, max_levels=max_levels, out_slots=out_slots,
-                wide_sids=wide_sids,
+                max_levels=max_levels, out_slots=out_slots,
             )
             # union across the subs axis rides ICI
             out_g = jax.lax.all_gather(out, "subs")  # [S, b_local, K]
@@ -483,7 +481,7 @@ class ShardedTpuMatcher:
                 disable_rep_check=True,
             )
         )
-        self._step = (wide_sids, step)
+        self._step = step
         return step
 
     @property

@@ -834,15 +834,17 @@ class TestTLSListener:
                     LConfig(
                         type="tcp",
                         id="tls1",
-                        address="127.0.0.1:18877",
+                        address="127.0.0.1:0",
                         tls_config=server_ctx,
                     )
                 )
             )
             await h.server.serve()
             try:
+                bound = h.server.listeners.get("tls1").address()
+                port = int(bound.rsplit(":", 1)[1])
                 reader, writer = await asyncio.open_connection(
-                    "127.0.0.1", 18877, ssl=client_ctx, server_hostname="localhost"
+                    "127.0.0.1", port, ssl=client_ctx, server_hostname="localhost"
                 )
                 writer.write(connect_packet("tls-client", 4))
                 await writer.drain()

@@ -213,38 +213,6 @@ def test_saturated_bucket_routes_to_host():
     assert matcher.stats.host_fallbacks == before
 
 
-def test_wide_sid_compaction_matches_narrow():
-    """The two-plane (wide) compaction must produce identical results to
-    the single-plane path (it engages when the sid space crosses f32's
-    exact-integer range; no test corpus is that big, so exercise the
-    kernel flag directly)."""
-    import jax.numpy as jnp
-    import numpy as np
-
-    from mqtt_tpu.ops.flat import flat_match_core
-    from mqtt_tpu.ops.hashing import tokenize_topics
-
-    index = TopicsIndex()
-    for i in range(50):
-        index.subscribe(f"cl{i}", Subscription(filter=f"w/{i % 7}", qos=0))
-        index.subscribe(f"cl{i}", Subscription(filter="w/+", qos=1))
-    matcher = TpuMatcher(index, max_levels=4)
-    arrays = matcher.device_arrays
-    flat = matcher.csr
-    topics = [f"w/{i % 9}" for i in range(32)]
-    toks = tokenize_topics(topics, 4, flat.salt)[:4]
-    args = tuple(jnp.asarray(a) for a in toks)
-    narrow = flat_match_core(
-        *arrays, *args, window=flat.window, max_levels=4, out_slots=64
-    )
-    wide = flat_match_core(
-        *arrays, *args, window=flat.window, max_levels=4, out_slots=64,
-        wide_sids=True,
-    )
-    for a, b in zip(narrow, wide):
-        assert np.array_equal(np.asarray(a), np.asarray(b))
-
-
 def test_window_above_meta_capacity_raises():
     from mqtt_tpu.ops.flat import MAX_WINDOW, build_flat_index
 
