@@ -1,0 +1,36 @@
+"""The examples/ scripts must not rot: run the self-contained ones
+end-to-end in-process (network-server examples are import-checked by the
+syntax sweep; these three exercise real broker behavior)."""
+
+import subprocess
+import sys
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(name, timeout=60):
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", name)],
+        capture_output=True,
+        timeout=timeout,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr.decode()[-500:]
+    return proc.stdout.decode()
+
+
+def test_direct_inline_example():
+    out = _run("direct_inline.py")
+    assert "direct/hello" in out and "direct/retained" in out
+
+
+def test_persistence_example():
+    out = _run("persistence_store.py")
+    assert "still here" in out
+
+
+def test_hooks_custom_example():
+    out = _run("hooks_custom.py")
+    assert "[modified] hello" in out
+    assert "forbidden" not in out.split("seen:")[-1]  # veto worked
