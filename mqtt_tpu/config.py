@@ -145,6 +145,12 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "inline_client",
         "client_net_write_buffer_size",
         "client_net_read_buffer_size",
+        # TPU device matcher + publish staging loop (mqtt_tpu.staging)
+        "device_matcher",
+        "matcher_opts",
+        "matcher_stage_window_ms",
+        "matcher_stage_max_batch",
+        "matcher_stage_max_inflight",
     ):
         if k in top:
             setattr(opts, k, top[k])

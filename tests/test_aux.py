@@ -863,3 +863,20 @@ class TestTLSListener:
                 await h.shutdown()
 
         run(scenario())
+
+
+class TestConfigDeviceMatcher:
+    def test_device_matcher_options_from_yaml(self):
+        from mqtt_tpu.config import from_bytes
+
+        opts = from_bytes(
+            b"options:\n"
+            b"  device_matcher: true\n"
+            b"  matcher_stage_window_ms: 3.5\n"
+            b"  matcher_opts:\n"
+            b"    max_levels: 4\n"
+            b"    background: false\n"
+        )
+        assert opts.device_matcher is True
+        assert opts.matcher_stage_window_ms == 3.5
+        assert opts.matcher_opts == {"max_levels": 4, "background": False}
