@@ -178,6 +178,16 @@ class Options:
             self.client_net_write_buffer_size = 1024 * 2
         if self.client_net_read_buffer_size == 0:
             self.client_net_read_buffer_size = 1024 * 2
+        # staging knobs are config-reachable: a zero/negative max_batch
+        # would busy-spin the collector on empty batches, and a zero
+        # max_inflight turns the bounded queue unbounded (asyncio.Queue
+        # semantics) — normalize both like the buffer sizes above
+        if self.matcher_stage_max_batch <= 0:
+            self.matcher_stage_max_batch = 4096
+        if self.matcher_stage_max_inflight <= 0:
+            self.matcher_stage_max_inflight = 4
+        if self.matcher_stage_window_ms < 0:
+            self.matcher_stage_window_ms = 0.0
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 

@@ -880,3 +880,19 @@ class TestConfigDeviceMatcher:
         assert opts.device_matcher is True
         assert opts.matcher_stage_window_ms == 3.5
         assert opts.matcher_opts == {"max_levels": 4, "background": False}
+
+    def test_degenerate_staging_knobs_normalized(self):
+        """Config-reachable zeros must not busy-spin the collector
+        (max_batch=0) or unbound the pipeline queue (max_inflight=0)."""
+        from mqtt_tpu.config import from_bytes
+
+        opts = from_bytes(
+            b"options:\n"
+            b"  matcher_stage_max_batch: 0\n"
+            b"  matcher_stage_max_inflight: 0\n"
+            b"  matcher_stage_window_ms: -1\n"
+        )
+        opts.ensure_defaults()
+        assert opts.matcher_stage_max_batch > 0
+        assert opts.matcher_stage_max_inflight > 0
+        assert opts.matcher_stage_window_ms == 0.0

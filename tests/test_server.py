@@ -11,9 +11,11 @@ import pytest
 from mqtt_tpu import Capabilities, Options, Server
 from mqtt_tpu.hooks import (
     ON_ACL_CHECK,
+    ON_CONNECT,
     ON_CONNECT_AUTHENTICATE,
     ON_PACKET_READ,
     ON_PUBLISH,
+    ON_QOS_DROPPED,
     Hook,
     Hooks,
 )
@@ -64,6 +66,7 @@ def connect_packet(client_id="test", version=4, clean=True, keepalive=30, will=N
         cp.will_topic = will[0]
         cp.will_payload = will[1]
         cp.will_qos = will[2] if len(will) > 2 else 0
+        cp.will_retain = will[3] if len(will) > 3 else False
     return encode_packet(
         Packet(fixed_header=FixedHeader(type=CONNECT), protocol_version=version, connect=cp)
     )
@@ -2101,6 +2104,320 @@ class TestDisconnectAndSessionEdges:
                     got += 1
                     await read_wire_packet(r)  # trailing pingresp
             assert got == 1  # exactly one group member receives it
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestReferenceScenarioParity:
+    """Edge scenarios ported from the reference suite that had no analog
+    here yet (server_test.go: ZeroByteUsername, ServerKeepalive,
+    ConnackFailureReason, AuthInvalidReason, PubrecInvalidReason,
+    PubrelBadReason, SendLWTRetain, OnPublishAckErrorContinue,
+    SubscribeWithRetain[DifferentFilter], BadFixedHeader)."""
+
+    def test_zero_byte_username_is_valid(self):
+        # server_test.go TestServerEstablishConnectionZeroByteUsernameIsValid
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.attach()
+            cp = ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=30,
+                client_identifier="zbu",
+                username_flag=True,
+                username=b"",
+            )
+            writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=CONNECT),
+                        protocol_version=5,
+                        connect=cp,
+                    )
+                )
+            )
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            assert ack.reason_code == 0  # [MQTT-3.1.3-11]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_connack_carries_server_keepalive(self):
+        # server_test.go TestServerSendConnackWithServerKeepalive
+        async def scenario():
+            h = Harness()
+
+            class KeepaliveSetter(Hook):
+                def id(self):
+                    return "ka-set"
+
+                def provides(self, b):
+                    return b == ON_CONNECT
+
+                def on_connect(self, cl, pk):
+                    cl.state.server_keepalive = True
+
+            h.server.add_hook(KeepaliveSetter())
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("kasrv", 5, keepalive=30))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            assert ack.properties.server_keep_alive_flag  # [MQTT-3.1.2-21]
+            assert ack.properties.server_keep_alive == 30
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_connack_failure_carries_reason_string(self):
+        # server_test.go TestServerSendConnackFailureReason
+        async def scenario():
+            h = Harness(allow=False)  # default deny-all
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("noway", 5))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            # connect-time auth failure maps to bad-username-or-password
+            # (server.go:552 validateConnect)
+            assert ack.reason_code == codes.ERR_BAD_USERNAME_OR_PASSWORD.code
+            assert (
+                ack.properties.reason_string
+                == codes.ERR_BAD_USERNAME_OR_PASSWORD.reason
+            )
+            assert ack.session_present is False  # [MQTT-3.2.2-6]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_auth_invalid_reason_code_disconnects(self):
+        # server_test.go TestServerProcessPacketAuthInvalidReason
+        async def scenario():
+            h = Harness()
+            r, w, task = await h.connect("badauth", version=5)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=AUTH),
+                        protocol_version=5,
+                        reason_code=0x99,  # not one of 0x00/0x18/0x19
+                    )
+                )
+            )
+            await w.drain()
+            out = await read_wire_packet(r, 5)
+            assert out.fixed_header.type == DISCONNECT  # [MQTT-3.15.2-1]
+            assert (
+                out.reason_code
+                == codes.ERR_PROTOCOL_VIOLATION_INVALID_REASON.code
+            )
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_pubrec_invalid_reason_drops_outbound_qos2(self):
+        # server_test.go TestServerProcessPacketPubrecInvalidReason
+        async def scenario():
+            h = Harness()
+            dropped = []
+
+            class DropWatch(Hook):
+                def id(self):
+                    return "drop-watch"
+
+                def provides(self, b):
+                    return b == ON_QOS_DROPPED
+
+                def on_qos_dropped(self, cl, pk):
+                    dropped.append(pk.packet_id)
+
+            h.server.add_hook(DropWatch())
+            r, w, _ = await h.connect("q2sub", version=5)
+            w.write(sub_packet(1, [Subscription(filter="o/q2", qos=2)], version=5))
+            await w.drain()
+            await read_wire_packet(r, 5)
+            pr, pw, _ = await h.connect("q2pub", version=5)
+            pw.write(pub_packet("o/q2", b"x", qos=2, pid=5, version=5))
+            await pw.drain()
+            out = await read_wire_packet(r, 5)  # server->sub PUBLISH qos2
+            assert out.fixed_header.type == PUBLISH
+            assert out.fixed_header.qos == 2
+            cl = h.server.clients.get("q2sub")
+            assert len(cl.state.inflight) == 1
+            # reply PUBREC with an error reason: flow must be abandoned
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBREC),
+                        protocol_version=5,
+                        packet_id=out.packet_id,
+                        reason_code=0x80,
+                    )
+                )
+            )
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            nxt = await read_wire_packet(r, 5)
+            assert nxt.fixed_header.type == PINGRESP  # no PUBREL was sent
+            assert len(cl.state.inflight) == 0
+            assert dropped == [out.packet_id]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_pubrel_bad_reason_drops_inbound_qos2(self):
+        # server_test.go TestServerProcessPacketPubrelBadReason
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("relbad", version=5)
+            w.write(pub_packet("i/q2", b"x", qos=2, pid=9, version=5))
+            await w.drain()
+            rec = await read_wire_packet(r, 5)
+            assert rec.fixed_header.type == PUBREC
+            cl = h.server.clients.get("relbad")
+            assert len(cl.state.inflight) == 1
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBREL, qos=1),
+                        protocol_version=5,
+                        packet_id=9,
+                        reason_code=0x83,  # error-class: MQTT5 4.13.2 ¶2
+                    )
+                )
+            )
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            nxt = await read_wire_packet(r, 5)
+            assert nxt.fixed_header.type == PINGRESP  # no PUBCOMP was sent
+            assert len(cl.state.inflight) == 0
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_lwt_retain_flag_stores_retained_message(self):
+        # server_test.go TestServerSendLWTRetain
+        async def scenario():
+            h = Harness()
+            r, w, task = await h.connect(
+                "willret", version=5, will=("will/ret", b"gone", 1, True)
+            )
+            w.close()  # abnormal disconnect fires the will
+            await asyncio.wait_for(task, TIMEOUT)
+            msgs = h.server.topics.messages("will/ret")
+            assert len(msgs) == 1  # [MQTT-3.1.2-14/-15]
+            assert bytes(msgs[0].payload) == b"gone"
+            assert msgs[0].fixed_header.retain
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_on_publish_error_v4_continues_delivery(self):
+        # server_test.go TestServerProcessPublishOnPublishAckErrorContinue
+        async def scenario():
+            h = Harness()
+
+            class Failer(Hook):
+                def id(self):
+                    return "pub-fail"
+
+                def provides(self, b):
+                    return b == ON_PUBLISH
+
+                def on_publish(self, cl, pk):
+                    raise codes.ERR_UNSPECIFIED_ERROR()
+
+            h.server.add_hook(Failer())
+            sr, sw, _ = await h.connect("v4watch")
+            sw.write(sub_packet(1, [Subscription(filter="c/#", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+            r, w, _ = await h.connect("v4pub")
+            w.write(pub_packet("c/1", b"still"))
+            await w.drain()
+            out = await read_wire_packet(sr)  # v3: error falls through
+            assert out.fixed_header.type == PUBLISH
+            assert bytes(out.payload) == b"still"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_on_publish_error_v5_qos1_acks_error_no_delivery(self):
+        # server_test.go TestServerProcessPublishOnPublishAckErrorRWError
+        async def scenario():
+            h = Harness()
+
+            class Failer(Hook):
+                def id(self):
+                    return "pub-fail5"
+
+                def provides(self, b):
+                    return b == ON_PUBLISH
+
+                def on_publish(self, cl, pk):
+                    raise codes.ERR_UNSPECIFIED_ERROR()
+
+            h.server.add_hook(Failer())
+            sr, sw, _ = await h.connect("v5watch", version=5)
+            sw.write(sub_packet(1, [Subscription(filter="c5/#", qos=0)], version=5))
+            await sw.drain()
+            await read_wire_packet(sr, 5)
+            r, w, _ = await h.connect("v5pub", version=5)
+            w.write(pub_packet("c5/1", b"no", qos=1, pid=4, version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == PUBACK
+            assert ack.reason_code == codes.ERR_UNSPECIFIED_ERROR.code
+            sw.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await sw.drain()
+            nxt = await read_wire_packet(sr, 5)
+            assert nxt.fixed_header.type == PINGRESP  # nothing was delivered
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inline_subscribe_receives_retained(self):
+        # server_test.go TestServerSubscribeWithRetain
+        async def scenario():
+            h = Harness()
+            h.server.publish("ret/in", b"kept", True, 0)
+            got = []
+            h.server.subscribe(
+                "ret/#", 7, lambda cl, sub, pk: got.append(bytes(pk.payload))
+            )
+            assert got == [b"kept"]  # [MQTT-3.8.4-4]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inline_subscribe_different_filter_gets_no_retained(self):
+        # server_test.go TestServerSubscribeWithRetainDifferentFilter
+        async def scenario():
+            h = Harness()
+            h.server.publish("ret/in2", b"kept", True, 0)
+            got = []
+            h.server.subscribe(
+                "other/#", 7, lambda cl, sub, pk: got.append(bytes(pk.payload))
+            )
+            assert got == []
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_bad_connect_fixed_header_closes_connection(self):
+        # server_test.go TestServerReadConnectionPacketBadFixedHeader
+        async def scenario():
+            h = Harness()
+            reader, writer, task = await h.attach()
+            writer.write(bytes([0x13, 0x00]))  # CONNECT with reserved flags set
+            await writer.drain()
+            await asyncio.wait_for(task, TIMEOUT)
+            data = await asyncio.wait_for(reader.read(16), TIMEOUT)
+            assert data == b""  # dropped before any CONNACK
             await h.shutdown()
 
         run(scenario())
