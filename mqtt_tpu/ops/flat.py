@@ -151,19 +151,19 @@ class _LazySubTable:
     synthetic — entry ordinal x window + slot — so the mapping is two
     integer ops. Memoized: hot topics resolve to dict hits."""
 
-    __slots__ = ("_window", "_snaps", "_n", "_memo")
+    __slots__ = ("_window", "_snaps", "_n", "memo")
 
     def __init__(self, window, snaps, n) -> None:
         self._window = window
         self._snaps = snaps
         self._n = n
-        self._memo: dict = {}
+        self.memo: dict = {}  # public: expand_sids probes it directly
 
     def __len__(self) -> int:
         return self._n
 
     def __getitem__(self, sid: int) -> SubEntry:
-        entry = self._memo.get(sid)
+        entry = self.memo.get(sid)
         if entry is not None:
             return entry
         cli, shr, inl = self._snaps[sid // self._window]
@@ -176,7 +176,7 @@ class _LazySubTable:
             entry = SubEntry(KIND_SHARED, client, sub.filter, sub)
         else:
             entry = SubEntry(KIND_INLINE, "", "", inl[local - len(cli) - len(shr)])
-        self._memo[sid] = entry
+        self.memo[sid] = entry
         return entry
 
 

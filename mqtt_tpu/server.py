@@ -330,6 +330,9 @@ class Server:
         """Start hooks, restore persisted state, init+serve all listeners,
         begin the housekeeping loop (server.go:334-371)."""
         self.log.info("mqtt_tpu starting version=%s", VERSION)
+        from .utils.gctune import tune_for_throughput
+
+        tune_for_throughput()
         # warm the native core now — its first-use lazy compile would
         # otherwise block the event loop mid-connection
         from .native import available as _native_available
