@@ -277,25 +277,41 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
     if hasattr(matcher, "match_tokens"):
         red = jax.jit(lambda o: o.sum())
         salt = matcher.csr.salt
+        # the kernel is gather-bound (PROFILE.md §3): per-batch cost is
+        # ~P*B row-gathers plus a fixed per-dispatch overhead that is
+        # ms-scale and volatile on the tunnel. Measure the sustained rate
+        # at a batch large enough to amortize the dispatch floor, like any
+        # throughput kernel is measured at its operating point; the e2e
+        # and latency numbers above keep the staging batch.
+        fast = os.environ.get("BENCH_FAST") == "1"
+        kb = max(
+            batch,
+            int(os.environ.get("BENCH_KERNEL_BATCH", batch if fast else 65536)),
+        )
+        kbatches = [[topic_gen() for _ in range(kb)] for _ in range(2)]
         resident = [
             tuple(
                 jnp.asarray(a)
                 for a in tokenize_topics(bt, matcher.max_levels, salt)[:4]
             )
-            for bt in batches
+            for bt in kbatches
         ]
         jax.block_until_ready(resident)  # H2D outside the timed loop
         np.asarray(red(matcher.match_tokens(*resident[0])[0]))
-        # enough iterations to ride out the tunnel's volatile per-dispatch
-        # overhead now that a batch is ~ms-scale
-        kiters = max(iters, 50)
-        t0 = time.perf_counter()
-        outs = [
-            matcher.match_tokens(*resident[i % len(resident)])[0]
-            for i in range(kiters)
-        ]
-        np.asarray(red(outs[-1]))  # dependent scalar D2H = true completion
-        kernel_rate = (kiters * batch) / (time.perf_counter() - t0)
+        # median of several timed windows: the tunneled device's effective
+        # gather rate varies ~1.5x over minutes (PROFILE.md §2), so one
+        # window can land in a throttled patch
+        kiters = max(4, (max(iters, 50) * batch) // (4 * kb))
+        rates = []
+        for _w in range(5):
+            t0 = time.perf_counter()
+            outs = [
+                matcher.match_tokens(*resident[i % len(resident)])[0]
+                for i in range(kiters)
+            ]
+            np.asarray(red(outs[-1]))  # dependent scalar D2H = true completion
+            rates.append((kiters * kb) / (time.perf_counter() - t0))
+        kernel_rate = sorted(rates)[len(rates) // 2]
 
     return {
         "e2e_matches_per_sec": round((iters * batch) / e2e_dt),
@@ -398,6 +414,15 @@ def run_cfg5(n_subs, batch, iters, rng):
 
     m = DeltaMatcher(index, max_levels=4, out_slots=64, transfer_slots=16,
                      rebuild_after=256, rebuild_interval=0.2, background=True)
+
+    # same GC posture as the other configs (time_matcher does this): the
+    # built index must not be young-gen-scanned every 700 allocations
+    # while churn + rebuilds allocate heavily
+    from mqtt_tpu.utils.gctune import freeze_index, tune_for_throughput
+
+    tune_for_throughput()
+    freeze_index()
+
     stop = threading.Event()
     mutations = [0]
 
@@ -592,7 +617,12 @@ def main() -> None:
     headline = configs.get("2_1m_plus") or next(
         (c for c in configs.values() if "e2e_matches_per_sec" in c), None
     )
-    value = headline["e2e_matches_per_sec"] if headline else 0
+    # headline stays the full-path e2e rate (BASELINE.md's definition and
+    # comparable with prior BENCH_rNN.json); the transfer-free kernel rate
+    # — the on-chip capability this harness's tunneled link (RTT/bandwidth
+    # in "link") cannot express e2e — is surfaced alongside.
+    value = (headline or {}).get("e2e_matches_per_sec") or 0
+    kernel = (headline or {}).get("device_kernel_matches_per_sec") or 0
     print(
         json.dumps(
             {
@@ -600,6 +630,8 @@ def main() -> None:
                 "value": value,
                 "unit": "matches/s",
                 "vs_baseline": round(value / TARGET_MATCHES_PER_SEC, 4),
+                "device_kernel_matches_per_sec": kernel,
+                "kernel_vs_baseline": round(kernel / TARGET_MATCHES_PER_SEC, 4),
                 "link": link,
                 "configs": configs,
             }

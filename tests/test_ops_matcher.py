@@ -220,3 +220,39 @@ def test_window_above_meta_capacity_raises():
     index.subscribe("c", Subscription(filter="a/b"))
     with pytest.raises(ValueError):
         build_flat_index(index, window=MAX_WINDOW + 1)
+
+
+def test_duplicate_client_merge_matches_host_exactly_and_does_not_accumulate():
+    """One client matching a topic through several filters must merge
+    exactly like the host gather (max QoS, identifiers union, sticky
+    no_local) — and repeated matching must NOT accumulate state across
+    results (the expand_sids fast path copies per result; a shared
+    identifiers map would leak merge products between batches)."""
+    index = TopicsIndex()
+    index.subscribe("dup", Subscription(filter="m/x", qos=0, identifier=7))
+    index.subscribe("dup", Subscription(filter="m/+", qos=2, identifier=9, no_local=True))
+    index.subscribe("dup", Subscription(filter="m/#", qos=1))
+    index.subscribe("other", Subscription(filter="m/x", qos=1))
+    matcher = TpuMatcher(index, max_levels=4)
+    matcher.rebuild()
+
+    host = index.subscribers("m/x")
+    for attempt in range(3):  # identical every time: no accumulation
+        dev = matcher.subscribers("m/x")
+        assert set(dev.subscriptions) == {"dup", "other"}
+        d, h = dev.subscriptions["dup"], host.subscriptions["dup"]
+        assert (d.qos, d.no_local) == (h.qos, h.no_local) == (2, True)
+        assert {k: v for k, v in d.identifiers.items() if v > 0} == {
+            k: v for k, v in h.identifiers.items() if v > 0
+        } == {"m/x": 7, "m/+": 9}, attempt
+        o = dev.subscriptions["other"]
+        assert (o.qos, {k: v for k, v in o.identifiers.items() if v > 0}) == (1, {})
+        # result objects are fresh per match: mutating one must not bleed
+        d.qos = 99
+        d.identifiers["poison"] = 1
+        # (the stored trie copy keeps its own map only when it had one; the
+        # device result's map must at minimum not feed back into results)
+        nxt = matcher.subscribers("m/x").subscriptions["dup"]
+        assert nxt.qos == 2 and "poison" not in {
+            k for k, v in nxt.identifiers.items() if v > 0
+        }
