@@ -274,6 +274,8 @@ class Client:
         from .native import MAX_FRAMES_PER_SCAN, frame_scan, varint_decode
 
         caps = self.ops.options.capabilities
+        fast_eligible = self.ops.fast_publish_eligible
+        fast_publish = self.ops.fast_publish
         rbuf = bytearray()
         deferred: Optional[list] = None
         self.refresh_deadline(self.state.keepalive)
@@ -287,12 +289,28 @@ class Client:
             # account for and process every complete packet
             start = 0
             for f in frames:
+                fstart = start
+                fend = f.body_offset + f.remaining
+                self.ops.info.bytes_received += (f.body_offset - start) + f.remaining
+                start = fend
+                # QoS0 v4 PUBLISH passthrough (flags all zero): deliver the
+                # frame bytes without materializing a Packet when the
+                # server proves nothing can observe the difference. The
+                # session gate runs BEFORE any bytes are copied.
+                if (
+                    f.first_byte == 0x30
+                    and fast_publish is not None
+                    and fast_eligible(self)
+                ):
+                    frame = bytes(rbuf[fstart:fend])
+                    if fast_publish(self, frame, f.body_offset - fstart):
+                        continue
+                    body = frame[f.body_offset - fstart :]
+                else:
+                    body = bytes(rbuf[f.body_offset : fend])
                 fh = FixedHeader()
                 fh.decode(f.first_byte)
                 fh.remaining = f.remaining
-                body = bytes(rbuf[f.body_offset : f.body_offset + f.remaining])
-                self.ops.info.bytes_received += (f.body_offset - start) + f.remaining
-                start = f.body_offset + f.remaining
                 pk = self._decode_body(fh, body)
                 result = packet_handler(self, pk)
                 if asyncio.iscoroutine(result):

@@ -173,6 +173,8 @@ class Hooks:
         self.log = log or logging.getLogger("mqtt_tpu.hooks")
         self._lock = threading.Lock()
         self._hooks: list[Hook] = []
+        # bumped on every add; lets hot paths cache provides() verdicts
+        self.generation = 0
 
     def __len__(self) -> int:
         return len(self._hooks)
@@ -193,6 +195,7 @@ class Hooks:
                 raise RuntimeError(f"failed initialising {hook.id()} hook: {e}") from e
             # copy-on-write so dispatch iteration never sees a mid-append list
             self._hooks = self._hooks + [hook]
+            self.generation += 1
 
     def stop(self) -> None:
         for hook in self._hooks:

@@ -21,7 +21,11 @@ from . import packets as pkts
 from .clients import Client, Clients, ConnectionClosedError, Will
 from .hooks import (
     ON_PACKET_ENCODE,
+    ON_PACKET_PROCESSED,
+    ON_PACKET_READ,
     ON_PACKET_SENT,
+    ON_PUBLISH,
+    ON_PUBLISHED,
     STORED_CLIENTS,
     STORED_INFLIGHT_MESSAGES,
     STORED_RETAINED_MESSAGES,
@@ -230,13 +234,17 @@ class _FrameCache:
 
 
 class _Ops:
-    """Server values propagated to clients (server.go:159-164)."""
+    """Server values propagated to clients (server.go:159-164).
+    ``fast_publish`` is the server's QoS0 frame-passthrough entry point
+    (None until the server wires it)."""
 
     def __init__(self, options: Options, info: Info, hooks: Hooks, log: logging.Logger) -> None:
         self.options = options
         self.info = info
         self.hooks = hooks
         self.log = log
+        self.fast_publish = None
+        self.fast_publish_eligible = None
 
 
 class Server:
@@ -258,6 +266,11 @@ class Server:
         self._event_loop_task: Optional[asyncio.Task] = None
         self.inline_client: Optional[Client] = None
         self._ops = _Ops(opts, self.info, self.hooks, self.log)
+        self._ops.fast_publish = self.try_fast_publish
+        self._ops.fast_publish_eligible = self.fast_publish_eligible
+        self._fastpub_gate_gen = -1  # hooks generation the gate was cached at
+        self._fastpub_gate_ok = False
+        self._fastpub_plans: dict = {}  # topic -> (trie version, fan-out plan)
         self.matcher = None  # device matcher; None = host trie walk
         self._stage = None  # publish staging loop (started in serve())
         if opts.device_matcher:
@@ -959,6 +972,185 @@ class Server:
             if expiry > 0:
                 pk.expiry = pk.created + expiry
 
+    def fast_publish_eligible(self, cl: Client) -> bool:
+        """Session-level gate for the QoS0 passthrough, checked by the
+        read loop BEFORE it materializes the frame bytes: v4 network
+        client, no staging loop, quota headroom, and no hook that takes
+        the packet (the provides() scan is cached per hooks
+        generation)."""
+        if cl.net.inline or cl.properties.protocol_version != 4:
+            return False
+        if self._stage is not None or cl.state.inflight.receive_quota == 0:
+            return False
+        gen = self.hooks.generation
+        if gen != self._fastpub_gate_gen:
+            self._fastpub_gate_ok = not self.hooks.provides(
+                ON_PACKET_READ,
+                ON_PUBLISH,
+                ON_PACKET_ENCODE,
+                ON_PACKET_SENT,
+                ON_PUBLISHED,
+                ON_PACKET_PROCESSED,
+            )
+            self._fastpub_gate_gen = gen
+        return self._fastpub_gate_ok
+
+    @staticmethod
+    def _shared_frame_ok(props: "ClientProperties", sub: Subscription) -> bool:
+        """Target eligibility for shared-frame delivery (nothing forces a
+        per-subscriber rewrite of the encoded publish): no positive
+        subscription identifiers, no outbound aliasing, no size cap.
+
+        Used verbatim by publish_to_client's frame-cache branch.
+        try_fast_publish intentionally SPLITS the same predicate: the
+        subscription half (identifiers) is precomputed into the cached
+        fan-out plan, the session half (alias/size, plus its extra
+        version==4 requirement) re-checks at delivery because cids can
+        reconnect with different properties under a live plan. Keep all
+        three sites in sync when extending the rule."""
+        ids = sub.identifiers
+        return (
+            props.props.topic_alias_maximum == 0
+            and props.props.maximum_packet_size == 0
+            and not (ids and any(v > 0 for v in ids.values()))
+        )
+
+    def _enqueue_frame(self, tcl: Client, data: bytes, pk_source) -> bool:
+        """Queue a pre-encoded frame on a target's bounded outbound queue;
+        False = dropped (queue full) with the shared drop accounting.
+        ``pk_source()`` materializes the Packet for on_publish_dropped."""
+        try:
+            tcl.state.outbound.put_nowait(data)
+            tcl.state.outbound_qty += 1
+            return True
+        except asyncio.QueueFull:
+            self.info.messages_dropped += 1
+            self.hooks.on_publish_dropped(tcl, pk_source())
+            return False
+
+    def try_fast_publish(self, cl: Client, frame: bytes, body_offset: int) -> bool:
+        """QoS0 v4 PUBLISH frame passthrough — the data-plane fast path.
+
+        Delivers an inbound frame without materializing a ``Packet`` when
+        nothing can observe the difference (the same shape Go reaches with
+        cheap structs, server.go:857-1021). The caller guarantees first
+        byte 0x30 (qos/dup/retain all zero) and that
+        ``fast_publish_eligible`` held; this method adds the topic gates —
+        plain non-``$`` topic, byte rules kept a strict superset of
+        ``is_valid_filter``'s publish rejections (see the cross-reference
+        there) — and requires no shared/inline subscribers. The v4 QoS0
+        frame is version- and property-free, so inbound bytes equal
+        outbound bytes for every shared-frame-eligible target.
+
+        Returns True when fully handled (including an ACL-denied silent
+        drop); False defers to the decode path, which owns all error and
+        edge-case semantics. Stats mirror ``_decode_body`` +
+        ``process_publish``.
+        """
+        body_len = len(frame) - body_offset
+        if body_len < 2:
+            return False
+        # the frame is relayed VERBATIM, so its remaining-length varint
+        # must be minimally encoded (a padded varint like 0x85 0x00 is
+        # tolerated by the scanner, but the decode path would re-encode
+        # it minimally — an observable difference for strict subscribers)
+        if body_offset - 1 != (
+            1 if body_len < 128 else 2 if body_len < 16384 else 3 if body_len < 2097152 else 4
+        ):
+            return False
+        tl = (frame[body_offset] << 8) | frame[body_offset + 1]
+        t0 = body_offset + 2
+        end = t0 + tl
+        if tl == 0 or len(frame) < end:
+            return False  # empty/truncated topic: decode path raises
+        raw = frame[t0:end]
+        if b"+" in raw or b"#" in raw or b"\x00" in raw or raw[:1] == b"$":
+            return False  # wildcard/$-topic rules live in the slow path
+        try:
+            topic = raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return False
+
+        # fan-out plan, cached per (topic, trie version): the walk and the
+        # per-subscription identifier scan re-run only after a mutation
+        version = self.topics.version
+        cached = self._fastpub_plans.get(topic)
+        if cached is not None and cached[0] == version:
+            plan = cached[1]
+        else:
+            subscribers = self.topics.subscribers(topic)
+            if subscribers.shared or subscribers.inline_subscriptions:
+                # negative-cache: shared/inline topics always take the
+                # decode path; don't re-walk here on every publish
+                if len(self._fastpub_plans) >= 4096:
+                    self._fastpub_plans.clear()
+                self._fastpub_plans[topic] = (version, None)
+                return False
+            plan = [
+                # frame-shareable iff nothing in the SUBSCRIPTION forces a
+                # rewrite; the per-SESSION half (version/alias/size) is
+                # re-verified at delivery, since cids can reconnect with
+                # different properties under the same plan
+                (cid, sub, not (sub.identifiers and any(v > 0 for v in sub.identifiers.values())), sub.no_local)
+                for cid, sub in subscribers.subscriptions.items()
+            ]
+            if len(self._fastpub_plans) >= 4096:
+                self._fastpub_plans.clear()
+            self._fastpub_plans[topic] = (version, plan)
+        if plan is None:
+            return False
+
+        self.info.packets_received += 1
+        self.info.messages_received += 1
+        if not self.hooks.on_acl_check(cl, topic, True):
+            return True  # QoS0 deny is a silent drop (server.go:879-881)
+
+        pk = None  # decoded lazily, once, for per-target slow paths
+
+        def pk_source() -> Packet:
+            nonlocal pk
+            if pk is None:
+                pk = self._decode_fast_frame(cl, frame[body_offset:])
+            return pk
+
+        origin = cl.id
+        clients_get = self.clients.get
+        on_acl = self.hooks.on_acl_check
+        for cid, sub, shareable, no_local in plan:
+            tcl = clients_get(cid)
+            if tcl is None or (no_local and cid == origin):
+                continue  # [MQTT-3.8.3-3]
+            props = tcl.properties
+            if (
+                shareable
+                and props.protocol_version == 4
+                and props.props.topic_alias_maximum == 0
+                and props.props.maximum_packet_size == 0
+            ):
+                if not on_acl(tcl, topic, False):
+                    continue
+                if tcl.net.writer is None or tcl.closed:
+                    continue
+                self._enqueue_frame(tcl, frame, pk_source)
+                continue
+            # v5 target / identifiers / alias / size cap: full per-sub path
+            try:
+                self.publish_to_client(tcl, sub, pk_source())
+            except Exception as e:
+                self.log.debug("failed publishing packet: error=%s client=%s", e, cid)
+        return True
+
+    def _decode_fast_frame(self, cl: Client, body: bytes) -> Packet:
+        """Materialize the Packet for a fast-path frame that met a
+        per-target slow case, stamped exactly like process_publish."""
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH), protocol_version=4
+        )
+        pk.publish_decode(body)
+        pk.origin = cl.id
+        self._stamp_publish_expiry(pk)
+        return pk
+
     def _fan_out(self, pk: Packet, subscribers) -> None:
         """Deliver one matched publish: shared-group selection, inline
         handlers, per-subscriber delivery (server.go:1000-1021)."""
@@ -1000,14 +1192,9 @@ class Server:
         if sub.no_local and pk.origin == cl.id:
             return pk  # [MQTT-3.8.3-3]
 
-        if (
-            fast is not None
-            # zero-valued identifiers never reach the wire (properties.py
-            # encodes only v > 0), so they don't disqualify the shared frame
-            and not any(v > 0 for v in sub.identifiers.values())
-            and cl.properties.props.topic_alias_maximum == 0
-            and cl.properties.props.maximum_packet_size == 0
-        ):
+        # zero-valued identifiers never reach the wire (properties.py
+        # encodes only v > 0), so they don't disqualify the shared frame
+        if fast is not None and self._shared_frame_ok(cl.properties, sub):
             if not self.hooks.on_acl_check(cl, pk.topic_name, False):
                 raise ERR_NOT_AUTHORIZED()
             retain = pk.fixed_header.retain and (
@@ -1017,13 +1204,8 @@ class Server:
             data = fast.get(cl.properties.protocol_version, retain)
             if cl.net.writer is None or cl.closed:
                 raise CODE_DISCONNECT()
-            try:
-                cl.state.outbound.put_nowait(data)
-                cl.state.outbound_qty += 1
-            except asyncio.QueueFull:
-                self.info.messages_dropped += 1
-                self.hooks.on_publish_dropped(cl, pk)
-                raise ERR_PENDING_CLIENT_WRITES_EXCEEDED() from None
+            if not self._enqueue_frame(cl, data, lambda: pk):
+                raise ERR_PENDING_CLIENT_WRITES_EXCEEDED()
             return pk
 
         out = pk.copy(False)

@@ -71,7 +71,14 @@ def is_shared_filter(filter: str) -> bool:
 
 def is_valid_filter(filter: str, for_publish: bool = False) -> bool:
     """Validate a topic filter (or topic name when ``for_publish``);
-    reference topics.go:707-745."""
+    reference topics.go:707-745.
+
+    COUPLING NOTE: ``Server.try_fast_publish`` (server.py) short-circuits
+    QoS0 v4 publishes using raw-byte gates that must remain a strict
+    SUPERSET of this function's ``for_publish`` rejections (it defers all
+    ``$``-prefixed, wildcard, NUL, and empty topics to the decode path).
+    If a new publish-topic rejection is added here whose topics would
+    still pass those byte gates, extend the fast-path gates too."""
     if not for_publish and len(filter) == 0:
         return False  # [MQTT-4.7.3-1]
     if for_publish:

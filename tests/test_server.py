@@ -2421,3 +2421,176 @@ class TestReferenceScenarioParity:
             await h.shutdown()
 
         run(scenario())
+
+
+class TestFastPublishPassthrough:
+    """The QoS0 v4 frame passthrough must be byte- and counter-identical
+    to the decode path, and must defer every case it cannot prove."""
+
+    async def _roundtrip(self, h, extra_hook=None):
+        if extra_hook is not None:
+            h.server.add_hook(extra_hook)
+        sr, sw, _ = await h.connect("fsub")
+        sw.write(sub_packet(1, [Subscription(filter="fp/+", qos=0)]))
+        await sw.drain()
+        await read_wire_packet(sr)
+        pr, pw, _ = await h.connect("fpub")
+        frames = []
+        for i in range(5):
+            pw.write(pub_packet(f"fp/{i}", f"payload-{i}".encode()))
+        await pw.drain()
+        for i in range(5):
+            pk = await read_wire_packet(sr)
+            frames.append((pk.topic_name, bytes(pk.payload), pk.fixed_header.retain))
+        stats = (h.server.info.messages_received, h.server.info.messages_sent)
+        return frames, stats
+
+    def test_fast_and_slow_paths_deliver_identical_bytes_and_counters(self):
+        async def scenario():
+            fast_h = Harness()
+            fast_frames, fast_stats = await self._roundtrip(fast_h)
+            await fast_h.shutdown()
+
+            class SlowForcer(Hook):
+                """Providing ON_PUBLISH disables the passthrough."""
+
+                def id(self):
+                    return "slow-forcer"
+
+                def provides(self, b):
+                    return b == ON_PUBLISH
+
+                def on_publish(self, cl, pk):
+                    return pk
+
+            slow_h = Harness()
+            slow_frames, slow_stats = await self._roundtrip(slow_h, SlowForcer())
+            await slow_h.shutdown()
+
+            assert fast_frames == slow_frames
+            assert fast_stats == slow_stats
+            await asyncio.sleep(0)
+
+        run(scenario())
+
+    def test_mixed_version_targets_fast_v4_slow_v5(self):
+        async def scenario():
+            h = Harness()
+            r4, w4, _ = await h.connect("v4t")
+            w4.write(sub_packet(1, [Subscription(filter="mx/#", qos=0)]))
+            await w4.drain()
+            await read_wire_packet(r4)
+            r5, w5, _ = await h.connect("v5t", version=5)
+            w5.write(sub_packet(1, [Subscription(filter="mx/#", qos=0)], version=5))
+            await w5.drain()
+            await read_wire_packet(r5, 5)
+            pr, pw, _ = await h.connect("mixpub")
+            pw.write(pub_packet("mx/a", b"both"))
+            await pw.drain()
+            pk4 = await read_wire_packet(r4)
+            pk5 = await read_wire_packet(r5, 5)
+            assert bytes(pk4.payload) == bytes(pk5.payload) == b"both"
+            assert pk4.topic_name == pk5.topic_name == "mx/a"
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_no_local_suppressed_on_fast_path(self):
+        async def scenario():
+            h = Harness()
+            # a v5 session subscribes with no_local, then is taken over by
+            # a v4 connection (subscriptions inherited): the v4 publisher
+            # IS eligible for the passthrough, so the no_local origin
+            # check must fire inside the fast dispatcher itself
+            r5, w5, _ = await h.connect("selfpub", version=5, clean=False)
+            w5.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="nl/#", qos=0, no_local=True)],
+                    version=5,
+                )
+            )
+            await w5.drain()
+            await read_wire_packet(r5, 5)
+            r, w, _ = await h.connect("selfpub", version=4, clean=False)
+            assert h.server.topics.subscribers("nl/x").subscriptions  # inherited
+            w.write(pub_packet("nl/x", b"echo"))
+            w.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await w.drain()
+            nxt = await read_wire_packet(r)
+            assert nxt.fixed_header.type == PINGRESP  # no echo delivered
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_acl_denied_fast_publish_drops_silently(self):
+        async def scenario():
+            h = Harness(allow=False)  # OR-auth: AllowHook would override
+
+            class DenyPub(Hook):
+                def id(self):
+                    return "deny-pub"
+
+                def provides(self, b):
+                    return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+                def on_connect_authenticate(self, cl, pk):
+                    return True
+
+                def on_acl_check(self, cl, topic, write):
+                    return not (write and topic.startswith("secret/"))
+
+            h.server.add_hook(DenyPub())
+            sr, sw, _ = await h.connect("aclsub")
+            sw.write(sub_packet(1, [Subscription(filter="#", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+            pr, pw, _ = await h.connect("aclpub")
+            pw.write(pub_packet("secret/x", b"no"))
+            pw.write(pub_packet("open/x", b"yes"))
+            pw.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await pw.drain()
+            assert (await read_wire_packet(pr)).fixed_header.type == PINGRESP
+            out = await read_wire_packet(sr)
+            assert out.topic_name == "open/x"  # denied topic never arrived
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_wildcard_and_dollar_topics_defer_to_slow_path(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("oddpub")
+            # publishing to a wildcard topic surfaces through the decode
+            # path (the passthrough must defer it), which for v4 drops
+            # the connection without a reply
+            w.write(pub_packet("bad/+/topic", b"x"))
+            await w.drain()
+            data = await asyncio.wait_for(r.read(16), TIMEOUT)
+            assert data == b""  # connection closed by the broker
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_padded_varint_publish_defers_to_decode_path(self):
+        """A non-minimal remaining-length varint must NOT be relayed
+        verbatim: the decode path re-encodes the frame minimally."""
+
+        async def scenario():
+            h = Harness()
+            sr, sw, _ = await h.connect("vsub")
+            sw.write(sub_packet(1, [Subscription(filter="pv/#", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+            pr, pw, _ = await h.connect("vpub")
+            body = b"\x00\x04pv/a" + b"x"
+            pw.write(bytes([0x30, 0x80 | len(body), 0x00]) + body)
+            await pw.drain()
+            raw_first = await asyncio.wait_for(sr.readexactly(2), TIMEOUT)
+            assert raw_first[1] == len(body)  # minimal single-byte varint
+            rest = await asyncio.wait_for(sr.readexactly(raw_first[1]), TIMEOUT)
+            pk = decode_packet(bytes(raw_first + rest), 4)
+            assert pk.topic_name == "pv/a" and bytes(pk.payload) == b"x"
+            await h.shutdown()
+
+        run(scenario())
