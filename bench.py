@@ -470,6 +470,7 @@ def run_cfg5(n_subs, batch, iters, rng):
             "host_fallback_ratio": round(fallbacks / max(1, n_topics), 5),
             "pending_deltas_at_end": m.pending_deltas,
             "snapshot_rebuilds": m.stats.rebuilds,
+            "snapshot_folds": m.stats.folds,
         }
     finally:
         stop.set()

@@ -49,7 +49,7 @@ from typing import Any
 
 import numpy as np
 
-from ..topics import TopicsIndex
+from ..topics import SHARE_PREFIX, TopicsIndex
 from .hashing import hash_token
 
 KIND_CLIENT = 0  # a normal client subscription
@@ -124,6 +124,7 @@ class FlatIndex:
     n_subs: int = 0  # actual subscriptions indexed (sid space is larger)
     n_sat: int = 0  # build-saturated buckets (probes host-route)
     n_spill: int = 0  # entries with more ids than the window (host-route)
+    n_orphans: int = 0  # sid windows abandoned by in-place folds
 
     @property
     def num_nodes(self) -> int:
@@ -137,6 +138,239 @@ class FlatIndex:
     @property
     def num_patterns(self) -> int:
         return int(self.pat_depth.shape[0])
+
+    # -- incremental fold --------------------------------------------------
+
+    def fold(self, index: TopicsIndex, filters) -> "Optional[tuple[list, bool]]":
+        """Apply subscription mutations for ``filters`` to this instance
+        and return ``(bucket_updates, pats_changed)`` — the device-side
+        scatter payload — or ``None`` when only a full rebuild can absorb
+        them.
+
+        MUST be called on a copy-on-write clone (TpuMatcher.fold builds
+        one via ``dataclasses.replace`` + ``subs.clone_for_fold()``), never
+        on the instance in-flight resolvers captured: a resolver issued
+        generations ago may decode sids for a filter mutated only later —
+        its generation's overlay does not host-route that filter, so it
+        must keep seeing the snapshot from its own issue time. The np
+        ``table``/pat arrays ARE shared with the live instance and
+        mutated in place — safe because resolvers never read them (device
+        arrays are swapped functionally) — which is also why an aborted
+        fold poisons folding until a full rebuild rebuilds them fresh
+        (TpuMatcher.fold).
+
+        This is the churn path: a full rebuild of a large index costs
+        seconds of host build plus a full-table H2D upload, while a fold
+        touches one bucket row per distinct filter path (~KB).
+
+        Full-rebuild (``None``) cases: a new wildcard SHAPE with no free
+        pad slot in the pattern arrays, a token hashing to the ``+``
+        sentinel pair under the current salt, a torn trie read that
+        persists across retries, or degradation beyond the compaction
+        thresholds (orphaned sid windows, fold-saturated buckets).
+        Residual risk: a new filter whose 64-bit path key collides with a
+        different live filter folds into the wrong entry (p ~ 2^-64 x n;
+        the same order as the kernel's own topic-key match); the periodic
+        full rebuild re-checks uniqueness and re-salts.
+        """
+        from .hashing import tokenize_topics
+
+        S = self.table.shape[0]
+        tbl = self.table.reshape(S, BUCKET_ENTRIES, ENTRY_INTS)
+        # compaction threshold: stop folding once orphaned sid windows
+        # exceed a quarter of the sid space — with an absolute floor so
+        # small indexes (where a full rebuild is cheap anyway, but also
+        # where every unsubscribe is a large fraction) never thrash
+        if self.n_orphans * self.window > max(4096, len(self.subs) // 4):
+            return None
+
+        seen_paths = set()
+        touched: set = set()
+        pats_changed = False
+        empty_snap = ((), (), ())
+
+        for f in filters:
+            parts = f.split("/")
+            if parts and parts[0].upper() == SHARE_PREFIX:
+                parts = parts[2:]
+            key = tuple(parts)
+            if key in seen_paths:
+                continue
+            seen_paths.add(key)
+            is_hash = bool(parts) and parts[-1] == "#"
+            levels = parts[:-1] if is_hash else parts
+            depth = len(levels)
+            if depth > self.max_levels:
+                continue  # over-deep: host-routed by length, never indexed
+
+            # path key under the current salt (mirrors build_flat_index)
+            mask = 0
+            for d, tok in enumerate(levels):
+                if tok == "+":
+                    mask |= 1 << d
+            tok1, tok2, _l, _dl, _ov = tokenize_topics(
+                ["/".join(levels)], self.max_levels, self.salt
+            )
+            kind = KIND_HASH if is_hash else KIND_EXACT
+            with np.errstate(over="ignore"):
+                h1 = np.uint32(depth) * np.uint32(_M2) ^ np.uint32(kind)
+                h2 = np.uint32(depth) * np.uint32(_M1) ^ np.uint32(kind)
+                for d in range(depth):
+                    if (mask >> d) & 1:
+                        t1, t2 = np.uint32(PLUS1), np.uint32(PLUS2)
+                    else:
+                        t1, t2 = tok1[0, d], tok2[0, d]
+                        if t1 == PLUS1 and t2 == PLUS2:
+                            return None  # sentinel collision: needs a re-salt
+                    h1 = _mix_np(h1, t1)
+                    h2 = _mix_np(h2, t2)
+            h1 = np.uint32(h1)
+            h2 = np.uint32(h2)
+
+            # live node snapshot (torn reads retried like the full walk)
+            share_rooted = f.split("/")[0].upper() == SHARE_PREFIX
+            snap = None
+            for _attempt in range(8):
+                try:
+                    node = index._seek(f, 2 if share_rooted else 0)
+                    if node is None:
+                        snap = empty_snap
+                    else:
+                        cli = tuple(node.subscriptions.internal.items())
+                        shr = (
+                            tuple(
+                                (c, s)
+                                for group in node.shared.internal.values()
+                                for c, s in group.items()
+                            )
+                            if node.shared.internal
+                            else ()
+                        )
+                        inl = tuple(node.inline_subscriptions.internal.values())
+                        snap = (cli, shr, inl)
+                    break
+                except (RuntimeError, KeyError):
+                    continue
+            if snap is None:
+                return None  # persistent tear: let the full rebuild quiesce
+            n_cli, n_shr, n_inl = len(snap[0]), len(snap[1]), len(snap[2])
+            total = n_cli + n_shr + n_inl
+
+            slot = int(h1 & np.uint32(S - 1))
+            row = tbl[slot]
+            if (int(row[0, 2]) >> _SAT_SHIFT) & 1:
+                continue  # saturated bucket: already fully host-routed
+            found = -1
+            free = -1
+            for e in range(BUCKET_ENTRIES):
+                if row[e, 0] == h1 and row[e, 1] == h2 and row[e].any():
+                    found = e
+                    break
+                if free < 0 and not row[e].any():
+                    free = e
+
+            top_wild = bool(parts) and parts[0] in ("+", "#")
+            last_plus = is_hash and depth > 0 and ((mask >> (depth - 1)) & 1) == 1
+            spill_new = (
+                total > self.window
+                or (n_cli + n_shr) > MAX_WINDOW
+                or n_inl > MAX_WINDOW
+            )
+
+            def meta_word(ncli, nreg, ninl, spill):
+                return np.uint32(
+                    (ncli << _NCLI_SHIFT)
+                    | (nreg << _NREG_SHIFT)
+                    | (ninl << _NINL_SHIFT)
+                    | (int(top_wild) << _TOPWILD_SHIFT)
+                    | (int(last_plus) << _LASTPLUS_SHIFT)
+                    | (int(spill) << _SPILL_SHIFT)
+                )
+
+            if found >= 0:
+                old_meta = int(row[found, 2])
+                old_spill = bool((old_meta >> _SPILL_SHIFT) & 1)
+                cnt_mask = (1 << _CNT_BITS) - 1
+                # spilled entries carry zeroed counts, so this is 0 for them
+                self.n_subs -= ((old_meta >> _NREG_SHIFT) & cnt_mask) + (
+                    (old_meta >> _NINL_SHIFT) & cnt_mask
+                )
+                if not spill_new:
+                    self.n_subs += total
+                if total == 0:
+                    if not old_spill:
+                        self.subs.replace(int(row[found, 3]) // self.window, empty_snap)
+                        self.n_orphans += 1
+                    else:
+                        self.n_spill -= 1
+                    row[found] = 0
+                    self.n_entries -= 1
+                elif spill_new:
+                    if not old_spill:
+                        self.subs.replace(int(row[found, 3]) // self.window, empty_snap)
+                        self.n_orphans += 1
+                        self.n_spill += 1
+                    row[found, 2] = meta_word(0, 0, 0, True)
+                    row[found, 3] = 0
+                else:
+                    if old_spill:
+                        ordinal = self.subs.append(snap)
+                        self.n_spill -= 1
+                    else:
+                        ordinal = int(row[found, 3]) // self.window
+                        self.subs.replace(ordinal, snap)
+                    row[found, 2] = meta_word(n_cli, n_cli + n_shr, n_inl, False)
+                    row[found, 3] = np.uint32(ordinal * self.window)
+                touched.add(slot)
+            else:
+                if total == 0:
+                    continue  # deleted before we ever indexed it
+                if free < 0:
+                    # fold-time saturation would orphan the bucket's OTHER
+                    # entries — filters that are NOT in the delta overlay,
+                    # so in-flight batches could still decode their sids
+                    # against emptied snapshots. Only the full rebuild
+                    # (which swaps a fresh FlatIndex wholesale, leaving
+                    # captured snapshots intact) can absorb this safely.
+                    return None
+                # the shape must already be compiled (or claim a pad slot)
+                shape_ok = False
+                pad_free = -1
+                for p in range(len(self.pat_depth)):
+                    if (
+                        self.pat_kind[p] == np.uint32(kind)
+                        and self.pat_depth[p] == depth
+                        and self.pat_mask[p] == np.uint32(mask)
+                    ):
+                        shape_ok = True
+                        break
+                    if pad_free < 0 and self.pat_depth[p] < 0:
+                        pad_free = p
+                if not shape_ok:
+                    if pad_free < 0:
+                        return None  # pads exhausted: recompile needed
+                    self.pat_kind[pad_free] = np.uint32(kind)
+                    self.pat_depth[pad_free] = np.int32(depth)
+                    self.pat_mask[pad_free] = np.uint32(mask)
+                    pats_changed = True
+                if spill_new:
+                    row[free] = (h1, h2, meta_word(0, 0, 0, True), 0)
+                    self.n_spill += 1
+                else:
+                    ordinal = self.subs.append(snap)
+                    row[free] = (
+                        h1,
+                        h2,
+                        meta_word(n_cli, n_cli + n_shr, n_inl, False),
+                        np.uint32(ordinal * self.window),
+                    )
+                    self.n_subs += total
+                self.n_entries += 1
+                touched.add(slot)
+
+        flat_rows = self.table  # [S, ROW_INTS] view of the same buffer
+        updates = [(s, flat_rows[s].copy()) for s in sorted(touched)]
+        return updates, pats_changed
 
 
 def _mix_np(h: np.ndarray, t: np.ndarray) -> np.ndarray:
@@ -178,6 +412,30 @@ class _LazySubTable:
             entry = SubEntry(KIND_INLINE, "", "", inl[local - len(cli) - len(shr)])
         self.memo[sid] = entry
         return entry
+
+    # -- fold support (FlatIndex.fold) ------------------------------------
+
+    def clone_for_fold(self) -> "_LazySubTable":
+        """A copy-on-write clone for one fold: the snaps list is copied
+        (refs only) so in-flight resolvers that captured THIS table keep
+        their snapshot untouched; the memo starts empty (hot sids
+        re-materialize in one batch). The clone is what fold mutates."""
+        return _LazySubTable(self._window, list(self._snaps), self._n)
+
+    def replace(self, ordinal: int, snap) -> None:
+        """Swap one entry's snapshot (only ever called on a fold clone)."""
+        self._snaps[ordinal] = snap
+        w = self._window
+        memo_pop = self.memo.pop
+        for sid in range(ordinal * w, ordinal * w + w):
+            memo_pop(sid, None)
+
+    def append(self, snap) -> int:
+        """Allocate a fresh ordinal for a new entry (fold clones only)."""
+        self._snaps.append(snap)
+        ordinal = len(self._snaps) - 1
+        self._n += self._window
+        return ordinal
 
 
 def _walk_terminals(index: TopicsIndex):
@@ -574,9 +832,11 @@ def _jit_core():
 
 class _LazyJit:
     """Defer the jax.jit wrapping until first call (keeps `import
-    mqtt_tpu.ops` light and CPU-only test processes fast)."""
+    mqtt_tpu.ops` light and CPU-only test processes fast). ``builder``
+    returns the jitted callable."""
 
-    def __init__(self):
+    def __init__(self, builder):
+        self._builder = builder
         self._fn = None
         self._lock = threading.Lock()
 
@@ -584,11 +844,11 @@ class _LazyJit:
         if self._fn is None:
             with self._lock:
                 if self._fn is None:
-                    self._fn = _jit_core()
+                    self._fn = self._builder()
         return self._fn(*args, **kwargs)
 
 
-flat_match = _LazyJit()
+flat_match = _LazyJit(_jit_core)
 
 
 def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
@@ -657,22 +917,30 @@ def _packed_core(
     )
 
 
-class _LazyJitPacked(_LazyJit):
-    def __call__(self, *args, **kwargs):
-        if self._fn is None:
-            with self._lock:
-                if self._fn is None:
-                    import jax
-
-                    self._fn = partial(
-                        jax.jit,
-                        static_argnames=(
-                            "max_levels",
-                            "out_slots",
-                            "transfer_slots",
-                        ),
-                    )(_packed_core)
-        return self._fn(*args, **kwargs)
+def _scatter_core(table, idx, rows):
+    """Functional bucket-row scatter: the fold's device-side update. The
+    caller pads ``idx``/``rows`` to a power-of-two length by repeating the
+    last pair — duplicate indices write identical rows, so the update
+    order XLA picks is immaterial."""
+    return table.at[idx].set(rows)
 
 
-flat_match_packed = _LazyJitPacked()
+def _jit_scatter():
+    import jax
+
+    return jax.jit(_scatter_core, donate_argnums=())
+
+
+scatter_rows = _LazyJit(_jit_scatter)
+
+
+def _jit_packed():
+    import jax
+
+    return partial(
+        jax.jit,
+        static_argnames=("max_levels", "out_slots", "transfer_slots"),
+    )(_packed_core)
+
+
+flat_match_packed = _LazyJit(_jit_packed)

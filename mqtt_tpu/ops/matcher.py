@@ -114,6 +114,7 @@ class MatcherStats:
     overflows: int = 0
     rebuilds: int = 0
     rebuild_seconds: float = 0.0
+    folds: int = 0  # incremental folds that avoided a full rebuild
 
     def as_dict(self) -> dict:
         out = {
@@ -123,6 +124,7 @@ class MatcherStats:
             "overflows": self.overflows,
             "rebuilds": self.rebuilds,
             "rebuild_seconds": round(self.rebuild_seconds, 3),
+            "folds": self.folds,
         }
         out["fallback_ratio"] = (
             round(self.host_fallbacks / self.topics, 6) if self.topics else 0.0
@@ -169,6 +171,9 @@ class TpuMatcher:
         # atomically by rebuild() so a concurrent match never mixes
         # arrays and salt from different generations
         self._state: Optional[tuple] = None
+        # True while the np table may diverge from the device table (an
+        # aborted fold); only a full rebuild clears it
+        self._fold_poisoned = False
 
     # -- index lifecycle ---------------------------------------------------
 
@@ -196,8 +201,67 @@ class TpuMatcher:
             )
         )
         self._state = (flat, device_arrays, version)
+        self._fold_poisoned = False
         self.stats.rebuilds += 1
         self.stats.rebuild_seconds += time.perf_counter() - t0
+
+    def fold(self, filters) -> bool:
+        """Incrementally fold mutations for ``filters`` into the compiled
+        index: copy-on-write host edits plus a bucket-row scatter on
+        device (~KB uploaded) instead of a seconds-long full rebuild +
+        table upload. Returns False when a full rebuild is required
+        (FlatIndex.fold documents the cases).
+
+        Concurrency: the fold mutates a CLONE of the sub table and swaps
+        a new FlatIndex, so resolvers that captured earlier state — even
+        ones issued generations before the mutation being folded — keep
+        decoding against their own snapshots. The np bucket table is
+        shared and edited in place (resolvers never read it); an aborted
+        fold leaves it diverged from the device table, so folding poisons
+        itself until the full rebuild that MUST follow a False return has
+        rebuilt both from scratch."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from .flat import scatter_rows
+
+        st = self._state
+        if st is None or self._fold_poisoned:
+            return False
+        flat, arrays, _ = st
+        t0 = time.perf_counter()
+        version = self.topics.version
+        flat = dataclasses.replace(flat, subs=flat.subs.clone_for_fold())
+        self._fold_poisoned = True  # cleared on success or by rebuild()
+        res = flat.fold(self.topics, filters)
+        if res is None:
+            return False
+        updates, pats_changed = res
+        new_table = arrays[0]
+        if updates:
+            k = _bucket(len(updates), minimum=8)
+            idx = np.full(k, updates[-1][0], dtype=np.int32)
+            rows = np.tile(updates[-1][1], (k, 1))
+            for i, (s, r) in enumerate(updates):
+                idx[i] = s
+                rows[i] = r
+            new_table = scatter_rows(
+                arrays[0], jnp.asarray(idx), jnp.asarray(rows)
+            )
+        new_pats = (
+            tuple(
+                jnp.asarray(a)
+                for a in (flat.pat_kind, flat.pat_depth, flat.pat_mask)
+            )
+            if pats_changed
+            else arrays[1:]
+        )
+        self._state = (flat, (new_table, *new_pats), version)
+        self._fold_poisoned = False
+        self.stats.folds += 1
+        self.stats.rebuild_seconds += time.perf_counter() - t0
+        return True
 
     @property
     def csr(self) -> Optional[FlatIndex]:

@@ -191,3 +191,92 @@ def test_server_option_wires_delta_matcher():
 
     got = asyncio.run(run())
     assert got == [b"hello"]
+
+
+def test_incremental_fold_parity_over_many_rounds():
+    """Folds (in-place bucket edits + device scatter) must keep the
+    snapshot bit-identical to a from-scratch rebuild across adds,
+    removals, spill transitions, and brand-new wildcard shapes."""
+    rng = random.Random(11)
+    v = [f"t{i}" for i in range(12)]
+    index = TopicsIndex()
+    for i in range(400):
+        parts = [rng.choice(v), rng.choice(v), rng.choice(v)]
+        if rng.random() < 0.2:
+            parts[rng.randrange(3)] = "+"
+        index.subscribe(f"c{i}", Subscription(filter="/".join(parts), qos=i % 3))
+    m = DeltaMatcher(index, background=False, max_levels=4)
+    base_rebuilds = m.stats.rebuilds
+    live = 400
+
+    def check(tag):
+        topics = ["/".join([rng.choice(v)] * 3) for _ in range(48)] + [
+            f"{rng.choice(v)}/{rng.choice(v)}/{rng.choice(v)}" for _ in range(48)
+        ]
+        for t in topics:
+            assert canon(m.subscribers(t)) == canon(index.subscribers(t)), (tag, t)
+
+    for round_ in range(6):
+        # adds (some to existing paths, some new paths)
+        for i in range(40):
+            parts = [rng.choice(v), rng.choice(v), rng.choice(v)]
+            if rng.random() < 0.3:
+                parts[rng.randrange(3)] = "+"
+            index.subscribe(f"n{live}", Subscription(filter="/".join(parts), qos=1))
+            live += 1
+        # removals
+        for i in range(20):
+            index.unsubscribe(
+                "/".join([rng.choice(v), rng.choice(v), rng.choice(v)]),
+                f"c{rng.randrange(400)}",
+            )
+        m.flush()
+        assert m.pending_deltas == 0
+        check(round_)
+    # folds actually ran (the whole point): no full rebuild after the first
+    assert m.stats.folds >= 5, m.stats.as_dict()
+    assert m.stats.rebuilds == base_rebuilds, m.stats.as_dict()
+
+
+def test_fold_new_wildcard_shape_claims_pad_slot():
+    index = TopicsIndex()
+    index.subscribe("a", Subscription(filter="x/y", qos=0))
+    m = DeltaMatcher(index, background=False, max_levels=4)
+    r0 = m.stats.rebuilds
+    # a shape that did not exist at build time: depth-3 with '+' at level 1
+    index.subscribe("b", Subscription(filter="x/+/z", qos=1))
+    m.flush()
+    assert canon(m.subscribers("x/q/z")) == canon(index.subscribers("x/q/z"))
+    assert m.stats.folds >= 1
+    assert m.stats.rebuilds == r0  # pad slot claimed, no recompile-rebuild
+
+
+def test_fold_spill_and_unspill_transitions():
+    index = TopicsIndex()
+    index.subscribe("seed", Subscription(filter="s/t", qos=0))
+    m = DeltaMatcher(index, background=False, max_levels=4, window=16)
+    # spill: push one path over the window
+    for i in range(40):
+        index.subscribe(f"sp{i}", Subscription(filter="s/t", qos=0))
+    m.flush()
+    assert canon(m.subscribers("s/t")) == canon(index.subscribers("s/t"))
+    # unspill: back under the window
+    for i in range(40):
+        index.unsubscribe("s/t", f"sp{i}")
+    m.flush()
+    assert canon(m.subscribers("s/t")) == canon(index.subscribers("s/t"))
+    assert m.stats.folds >= 2, m.stats.as_dict()
+
+
+def test_fold_empty_then_resubscribe_path():
+    index = TopicsIndex()
+    index.subscribe("a", Subscription(filter="e/1", qos=0))
+    index.subscribe("b", Subscription(filter="e/2", qos=0))
+    m = DeltaMatcher(index, background=False, max_levels=4)
+    index.unsubscribe("e/1", "a")
+    m.flush()
+    assert canon(m.subscribers("e/1")) == canon(index.subscribers("e/1"))
+    index.subscribe("c", Subscription(filter="e/1", qos=2))
+    m.flush()
+    assert canon(m.subscribers("e/1")) == canon(index.subscribers("e/1"))
+    assert list(m.subscribers("e/1").subscriptions) == ["c"]
