@@ -2594,3 +2594,97 @@ class TestFastPublishPassthrough:
             await h.shutdown()
 
         run(scenario())
+
+
+class TestMoreReferenceScenarios:
+    def test_on_publish_reject_packet_silently_ignores(self):
+        # server_test.go TestServerProcessPublishOnMessageRecvRejected:
+        # ErrRejectPacket from on_publish drops the message with no error
+        async def scenario():
+            h = Harness()
+
+            class Rejecter(Hook):
+                def id(self):
+                    return "rejector"
+
+                def provides(self, b):
+                    return b == ON_PUBLISH
+
+                def on_publish(self, cl, pk):
+                    if pk.topic_name.startswith("reject/"):
+                        raise codes.ERR_REJECT_PACKET()
+                    return pk
+
+            h.server.add_hook(Rejecter())
+            sr, sw, _ = await h.connect("rsub")
+            sw.write(sub_packet(1, [Subscription(filter="#", qos=0)]))
+            await sw.drain()
+            await read_wire_packet(sr)
+            pr, pw, _ = await h.connect("rpub")
+            pw.write(pub_packet("reject/x", b"no"))
+            pw.write(pub_packet("pass/x", b"yes"))
+            pw.write(encode_packet(Packet(fixed_header=FixedHeader(type=PINGREQ))))
+            await pw.drain()
+            # publisher not disconnected (silent drop)
+            assert (await read_wire_packet(pr)).fixed_header.type == PINGRESP
+            out = await read_wire_packet(sr)
+            assert out.topic_name == "pass/x"  # rejected one never delivered
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_server_close_fires_on_stopped_and_sets_done(self):
+        # server_test.go TestServerClose
+        async def scenario():
+            h = Harness()
+            stopped = []
+
+            class StopWatch(Hook):
+                def id(self):
+                    return "stop-watch"
+
+                def provides(self, b):
+                    from mqtt_tpu.hooks import ON_STOPPED
+
+                    return b == ON_STOPPED
+
+                def on_stopped(self):
+                    stopped.append(True)
+
+            h.server.add_hook(StopWatch())
+            r, w, task = await h.connect("closer")
+            await h.server.close()
+            assert stopped == [True]
+            assert h.server.done.is_set()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_sys_info_tick_republishes_uptime(self):
+        # server_test.go TestServerEventLoop analog: the $SYS publication
+        # refreshes uptime and fires the OnSysInfoTick hook
+        async def scenario():
+            h = Harness()
+            ticks = []
+
+            class TickWatch(Hook):
+                def id(self):
+                    return "tick-watch"
+
+                def provides(self, b):
+                    from mqtt_tpu.hooks import ON_SYS_INFO_TICK
+
+                    return b == ON_SYS_INFO_TICK
+
+                def on_sys_info_tick(self, info):
+                    ticks.append(info.uptime)
+
+            h.server.add_hook(TickWatch())
+            h.server.info.started -= 5  # pretend 5s of uptime
+            h.server.publish_sys_topics()
+            assert ticks and ticks[0] >= 5
+            msgs = {p.topic_name: p for p in h.server.topics.messages("$SYS/#")}
+            assert int(bytes(msgs["$SYS/broker/uptime"].payload)) >= 5
+            await h.shutdown()
+
+        run(scenario())
