@@ -324,7 +324,6 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         "device_kernel_best_window": round(kernel_best) if kernel_best else None,
         "p99_batch_ms": round(pctl(lat, 0.99) * 1e3, 3),
         "batch": batch,
-        "transfer_slots": getattr(matcher, "transfer_slots", None),
         "avg_hits_per_topic": round(hits / batch, 2),
         "host_fallback_ratio": round(fallbacks / max(1, n_topics), 5),
         "overflow_ratio": round(overflows / max(1, n_topics), 5),

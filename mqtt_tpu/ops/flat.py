@@ -703,35 +703,15 @@ def build_flat_index(
 # ---------------------------------------------------------------------------
 
 
-def flat_match_core(
-    table,
-    pat_kind,
-    pat_depth,
-    pat_mask,
-    tok1,
-    tok2,
-    lengths,
-    is_dollar,
-    *,
-    max_levels: int,
-    out_slots: int,
-    overflow_slots: int = 0,
+def _probe_head(
+    table, pat_kind, pat_depth, pat_mask, tok1, tok2, lengths, is_dollar,
+    *, max_levels
 ):
-    """Match ``B`` topics against the flat index in one dispatch.
-
-    ``overflow_slots`` (default: ``out_slots``) sets the totals threshold
-    for the overflow flag separately from the output width — the packed
-    path emits only the transfer prefix while keeping the overflow flag's
-    meaning (a genuine device-capacity route, distinct from a
-    transfer-prefix route).
-
-    Returns ``(sub_ids[B, out_slots] int32 (-1 padded), totals[B] int32,
-    overflow[B] bool)`` — ``overflow`` marks topics the host must re-walk
-    (saturated-bucket probe, spilled entry hit, or more matches than
-    ``out_slots``). Pure jnp; jit/shard_map-able (mqtt_tpu.parallel shards
-    the table's bucket axis across a device mesh).
-    """
-    import jax
+    """The shared probe stage: whole-path hashes, ONE bucket row gather per
+    probe, hit/meta decode, and the per-probe surviving id range
+    ``[base+lo, base+lo+cnt)`` (synthetic ids make every probe's result a
+    contiguous range; the $-mask drops exactly the client prefix).
+    Returns ``(start[B,P] i32, cnt[B,P] i32, overflow[B] bool)``."""
     import jax.numpy as jnp
 
     B, L = tok1.shape
@@ -739,12 +719,6 @@ def flat_match_core(
     S = table.shape[0]
     m1 = jnp.uint32(_M1)
     m2 = jnp.uint32(_M2)
-    if P == 0:  # empty index: nothing matches, nothing overflows
-        return (
-            jnp.full((B, out_slots), -1, jnp.int32),
-            jnp.zeros((B,), jnp.int32),
-            jnp.zeros((B,), bool),
-        )
 
     def rotl13(x):
         return (x << jnp.uint32(13)) | (x >> jnp.uint32(19))
@@ -791,15 +765,54 @@ def flat_match_core(
     count = jnp.where(hash_pat & exact_len, nreg, nreg + ninl)
     count = jnp.where(valid_hit, count, 0)
 
-    # ids are synthetic (base + slot) and, after the $-mask, each probe's
-    # surviving ids form ONE contiguous range: clients occupy the window's
-    # prefix [0, ncli) and are exactly what the $-mask drops, so a probe
-    # contributes [lo, count) with lo in {0, ncli}. Compaction is therefore
-    # pure range concatenation — a [B, K, P] one-hot over the (tiny) probe
-    # axis — with no gathers and no O(P*window) one-hot matmul.
+    # $-topics never match top-level-wildcard CLIENT subscriptions
+    # [MQTT-4.7.1-1/2]; clients occupy the window prefix [0, ncli)
     dollar = is_dollar[:, None] & (top_wild == 1)
     lo = jnp.where(dollar, jnp.minimum(ncli, count), 0)  # [B, P]
     cnt = count - lo
+    start = base.astype(jnp.int32) + lo
+    overflow = (sat_probe & active).any(axis=1) | (spill & valid_hit).any(axis=1)
+    return start, cnt, overflow
+
+
+def flat_match_core(
+    table,
+    pat_kind,
+    pat_depth,
+    pat_mask,
+    tok1,
+    tok2,
+    lengths,
+    is_dollar,
+    *,
+    max_levels: int,
+    out_slots: int,
+    overflow_slots: int = 0,
+):
+    """Match ``B`` topics against the flat index in one dispatch,
+    expanding results to sid slots (the mesh-sharded path's form: slot
+    arrays concatenate across shards under ``all_gather``).
+
+    Returns ``(sub_ids[B, out_slots] int32 (-1 padded), totals[B] int32,
+    overflow[B] bool)`` — ``overflow`` marks topics the host must re-walk
+    (saturated-bucket probe, spilled entry hit, or more matches than
+    ``overflow_slots``/``out_slots``). Pure jnp; jit/shard_map-able
+    (mqtt_tpu.parallel shards the table's bucket axis across a device
+    mesh)."""
+    import jax.numpy as jnp
+
+    B, L = tok1.shape
+    P = pat_depth.shape[0]
+    if P == 0:  # empty index: nothing matches, nothing overflows
+        return (
+            jnp.full((B, out_slots), -1, jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), bool),
+        )
+    start, cnt, overflow = _probe_head(
+        table, pat_kind, pat_depth, pat_mask, tok1, tok2, lengths, is_dollar,
+        max_levels=max_levels,
+    )
     offs = jnp.cumsum(cnt, axis=1)  # inclusive [B, P]
     totals = offs[:, -1]
     prev = offs - cnt  # exclusive
@@ -809,17 +822,52 @@ def flat_match_core(
         ks[None, :, None] < offs[:, None, :]
     )  # [B, K, P]
     sel = sel_onehot.astype(jnp.int32)
-    # out slot k = base + lo + (k - prev) of its probe: one fused reduction
-    comb = (base.astype(jnp.int32) + lo - prev)[:, None, :]
+    # out slot k = start + (k - prev) of its probe: one fused reduction
+    comb = (start - prev)[:, None, :]
     in_range = ks[None, :] < totals[:, None]
     out = jnp.where(in_range, ks[None, :] + (sel * comb).sum(axis=2), -1)
-
-    overflow = (
-        (sat_probe & active).any(axis=1)
-        | (spill & valid_hit).any(axis=1)
-        | (totals > (overflow_slots or out_slots))
-    )
+    overflow = overflow | (totals > (overflow_slots or out_slots))
     return out, totals, overflow
+
+
+def flat_match_ranges_core(
+    table,
+    pat_kind,
+    pat_depth,
+    pat_mask,
+    tok1,
+    tok2,
+    lengths,
+    is_dollar,
+    *,
+    max_levels: int,
+):
+    """Match ``B`` topics, emitting per-probe sid RANGES instead of
+    expanded slots: ``(start[B,P] i32, cnt[B,P] i32, totals[B] i32,
+    overflow[B] bool)``.
+
+    This is the single-device production form: synthetic ids make every
+    probe's surviving result one contiguous range, so ranges carry the
+    COMPLETE result in 2P ints/topic — no transfer-prefix cap (and no
+    host fallback class for it), no device-side compaction, and totals
+    are naturally bounded by P x window. ``overflow`` = saturated-bucket
+    probe or spilled-entry hit only."""
+    import jax.numpy as jnp
+
+    B, L = tok1.shape
+    P = pat_depth.shape[0]
+    if P == 0:  # empty index: honor the [B, P] contract with P = 0
+        return (
+            jnp.zeros((B, 0), jnp.int32),
+            jnp.zeros((B, 0), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B,), bool),
+        )
+    start, cnt, overflow = _probe_head(
+        table, pat_kind, pat_depth, pat_mask, tok1, tok2, lengths, is_dollar,
+        max_levels=max_levels,
+    )
+    return start, cnt, cnt.sum(axis=1), overflow
 
 
 def _jit_core():
@@ -874,13 +922,13 @@ def _packed_core(
     packed_tokens,
     *,
     max_levels,
-    out_slots,
-    transfer_slots,
 ):
-    """flat_match_core with ONE packed input and ONE packed output transfer:
-    in ``[B, 2L+2]`` i32, out ``[B, transfer_slots+2]`` i32 = (sid prefix |
-    total | overflow). Topics matching more ids than the prefix re-walk on
-    host, so any ``transfer_slots`` stays bit-identical."""
+    """The production single-device form: ONE packed input transfer and
+    ONE packed RANGES output transfer. In ``[B, 2L+2]`` i32, out
+    ``[B, 2P+2]`` i32 = (range starts | range counts | total | overflow).
+    Ranges carry the complete result (flat_match_ranges_core), so there is
+    no transfer-prefix host-fallback class and no device-side compaction;
+    2P ints/topic also transfer less than any useful slot prefix."""
     import jax
     import jax.numpy as jnp
 
@@ -889,12 +937,7 @@ def _packed_core(
     tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
     lengths = packed_tokens[:, 2 * L]
     is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
-    # compact only to the transfer prefix: slots beyond it are discarded,
-    # and the resolver host-routes on totals > transfer_slots regardless of
-    # the kernel's own overflow threshold, so narrowing out_slots here is
-    # semantics-free and shrinks the one-hot matmul proportionally
-    k = min(out_slots, transfer_slots)
-    out, totals, overflow = flat_match_core(
+    start, cnt, totals, overflow = flat_match_ranges_core(
         table,
         pat_kind,
         pat_depth,
@@ -904,12 +947,11 @@ def _packed_core(
         lengths,
         is_dollar,
         max_levels=max_levels,
-        out_slots=k,
-        overflow_slots=out_slots,
     )
     return jnp.concatenate(
         [
-            out[:, :transfer_slots],
+            start,
+            cnt,
             totals[:, None],
             overflow[:, None].astype(jnp.int32),
         ],
@@ -934,13 +976,21 @@ def _jit_scatter():
 scatter_rows = _LazyJit(_jit_scatter)
 
 
+def _jit_ranges():
+    import jax
+
+    return partial(jax.jit, static_argnames=("max_levels",))(
+        flat_match_ranges_core
+    )
+
+
+flat_match_ranges = _LazyJit(_jit_ranges)
+
+
 def _jit_packed():
     import jax
 
-    return partial(
-        jax.jit,
-        static_argnames=("max_levels", "out_slots", "transfer_slots"),
-    )(_packed_core)
+    return partial(jax.jit, static_argnames=("max_levels",))(_packed_core)
 
 
 flat_match_packed = _LazyJit(_jit_packed)

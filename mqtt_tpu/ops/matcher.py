@@ -29,8 +29,8 @@ from .flat import (
     FlatIndex,
     _bucket,
     build_flat_index,
-    flat_match,
     flat_match_packed,
+    flat_match_ranges,
     pack_tokens,
 )
 from .hashing import tokenize_topics
@@ -105,7 +105,7 @@ class MatcherStats:
     ``host_fallbacks`` counts topics re-walked on the host for any reason;
     ``overflows`` counts the subset caused by device-side routing (spilled
     entries, saturated buckets, over-deep topics) rather than delta-overlay
-    or transfer-prefix routes.
+    routes.
     """
 
     batches: int = 0
@@ -138,9 +138,11 @@ class TpuMatcher:
     ``frontier`` is accepted for API continuity with the retired NFA
     kernel and ignored — the flat matcher has no frontier; wildcard-shape
     fan-out is a build-time property of the filter set (ops/flat.py).
-    ``out_slots`` caps the per-topic device result (larger sets host-route);
-    ``window`` caps ids per filter path; ``transfer_slots`` sizes the D2H
-    prefix of the packed transfer path.
+    ``out_slots`` caps the per-topic device result on the slot-expanding
+    core (the mesh-sharded form); ``window`` caps ids per filter path.
+    ``transfer_slots`` is accepted for API continuity and unused: the
+    production packed path transfers per-probe RANGES, which carry the
+    complete result in 2P ints per topic.
     """
 
     def __init__(
@@ -161,10 +163,8 @@ class TpuMatcher:
         # cooperative rebuilds yield the GIL periodically — set by owners
         # that rebuild on a background thread while another thread serves
         self.cooperative = cooperative
-        # how many sid slots come back per topic in the single packed D2H;
-        # topics with more matches (but no device overflow) re-walk on host.
-        # Smaller values trade rare host walks for less D2H traffic — the
-        # dominant e2e cost on high-latency host<->device links.
+        # retired knob (kept for API continuity): the packed transfer is
+        # per-probe ranges — complete results at 2P+2 ints/topic
         self.transfer_slots = min(transfer_slots or out_slots, out_slots)
         self.stats = MatcherStats()
         # one (flat_index, device_arrays, built_version) tuple, swapped
@@ -285,18 +285,19 @@ class TpuMatcher:
 
     def match_tokens(self, tok1, tok2, lengths, is_dollar):
         """Raw device match over pre-tokenized topics; returns device
-        ``(sub_ids[B,K], totals[B], overflow[B])``. The benchmark path."""
+        ``(starts[B,P], cnts[B,P], totals[B], overflow[B])`` — the
+        production ranges kernel (flat_match_ranges_core). The benchmark
+        path."""
         if self._state is None or self.stale:
             self.rebuild()
         flat, arrays, _ = self._state
-        return flat_match(
+        return flat_match_ranges(
             *arrays,
             tok1,
             tok2,
             lengths,
             is_dollar,
             max_levels=flat.max_levels,
-            out_slots=self.out_slots,
         )
 
     # -- matching ----------------------------------------------------------
@@ -315,7 +316,6 @@ class TpuMatcher:
         if self._state is None or self.stale:
             self.rebuild()
         flat, arrays, _ = self._state
-        ts = self.transfer_slots
         # pad ragged batches (the staging loop's windows) to a power-of-two
         # bucket so every batch size reuses one jitted executable; padded
         # rows are ignored at resolve time
@@ -328,23 +328,21 @@ class TpuMatcher:
             *arrays,
             jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
             max_levels=flat.max_levels,
-            out_slots=self.out_slots,
-            transfer_slots=ts,
         )
+        P = flat.pat_depth.shape[0]
 
         def resolve() -> list[Subscribers]:
-            packed = np.asarray(packed_dev)  # ONE D2H: [B, ts+2]
+            packed = np.asarray(packed_dev)  # ONE D2H: [B, 2P+2]
             packed = packed[: len(topics)]  # drop bucket-padding rows
-            totals = packed[:, ts]
-            # host route: device overflow, >max_levels topics, or more
-            # matches than the transferred prefix carries
-            overflow = packed[:, ts + 1].astype(bool) | len_overflow[: len(topics)]
-            host_route = (overflow | (totals > ts)).tolist()
-            overflow = overflow.tolist()
-            # one bulk C conversion: per-row numpy boolean slicing costs
-            # ~10us of fixed overhead per topic, a list comp over <=ts
-            # ints is ~10x cheaper at these widths
-            out_rows = packed[:, :ts].tolist()
+            # the ONLY host-route class left: device overflow (sat/spill)
+            # or >max_levels topics — ranges carry the COMPLETE result,
+            # so every fallback is also an overflow
+            overflow = (
+                packed[:, 2 * P + 1].astype(bool) | len_overflow[: len(topics)]
+            ).tolist()
+            # one bulk C conversion: per-row numpy slicing costs ~10us of
+            # fixed overhead per topic, plain list walks are ~10x cheaper
+            out_rows = packed[:, : 2 * P].tolist()
             results = []
             results_append = results.append
             stats = self.stats
@@ -354,7 +352,7 @@ class TpuMatcher:
             for i, topic in enumerate(topics):
                 if not topic:
                     results_append(Subscribers())  # empty topic never matches
-                elif host_route[i] or (
+                elif overflow[i] or (
                     route_to_host is not None and route_to_host(topic)
                 ):
                     stats.host_fallbacks += 1
@@ -362,11 +360,13 @@ class TpuMatcher:
                     results_append(self.topics.subscribers(topic))  # host fallback
                 else:
                     row = out_rows[i]
-                    results_append(
-                        expand_sids(
-                            table, [s for s in row if s >= 0], Subscribers()
-                        )
-                    )
+                    sids = []
+                    for p in range(P):
+                        c = row[P + p]
+                        if c:
+                            s0 = row[p]
+                            sids.extend(range(s0, s0 + c))
+                    results_append(expand_sids(table, sids, Subscribers()))
             return results
 
         return resolve

@@ -146,9 +146,11 @@ def test_frontier_overflow_falls_back():
     assert len(subs.subscriptions) == 5
 
 
-def test_transfer_slots_prefix_routes_deep_topics_to_host():
-    """A transfer prefix smaller than out_slots must stay bit-identical:
-    topics matching more subs than the prefix carries re-walk on host."""
+def test_ranges_transfer_carries_large_fanouts_without_fallback():
+    """The packed ranges output carries the COMPLETE result (2P ints per
+    topic), so a fan-out that would have exceeded any slot prefix still
+    resolves entirely from the device — no host fallback class for it.
+    (``transfer_slots`` remains accepted for API compatibility.)"""
     index = TopicsIndex()
     # 12 subs all matching 'hot/x'; 1 sub matching 'cold/y'
     for i in range(6):
@@ -160,10 +162,9 @@ def test_transfer_slots_prefix_routes_deep_topics_to_host():
     cold = matcher.subscribers("cold/y")
     assert canon(hot) == canon(index.subscribers("hot/x"))
     assert canon(cold) == canon(index.subscribers("cold/y"))
-    # the hot topic exceeded the prefix -> host fallback, NOT device overflow
-    assert matcher.stats.host_fallbacks == 1
+    assert len(hot.subscriptions) == 12
+    assert matcher.stats.host_fallbacks == 0
     assert matcher.stats.overflows == 0
-    # the cold topic fit in the prefix -> served from the device result
     assert matcher.stats.topics == 2
 
 
