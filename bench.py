@@ -559,18 +559,26 @@ def main() -> None:
         # would otherwise wedge the whole bench run and produce nothing
         import subprocess
 
-        probe = subprocess.run(
-            [
-                sys.executable,
-                "-c",
-                "import faulthandler; faulthandler.dump_traceback_later(90, exit=True)\n"
-                "import jax, numpy, jax.numpy as jnp\n"
-                "print(jax.devices()); print(int(numpy.asarray((jnp.ones((8,))*2).sum())))",
-            ],
-            timeout=150,
-            capture_output=True,
-        )
-        device_ok = probe.returncode == 0
+        probe = None
+        device_ok = False
+        for attempt in range(max(1, int(os.environ.get("BENCH_PROBE_RETRIES", "3")))):
+            if attempt:
+                log(f"device probe retry {attempt} in 60s (tunnel may be restarting)")
+                time.sleep(60)
+            probe = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import faulthandler; faulthandler.dump_traceback_later(90, exit=True)\n"
+                    "import jax, numpy, jax.numpy as jnp\n"
+                    "print(jax.devices()); print(int(numpy.asarray((jnp.ones((8,))*2).sum())))",
+                ],
+                timeout=150,
+                capture_output=True,
+            )
+            device_ok = probe.returncode == 0
+            if device_ok:
+                break
         if not device_ok:
             log(
                 "DEVICE UNREACHABLE (backend init hung or failed); skipping "
