@@ -141,6 +141,14 @@ class FlatIndex:
 
     # -- incremental fold --------------------------------------------------
 
+    def clone_for_fold(self) -> "FlatIndex":
+        """The copy-on-write clone a fold mutates: scalar fields and np
+        arrays shared, sub table cloned (see ``fold`` for the safety
+        contract)."""
+        import dataclasses
+
+        return dataclasses.replace(self, subs=self.subs.clone_for_fold())
+
     def fold(self, index: TopicsIndex, filters) -> "Optional[tuple[list, bool]]":
         """Apply subscription mutations for ``filters`` to this instance
         and return ``(bucket_updates, pats_changed)`` — the device-side
@@ -188,10 +196,12 @@ class FlatIndex:
         touched: set = set()
         pats_changed = False
         empty_snap = ((), (), ())
+        cnt_mask = (1 << _CNT_BITS) - 1
 
         for f in filters:
             parts = f.split("/")
-            if parts and parts[0].upper() == SHARE_PREFIX:
+            share_rooted = bool(parts) and parts[0].upper() == SHARE_PREFIX
+            if share_rooted:
                 parts = parts[2:]
             key = tuple(parts)
             if key in seen_paths:
@@ -228,7 +238,6 @@ class FlatIndex:
             h2 = np.uint32(h2)
 
             # live node snapshot (torn reads retried like the full walk)
-            share_rooted = f.split("/")[0].upper() == SHARE_PREFIX
             snap = None
             for _attempt in range(8):
                 try:
@@ -290,7 +299,6 @@ class FlatIndex:
             if found >= 0:
                 old_meta = int(row[found, 2])
                 old_spill = bool((old_meta >> _SPILL_SHIFT) & 1)
-                cnt_mask = (1 << _CNT_BITS) - 1
                 # spilled entries carry zeroed counts, so this is 0 for them
                 self.n_subs -= ((old_meta >> _NREG_SHIFT) & cnt_mask) + (
                     (old_meta >> _NINL_SHIFT) & cnt_mask

@@ -220,8 +220,6 @@ class TpuMatcher:
         fold leaves it diverged from the device table, so folding poisons
         itself until the full rebuild that MUST follow a False return has
         rebuilt both from scratch."""
-        import dataclasses
-
         import jax.numpy as jnp
 
         from .flat import scatter_rows
@@ -232,7 +230,7 @@ class TpuMatcher:
         flat, arrays, _ = st
         t0 = time.perf_counter()
         version = self.topics.version
-        flat = dataclasses.replace(flat, subs=flat.subs.clone_for_fold())
+        flat = flat.clone_for_fold()
         self._fold_poisoned = True  # cleared on success or by rebuild()
         res = flat.fold(self.topics, filters)
         if res is None:
