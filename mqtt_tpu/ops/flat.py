@@ -155,8 +155,7 @@ class FlatIndex:
         scatter payload — or ``None`` when only a full rebuild can absorb
         them.
 
-        MUST be called on a copy-on-write clone (TpuMatcher.fold builds
-        one via ``dataclasses.replace`` + ``subs.clone_for_fold()``), never
+        MUST be called on a copy-on-write clone (``clone_for_fold``), never
         on the instance in-flight resolvers captured: a resolver issued
         generations ago may decode sids for a filter mutated only later —
         its generation's overlay does not host-route that filter, so it

@@ -2688,3 +2688,115 @@ class TestMoreReferenceScenarios:
             await h.shutdown()
 
         run(scenario())
+
+
+class TestProtocolEdges:
+    def test_subscribe_without_filters_is_protocol_violation(self):
+        # server_test.go TestServerProcessPacketSubscribeInvalid
+        async def scenario():
+            h = Harness()
+            r, w, task = await h.connect("nofilt", version=5)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=5,
+                        packet_id=3,
+                        filters=[],
+                    )
+                )
+            )
+            await w.drain()
+            out = await read_wire_packet(r, 5)
+            assert out.fixed_header.type == DISCONNECT  # [MQTT-3.10.3-2]
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_without_filters_is_protocol_violation(self):
+        # server_test.go TestServerProcessPacketUnsubscribeInvalid
+        async def scenario():
+            h = Harness()
+            r, w, task = await h.connect("nounfilt", version=5)
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=UNSUBSCRIBE, qos=1),
+                        protocol_version=5,
+                        packet_id=4,
+                        filters=[],
+                    )
+                )
+            )
+            await w.drain()
+            out = await read_wire_packet(r, 5)
+            assert out.fixed_header.type == DISCONNECT
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_nonexistent_filter_acks_no_subscription_existed(self):
+        async def scenario():
+            h = Harness()
+            r, w, _ = await h.connect("unx", version=5)
+            w.write(unsub_packet(5, ["never/was"], version=5))
+            await w.drain()
+            ack = await read_wire_packet(r, 5)
+            assert ack.fixed_header.type == UNSUBACK
+            assert ack.reason_codes[0] == codes.CODE_NO_SUBSCRIPTION_EXISTED.code
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_connack_advertises_reduced_maximum_qos(self):
+        # SendConnack capability surface [MQTT-3.2.2-9]
+        async def scenario():
+            opts = Options(capabilities=Capabilities(maximum_qos=1))
+            h = Harness(opts)
+            reader, writer, task = await h.attach()
+            writer.write(connect_packet("qcap", 5))
+            await writer.drain()
+            ack = await read_wire_packet(reader, 5)
+            assert ack.fixed_header.type == CONNACK
+            assert ack.properties.maximum_qos_flag
+            assert ack.properties.maximum_qos == 1
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inline_subscribe_invalid_filter_raises(self):
+        from mqtt_tpu.packets import Code
+
+        async def scenario():
+            h = Harness()
+            with pytest.raises(Code):
+                h.server.subscribe("bad/#/deep", 1, lambda *a: None)
+            with pytest.raises(Code):
+                h.server.unsubscribe("bad/#/deep", 1)
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_serve_propagates_read_store_failure(self):
+        # server_test.go TestServerServeReadStoreFailure
+        async def scenario():
+            h = Harness()
+
+            class BadStore(Hook):
+                def id(self):
+                    return "bad-store"
+
+                def provides(self, b):
+                    from mqtt_tpu.hooks import STORED_CLIENTS
+
+                    return b == STORED_CLIENTS
+
+                def stored_clients(self):
+                    raise RuntimeError("store corrupted")
+
+            h.server.add_hook(BadStore())
+            with pytest.raises(RuntimeError, match="store corrupted"):
+                await h.server.serve()
+            await h.shutdown()
+
+        run(scenario())
