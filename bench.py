@@ -3,7 +3,7 @@
 indexes on the real device — ALL FIVE BASELINE.md configs, timed end to end.
 
 Per config the timed loop covers the full seam: host tokenization, H2D
-transfer, the device NFA match, D2H transfer, and host expansion into
+transfer, the device flat-hash match, D2H transfer, and host expansion into
 bit-identical ``Subscribers`` sets (including host-fallback re-walks for
 overflowed topics) — i.e. exactly what ``publish_to_subscribers`` pays when
 the device matcher is enabled. A separate pipeline rate isolates the device
@@ -356,7 +356,7 @@ def run_cfg2(n_subs, batch, iters, rng):
     matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
     t0 = time.perf_counter()
     matcher.rebuild()
-    log(f"cfg2 CSR compile {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
+    log(f"cfg2 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
     parity_check(matcher, index, topic_gen)
     return time_matcher(matcher, index, topic_gen, batch, iters)
 
@@ -370,7 +370,7 @@ def run_cfg3(n_subs, batch, iters, rng):
     matcher = TpuMatcher(index, max_levels=8, frontier=8, out_slots=256, transfer_slots=32)
     t0 = time.perf_counter()
     matcher.rebuild()
-    log(f"cfg3 CSR compile {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
+    log(f"cfg3 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
     parity_check(matcher, index, topic_gen)
     return time_matcher(matcher, index, topic_gen, batch, iters)
 
@@ -382,7 +382,7 @@ def run_cfg4(n_groups, members, batch, iters, rng):
     matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=128, transfer_slots=48)
     t0 = time.perf_counter()
     matcher.rebuild()
-    log(f"cfg4 CSR compile {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
+    log(f"cfg4 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
     parity_check(matcher, index, topic_gen)
     return time_matcher(matcher, index, topic_gen, batch, iters, select_shared=True)
 

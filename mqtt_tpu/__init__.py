@@ -9,7 +9,7 @@ hooks.
 
 The host data plane (codec, sessions, hooks) is Python/asyncio; the
 performance-critical wildcard topic matcher runs as a batched JAX/Pallas
-NFA-over-CSR kernel on TPU (``mqtt_tpu.ops``), sharded across device meshes
+flat-hash match kernel on TPU (``mqtt_tpu.ops``), sharded across device meshes
 via ``mqtt_tpu.parallel``.
 """
 
