@@ -512,7 +512,13 @@ def run_broker_bench(fast: bool) -> dict:
     out = {"cpus": os.cpu_count()}
     try:
         assert proc.stdout.readline().strip() == b"READY"
-        scenarios = [(2, 1000), (10, 500)] if fast else [(2, 10000), (10, 5000), (100, 1000)]
+        # the reference table's exact mqtt-stresser scenarios: 2/10/100
+        # clients x 10000 messages each (README.md:482-506)
+        scenarios = (
+            [(2, 1000), (10, 500)]
+            if fast
+            else [(2, 10000), (10, 10000), (100, 10000)]
+        )
         for n, m in scenarios:
             import asyncio
 
