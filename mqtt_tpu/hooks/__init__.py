@@ -193,7 +193,13 @@ class Hooks:
                 hook.init(config)
             except Exception as e:
                 raise RuntimeError(f"failed initialising {hook.id()} hook: {e}") from e
-            # copy-on-write so dispatch iteration never sees a mid-append list
+            # copy-on-write so dispatch iteration never sees a mid-append
+            # list. The generation bumps BRACKET the publish: a reader that
+            # scanned the old list against the pre-publish generation can
+            # never cache its verdict as current, because by the time add()
+            # returns the generation has moved again (the fast-publish gate
+            # in server.py re-checks the generation before caching).
+            self.generation += 1
             self._hooks = self._hooks + [hook]
             self.generation += 1
 

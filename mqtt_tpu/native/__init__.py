@@ -35,9 +35,12 @@ import numpy as np
 _log = logging.getLogger("mqtt_tpu.native")
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "mqtt_native.c")
+_ACCEL_SRC = os.path.join(_HERE, "accelmod.c")
 _LOCK = threading.Lock()
 _LIB: Optional[ctypes.CDLL] = None
 _TRIED = False
+_ACCEL = None
+_ACCEL_TRIED = False
 
 # Per-scan frame cap: bounds the output arrays while the read loop keeps
 # rescanning until the buffer is drained, so it is not a throughput cap.
@@ -137,6 +140,76 @@ def _declare(l: ctypes.CDLL) -> None:
 
 def available() -> bool:
     return lib() is not None
+
+
+def _accel_so_path() -> str:
+    tag = f"{sys.implementation.cache_tag}-{os.uname().machine}"
+    return os.path.join(_HERE, f"mqtt_accel-{tag}.so")
+
+
+def _build_accel(so: str) -> bool:
+    """Compile accelmod.c → a CPython extension .so. Unlike mqtt_native.c
+    (plain C via ctypes), the materializer builds Python result objects, so
+    it compiles against the CPython headers and loads as a real extension
+    module."""
+    import sysconfig
+
+    include = sysconfig.get_paths()["include"]
+    for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cc:
+            continue
+        fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
+        os.close(fd)
+        try:
+            cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", "-o", tmp,
+                   _ACCEL_SRC]
+            r = subprocess.run(cmd, capture_output=True, timeout=120)
+            if r.returncode == 0:
+                os.replace(tmp, so)
+                return True
+            _log.debug("accel build with %s failed: %s", cc, r.stderr.decode())
+        except (OSError, subprocess.SubprocessError) as e:
+            _log.debug("accel build with %s failed: %s", cc, e)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+    return False
+
+
+def accel():
+    """The C materializer extension module (PROFILE.md §4's planned native
+    result path), building it on first use; None when unavailable. Every
+    caller keeps the pure-Python path as fallback and source of truth."""
+    global _ACCEL, _ACCEL_TRIED
+    if _ACCEL is not None or _ACCEL_TRIED:
+        return _ACCEL
+    with _LOCK:
+        if _ACCEL is not None or _ACCEL_TRIED:
+            return _ACCEL
+        _ACCEL_TRIED = True
+        if os.environ.get("MQTT_TPU_NO_NATIVE"):
+            return None
+        so = _accel_so_path()
+        try:
+            stale = (not os.path.exists(so)) or (
+                os.path.getmtime(so) < os.path.getmtime(_ACCEL_SRC)
+            )
+            if stale and not _build_accel(so):
+                return None
+            import importlib.machinery
+            import importlib.util
+
+            loader = importlib.machinery.ExtensionFileLoader("mqtt_accel", so)
+            spec = importlib.util.spec_from_file_location(
+                "mqtt_accel", so, loader=loader
+            )
+            mod = importlib.util.module_from_spec(spec)
+            loader.exec_module(mod)
+            _ACCEL = mod
+        except (OSError, ImportError) as e:
+            _log.debug("accel module unavailable: %s", e)
+            return None
+        return _ACCEL
 
 
 # -- high-level wrappers ----------------------------------------------------

@@ -1,0 +1,583 @@
+/* mqtt_accel — CPython extension for the broker's hottest host loop:
+ * materializing device match results into Subscribers objects.
+ *
+ * The device matcher (ops/flat.py) returns per-topic sid RANGES packed as
+ * one int32 array [B, 2P+2] = (P range starts | P range counts | total |
+ * overflow). The host must expand each row into a Subscribers result —
+ * per-client Subscription merges, shared groups keyed on the group filter,
+ * inline subscriptions keyed on identifier — value-identical to the host
+ * trie gather (reference gatherSubscriptions, topics.go:631-678).
+ *
+ * Pure-Python expansion caps the pipeline at the ~60-70K topics/s CPython
+ * allocation floor measured in PROFILE.md §4 no matter how fast the device
+ * kernel runs. This module performs the same expansion through the C API,
+ * exploiting the slots layout of the result types (packets.Subscription,
+ * topics.Subscribers are `slots` classes): a per-type descriptor-offset
+ * table is read once from the class's member descriptors, after which a
+ * subscription copy is tp_alloc + N pointer moves and a Subscribers
+ * result is tp_alloc + four dict stores. Classes without a usable slots
+ * layout (exotic subclasses) transparently fall back to calling the
+ * Python methods, so semantics never depend on layout.
+ *
+ * The semantics are pinned by differential tests (tests/test_native.py)
+ * against ops/matcher.expand_sids, which remains the readable source of
+ * truth and the fallback when no C toolchain is available.
+ *
+ * Contract notes mirrored from expand_sids:
+ *  - a client's first sighting takes Subscription.self_merged_copy(): a
+ *    fresh instance with the identifiers map materialized ({filter: id}
+ *    when absent) or shared-and-extended (ids[filter] = id when id > 0 —
+ *    mutating the SHARED map, exactly like Subscription.merge);
+ *  - later sightings call prev.merge(sub) — the Python method, so any
+ *    subclass override keeps winning;
+ *  - shared entries are NOT copied: the group dict references the stored
+ *    subscription (host gather does the same, topics.go:651-666);
+ *  - inline entries key on the subscription identifier;
+ *  - out-of-range sids are skipped (host parity: expand_sids bounds-checks
+ *    against the sid space).
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <structmember.h>
+#include <stdint.h>
+
+#ifndef Py_T_OBJECT_EX
+#define Py_T_OBJECT_EX T_OBJECT_EX
+#endif
+
+/* interned attribute / key names (module-lifetime references) */
+static PyObject *s_merge, *s_filter, *s_identifier, *s_identifiers;
+static PyObject *s_subscriptions, *s_shared, *s_shared_selected;
+static PyObject *s_inline_subscriptions, *s_self_merged_copy;
+
+/* ---------------------------------------------------------------------- */
+/* per-type slot layouts, read once from the class's member descriptors   */
+
+#define MAX_SLOTS 32
+#define MAX_LAYOUTS 8
+
+typedef struct {
+    PyTypeObject *tp;
+    int ok;                 /* slot fast path usable for this type */
+    int n;                  /* number of object slots */
+    Py_ssize_t offs[MAX_SLOTS];
+    Py_ssize_t ids_off, filter_off, ident_off; /* -1 when absent */
+} SubLayout;
+
+typedef struct {
+    PyTypeObject *tp;
+    int ok;
+    Py_ssize_t subscriptions_off, shared_off, shared_selected_off,
+        inline_off;
+} ResLayout;
+
+static SubLayout sub_layouts[MAX_LAYOUTS];
+static int n_sub_layouts;
+static ResLayout res_layouts[MAX_LAYOUTS];
+static int n_res_layouts;
+
+/* Collect every Py_T_OBJECT_EX member descriptor reachable through the
+ * MRO. Returns the count, or -1 when the type cannot take the fast path
+ * (instance dict present, too many slots, or non-object members). */
+static int
+collect_object_slots(PyTypeObject *tp, Py_ssize_t *offs, int max,
+                     Py_ssize_t *named_offs[], PyObject *named[], int n_named)
+{
+    /* an instance dict can carry attributes a slot copy would miss */
+    if (tp->tp_dictoffset != 0 ||
+        (tp->tp_flags & Py_TPFLAGS_MANAGED_DICT))
+        return -1;
+    PyObject *mro = tp->tp_mro;
+    if (mro == NULL || !PyTuple_Check(mro))
+        return -1;
+    int n = 0;
+    for (Py_ssize_t m = 0; m < PyTuple_GET_SIZE(mro); m++) {
+        PyObject *base = PyTuple_GET_ITEM(mro, m);
+        if (!PyType_Check(base))
+            continue;
+        PyObject *dict = ((PyTypeObject *)base)->tp_dict;
+        if (dict == NULL)
+            continue;
+        PyObject *key, *value;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(dict, &pos, &key, &value)) {
+            if (!Py_IS_TYPE(value, &PyMemberDescr_Type))
+                continue;
+            PyMemberDef *def = ((PyMemberDescrObject *)value)->d_member;
+            if (def == NULL)
+                continue;
+            if (def->type != Py_T_OBJECT_EX && def->type != T_OBJECT_EX)
+                return -1; /* non-object slot: no generic pointer copy */
+            int dup = 0; /* a subclass may shadow a base slot name */
+            for (int i = 0; i < n; i++)
+                if (offs[i] == def->offset) {
+                    dup = 1;
+                    break;
+                }
+            if (dup)
+                continue;
+            if (n >= max)
+                return -1;
+            offs[n++] = def->offset;
+            for (int k = 0; k < n_named; k++) {
+                int eq = PyObject_RichCompareBool(key, named[k], Py_EQ);
+                if (eq < 0)
+                    return -1;
+                if (eq)
+                    *named_offs[k] = def->offset;
+            }
+        }
+    }
+    return n;
+}
+
+static SubLayout *
+sub_layout_for(PyTypeObject *tp)
+{
+    for (int i = 0; i < n_sub_layouts; i++)
+        if (sub_layouts[i].tp == tp)
+            return &sub_layouts[i];
+    if (n_sub_layouts >= MAX_LAYOUTS)
+        return NULL; /* caller falls back to the Python method */
+    SubLayout *L = &sub_layouts[n_sub_layouts];
+    L->tp = tp;
+    L->ids_off = L->filter_off = L->ident_off = -1;
+    Py_ssize_t *named_offs[3] = {&L->ids_off, &L->filter_off, &L->ident_off};
+    PyObject *named[3] = {s_identifiers, s_filter, s_identifier};
+    int n = collect_object_slots(tp, L->offs, MAX_SLOTS, named_offs, named, 3);
+    if (PyErr_Occurred())
+        PyErr_Clear();
+    L->n = n > 0 ? n : 0;
+    L->ok = (n > 0 && L->ids_off >= 0 && L->filter_off >= 0 &&
+             L->ident_off >= 0);
+    n_sub_layouts++;
+    return L;
+}
+
+static ResLayout *
+res_layout_for(PyTypeObject *tp)
+{
+    for (int i = 0; i < n_res_layouts; i++)
+        if (res_layouts[i].tp == tp)
+            return &res_layouts[i];
+    if (n_res_layouts >= MAX_LAYOUTS)
+        return NULL;
+    ResLayout *L = &res_layouts[n_res_layouts];
+    L->tp = tp;
+    L->subscriptions_off = L->shared_off = L->shared_selected_off =
+        L->inline_off = -1;
+    Py_ssize_t dummy[MAX_SLOTS];
+    Py_ssize_t *named_offs[4] = {&L->subscriptions_off, &L->shared_off,
+                                 &L->shared_selected_off, &L->inline_off};
+    PyObject *named[4] = {s_subscriptions, s_shared, s_shared_selected,
+                          s_inline_subscriptions};
+    int n = collect_object_slots(tp, dummy, MAX_SLOTS, named_offs, named, 4);
+    if (PyErr_Occurred())
+        PyErr_Clear();
+    L->ok = (n > 0 && L->subscriptions_off >= 0 && L->shared_off >= 0 &&
+             L->shared_selected_off >= 0 && L->inline_off >= 0);
+    n_res_layouts++;
+    return L;
+}
+
+/* ---------------------------------------------------------------------- */
+
+#define SLOT_AT(obj, off) (*(PyObject **)((char *)(obj) + (off)))
+
+/* Subscription.self_merged_copy through the slot layout; falls back to
+ * the Python method for unknown layouts. New reference or NULL. */
+static PyObject *
+client_first_sighting(PyObject *sub)
+{
+    SubLayout *L = sub_layout_for(Py_TYPE(sub));
+    if (L == NULL || !L->ok)
+        return PyObject_CallMethodNoArgs(sub, s_self_merged_copy);
+    PyTypeObject *tp = Py_TYPE(sub);
+    PyObject *fresh = tp->tp_alloc(tp, 0);
+    if (fresh == NULL)
+        return NULL;
+    for (int i = 0; i < L->n; i++) {
+        PyObject *v = SLOT_AT(sub, L->offs[i]);
+        Py_XINCREF(v);
+        SLOT_AT(fresh, L->offs[i]) = v;
+    }
+    /* Result copies reference only strings/ints/bools plus the shared
+     * identifiers dict and share_name list (themselves still tracked):
+     * they cannot participate in reference cycles, so untracking them
+     * keeps tens of thousands of per-batch copies out of every young-gen
+     * GC scan — measurably half the materialization cost at full batch
+     * sizes (subtype_dealloc handles an already-untracked object fine). */
+    PyObject_GC_UnTrack(fresh);
+    PyObject *ids = SLOT_AT(fresh, L->ids_off);
+    PyObject *filter = SLOT_AT(fresh, L->filter_off);
+    PyObject *ident = SLOT_AT(fresh, L->ident_off);
+    if (filter != NULL && ident != NULL) {
+        if (ids == NULL || ids == Py_None) {
+            PyObject *d = PyDict_New();
+            if (d == NULL || PyDict_SetItem(d, filter, ident) < 0) {
+                Py_XDECREF(d);
+                Py_DECREF(fresh);
+                return NULL;
+            }
+            SLOT_AT(fresh, L->ids_off) = d; /* owns the new dict */
+            Py_XDECREF(ids);
+        }
+        else {
+            long idv = PyLong_AsLong(ident);
+            if (idv == -1 && PyErr_Occurred()) {
+                Py_DECREF(fresh);
+                return NULL;
+            }
+            if (idv > 0 && PyDict_SetItem(ids, filter, ident) < 0) {
+                Py_DECREF(fresh);
+                return NULL;
+            }
+        }
+    }
+    return fresh;
+}
+
+/* Merge one sid into the result dicts. Returns 0 on success, -1 on
+ * error. Skips (returns 0) on out-of-range sids — host-parity with
+ * expand_sids' bounds check. */
+static int
+merge_sid(int64_t sid, PyObject *snaps, Py_ssize_t n_snaps, int64_t window,
+          PyObject *subscriptions, PyObject *shared, PyObject *inline_subs)
+{
+    int64_t ordinal = sid / window;
+    int64_t local = sid % window;
+    if (sid < 0 || ordinal >= n_snaps)
+        return 0;
+
+    PyObject *snap = PyList_GET_ITEM(snaps, ordinal); /* borrowed */
+    if (!PyTuple_Check(snap) || PyTuple_GET_SIZE(snap) != 3) {
+        PyErr_SetString(PyExc_TypeError, "snapshot entries must be 3-tuples");
+        return -1;
+    }
+    PyObject *cli = PyTuple_GET_ITEM(snap, 0);
+    PyObject *shr = PyTuple_GET_ITEM(snap, 1);
+    PyObject *inl = PyTuple_GET_ITEM(snap, 2);
+    Py_ssize_t n_cli = PyTuple_GET_SIZE(cli);
+    Py_ssize_t n_shr = PyTuple_GET_SIZE(shr);
+    Py_ssize_t n_inl = PyTuple_GET_SIZE(inl);
+
+    if (local < n_cli) {
+        /* client subscription: first sighting copies, repeats merge */
+        PyObject *pair = PyTuple_GET_ITEM(cli, local);
+        PyObject *client = PyTuple_GET_ITEM(pair, 0);
+        PyObject *sub = PyTuple_GET_ITEM(pair, 1);
+        PyObject *prev = PyDict_GetItemWithError(subscriptions, client);
+        if (prev == NULL) {
+            if (PyErr_Occurred())
+                return -1;
+            PyObject *fresh = client_first_sighting(sub);
+            if (fresh == NULL)
+                return -1;
+            int r = PyDict_SetItem(subscriptions, client, fresh);
+            Py_DECREF(fresh);
+            return r;
+        }
+        PyObject *merged =
+            PyObject_CallMethodObjArgs(prev, s_merge, sub, NULL);
+        if (merged == NULL)
+            return -1;
+        int r = PyDict_SetItem(subscriptions, client, merged);
+        Py_DECREF(merged);
+        return r;
+    }
+    if (local < n_cli + n_shr) {
+        /* shared: group dict keyed on the full $SHARE filter; the stored
+         * subscription is referenced, not copied */
+        PyObject *pair = PyTuple_GET_ITEM(shr, local - n_cli);
+        PyObject *client = PyTuple_GET_ITEM(pair, 0);
+        PyObject *sub = PyTuple_GET_ITEM(pair, 1);
+        SubLayout *L = sub_layout_for(Py_TYPE(sub));
+        PyObject *gf;
+        int gf_owned = 0;
+        if (L != NULL && L->ok && (gf = SLOT_AT(sub, L->filter_off)) != NULL)
+            ; /* borrowed from the instance slot */
+        else {
+            gf = PyObject_GetAttr(sub, s_filter);
+            if (gf == NULL)
+                return -1;
+            gf_owned = 1;
+        }
+        PyObject *group = PyDict_GetItemWithError(shared, gf);
+        if (group == NULL) {
+            if (PyErr_Occurred()) {
+                if (gf_owned)
+                    Py_DECREF(gf);
+                return -1;
+            }
+            group = PyDict_New();
+            if (group == NULL || PyDict_SetItem(shared, gf, group) < 0) {
+                Py_XDECREF(group);
+                if (gf_owned)
+                    Py_DECREF(gf);
+                return -1;
+            }
+            Py_DECREF(group); /* borrowed from `shared` hereafter */
+        }
+        if (gf_owned)
+            Py_DECREF(gf);
+        return PyDict_SetItem(group, client, sub);
+    }
+    if (local < n_cli + n_shr + n_inl) {
+        /* inline: keyed on the subscription identifier */
+        PyObject *sub = PyTuple_GET_ITEM(inl, local - n_cli - n_shr);
+        SubLayout *L = sub_layout_for(Py_TYPE(sub));
+        PyObject *ident;
+        int owned = 0;
+        if (L != NULL && L->ok &&
+            (ident = SLOT_AT(sub, L->ident_off)) != NULL)
+            ;
+        else {
+            ident = PyObject_GetAttr(sub, s_identifier);
+            if (ident == NULL)
+                return -1;
+            owned = 1;
+        }
+        int r = PyDict_SetItem(inline_subs, ident, sub);
+        if (owned)
+            Py_DECREF(ident);
+        return r;
+    }
+    return 0; /* slot beyond the snapshot: skip (parity with bounds check) */
+}
+
+/* A fresh Subscribers result: tp_alloc + four empty dicts when the class
+ * has the expected slots layout, the plain constructor otherwise. The
+ * three gather dicts are returned as BORROWED pointers. */
+static PyObject *
+new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
+           PyObject **shared, PyObject **inline_subs)
+{
+    if (L != NULL && L->ok) {
+        PyTypeObject *tp = (PyTypeObject *)cls;
+        PyObject *o = tp->tp_alloc(tp, 0);
+        if (o == NULL)
+            return NULL;
+        PyObject *a = PyDict_New(), *b = PyDict_New(), *c = PyDict_New(),
+                 *d = PyDict_New();
+        if (a == NULL || b == NULL || c == NULL || d == NULL) {
+            Py_XDECREF(a);
+            Py_XDECREF(b);
+            Py_XDECREF(c);
+            Py_XDECREF(d);
+            Py_DECREF(o);
+            return NULL;
+        }
+        SLOT_AT(o, L->shared_off) = a;
+        SLOT_AT(o, L->shared_selected_off) = b;
+        SLOT_AT(o, L->subscriptions_off) = c;
+        SLOT_AT(o, L->inline_off) = d;
+        /* same cycle argument as the subscription copies: the result
+         * object only points at its four dicts (which stay tracked) */
+        PyObject_GC_UnTrack(o);
+        *subscriptions = c;
+        *shared = a;
+        *inline_subs = d;
+        return o;
+    }
+    PyObject *o = PyObject_CallNoArgs(cls);
+    if (o == NULL)
+        return NULL;
+    /* borrowed via the object's attributes: fetch and release */
+    PyObject *c = PyObject_GetAttr(o, s_subscriptions);
+    PyObject *a = PyObject_GetAttr(o, s_shared);
+    PyObject *d = PyObject_GetAttr(o, s_inline_subscriptions);
+    if (c == NULL || a == NULL || d == NULL) {
+        Py_XDECREF(c);
+        Py_XDECREF(a);
+        Py_XDECREF(d);
+        Py_DECREF(o);
+        return NULL;
+    }
+    /* the object keeps them alive for the caller's scope */
+    Py_DECREF(c);
+    Py_DECREF(a);
+    Py_DECREF(d);
+    *subscriptions = c;
+    *shared = a;
+    *inline_subs = d;
+    return o;
+}
+
+/* resolve_batch(packed, n_topics, P, snaps, window, subscribers_cls)
+ *   packed:   C-contiguous int32 buffer, rows of 2P+2 ints
+ *             (P starts | P counts | total | overflow)
+ *   snaps:    list of (clients, shared, inline) tuples (sid // window)
+ *   returns:  (results, overflow_indices) — results[i] is a Subscribers
+ *             instance, or None where the row's overflow flag was set
+ *             (the caller re-walks those topics on the host trie). */
+static PyObject *
+resolve_batch(PyObject *self, PyObject *args)
+{
+    PyObject *packed_obj, *snaps, *subscribers_cls;
+    Py_ssize_t n_topics, P;
+    long long window;
+    if (!PyArg_ParseTuple(args, "OnnOLO", &packed_obj, &n_topics, &P,
+                          &snaps, &window, &subscribers_cls))
+        return NULL;
+    if (!PyList_Check(snaps)) {
+        PyErr_SetString(PyExc_TypeError, "snaps must be a list");
+        return NULL;
+    }
+    if (window <= 0 || P < 0 || !PyType_Check(subscribers_cls)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "window must be > 0, P >= 0, cls a type");
+        return NULL;
+    }
+
+    Py_buffer view;
+    if (PyObject_GetBuffer(packed_obj, &view, PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    Py_ssize_t row_ints = 2 * P + 2;
+    if (view.itemsize != 4 ||
+        view.len < n_topics * row_ints * (Py_ssize_t)sizeof(int32_t)) {
+        PyBuffer_Release(&view);
+        PyErr_SetString(PyExc_ValueError,
+                        "packed buffer must be int32 [n_topics, 2P+2]");
+        return NULL;
+    }
+    const int32_t *data = (const int32_t *)view.buf;
+    Py_ssize_t n_snaps = PyList_GET_SIZE(snaps);
+    ResLayout *RL = res_layout_for((PyTypeObject *)subscribers_cls);
+
+    PyObject *results = PyList_New(n_topics);
+    PyObject *overflow_idx = PyList_New(0);
+    if (results == NULL || overflow_idx == NULL)
+        goto fail;
+
+    for (Py_ssize_t i = 0; i < n_topics; i++) {
+        const int32_t *row = data + i * row_ints;
+        if (row[2 * P + 1]) { /* overflow: host re-walk decides */
+            PyObject *idx = PyLong_FromSsize_t(i);
+            if (idx == NULL || PyList_Append(overflow_idx, idx) < 0) {
+                Py_XDECREF(idx);
+                goto fail;
+            }
+            Py_DECREF(idx);
+            Py_INCREF(Py_None);
+            PyList_SET_ITEM(results, i, Py_None);
+            continue;
+        }
+        PyObject *subscriptions, *shared, *inline_subs;
+        PyObject *subs_obj = new_result(subscribers_cls, RL, &subscriptions,
+                                        &shared, &inline_subs);
+        if (subs_obj == NULL)
+            goto fail;
+        PyList_SET_ITEM(results, i, subs_obj); /* steals */
+        for (Py_ssize_t p = 0; p < P; p++) {
+            int32_t cnt = row[P + p];
+            if (cnt <= 0)
+                continue;
+            int64_t start = row[p];
+            for (int32_t k = 0; k < cnt; k++) {
+                if (merge_sid(start + k, snaps, n_snaps, window,
+                              subscriptions, shared, inline_subs) < 0)
+                    goto fail;
+            }
+        }
+    }
+
+    PyBuffer_Release(&view);
+    PyObject *out = PyTuple_Pack(2, results, overflow_idx);
+    Py_DECREF(results);
+    Py_DECREF(overflow_idx);
+    return out;
+
+fail:
+    PyBuffer_Release(&view);
+    Py_XDECREF(results);
+    Py_XDECREF(overflow_idx);
+    return NULL;
+}
+
+/* expand_sids_list(sids, snaps, window, subscribers_obj) — the same merge
+ * over an explicit sid list into an EXISTING Subscribers instance; used by
+ * the differential tests and any caller holding slot arrays rather than
+ * ranges. Duplicate sids merge twice exactly like expand_sids would
+ * without its seen-set — callers pass de-duplicated lists (ranges are
+ * disjoint by construction). */
+static PyObject *
+expand_sids_list(PyObject *self, PyObject *args)
+{
+    PyObject *sids, *snaps, *subs_obj;
+    long long window;
+    if (!PyArg_ParseTuple(args, "OOLO", &sids, &snaps, &window, &subs_obj))
+        return NULL;
+    if (!PyList_Check(sids) || !PyList_Check(snaps)) {
+        PyErr_SetString(PyExc_TypeError, "sids and snaps must be lists");
+        return NULL;
+    }
+    if (window <= 0) {
+        PyErr_SetString(PyExc_ValueError, "window must be > 0");
+        return NULL;
+    }
+    PyObject *subscriptions = PyObject_GetAttr(subs_obj, s_subscriptions);
+    PyObject *shared = PyObject_GetAttr(subs_obj, s_shared);
+    PyObject *inline_subs =
+        PyObject_GetAttr(subs_obj, s_inline_subscriptions);
+    if (subscriptions == NULL || shared == NULL || inline_subs == NULL) {
+        Py_XDECREF(subscriptions);
+        Py_XDECREF(shared);
+        Py_XDECREF(inline_subs);
+        return NULL;
+    }
+    Py_ssize_t n_snaps = PyList_GET_SIZE(snaps);
+    Py_ssize_t n = PyList_GET_SIZE(sids);
+    int err = 0;
+    for (Py_ssize_t i = 0; i < n && !err; i++) {
+        PyObject *sid_obj = PyList_GET_ITEM(sids, i);
+        long long sid = PyLong_AsLongLong(sid_obj);
+        if (sid == -1 && PyErr_Occurred()) {
+            err = 1;
+            break;
+        }
+        if (merge_sid(sid, snaps, n_snaps, window, subscriptions, shared,
+                      inline_subs) < 0)
+            err = 1;
+    }
+    Py_DECREF(subscriptions);
+    Py_DECREF(shared);
+    Py_DECREF(inline_subs);
+    if (err)
+        return NULL;
+    Py_INCREF(subs_obj);
+    return subs_obj;
+}
+
+static PyMethodDef methods[] = {
+    {"resolve_batch", resolve_batch, METH_VARARGS,
+     "Expand packed device range rows into Subscribers results."},
+    {"expand_sids_list", expand_sids_list, METH_VARARGS,
+     "Merge an explicit sid list into an existing Subscribers instance."},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "mqtt_accel",
+    "C materializer for device match results (see accelmod.c).", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit_mqtt_accel(void)
+{
+    s_merge = PyUnicode_InternFromString("merge");
+    s_filter = PyUnicode_InternFromString("filter");
+    s_identifier = PyUnicode_InternFromString("identifier");
+    s_identifiers = PyUnicode_InternFromString("identifiers");
+    s_subscriptions = PyUnicode_InternFromString("subscriptions");
+    s_shared = PyUnicode_InternFromString("shared");
+    s_shared_selected = PyUnicode_InternFromString("shared_selected");
+    s_inline_subscriptions =
+        PyUnicode_InternFromString("inline_subscriptions");
+    s_self_merged_copy = PyUnicode_InternFromString("self_merged_copy");
+    if (!s_merge || !s_filter || !s_identifier || !s_identifiers ||
+        !s_subscriptions || !s_shared || !s_shared_selected ||
+        !s_inline_subscriptions || !s_self_merged_copy)
+        return NULL;
+    return PyModule_Create(&moduledef);
+}

@@ -111,6 +111,16 @@ class _Gen:
         s = self.delta_trie.subscribers(topic)
         return bool(s.subscriptions or s.shared or s.inline_subscriptions)
 
+    def affected_batch(self, topics: list[str]) -> list[int]:
+        """Indices of topics the overlay may affect. The batch form lets
+        the resolver skip the per-topic predicate loop entirely when no
+        mutations are pending — the common case for a broker whose
+        subscriptions arrive at connect time."""
+        if not self.deltas:
+            return []
+        affected = self.affected
+        return [i for i, t in enumerate(topics) if t and affected(t)]
+
 
 class DeltaMatcher:
     """Drop-in for ``TopicsIndex.subscribers`` that serves device matches
@@ -299,9 +309,12 @@ class DeltaMatcher:
 
     def match_topics_async(self, topics: list[str]):
         """Issue one batch; the returned resolver yields the results.
-        The generation (snapshot + overlay) is captured at issue time."""
+        The generation (snapshot + overlay) is captured at issue time; the
+        generation object itself is the route-to-host authority (it
+        exposes both the per-topic ``affected`` predicate and the batch
+        form the C materializer prefers)."""
         gen = self._gen  # atomic read: one generation per call
-        return gen.snap.match_topics_async(topics, route_to_host=gen.affected)
+        return gen.snap.match_topics_async(topics, route_to_host=gen)
 
     def match_topics(self, topics: list[str]) -> list[Subscribers]:
         """Match a batch of topics, bit-identical to the live host trie."""

@@ -125,6 +125,20 @@ class FlatIndex:
     n_sat: int = 0  # build-saturated buckets (probes host-route)
     n_spill: int = 0  # entries with more ids than the window (host-route)
     n_orphans: int = 0  # sid windows abandoned by in-place folds
+    # Wildcard-free fast path (SURVEY §7 hard part 4: "host fast-path for
+    # exact-match-only tries"): when the filter set has NO '+'/'#' anywhere,
+    # matching degenerates to one dict probe — path string -> snapshot
+    # tuple — and the device round trip (ms-scale on a tunneled link) is
+    # pure loss. ``exact_map`` covers ALL terminal paths, including
+    # over-deep and spilled entries the device table cannot serve, so the
+    # fast path has no fallback classes at all. None when the filter set
+    # has wildcards (or after a fold introduces one).
+    exact_map: Any = None
+
+    @property
+    def wildcard_free(self) -> bool:
+        """True when the exact-map fast path can serve every topic."""
+        return self.exact_map is not None
 
     @property
     def num_nodes(self) -> int:
@@ -190,12 +204,24 @@ class FlatIndex:
         # where every unsubscribe is a large fraction) never thrash
         if self.n_orphans * self.window > max(4096, len(self.subs) // 4):
             return None
+        # a fold appends at most one fresh window per filter: re-check the
+        # sid-space int32 bound build_flat_index enforces (conservative
+        # upper estimate; a None forces the rebuild that re-packs sids)
+        if len(self.subs) + len(filters) * self.window >= 1 << 30:
+            return None
 
         seen_paths = set()
         touched: set = set()
         pats_changed = False
         empty_snap = ((), (), ())
         cnt_mask = (1 << _CNT_BITS) - 1
+        # exact-map maintenance is STAGED and applied only when the whole
+        # fold succeeds: the dict is shared with the live instance
+        # (clone_for_fold does not copy it — a 1M-entry dict copy would
+        # defeat the fold's purpose), so an aborted fold must leave it
+        # byte-identical to the snapshot the live instance serves
+        map_updates: list = []
+        map_disable = False
 
         for f in filters:
             parts = f.split("/")
@@ -209,6 +235,30 @@ class FlatIndex:
             is_hash = bool(parts) and parts[-1] == "#"
             levels = parts[:-1] if is_hash else parts
             depth = len(levels)
+
+            # ONE live node snapshot per filter (torn reads retried like
+            # the full walk); serves both the exact-map and the bucket fold
+            snap = None
+            for _attempt in range(8):
+                try:
+                    node = index._seek(f, 2 if share_rooted else 0)
+                    snap = empty_snap if node is None else _node_snap(node)
+                    break
+                except (RuntimeError, KeyError):
+                    continue
+            if snap is None:
+                return None  # persistent tear: let the full rebuild quiesce
+
+            if self.exact_map is not None and not map_disable:
+                if is_hash or "+" in levels:
+                    # a wildcard filter ends the exact-only regime; the
+                    # fast path disengages until the next full rebuild
+                    # re-evaluates the filter set
+                    map_disable = True
+                else:
+                    map_updates.append(
+                        ("/".join(parts), None if snap == empty_snap else snap)
+                    )
             if depth > self.max_levels:
                 continue  # over-deep: host-routed by length, never indexed
 
@@ -236,31 +286,6 @@ class FlatIndex:
             h1 = np.uint32(h1)
             h2 = np.uint32(h2)
 
-            # live node snapshot (torn reads retried like the full walk)
-            snap = None
-            for _attempt in range(8):
-                try:
-                    node = index._seek(f, 2 if share_rooted else 0)
-                    if node is None:
-                        snap = empty_snap
-                    else:
-                        cli = tuple(node.subscriptions.internal.items())
-                        shr = (
-                            tuple(
-                                (c, s)
-                                for group in node.shared.internal.values()
-                                for c, s in group.items()
-                            )
-                            if node.shared.internal
-                            else ()
-                        )
-                        inl = tuple(node.inline_subscriptions.internal.values())
-                        snap = (cli, shr, inl)
-                    break
-                except (RuntimeError, KeyError):
-                    continue
-            if snap is None:
-                return None  # persistent tear: let the full rebuild quiesce
             n_cli, n_shr, n_inl = len(snap[0]), len(snap[1]), len(snap[2])
             total = n_cli + n_shr + n_inl
 
@@ -375,6 +400,20 @@ class FlatIndex:
                 self.n_entries += 1
                 touched.add(slot)
 
+        # the fold succeeded: apply the staged exact-map maintenance. The
+        # dict is shared with the live instance; mutating it here (before
+        # the owner swaps this clone in) is safe for the same reason the
+        # in-place np table edits are — every filter touched is in the
+        # delta overlay, so in-flight resolvers host-route it
+        if map_disable:
+            self.exact_map = None
+        elif self.exact_map is not None:
+            for key_str, map_snap in map_updates:
+                if map_snap is None:
+                    self.exact_map.pop(key_str, None)
+                else:
+                    self.exact_map[key_str] = map_snap
+
         flat_rows = self.table  # [S, ROW_INTS] view of the same buffer
         updates = [(s, flat_rows[s].copy()) for s in sorted(touched)]
         return updates, pats_changed
@@ -402,6 +441,12 @@ class _LazySubTable:
 
     def __len__(self) -> int:
         return self._n
+
+    @property
+    def snaps(self) -> list:
+        """The raw snapshot tuples, indexed by entry ordinal — the C
+        materializer (native/accelmod.c) walks these directly."""
+        return self._snaps
 
     def __getitem__(self, sid: int) -> SubEntry:
         entry = self.memo.get(sid)
@@ -443,6 +488,25 @@ class _LazySubTable:
         ordinal = len(self._snaps) - 1
         self._n += self._window
         return ordinal
+
+
+def _node_snap(node) -> tuple:
+    """Capture one trie node's subscriptions as an immutable snapshot
+    tuple ``(clients, shared, inline)`` — the unit both the sid table and
+    the exact-map fast path serve from. Reads the live maps without the
+    lock (tears retry, same contract as ``_walk_terminals``)."""
+    cli = tuple(node.subscriptions.internal.items())
+    shr = (
+        tuple(
+            (c, s)
+            for group in node.shared.internal.values()
+            for c, s in group.items()
+        )
+        if node.shared.internal
+        else ()
+    )
+    inl = tuple(node.inline_subscriptions.internal.values())
+    return (cli, shr, inl)
 
 
 def _walk_terminals(index: TopicsIndex):
@@ -502,8 +566,11 @@ def build_flat_index(
     depths = np.zeros(n_all, dtype=np.int32)
     masks = np.zeros(n_all, dtype=np.uint32)
     level_strs: list[list[str]] = []
+    any_wild = False  # any '+'/'#' anywhere (incl. over-deep paths)
     for i, path in enumerate(paths):
         hsh = bool(path) and path[-1] == "#"
+        if hsh or "+" in path:
+            any_wild = True
         levels = path[:-1] if hsh else path
         if len(levels) > max_levels:
             keep[i] = False
@@ -586,18 +653,7 @@ def build_flat_index(
             _time.sleep(0)
         top_wilds[i] = bool(path) and path[0] in ("+", "#")
         # .internal (no locked copy): tears retry, see _walk_terminals
-        cli = tuple(node.subscriptions.internal.items())
-        shr = (
-            tuple(
-                (c, s)
-                for group in node.shared.internal.values()
-                for c, s in group.items()
-            )
-            if node.shared.internal
-            else ()
-        )
-        inl = tuple(node.inline_subscriptions.internal.values())
-        snaps[i] = (cli, shr, inl)
+        cli, shr, inl = snaps[i] = _node_snap(node)
         n_cli[i] = len(cli)
         n_shr[i] = len(shr)
         n_inl[i] = len(inl)
@@ -689,6 +745,17 @@ def build_flat_index(
         pat_depth = _pad_to(pat_depth, pb, np.int32(-1))
         pat_mask = _pad_to(pat_mask, pb, np.uint32(0))
 
+    # wildcard-free fast path: every terminal path (kept, spilled, and
+    # over-deep alike) keyed by its literal path string — one dict probe
+    # replaces the whole device round trip (FlatIndex.exact_map)
+    exact_map = None
+    if not any_wild:
+        exact_map = {}
+        for i in sel:
+            exact_map["/".join(level_strs[i])] = snaps[i]
+        for i in np.nonzero(~keep)[0]:
+            exact_map["/".join(paths[i])] = _node_snap(nodes[i])
+
     return FlatIndex(
         table=table,
         pat_kind=pat_kind,
@@ -702,6 +769,7 @@ def build_flat_index(
         n_subs=n_subs_total,
         n_sat=n_sat,
         n_spill=n_spill,
+        exact_map=exact_map,
     )
 
 

@@ -35,6 +35,19 @@ from .flat import (
 )
 from .hashing import tokenize_topics
 
+_ACCEL_CACHE: list = []
+
+
+def _accel():
+    """The C materializer module (native/accelmod.c) or None; resolved once
+    and cached (the native loader itself is also memoized, this just skips
+    the call overhead in the per-batch path)."""
+    if not _ACCEL_CACHE:
+        from .. import native
+
+        _ACCEL_CACHE.append(native.accel())
+    return _ACCEL_CACHE[0]
+
 
 def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None) -> Subscribers:
     """Merge device sub ids (local to ``table``) into a Subscribers result,
@@ -43,14 +56,14 @@ def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None
     and mesh-sharded matchers.
 
     This is the broker's per-publish result materialization — the hottest
-    host loop after the kernel itself — so it is written for CPython speed:
-    pass ``sids`` as a plain int list when possible (numpy scalar iteration
-    is ~3x slower), and a client's first sighting takes an inlined
-    self-merge (``__new__`` + ``__dict__`` copy + the identifiers
-    materialization from packets.py ``Subscription.merge``) instead of the
-    ~3x costlier general merge call. The result stays field-for-field what
-    the host gather produces, including the shared-and-extended identifiers
-    map when the stored subscription carries one."""
+    host loop after the kernel itself. The production path is the C
+    materializer (native/accelmod.c), which performs the same merges via
+    slot offsets; this Python form is the fallback and the semantic
+    source of truth the differential tests pin the C module against:
+    a client's first sighting takes ``Subscription.self_merged_copy`` —
+    value-identical to ``merge(self, self)`` including the
+    shared-and-extended identifiers map — and later sightings call the
+    real ``merge``."""
     if seen is None:
         seen = set()
     if not isinstance(sids, list):
@@ -61,7 +74,6 @@ def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None
     shared = subs.shared
     inline = subs.inline_subscriptions
     memo_get = getattr(table, "memo", {}).get
-    sub_new = Subscription.__new__
     for sid in sids:
         if sid < 0 or sid >= n or sid in seen:
             continue
@@ -75,15 +87,7 @@ def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None
             sub = entry.subscription
             prev = subscriptions.get(client)
             if prev is None:
-                # inlined self-merge (Subscription.merge with n=self)
-                s = sub_new(Subscription)
-                s.__dict__ = sub.__dict__.copy()
-                ids = s.identifiers
-                if ids is None:
-                    s.identifiers = {s.filter: s.identifier}
-                elif s.identifier > 0:
-                    ids[s.filter] = s.identifier
-                subscriptions[client] = s
+                subscriptions[client] = sub.self_merged_copy()
             else:
                 subscriptions[client] = prev.merge(sub)
         elif kind == KIND_SHARED:
@@ -115,6 +119,9 @@ class MatcherStats:
     rebuilds: int = 0
     rebuild_seconds: float = 0.0
     folds: int = 0  # incremental folds that avoided a full rebuild
+    # topics served by the exact-map host fast path (wildcard-free filter
+    # sets answer from one dict probe; no device round trip)
+    host_fast: int = 0
 
     def as_dict(self) -> dict:
         out = {
@@ -125,6 +132,7 @@ class MatcherStats:
             "rebuilds": self.rebuilds,
             "rebuild_seconds": round(self.rebuild_seconds, 3),
             "folds": self.folds,
+            "host_fast": self.host_fast,
         }
         out["fallback_ratio"] = (
             round(self.host_fallbacks / self.topics, 6) if self.topics else 0.0
@@ -308,12 +316,24 @@ class TpuMatcher:
         expansion, returning ``list[Subscribers]``. Keeping a second batch
         in flight while the first resolves hides the host<->device round
         trip — the broker's staging loop and the benchmark both rely on it.
+
+        ``route_to_host`` forces extra topics onto the host walk. It is
+        either a plain ``topic -> bool`` predicate or an object exposing
+        ``affected(topic)`` plus ``affected_batch(topics) -> indices`` (the
+        delta overlay, ops/delta._Gen) — the batch form lets the C
+        materializer skip the per-topic Python predicate loop entirely
+        when no mutations are pending.
         """
         import jax.numpy as jnp
 
         if self._state is None or self.stale:
             self.rebuild()
         flat, arrays, _ = self._state
+        if flat.exact_map is not None:
+            # wildcard-free filter set: one host dict probe per topic beats
+            # any device round trip (SURVEY §7 hard part 4) — serve
+            # synchronously, return a pre-resolved resolver
+            return self._match_exact_fast(topics, flat, route_to_host)
         # pad ragged batches (the staging loop's windows) to a power-of-two
         # bucket so every batch size reuses one jitted executable; padded
         # rows are ignored at resolve time
@@ -327,11 +347,35 @@ class TpuMatcher:
             jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
             max_levels=flat.max_levels,
         )
+        try:
+            # start the D2H as soon as the kernel finishes instead of when
+            # the resolver blocks: on a high-RTT tunneled link this overlaps
+            # the transfer with the pipeline's other in-flight batches
+            packed_dev.copy_to_host_async()
+        except AttributeError:  # pragma: no cover - older jax arrays
+            pass
         P = flat.pat_depth.shape[0]
+        if route_to_host is None:
+            pred = batch_pred = None
+        elif hasattr(route_to_host, "affected_batch"):
+            pred = route_to_host.affected
+            batch_pred = route_to_host.affected_batch
+        else:
+            pred = route_to_host
+            batch_pred = None
 
         def resolve() -> list[Subscribers]:
             packed = np.asarray(packed_dev)  # ONE D2H: [B, 2P+2]
             packed = packed[: len(topics)]  # drop bucket-padding rows
+            stats = self.stats
+            stats.batches += 1
+            stats.topics += len(topics)
+            acc = _accel()
+            if acc is not None:
+                return self._resolve_native(
+                    acc, packed, topics, flat, P,
+                    len_overflow[: len(topics)], pred, batch_pred,
+                )
             # the ONLY host-route class left: device overflow (sat/spill)
             # or >max_levels topics — ranges carry the COMPLETE result,
             # so every fallback is also an overflow
@@ -343,16 +387,11 @@ class TpuMatcher:
             out_rows = packed[:, : 2 * P].tolist()
             results = []
             results_append = results.append
-            stats = self.stats
-            stats.batches += 1
-            stats.topics += len(topics)
             table = flat.subs
             for i, topic in enumerate(topics):
                 if not topic:
                     results_append(Subscribers())  # empty topic never matches
-                elif overflow[i] or (
-                    route_to_host is not None and route_to_host(topic)
-                ):
+                elif overflow[i] or (pred is not None and pred(topic)):
                     stats.host_fallbacks += 1
                     stats.overflows += int(overflow[i])
                     results_append(self.topics.subscribers(topic))  # host fallback
@@ -368,6 +407,115 @@ class TpuMatcher:
             return results
 
         return resolve
+
+    def _match_exact_fast(self, topics: list[str], flat, route_to_host):
+        """Serve a batch from the exact-map (wildcard-free filter sets):
+        every topic is one dict probe + one snapshot expansion, covering
+        spilled and over-deep entries too — no fallback classes, no device
+        dispatch. Results are bit-identical to the host walk: in an
+        exact-only trie the walk gathers exactly the literal path's node.
+        Returns a pre-resolved zero-arg resolver (API parity with the
+        device path)."""
+        stats = self.stats
+        stats.batches += 1
+        stats.topics += len(topics)
+        if route_to_host is None:
+            routed = ()
+        elif hasattr(route_to_host, "affected_batch"):
+            routed = frozenset(route_to_host.affected_batch(topics))
+        else:
+            routed = frozenset(
+                i for i, t in enumerate(topics) if t and route_to_host(t)
+            )
+        get = flat.exact_map.get
+        expand = self._expand_snap
+        subscribers = self.topics.subscribers
+        results = []
+        results_append = results.append
+        n_fast = 0
+        for i, topic in enumerate(topics):
+            if not topic:
+                results_append(Subscribers())
+            elif i in routed:
+                stats.host_fallbacks += 1
+                results_append(subscribers(topic))
+            else:
+                n_fast += 1
+                snap = get(topic)
+                results_append(expand(snap) if snap is not None else Subscribers())
+        stats.host_fast += n_fast
+        return lambda: results
+
+    @staticmethod
+    def _expand_snap(snap) -> Subscribers:
+        """Materialize one node snapshot tuple into a Subscribers result —
+        the single-node case of the host gather (topics.go:631-678): each
+        client appears at most once per node, so the per-client entry is
+        the inlined self-merge copy from ``expand_sids``; shared entries
+        are referenced (not copied) keyed on the group filter; inline
+        entries key on identifier."""
+        subs = Subscribers()
+        cli, shr, inl = snap
+        subscriptions = subs.subscriptions
+        for client, sub in cli:
+            subscriptions[client] = sub.self_merged_copy()
+        if shr:
+            shared = subs.shared
+            for client, sub in shr:
+                group = shared.get(sub.filter)
+                if group is None:
+                    group = shared[sub.filter] = {}
+                group[client] = sub
+        if inl:
+            inline = subs.inline_subscriptions
+            for isub in inl:
+                inline[isub.identifier] = isub
+        return subs
+
+    def _resolve_native(
+        self, acc, packed, topics, flat, P, len_overflow, pred, batch_pred
+    ) -> list[Subscribers]:
+        """Materialize one resolved batch through the C extension
+        (native/accelmod.c), byte-identical to the Python loop above:
+        overflow rows and delta-routed topics re-walk the host trie, empty
+        topics yield empty results, everything else expands from the packed
+        sid ranges."""
+        stats = self.stats
+        col = 2 * P + 1
+        # every host-route class — device overflow, over-deep topics, and
+        # delta-routed topics — is merged into the overflow column BEFORE
+        # the C call, so routed rows are never materialized just to be
+        # thrown away by a patch-up loop
+        true_overflow = (packed[:, col] != 0) | len_overflow
+        if batch_pred is not None:
+            routed = batch_pred(topics)
+        elif pred is not None:
+            routed = [i for i, t in enumerate(topics) if t and pred(t)]
+        else:
+            routed = ()
+        if len_overflow.any() or len(routed):
+            packed = packed.copy()
+            packed[:, col] |= len_overflow
+            if len(routed):
+                packed[np.asarray(routed, dtype=np.int64), col] = 1
+        results, ovf_idx = acc.resolve_batch(
+            packed, len(topics), P, flat.subs.snaps, flat.window, Subscribers
+        )
+        subscribers = self.topics.subscribers
+        for i in ovf_idx:
+            topic = topics[i]
+            if topic:
+                stats.host_fallbacks += 1
+                # routed-only rows are fallbacks but not device overflows
+                stats.overflows += int(bool(true_overflow[i]))
+                results[i] = subscribers(topic)
+            else:
+                results[i] = Subscribers()
+        if "" in topics:  # empty topic never matches (host-walk parity)
+            for i, topic in enumerate(topics):
+                if not topic:
+                    results[i] = Subscribers()
+        return results
 
     def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
         """Match a batch of topics; every result is bit-identical to the

@@ -519,6 +519,10 @@ class ShardedTpuMatcher:
                 for a in (tok1, tok2, lengths, is_dollar)
             ),
         )
+        # accept both route forms (ops/matcher.py): a plain predicate or
+        # the delta overlay object exposing .affected
+        if route_to_host is not None and hasattr(route_to_host, "affected"):
+            route_to_host = route_to_host.affected
 
         def resolve() -> list[Subscribers]:
             out = np.asarray(out_dev)  # [S, B, K]

@@ -170,6 +170,14 @@ class Options:
     matcher_stage_window_ms: float = 2.0
     matcher_stage_max_batch: int = 4096
     matcher_stage_max_inflight: int = 4
+    # p99 latency budget for one staged publish (staging.MatchStage adapts
+    # window + batch cap to hold it); <= 0 disables adaptation
+    matcher_stage_latency_budget_ms: float = 250.0
+    # raise the process-global CPython GC thresholds for broker throughput
+    # (utils/gctune.py). Default on for the standalone broker; an embedding
+    # application that wants its own GC cadence sets this False (the change
+    # is process-wide and logged at info level)
+    gc_tuning: bool = True
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -343,9 +351,15 @@ class Server:
         """Start hooks, restore persisted state, init+serve all listeners,
         begin the housekeeping loop (server.go:334-371)."""
         self.log.info("mqtt_tpu starting version=%s", VERSION)
-        from .utils.gctune import tune_for_throughput
+        if self.options.gc_tuning:
+            # process-global: embedders opt out via Options.gc_tuning
+            from .utils.gctune import tune_for_throughput
 
-        tune_for_throughput()
+            tune_for_throughput()
+            self.log.info(
+                "gc thresholds tuned for broker throughput "
+                "(Options.gc_tuning=False restores the application's cadence)"
+            )
         # warm the native core now — its first-use lazy compile would
         # otherwise block the event loop mid-connection
         from .native import available as _native_available
@@ -368,12 +382,14 @@ class Server:
         if self.matcher is not None:
             from .staging import MatchStage
 
+            budget_ms = self.options.matcher_stage_latency_budget_ms
             self._stage = MatchStage(
                 self.matcher,
                 host_fallback=self.topics.subscribers,
                 window_s=self.options.matcher_stage_window_ms / 1e3,
                 max_batch=self.options.matcher_stage_max_batch,
                 max_inflight=self.options.matcher_stage_max_inflight,
+                latency_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
             )
             self._stage.start()
 
@@ -984,7 +1000,7 @@ class Server:
             return False
         gen = self.hooks.generation
         if gen != self._fastpub_gate_gen:
-            self._fastpub_gate_ok = not self.hooks.provides(
+            ok = not self.hooks.provides(
                 ON_PACKET_READ,
                 ON_PUBLISH,
                 ON_PACKET_ENCODE,
@@ -992,7 +1008,15 @@ class Server:
                 ON_PUBLISHED,
                 ON_PACKET_PROCESSED,
             )
-            self._fastpub_gate_gen = gen
+            # only cache when no add_hook raced the scan: Hooks.add bumps
+            # the generation on BOTH sides of the list publish, so a scan
+            # that saw a mid-add list can never be cached as current (it
+            # still decides this one frame — the same one-frame window the
+            # reference's lock-free hook swap has, hooks.go:150-170)
+            if self.hooks.generation == gen:
+                self._fastpub_gate_ok = ok
+                self._fastpub_gate_gen = gen
+            return ok
         return self._fastpub_gate_ok
 
     @staticmethod

@@ -10,12 +10,21 @@ pipelined batch engine:
   the caller (one asyncio task per client, mirroring the reference's
   goroutine-per-connection) awaits it, so *that* client blocks while every
   other client keeps being served.
-- A collector task gathers everything submitted within ``window_s`` (or up
-  to ``max_batch``) and issues ONE ``match_topics_async`` dispatch — the
-  issue side runs on the event loop (host tokenization is native C and the
-  device dispatch is asynchronous), so batches are dispatched ahead while
-  earlier ones are still resolving (the depth-``max_inflight`` pipeline
-  that hides the host<->device round trip).
+- A collector task gathers everything submitted within the accumulation
+  window (or up to the batch cap) and issues ONE ``match_topics_async``
+  dispatch — the issue side runs on the event loop (host tokenization is
+  native C and the device dispatch is asynchronous), so batches are
+  dispatched ahead while earlier ones are still resolving (the
+  depth-``max_inflight`` pipeline that hides the host<->device round
+  trip).
+- The window and the batch cap ADAPT to the measured per-batch service
+  time against ``latency_budget_s`` (SURVEY §7 hard part 4: "adaptive
+  batch window + host fast-path"): under light load the window shrinks
+  toward immediate dispatch (p99 ~= one service time); under heavy load
+  batches grow until the service-time EWMA approaches the budget, then
+  the cap backs off so publish latency stays bounded instead of batches
+  compounding (16K-topic batches cost >1.5s on a tunneled link —
+  BENCH_r04 p99).
 - A drainer task resolves batches IN ORDER off the event loop (the D2H
   sync blocks, so it runs in the executor) and completes the futures in
   submission order — per-publish fan-out order is exactly submission
@@ -46,17 +55,71 @@ class MatchStage:
         window_s: float = 0.002,
         max_batch: int = 4096,
         max_inflight: int = 4,
+        latency_budget_s: Optional[float] = 0.25,
+        min_batch: int = 64,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
-        self.window_s = window_s
+        self.window_s = window_s  # the MAXIMUM accumulation window
         self.max_batch = max_batch
         self.max_inflight = max_inflight
+        # p99 target for one staged publish: wait + service must fit it.
+        # None disables adaptation (fixed window + cap — benchmarking the
+        # throughput-optimal point needs this)
+        self.latency_budget_s = latency_budget_s
+        self.min_batch = max(1, min_batch)
         self._pending: list[tuple[str, asyncio.Future]] = []
         self._wake: Optional[asyncio.Event] = None
         self._queue: Optional[asyncio.Queue] = None
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
+        self._ewma_s = 0.0  # per-batch service-time EWMA (drainer-updated)
+        self._batch_cap = max_batch if latency_budget_s is None else max(
+            self.min_batch, min(max_batch, 1024)
+        )
+
+    @property
+    def batch_cap(self) -> int:
+        """The current adaptive batch-size cap (<= max_batch)."""
+        return self._batch_cap
+
+    def _window(self) -> float:
+        """The adaptive accumulation sleep: a fraction of the measured
+        service time (batching beyond that trades latency for nothing —
+        the pipeline is already busy for that long), never exceeding the
+        configured maximum window or the latency budget's headroom."""
+        if self.latency_budget_s is None or self._ewma_s <= 0.0:
+            return self.window_s
+        headroom = self.latency_budget_s - self._ewma_s
+        if headroom <= 0.0:
+            return 0.0  # over budget already: dispatch immediately
+        return min(self.window_s, 0.5 * self._ewma_s, headroom)
+
+    def _observe_service(self, dt: float, n: int, depth: int) -> None:
+        """Feed one batch's resolve wall time into the controller: grow
+        the cap while service time is comfortably under budget, shrink it
+        proportionally when a batch overruns (service scales ~linearly in
+        batch size past the fixed dispatch cost).
+
+        ``depth`` is the number of batches that were queued behind this
+        one: a submitted publish waits for every batch ahead of it, so the
+        budget must bound depth x service, not one batch's service — the
+        controller compares the EFFECTIVE latency (dt * depth) against the
+        budget."""
+        self._ewma_s = dt if self._ewma_s == 0.0 else (
+            0.7 * self._ewma_s + 0.3 * dt
+        )
+        budget = self.latency_budget_s
+        if budget is None or n <= 0:
+            return
+        effective = dt * max(1, depth)
+        if effective > 0.8 * budget:
+            target = max(int(n * 0.6 * budget / effective), self.min_batch)
+            if target < self._batch_cap:
+                self._batch_cap = target
+        elif effective < 0.4 * budget and n >= self._batch_cap:
+            # only grow when the cap actually bound the batch
+            self._batch_cap = min(self.max_batch, self._batch_cap * 2)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -110,12 +173,17 @@ class MatchStage:
                 continue
             # the accumulation window: give concurrent publishers a beat to
             # land in this batch (latency cost) so the device sees real
-            # batches (throughput win); capped by max_batch
-            if len(self._pending) < self.max_batch and self.window_s > 0:
-                await asyncio.sleep(self.window_s)
+            # batches (throughput win); adaptively sized (see _window) and
+            # capped by the adaptive batch cap
+            cap = self._batch_cap
+            if len(self._pending) < cap:
+                w = self._window()
+                if w > 0:
+                    await asyncio.sleep(w)
+                cap = self._batch_cap  # the drainer may have adapted it
             batch, self._pending = (
-                self._pending[: self.max_batch],
-                self._pending[self.max_batch :],
+                self._pending[:cap],
+                self._pending[cap:],
             )
             if self._pending:
                 self._wake.set()  # leftovers start the next window now
@@ -140,8 +208,13 @@ class MatchStage:
         while True:
             resolver, futs, topics = await self._queue.get()
             try:
-                # the D2H sync blocks — run it off the loop
+                # the D2H sync blocks — run it off the loop. Queue depth is
+                # sampled at resolve time: batches still queued waited for
+                # this one, so the controller budgets depth x service.
+                depth = self._queue.qsize() + 1
+                t0 = loop.time()
                 results = await loop.run_in_executor(None, resolver)
+                self._observe_service(loop.time() - t0, len(topics), depth)
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch already popped: it is
                 # invisible to stop()'s queue drain, so resolve it here

@@ -122,6 +122,11 @@ async def _count_publishes(reader, want: int) -> None:
                 if not (b & 0x80):
                     break
                 shift += 7
+                if shift > 21:
+                    # 4-continuation-byte cap, matching the broker-side
+                    # scanner: a malformed stream must error, not grow
+                    # remaining unboundedly and mis-frame what follows
+                    raise ValueError("malformed varint in stress stream")
             if not ok or vend + remaining > n:
                 break
             if (buf[pos] >> 4) == PUBLISH:

@@ -208,7 +208,7 @@ class SharedSubscriptions:
 InlineSubFn = Callable[["object", Subscription, Packet], None]
 
 
-@dataclass
+@dataclass(slots=True)
 class InlineSubscription(Subscription):
     """An in-process subscription: a Subscription plus a handler callback,
     keyed on the subscription identifier (topics.go:306-309)."""
@@ -229,7 +229,13 @@ ClientSubscriptions = dict
 
 
 class Subscribers:
-    """The result set of a subscriber scan (topics.go:312-347)."""
+    """The result set of a subscriber scan (topics.go:312-347).
+
+    ``__slots__`` keeps the result object dict-free so the C materializer
+    (native/accelmod.c) can build one per matched topic at tp_alloc + four
+    dict stores."""
+
+    __slots__ = ("shared", "shared_selected", "subscriptions", "inline_subscriptions")
 
     def __init__(self) -> None:
         self.shared: dict[str, dict[str, Subscription]] = {}

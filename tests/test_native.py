@@ -245,3 +245,199 @@ def test_matcher_pipeline_uses_native(monkeypatch):
     py = tokenize_topics_py(topics, 4, 3)
     for a, b in zip(nat, py):
         assert np.array_equal(a, b)
+
+
+# -- C materializer (accelmod.c) differential tests -------------------------
+
+needs_accel = pytest.mark.skipif(
+    native.accel() is None, reason="accel extension unavailable"
+)
+
+
+def _random_snaps(rng, n_entries, window):
+    """Snapshot tuples shaped like ops/flat builds them: clients first,
+    then shared members, then inline subscriptions, all within window."""
+    from mqtt_tpu.packets import Subscription
+    from mqtt_tpu.topics import InlineSubscription
+
+    snaps = []
+    for e in range(n_entries):
+        n_cli = rng.randint(0, 4)
+        n_shr = rng.randint(0, 2)
+        n_inl = rng.randint(0, 2)
+        cli = tuple(
+            (
+                f"cl{e}_{i}" if rng.random() < 0.8 else "dup",  # force merges
+                Subscription(
+                    filter=f"f/{e}/{i}",
+                    qos=rng.randint(0, 2),
+                    identifier=rng.choice([0, 0, 5, 9]),
+                    identifiers={f"prev/{e}": 3} if rng.random() < 0.3 else None,
+                    no_local=rng.random() < 0.2,
+                ),
+            )
+            for i in range(n_cli)
+        )
+        shr = tuple(
+            (
+                f"m{e}_{i}",
+                Subscription(filter=f"$SHARE/g{i % 2}/f/{e}", qos=1),
+            )
+            for i in range(n_shr)
+        )
+        inl = tuple(
+            InlineSubscription(
+                filter=f"f/{e}", identifier=e * 10 + i + 1, handler=lambda *a: None
+            )
+            for i in range(n_inl)
+        )
+        snaps.append((cli, shr, inl))
+    return snaps
+
+
+def _canon(s):
+    return (
+        {
+            c: (
+                sub.qos,
+                sub.no_local,
+                sub.filter,
+                tuple(sorted((sub.identifiers or {}).items())),
+            )
+            for c, sub in s.subscriptions.items()
+        },
+        {f: set(m) for f, m in s.shared.items()},
+        set(s.inline_subscriptions),
+    )
+
+
+@needs_accel
+class TestResolveBatch:
+    def _packed(self, rng, n_topics, P, snaps, window):
+        """Random VALID range rows: counts never exceed the entry's actual
+        snapshot population (the device meta word guarantees this in
+        production — counts are derived from the snapshot lengths)."""
+        totals = [sum(len(part) for part in s) for s in snaps]
+        packed = np.zeros((n_topics, 2 * P + 2), dtype=np.int32)
+        for i in range(n_topics):
+            if rng.random() < 0.1:
+                packed[i, 2 * P + 1] = 1  # overflow row
+                continue
+            for p in range(P):
+                if rng.random() < 0.5:
+                    e = rng.randrange(len(snaps))
+                    if not totals[e]:
+                        continue
+                    lo = rng.randrange(totals[e])  # the $-mask's lo offset
+                    packed[i, p] = e * window + lo
+                    packed[i, P + p] = rng.randint(0, totals[e] - lo)
+        return packed
+
+    def _python_reference(self, packed, P, snaps, window):
+        """expand_sids over a _LazySubTable built from the same snaps."""
+        from mqtt_tpu.ops.flat import _LazySubTable
+        from mqtt_tpu.ops.matcher import expand_sids
+        from mqtt_tpu.topics import Subscribers
+
+        table = _LazySubTable(window, list(snaps), len(snaps) * window)
+        results = []
+        for row in packed.tolist():
+            if row[2 * P + 1]:
+                results.append(None)
+                continue
+            sids = []
+            for p in range(P):
+                c = row[P + p]
+                if c:
+                    sids.extend(range(row[p], row[p] + c))
+            results.append(expand_sids(table, sids, Subscribers()))
+        return results
+
+    def test_differential_random(self):
+        from mqtt_tpu.topics import Subscribers
+
+        acc = native.accel()
+        rng = random.Random(11)
+        window, P, n_entries = 8, 3, 64
+        snaps = _random_snaps(rng, n_entries, window)
+        packed = self._packed(rng, 512, P, snaps, window)
+        res_c, ovf = acc.resolve_batch(packed, 512, P, snaps, window, Subscribers)
+        res_py = self._python_reference(packed, P, snaps, window)
+        assert len(res_c) == len(res_py) == 512
+        assert [i for i, r in enumerate(res_py) if r is None] == list(ovf)
+        for a, b in zip(res_c, res_py):
+            assert (a is None) == (b is None)
+            if a is not None:
+                assert _canon(a) == _canon(b)
+
+    def test_identifiers_shared_and_extended(self):
+        """A stored identifiers map is mutated by the copy when
+        identifier > 0 — Subscription.merge semantics, which the Python
+        and C paths must share exactly."""
+        from mqtt_tpu.packets import Subscription
+        from mqtt_tpu.topics import Subscribers
+
+        acc = native.accel()
+        stored = Subscription(filter="a/b", qos=1, identifier=7, identifiers={"x": 1})
+        snaps = [(( ("c1", stored), ), (), ())]
+        packed = np.zeros((1, 2 * 1 + 2), dtype=np.int32)
+        packed[0, 0] = 0
+        packed[0, 1] = 1
+        res, ovf = acc.resolve_batch(packed, 1, 1, snaps, 4, Subscribers)
+        got = res[0].subscriptions["c1"]
+        assert got is not stored  # fresh copy
+        assert got.identifiers is stored.identifiers  # the SHARED map
+        assert stored.identifiers == {"x": 1, "a/b": 7}  # extended in place
+
+    def test_out_of_range_sids_skipped(self):
+        from mqtt_tpu.topics import Subscribers
+
+        acc = native.accel()
+        snaps = _random_snaps(random.Random(1), 2, 4)
+        packed = np.zeros((1, 4), dtype=np.int32)
+        packed[0, 0] = 4 * 100  # ordinal way past the table
+        packed[0, 1] = 3
+        res, ovf = acc.resolve_batch(packed, 1, 1, snaps, 4, Subscribers)
+        assert not ovf
+        assert not res[0].subscriptions
+
+    def test_dict_class_fallback(self):
+        """Subclasses without a usable slots layout route through the
+        Python self_merged_copy / merge methods — same values."""
+        from mqtt_tpu.packets import Subscription
+        from mqtt_tpu.topics import Subscribers
+
+        class DictSub(Subscription):
+            pass  # plain subclass: instances carry a __dict__
+
+        acc = native.accel()
+        stored = DictSub(filter="q/w", qos=2, identifier=3)
+        snaps = [((("c1", stored),), (), ())]
+        packed = np.zeros((1, 4), dtype=np.int32)
+        packed[0, 1] = 1
+        res, _ = acc.resolve_batch(packed, 1, 1, snaps, 8, Subscribers)
+        got = res[0].subscriptions["c1"]
+        assert type(got) is DictSub
+        assert (got.qos, got.identifiers) == (2, {"q/w": 3})
+
+    def test_expand_sids_list_matches_expand_sids(self):
+        from mqtt_tpu.ops.flat import _LazySubTable
+        from mqtt_tpu.ops.matcher import expand_sids
+        from mqtt_tpu.topics import Subscribers
+
+        acc = native.accel()
+        rng = random.Random(3)
+        window = 8
+        snaps = _random_snaps(rng, 32, window)
+        table = _LazySubTable(window, list(snaps), len(snaps) * window)
+        # only slots the snapshots actually populate (production sids are
+        # bounded by the per-entry counts)
+        valid = [
+            e * window + k
+            for e, s in enumerate(snaps)
+            for k in range(sum(len(part) for part in s))
+        ]
+        sids = sorted(rng.sample(valid, min(64, len(valid))))
+        a = acc.expand_sids_list(sids, snaps, window, Subscribers())
+        b = expand_sids(table, list(sids), Subscribers())
+        assert _canon(a) == _canon(b)

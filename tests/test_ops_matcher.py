@@ -200,6 +200,10 @@ def test_saturated_bucket_routes_to_host():
     for i, tok in enumerate(colliding):
         index.subscribe(f"cl{i}", Subscription(filter=tok, qos=1))
     index.subscribe("solo", Subscription(filter="plain/topic", qos=0))
+    # one wildcard filter keeps the index off the exact-map host fast path
+    # (this test exercises the DEVICE path's saturation routing); it
+    # matches neither the colliding tokens nor plain/topic
+    index.subscribe("wild", Subscription(filter="wild/only/+", qos=0))
     matcher = TpuMatcher(index, max_levels=4)
     matcher.rebuild()
     assert matcher.csr.n_sat >= 1  # the build really saturated a bucket
@@ -257,3 +261,90 @@ def test_duplicate_client_merge_matches_host_exactly_and_does_not_accumulate():
         assert nxt.qos == 2 and "poison" not in {
             k for k, v in nxt.identifiers.items() if v > 0
         }
+
+
+class TestExactMapFastPath:
+    """Wildcard-free filter sets answer from the host exact-map — one dict
+    probe per topic, no device dispatch, no fallback classes (SURVEY §7
+    hard part 4; VERDICT r4 item 5)."""
+
+    def _index(self):
+        index = TopicsIndex()
+        index.subscribe("c1", Subscription(filter="a/b/c", qos=1, identifier=9))
+        index.subscribe("c2", Subscription(filter="a/b/c", qos=2))
+        index.subscribe("c3", Subscription(filter="x/y", qos=0))
+        index.subscribe("sys", Subscription(filter="$SYS/broker/load", qos=0))
+        index.subscribe(
+            "m1", Subscription(filter=f"{SHARE_PREFIX}/g1/a/b/c", qos=1)
+        )
+        index.inline_subscribe(
+            InlineSubscription(filter="x/y", identifier=5, handler=lambda *a: None)
+        )
+        # deeper than max_levels: the device table would drop it; the map
+        # still serves it
+        index.subscribe("deep", Subscription(filter="d/e/f/g/h/i", qos=1))
+        return index
+
+    def test_serves_without_device_and_matches_host(self):
+        index = self._index()
+        matcher = TpuMatcher(index, max_levels=4)
+        matcher.rebuild()
+        assert matcher.csr.exact_map is not None
+        topics = ["a/b/c", "x/y", "$SYS/broker/load", "d/e/f/g/h/i", "no/match", ""]
+        results = matcher.match_topics(topics)
+        for topic, got in zip(topics, results):
+            assert canon(got) == canon(index.subscribers(topic)), topic
+        assert matcher.stats.host_fast == 5  # all but the empty topic
+        assert matcher.stats.host_fallbacks == 0
+
+    def test_spilled_entry_served_from_map(self):
+        index = TopicsIndex()
+        for i in range(40):  # >> window: device entry would spill
+            index.subscribe(f"c{i}", Subscription(filter="hot/topic", qos=1))
+        matcher = TpuMatcher(index, max_levels=4, window=8)
+        matcher.rebuild()
+        assert matcher.csr.exact_map is not None
+        subs = matcher.subscribers("hot/topic")
+        assert len(subs.subscriptions) == 40
+        assert canon(subs) == canon(index.subscribers("hot/topic"))
+        assert matcher.stats.host_fallbacks == 0
+
+    def test_any_wildcard_disables_map(self):
+        index = self._index()
+        index.subscribe("w", Subscription(filter="a/+/c", qos=0))
+        matcher = TpuMatcher(index, max_levels=4)
+        matcher.rebuild()
+        assert matcher.csr.exact_map is None
+        # deep-wildcard-only sets must not sneak back onto the fast path
+        index2 = TopicsIndex()
+        index2.subscribe("c", Subscription(filter="a/b/c/d/e/f/+", qos=0))
+        m2 = TpuMatcher(index2, max_levels=4)
+        m2.rebuild()
+        assert m2.csr.exact_map is None
+
+    def test_fold_maintains_map(self):
+        from mqtt_tpu.ops.delta import DeltaMatcher
+
+        index = self._index()
+        m = DeltaMatcher(index, max_levels=4, background=False)
+        assert m._snap.csr.exact_map is not None
+        index.subscribe("new", Subscription(filter="fresh/topic", qos=2))
+        index.unsubscribe("x/y", "c3")
+        m.flush()
+        for topic in ["fresh/topic", "x/y", "a/b/c"]:
+            assert canon(m.subscribers(topic)) == canon(index.subscribers(topic))
+        # a folded-in wildcard drops the map and stays correct
+        index.subscribe("w", Subscription(filter="fresh/+", qos=1))
+        m.flush()
+        assert canon(m.subscribers("fresh/topic")) == canon(
+            index.subscribers("fresh/topic")
+        )
+        m.close()
+
+    def test_identifier_merge_parity_on_fast_path(self):
+        index = TopicsIndex()
+        index.subscribe("c1", Subscription(filter="t/1", qos=1, identifier=3))
+        matcher = TpuMatcher(index)
+        got = matcher.subscribers("t/1").subscriptions["c1"]
+        want = index.subscribers("t/1").subscriptions["c1"]
+        assert got.identifiers == want.identifiers == {"t/1": 3}
