@@ -279,6 +279,7 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
     while bb >= 64:  # floor matches the staging controller's min_batch
         bl = []
         sub = [batches[0][:bb], batches[1][:bb]]
+        matcher.match_topics(sub[0])  # warm this bucket's executable (JIT)
         for i in range(4):
             t1 = time.perf_counter()
             matcher.match_topics(sub[i % 2])
@@ -542,15 +543,23 @@ def run_broker_bench(fast: bool) -> dict:
     repo = os.path.dirname(os.path.abspath(__file__))
     env = dict(os.environ)
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    # multi-core data plane (mqtt_tpu.cluster): one SO_REUSEPORT worker
+    # per core when the host has them — the scale-out the reference gets
+    # from goroutine-per-connection; a 1-core host stays single-process
+    # (workers would only timeshare the core and pay mesh overhead)
+    workers = max(1, int(os.environ.get("BENCH_BROKER_WORKERS", os.cpu_count() or 1)))
+    cmd = [sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
+           f"127.0.0.1:{port}"]
+    if workers > 1:
+        cmd += ["--workers", str(workers)]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
-         f"127.0.0.1:{port}"],
+        cmd,
         stdin=subprocess.PIPE,
         stdout=subprocess.PIPE,
         cwd=repo,
         env=env,
     )
-    out = {"cpus": os.cpu_count()}
+    out = {"cpus": os.cpu_count(), "broker_workers": workers}
     try:
         assert proc.stdout.readline().strip() == b"READY"
         # the reference table's exact mqtt-stresser scenarios: 2/10/100

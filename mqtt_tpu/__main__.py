@@ -21,6 +21,7 @@ import asyncio
 import getpass
 import json
 import logging
+import os
 import signal
 import socket
 import ssl
@@ -153,8 +154,16 @@ def build_server(args) -> Server:
                 )
             server.add_hook(AuthHook(), AuthOptions(ledger=ledger))
 
+    # cluster workers share every TCP-family port via SO_REUSEPORT
+    clustered = os.environ.get("MQTT_TPU_WORKER") is not None
     if not opts.listeners and len(server.listeners) == 0:
-        server.add_listener(TCP(ListenerConfig(type="tcp", id="tcp", address=f":{args.port}")))
+        server.add_listener(
+            TCP(
+                ListenerConfig(
+                    type="tcp", id="tcp", address=f":{args.port}", reuse_port=clustered
+                )
+            )
+        )
         if args.tls_port:
             if not (args.cert and args.key):
                 raise SystemExit("--tls-port requires --cert and --key")
@@ -165,7 +174,11 @@ def build_server(args) -> Server:
             server.add_listener(
                 TCP(
                     ListenerConfig(
-                        type="tcp", id="tls", address=f":{args.tls_port}", tls_config=tls
+                        type="tcp",
+                        id="tls",
+                        address=f":{args.tls_port}",
+                        tls_config=tls,
+                        reuse_port=clustered,
                     )
                 )
             )
@@ -205,7 +218,59 @@ def build_server(args) -> Server:
     return server
 
 
+def _spawn_workers(args, n: int) -> int:
+    """Launcher for --workers N: re-exec this CLI once per worker with the
+    cluster env set; each worker binds the same ports with SO_REUSEPORT
+    and joins the unix-socket mesh (mqtt_tpu.cluster)."""
+    import os
+    import subprocess
+    import tempfile
+
+    from .cluster import worker_env
+
+    sock_dir = tempfile.mkdtemp(prefix="mqtt-tpu-cluster-")
+    # strip --workers (both "--workers N" and "--workers=N" forms): the
+    # children must not recurse into the launcher
+    cleaned = []
+    skip = False
+    for a in sys.argv[1:]:
+        if skip:
+            skip = False
+            continue
+        if a == "--workers":
+            skip = True
+            continue
+        if a.startswith("--workers="):
+            continue
+        cleaned.append(a)
+    procs = []
+    try:
+        for i in range(n):
+            env = dict(os.environ)
+            env.update(worker_env(i, n, sock_dir))
+            procs.append(
+                subprocess.Popen([sys.executable, "-m", "mqtt_tpu"] + cleaned, env=env)
+            )
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+
+
 def cmd_serve(args) -> int:
+    workers = getattr(args, "workers", 1)
+    if workers == 0:
+        import os as _os
+
+        workers = _os.cpu_count() or 1
+    if workers > 1 and os.environ.get("MQTT_TPU_WORKER") is None:
+        return _spawn_workers(args, workers)
     if args.admin_user is not None:
         user, sep, pwd = args.admin_user.partition(":")
         if not user or not sep or not pwd:
@@ -221,8 +286,13 @@ def cmd_serve(args) -> int:
     )
 
     async def run() -> None:
+        from .cluster import maybe_attach_from_env
+
         server = build_server(args)
+        cluster = maybe_attach_from_env(server)
         await server.serve()
+        if cluster is not None:
+            await cluster.start()
         stop = asyncio.Event()
         loop = asyncio.get_running_loop()
         for sig in (signal.SIGINT, signal.SIGTERM):
@@ -231,6 +301,8 @@ def cmd_serve(args) -> int:
             except NotImplementedError:
                 pass
         await stop.wait()
+        if cluster is not None:
+            await cluster.stop()
         await server.close()
 
     asyncio.run(run())
@@ -284,6 +356,14 @@ def main(argv=None) -> int:
         arg("--stats-port", type=int, default=0, help="$SYS stats HTTP port")
         arg("--dashboard-port", type=int, default=0, help="status dashboard port")
         arg("--msg-timeout", type=int, default=0, help="message expiry seconds")
+        arg(
+            "--workers",
+            type=int,
+            default=1,
+            help="broker worker processes sharing the MQTT port via "
+            "SO_REUSEPORT, joined by the forwarding mesh (multi-core data "
+            "plane, mqtt_tpu.cluster); 0 = one per CPU core",
+        )
         arg("--log-level", default="info")
         arg("--log2file", help="also log to this file")
     args = parser.parse_args(argv)

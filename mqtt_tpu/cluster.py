@@ -1,0 +1,459 @@
+"""Multi-core broker data plane: SO_REUSEPORT worker processes with a
+full-mesh forwarding fabric.
+
+The reference gets every core for free — goroutine-per-connection over one
+shared listener (listeners/tcp.go:84, clients.go:363) — while a CPython
+worker owns exactly one core. Clustering proper is out of scope on both
+sides (the reference lists it as roadmap, README.md:59-62); this module is
+the listener-compatible scale-OUT of one broker onto N processes on ONE
+machine:
+
+- N worker processes bind the SAME TCP address with ``SO_REUSEPORT``; the
+  kernel load-balances accepted connections across them. Each worker is a
+  full ``Server`` (sessions, trie, QoS, hooks) for its own clients.
+- Workers connect a full mesh of unix-domain sockets. Each worker
+  broadcasts subscription PRESENCE — "I have at least one subscriber on
+  filter F" — computed from its live trie (idempotent set/clear, so no
+  refcount drift), and keeps a ``remote`` TopicsIndex of pseudo-subscribers
+  per peer. A local publish therefore matches remote interest with the
+  same trie walk used for local fan-out, and the frame is forwarded ONCE
+  per interested peer, which re-matches and delivers to its own clients.
+- The QoS0 v4 passthrough stays intact end to end: eligible frames are
+  forwarded verbatim (type ``F``) and delivered at the peer through the
+  same cached fan-out plans ``try_fast_publish`` uses; everything else
+  (QoS>0, v5 properties, retain) forwards as a decoded packet re-encoded
+  by the wire codec (type ``P``).
+- Retained messages replicate to ALL workers (a future subscriber may land
+  anywhere); $SYS topics never forward (every worker maintains its own).
+
+Known limits (documented, not hidden): shared-subscription (``$SHARE``)
+groups select one member PER WORKER holding members (the reference's
+single process selects one total); session takeover only sees clients on
+the same worker; storage hooks should be per-worker stores. These are the
+standard SO_REUSEPORT-broker trade-offs — a deployment that needs exact
+single-process semantics runs one worker.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import struct
+from typing import Optional
+
+from .packets import PUBLISH, FixedHeader, Packet
+from .packets import Subscription
+from .topics import SHARE_PREFIX, InlineSubscription, TopicsIndex
+
+_log = logging.getLogger("mqtt_tpu.cluster")
+
+# wire: 4-byte big-endian length | 1-byte type | payload
+_T_HELLO = 0x48  # 'H' json {worker}
+_T_PRESENCE = 0x53  # 'S' json {filter, populated, inline}
+_T_FRAME = 0x46  # 'F' u16 origin_len | origin | raw v4 qos0 PUBLISH frame
+_T_PACKET = 0x50  # 'P' json header | 0x00 | encoded publish body
+
+
+def _noop_inline(*_a) -> None:  # pragma: no cover - marker, never invoked
+    pass
+
+
+class Cluster:
+    """The per-worker forwarding fabric. Attach to a built ``Server``
+    before ``serve()``; peers are the other workers' unix socket paths."""
+
+    def __init__(self, server, worker_id: int, n_workers: int, sock_dir: str) -> None:
+        self.server = server
+        self.worker_id = worker_id
+        self.n_workers = n_workers
+        self.sock_dir = sock_dir
+        # pseudo-subscribers: client f"\x00w{peer}" per (peer, filter) —
+        # matching remote interest IS a trie walk on this index
+        self.remote = TopicsIndex()
+        self._writers: dict[int, asyncio.StreamWriter] = {}
+        self._unix_server: Optional[asyncio.base_events.Server] = None
+        self._pending_presence: set[str] = set()
+        self._presence_wake: Optional[asyncio.Event] = None
+        self._tasks: list[asyncio.Task] = []
+        self._plan_cache: dict[str, tuple[int, tuple[int, ...]]] = {}
+        self._stopping = False
+        self.dropped_forwards = 0  # forwards dropped at the peer-buffer cap
+        server._cluster = self
+        server.topics.add_observer(self._on_mutation)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _sock_path(self, worker: int) -> str:
+        return os.path.join(self.sock_dir, f"mqtt-tpu-w{worker}.sock")
+
+    async def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._presence_wake = asyncio.Event()
+        path = self._sock_path(self.worker_id)
+        try:
+            os.unlink(path)
+        except FileNotFoundError:
+            pass
+        self._unix_server = await asyncio.start_unix_server(
+            self._on_peer_connect, path
+        )
+        # connect to lower-numbered peers (they accept from us); retries
+        # cover start-order races
+        for peer in range(self.worker_id):
+            self._tasks.append(
+                loop.create_task(self._dial(peer), name=f"cluster-dial-{peer}")
+            )
+        self._tasks.append(
+            loop.create_task(self._presence_loop(), name="cluster-presence")
+        )
+
+    async def stop(self) -> None:
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for w in self._writers.values():
+            w.close()
+        if self._unix_server is not None:
+            self._unix_server.close()
+        try:
+            os.unlink(self._sock_path(self.worker_id))
+        except OSError:
+            pass
+
+    async def _dial(self, peer: int) -> None:
+        path = self._sock_path(peer)
+        while not self._stopping:
+            try:
+                reader, writer = await asyncio.open_unix_connection(path)
+            except OSError:
+                await asyncio.sleep(0.1)
+                continue
+            await self._send(
+                writer, _T_HELLO, json.dumps({"worker": self.worker_id}).encode()
+            )
+            self._register(peer, writer)
+            await self._read_loop(peer, reader)
+            return
+
+    async def _on_peer_connect(self, reader, writer) -> None:
+        try:
+            mtype, payload = await self._recv(reader)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            writer.close()
+            return
+        if mtype != _T_HELLO:
+            writer.close()
+            return
+        peer = json.loads(payload)["worker"]
+        self._register(peer, writer)
+        await self._read_loop(peer, reader)
+
+    def _register(self, peer: int, writer: asyncio.StreamWriter) -> None:
+        self._writers[peer] = writer
+        # announce every currently-populated filter to the new peer: walk
+        # the live trie terminals (late-joining workers must converge)
+        for f in self._populated_filters():
+            self._pending_presence.add(f)
+        if self._presence_wake is not None:
+            self._presence_wake.set()
+
+    # -- wire helpers ------------------------------------------------------
+
+    @staticmethod
+    async def _send(writer, mtype: int, payload: bytes) -> None:
+        writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
+        await writer.drain()
+
+    # per-peer write-buffer cap: a stalled peer must cost bounded memory.
+    # Past it, forwards DROP (accounted) — the same posture as the bounded
+    # per-client outbound queue (server.py drop accounting); presence
+    # messages are exempt (tiny, and correctness depends on them)
+    MAX_PEER_BUFFER = 8 * 1024 * 1024
+
+    def _send_nowait(self, writer, mtype: int, payload: bytes) -> None:
+        if (
+            mtype != _T_PRESENCE
+            and writer.transport.get_write_buffer_size() > self.MAX_PEER_BUFFER
+        ):
+            self.dropped_forwards += 1
+            return
+        writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
+
+    @staticmethod
+    async def _recv(reader):
+        head = await reader.readexactly(5)
+        (n, mtype) = struct.unpack(">IB", head)
+        payload = await reader.readexactly(n - 1)
+        return mtype, payload
+
+    # -- presence sync -----------------------------------------------------
+
+    def _on_mutation(self, m) -> None:
+        """Trie observer (called under the trie lock): queue the filter;
+        the presence loop computes its populated state off-lock and
+        broadcasts idempotently."""
+        if m.filter:
+            self._pending_presence.add(m.filter)
+            wake = self._presence_wake
+            if wake is not None:
+                wake.set()
+
+    def _populated_filters(self) -> list[str]:
+        """Every filter with at least one subscriber, from the live trie
+        (lock-free walk, tears retried by the caller's cadence)."""
+        from .ops.flat import _walk_terminals
+
+        out = []
+        try:
+            for path, node in _walk_terminals(self.server.topics):
+                base = "/".join(path)
+                for group in list(node.shared.internal):
+                    out.append(f"{SHARE_PREFIX}/{group}/{base}")
+                if node.subscriptions.internal or node.inline_subscriptions.internal:
+                    out.append(base)
+        except (RuntimeError, KeyError):
+            pass  # racing mutations re-enter via the observer anyway
+        return out
+
+    def _probe_populated(self, f: str) -> tuple[bool, bool]:
+        """(has_subscribers, inline_only) for one filter on the live trie."""
+        share_rooted = f.split("/", 1)[0].upper() == SHARE_PREFIX
+        for _ in range(8):
+            try:
+                node = self.server.topics._seek(f, 2 if share_rooted else 0)
+                if node is None:
+                    return False, False
+                has_cli = bool(node.subscriptions.internal) or bool(
+                    node.shared.internal
+                )
+                has_inl = bool(node.inline_subscriptions.internal)
+                return has_cli or has_inl, has_inl and not has_cli
+            except (RuntimeError, KeyError):
+                continue
+        return True, False  # persistent tear: err on the forwarding side
+
+    async def _presence_loop(self) -> None:
+        while True:
+            await self._presence_wake.wait()
+            self._presence_wake.clear()
+            pending, self._pending_presence = self._pending_presence, set()
+            for f in pending:
+                populated, inline_only = self._probe_populated(f)
+                msg = json.dumps(
+                    {"filter": f, "populated": populated, "inline": inline_only}
+                ).encode()
+                for w in list(self._writers.values()):
+                    try:
+                        self._send_nowait(w, _T_PRESENCE, msg)
+                    except (ConnectionError, RuntimeError):
+                        pass
+            # yield so bursts coalesce instead of one message per mutation
+            await asyncio.sleep(0)
+
+    def _apply_presence(self, peer: int, filter: str, populated: bool, inline: bool) -> None:
+        pseudo = f"\x00w{peer}"
+        if populated:
+            # inline-only filters follow inline gather rules on $-topics
+            # [MQTT-4.7.1-1/2]: mirror kind so forwarding decisions match
+            if inline:
+                self.remote.inline_subscribe(
+                    InlineSubscription(
+                        filter=filter, identifier=peer + 1, handler=_noop_inline
+                    )
+                )
+                self.remote.unsubscribe(filter, pseudo)
+            else:
+                self.remote.subscribe(pseudo, Subscription(filter=filter))
+        else:
+            self.remote.unsubscribe(filter, pseudo)
+            self.remote.inline_unsubscribe(peer + 1, filter)
+
+    # -- forwarding (origin side) ------------------------------------------
+
+    def _interested_peers(self, topic: str) -> tuple[int, ...]:
+        """Peers with at least one matching subscriber, via the remote
+        pseudo-trie; cached per (topic, remote version)."""
+        version = self.remote.version
+        cached = self._plan_cache.get(topic)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        subs = self.remote.subscribers(topic)
+        peers = set()
+        for pseudo in subs.subscriptions:
+            peers.add(int(pseudo[2:]))
+        for group in subs.shared.values():
+            for pseudo in group:
+                peers.add(int(pseudo[2:]))
+        for ident in subs.inline_subscriptions:
+            peers.add(ident - 1)
+        plan = tuple(sorted(peers))
+        if len(self._plan_cache) >= 4096:
+            self._plan_cache.clear()
+        self._plan_cache[topic] = (version, plan)
+        return plan
+
+    def forward_frame(self, topic: str, frame: bytes, origin: str) -> None:
+        """Forward a QoS0 v4 passthrough frame to interested peers
+        verbatim (the fast path's cluster leg)."""
+        peers = self._interested_peers(topic)
+        if not peers:
+            return
+        ob = origin.encode()
+        payload = struct.pack(">H", len(ob)) + ob + frame
+        for p in peers:
+            w = self._writers.get(p)
+            if w is not None:
+                try:
+                    self._send_nowait(w, _T_FRAME, payload)
+                except (ConnectionError, RuntimeError):
+                    pass
+
+    def forward_packet(self, pk: Packet) -> None:
+        """Forward a decoded publish (QoS>0 / v5 / retained) to interested
+        peers; retained messages go to ALL peers so every worker converges
+        on the retained store."""
+        topic = pk.topic_name
+        if not topic or topic.startswith("$"):
+            return  # $SYS is per-worker; never forwarded
+        if pk.fixed_header.retain:
+            peers = tuple(p for p in self._writers)
+        else:
+            peers = self._interested_peers(topic)
+        if not peers:
+            return
+        # re-encode canonically as v5 on a copy (copy drops the per-
+        # connection topic alias [MQTT-3.3.2-7] and the DUP flag)
+        c = pk.copy(False)
+        c.protocol_version = 5
+        c.fixed_header.qos = pk.fixed_header.qos
+        c.packet_id = pk.packet_id or pk.fixed_header.qos  # encoder guard
+        body = bytearray()
+        c.publish_encode(body)
+        head = json.dumps(
+            {
+                "origin": pk.origin,
+                "created": pk.created,
+                "expiry": pk.expiry,
+                "retain": bool(pk.fixed_header.retain),
+                "qos": pk.fixed_header.qos,
+            }
+        ).encode()
+        payload = head + b"\x00" + bytes(body)
+        for p in peers:
+            w = self._writers.get(p)
+            if w is not None:
+                try:
+                    self._send_nowait(w, _T_PACKET, payload)
+                except (ConnectionError, RuntimeError):
+                    pass
+
+    # -- delivery (receiving side) -----------------------------------------
+
+    async def _read_loop(self, peer: int, reader) -> None:
+        while True:
+            try:
+                mtype, payload = await self._recv(reader)
+            except (asyncio.IncompleteReadError, ConnectionError):
+                self._writers.pop(peer, None)
+                return
+            try:
+                if mtype == _T_PRESENCE:
+                    d = json.loads(payload)
+                    self._apply_presence(
+                        peer, d["filter"], d["populated"], d.get("inline", False)
+                    )
+                elif mtype == _T_FRAME:
+                    (olen,) = struct.unpack(">H", payload[:2])
+                    origin = payload[2 : 2 + olen].decode()
+                    self._deliver_frame(payload[2 + olen :], origin)
+                elif mtype == _T_PACKET:
+                    sep = payload.index(b"\x00")
+                    head = json.loads(payload[:sep])
+                    self._deliver_packet(head, payload[sep + 1 :])
+            except Exception:
+                _log.exception("cluster delivery failed (peer %d)", peer)
+
+    def _deliver_frame(self, frame: bytes, origin: str) -> None:
+        """Deliver a forwarded v4 QoS0 frame to local subscribers through
+        the server's fast-path plans; write ACL was enforced at the origin
+        worker, so only per-target read ACL applies here."""
+        s = self.server
+        if not s.fast_deliver_frame(frame, origin):
+            # a local shared/inline/v5 case: decode and take the full path
+            off = 1
+            while frame[off] & 0x80:
+                off += 1
+            pk = Packet(
+                fixed_header=FixedHeader(type=PUBLISH), protocol_version=4
+            )
+            pk.publish_decode(frame[off + 1 :])
+            pk.origin = origin
+            s._stamp_publish_expiry(pk)
+            self._deliver_local(pk)
+
+    def _deliver_packet(self, head: dict, frame: bytes) -> None:
+        # publish_encode produced a full frame; decode wants only the body
+        off = 1
+        while frame[off] & 0x80:
+            off += 1
+        pk = Packet(
+            fixed_header=FixedHeader(
+                type=PUBLISH, qos=head.get("qos", 0), retain=head.get("retain", False)
+            ),
+            protocol_version=5,
+        )
+        pk.publish_decode(frame[off + 1 :])
+        pk.origin = head.get("origin", "")
+        pk.created = head.get("created", 0)
+        pk.expiry = head.get("expiry", 0)
+        if head.get("retain"):
+            self.server.retain_message(self._system_client(), pk)
+        self._deliver_local(pk)
+
+    def _system_client(self):
+        """A local client identity for hook callbacks on forwarded
+        messages (the inline client when enabled, else a detached one)."""
+        s = self.server
+        if s.inline_client is not None:
+            return s.inline_client
+        cl = getattr(self, "_pseudo_client", None)
+        if cl is None:
+            from .server import LOCAL_LISTENER
+
+            cl = self._pseudo_client = s.new_client(
+                None, None, LOCAL_LISTENER, f"\x00cluster-w{self.worker_id}", True
+            )
+        return cl
+
+    def _deliver_local(self, pk: Packet) -> None:
+        """Local-only fan-out of a forwarded publish (never re-forwarded:
+        forwarding happens only at the origin worker)."""
+        s = self.server
+        pk.packet_id = 0  # QoS state is owned per-worker per-subscriber
+        s._fan_out(pk, s.topics.subscribers(pk.topic_name))
+
+
+def worker_env(worker_id: int, n_workers: int, sock_dir: str) -> dict:
+    """Environment for a spawned worker process (read by __main__/stress)."""
+    return {
+        "MQTT_TPU_WORKER": str(worker_id),
+        "MQTT_TPU_WORKERS": str(n_workers),
+        "MQTT_TPU_CLUSTER_DIR": sock_dir,
+    }
+
+
+def maybe_attach_from_env(server) -> Optional[Cluster]:
+    """Attach a Cluster to ``server`` when worker env vars are present
+    (set by the multi-process launcher). Returns the cluster or None."""
+    wid = os.environ.get("MQTT_TPU_WORKER")
+    if wid is None:
+        return None
+    return Cluster(
+        server,
+        int(wid),
+        int(os.environ.get("MQTT_TPU_WORKERS", "1")),
+        os.environ.get("MQTT_TPU_CLUSTER_DIR", "/tmp"),
+    )

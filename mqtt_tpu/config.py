@@ -152,6 +152,7 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "matcher_stage_max_batch",
         "matcher_stage_max_inflight",
         "matcher_stage_latency_budget_ms",
+        "gc_tuning",
     ):
         if k in top:
             setattr(opts, k, top[k])

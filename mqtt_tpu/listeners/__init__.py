@@ -28,12 +28,17 @@ TYPE_MOCK = "mock"
 
 @dataclass
 class Config:
-    """Listener instantiation config (listeners.go:16-22)."""
+    """Listener instantiation config (listeners.go:16-22).
+
+    ``reuse_port`` enables SO_REUSEPORT binding so multiple broker worker
+    processes share one address with kernel load-balancing — the
+    multi-core data plane's listener mode (mqtt_tpu.cluster)."""
 
     type: str = ""
     id: str = ""
     address: str = ""
     tls_config: Optional[ssl.SSLContext] = None
+    reuse_port: bool = False
 
 
 class Listener:

@@ -414,37 +414,46 @@ class TpuMatcher:
         spilled and over-deep entries too — no fallback classes, no device
         dispatch. Results are bit-identical to the host walk: in an
         exact-only trie the walk gathers exactly the literal path's node.
-        Returns a pre-resolved zero-arg resolver (API parity with the
-        device path)."""
-        stats = self.stats
-        stats.batches += 1
-        stats.topics += len(topics)
-        if route_to_host is None:
-            routed = ()
-        elif hasattr(route_to_host, "affected_batch"):
-            routed = frozenset(route_to_host.affected_batch(topics))
-        else:
-            routed = frozenset(
-                i for i, t in enumerate(topics) if t and route_to_host(t)
-            )
-        get = flat.exact_map.get
-        expand = self._expand_snap
-        subscribers = self.topics.subscribers
-        results = []
-        results_append = results.append
-        n_fast = 0
-        for i, topic in enumerate(topics):
-            if not topic:
-                results_append(Subscribers())
-            elif i in routed:
-                stats.host_fallbacks += 1
-                results_append(subscribers(topic))
+
+        The work happens when the RESOLVER runs, not at issue time: the
+        staging loop issues on the event loop and resolves in an executor
+        thread, and a large-fan-out batch materialized at issue time would
+        stall every connected client's I/O for the duration."""
+
+        def resolve() -> list[Subscribers]:
+            stats = self.stats
+            stats.batches += 1
+            stats.topics += len(topics)
+            if route_to_host is None:
+                routed = ()
+            elif hasattr(route_to_host, "affected_batch"):
+                routed = frozenset(route_to_host.affected_batch(topics))
             else:
-                n_fast += 1
-                snap = get(topic)
-                results_append(expand(snap) if snap is not None else Subscribers())
-        stats.host_fast += n_fast
-        return lambda: results
+                routed = frozenset(
+                    i for i, t in enumerate(topics) if t and route_to_host(t)
+                )
+            get = flat.exact_map.get
+            expand = self._expand_snap
+            subscribers = self.topics.subscribers
+            results = []
+            results_append = results.append
+            n_fast = 0
+            for i, topic in enumerate(topics):
+                if not topic:
+                    results_append(Subscribers())
+                elif i in routed:
+                    stats.host_fallbacks += 1
+                    results_append(subscribers(topic))
+                else:
+                    n_fast += 1
+                    snap = get(topic)
+                    results_append(
+                        expand(snap) if snap is not None else Subscribers()
+                    )
+            stats.host_fast += n_fast
+            return results
+
+        return resolve
 
     @staticmethod
     def _expand_snap(snap) -> Subscribers:

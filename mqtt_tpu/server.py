@@ -279,6 +279,8 @@ class Server:
         self._fastpub_gate_gen = -1  # hooks generation the gate was cached at
         self._fastpub_gate_ok = False
         self._fastpub_plans: dict = {}  # topic -> (trie version, fan-out plan)
+        # multi-core worker fabric (mqtt_tpu.cluster); None = single process
+        self._cluster = None
         self.matcher = None  # device matcher; None = host trie walk
         self._stage = None  # publish staging loop (started in serve())
         if opts.device_matcher:
@@ -953,6 +955,8 @@ class Server:
             self._stamp_publish_expiry(pk)
             subscribers = await self._stage.submit(pk.topic_name)
             self._fan_out(pk, subscribers)
+            if self._cluster is not None:
+                self._cluster.forward_packet(pk)
         self.hooks.on_published(cl, pk)
 
     def retain_message(self, cl: Client, pk: Packet) -> None:
@@ -976,6 +980,11 @@ class Server:
             return
         self._stamp_publish_expiry(pk)
         self._fan_out(pk, self.topics.subscribers(pk.topic_name))
+        if self._cluster is not None:
+            # peer workers with matching subscribers receive the packet
+            # once each and fan out locally ($SYS never forwards; retained
+            # packets go to all peers) — mqtt_tpu.cluster
+            self._cluster.forward_packet(pk)
 
     def _stamp_publish_expiry(self, pk: Packet) -> None:
         if pk.created == 0:
@@ -1095,32 +1104,7 @@ class Server:
         except UnicodeDecodeError:
             return False
 
-        # fan-out plan, cached per (topic, trie version): the walk and the
-        # per-subscription identifier scan re-run only after a mutation
-        version = self.topics.version
-        cached = self._fastpub_plans.get(topic)
-        if cached is not None and cached[0] == version:
-            plan = cached[1]
-        else:
-            subscribers = self.topics.subscribers(topic)
-            if subscribers.shared or subscribers.inline_subscriptions:
-                # negative-cache: shared/inline topics always take the
-                # decode path; don't re-walk here on every publish
-                if len(self._fastpub_plans) >= 4096:
-                    self._fastpub_plans.clear()
-                self._fastpub_plans[topic] = (version, None)
-                return False
-            plan = [
-                # frame-shareable iff nothing in the SUBSCRIPTION forces a
-                # rewrite; the per-SESSION half (version/alias/size) is
-                # re-verified at delivery, since cids can reconnect with
-                # different properties under the same plan
-                (cid, sub, not (sub.identifiers and any(v > 0 for v in sub.identifiers.values())), sub.no_local)
-                for cid, sub in subscribers.subscriptions.items()
-            ]
-            if len(self._fastpub_plans) >= 4096:
-                self._fastpub_plans.clear()
-            self._fastpub_plans[topic] = (version, plan)
+        plan = self._plan_for_topic(topic)
         if plan is None:
             return False
 
@@ -1129,15 +1113,61 @@ class Server:
         if not self.hooks.on_acl_check(cl, topic, True):
             return True  # QoS0 deny is a silent drop (server.go:879-881)
 
+        self._fast_fan_frame(plan, topic, frame, body_offset, cl.id)
+        if self._cluster is not None:
+            # cluster leg: relay the frame verbatim to peer workers with
+            # matching subscribers (mqtt_tpu.cluster); write ACL was
+            # enforced above, peers apply per-target read ACL
+            self._cluster.forward_frame(topic, frame, cl.id)
+        return True
+
+    def _plan_for_topic(self, topic: str):
+        """The fast path's fan-out plan, cached per (topic, trie version):
+        the walk and the per-subscription identifier scan re-run only
+        after a mutation. None means the topic needs the decode path
+        (shared/inline subscribers — negative-cached too). Shared by
+        try_fast_publish and the cluster's forwarded-frame delivery: any
+        change to the shareability predicate applies to both legs."""
+        version = self.topics.version
+        cached = self._fastpub_plans.get(topic)
+        if cached is not None and cached[0] == version:
+            return cached[1]
+        subscribers = self.topics.subscribers(topic)
+        if subscribers.shared or subscribers.inline_subscriptions:
+            # negative-cache: shared/inline topics always take the
+            # decode path; don't re-walk here on every publish
+            if len(self._fastpub_plans) >= 4096:
+                self._fastpub_plans.clear()
+            self._fastpub_plans[topic] = (version, None)
+            return None
+        plan = [
+            # frame-shareable iff nothing in the SUBSCRIPTION forces a
+            # rewrite; the per-SESSION half (version/alias/size) is
+            # re-verified at delivery, since cids can reconnect with
+            # different properties under the same plan
+            (cid, sub, not (sub.identifiers and any(v > 0 for v in sub.identifiers.values())), sub.no_local)
+            for cid, sub in subscribers.subscriptions.items()
+        ]
+        if len(self._fastpub_plans) >= 4096:
+            self._fastpub_plans.clear()
+        self._fastpub_plans[topic] = (version, plan)
+        return plan
+
+    def _fast_fan_frame(
+        self, plan, topic: str, frame: bytes, body_offset: int, origin: str
+    ) -> None:
+        """The fast path's delivery loop over a cached fan-out plan:
+        shareable v4 targets get the frame verbatim, everything else takes
+        the full per-subscription path. Shared by try_fast_publish and the
+        cluster's forwarded-frame delivery."""
         pk = None  # decoded lazily, once, for per-target slow paths
 
         def pk_source() -> Packet:
             nonlocal pk
             if pk is None:
-                pk = self._decode_fast_frame(cl, frame[body_offset:])
+                pk = self._decode_fast_frame(origin, frame[body_offset:])
             return pk
 
-        origin = cl.id
         clients_get = self.clients.get
         on_acl = self.hooks.on_acl_check
         for cid, sub, shareable, no_local in plan:
@@ -1162,16 +1192,37 @@ class Server:
                 self.publish_to_client(tcl, sub, pk_source())
             except Exception as e:
                 self.log.debug("failed publishing packet: error=%s client=%s", e, cid)
+
+    def fast_deliver_frame(self, frame: bytes, origin: str) -> bool:
+        """Deliver a peer-forwarded v4 QoS0 PUBLISH frame to local
+        subscribers through the cached fan-out plans (mqtt_tpu.cluster).
+        Returns False when this worker needs the decode path for the topic
+        (shared/inline subscribers, or a plan miss class). Write ACL was
+        enforced at the origin worker."""
+        off = 1
+        while frame[off] & 0x80:
+            off += 1
+        body_offset = off + 1
+        tl = (frame[body_offset] << 8) | frame[body_offset + 1]
+        t0 = body_offset + 2
+        try:
+            topic = frame[t0 : t0 + tl].decode("utf-8")
+        except UnicodeDecodeError:
+            return True  # origin validated it; nothing deliverable here
+        plan = self._plan_for_topic(topic)
+        if plan is None:
+            return False
+        self._fast_fan_frame(plan, topic, frame, body_offset, origin)
         return True
 
-    def _decode_fast_frame(self, cl: Client, body: bytes) -> Packet:
+    def _decode_fast_frame(self, origin: str, body: bytes) -> Packet:
         """Materialize the Packet for a fast-path frame that met a
         per-target slow case, stamped exactly like process_publish."""
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH), protocol_version=4
         )
         pk.publish_decode(body)
-        pk.origin = cl.id
+        pk.origin = origin
         self._stamp_publish_expiry(pk)
         return pk
 

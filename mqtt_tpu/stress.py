@@ -207,10 +207,24 @@ async def run_stress(
     }
 
 
-def broker_main(address: str, device_matcher: bool = False) -> None:
+def broker_main(address: str, device_matcher: bool = False, workers: int = 1) -> None:
     """Run a bench broker on ``address`` until stdin closes (the bench
-    driver's subprocess entry; prints READY once serving)."""
+    driver's subprocess entry; prints READY once serving).
+
+    ``workers > 1`` starts the multi-core data plane (mqtt_tpu.cluster):
+    this process becomes the launcher, spawning one worker process per
+    core slot, each binding ``address`` with SO_REUSEPORT plus a private
+    per-worker port (base+1+i) for deterministic testing, all joined by
+    the unix-socket forwarding mesh."""
+    import os
     import sys
+
+    from .cluster import maybe_attach_from_env
+
+    wid_env = os.environ.get("MQTT_TPU_WORKER")
+    if workers > 1 and wid_env is None:
+        _cluster_launcher(address, device_matcher, workers)
+        return
 
     from .hooks.auth.allow_all import AllowHook
     from .listeners import Config
@@ -220,15 +234,70 @@ def broker_main(address: str, device_matcher: bool = False) -> None:
     async def main() -> None:
         srv = Server(Options(device_matcher=device_matcher))
         srv.add_hook(AllowHook())
-        srv.add_listener(TCP(Config(type="tcp", id="bench", address=address)))
+        clustered = wid_env is not None
+        srv.add_listener(
+            TCP(Config(type="tcp", id="bench", address=address, reuse_port=clustered))
+        )
+        cluster = maybe_attach_from_env(srv)
+        if cluster is not None and os.environ.get("MQTT_TPU_WORKER_PORTS") == "1":
+            # opt-in per-worker private ports (base+1+id): tests use them
+            # to pin which worker a client lands on; production stays off
+            # them (N extra non-REUSEPORT binds = N collision chances)
+            host, port = address.rsplit(":", 1)
+            private = f"{host}:{int(port) + 1 + cluster.worker_id}"
+            srv.add_listener(
+                TCP(Config(type="tcp", id=f"w{cluster.worker_id}", address=private))
+            )
         await srv.serve()
+        if cluster is not None:
+            await cluster.start()
         print("READY", flush=True)
         loop = asyncio.get_running_loop()
         # exit when the parent closes our stdin (robust to parent death)
         await loop.run_in_executor(None, sys.stdin.read)
+        if cluster is not None:
+            await cluster.stop()
         await srv.close()
 
     asyncio.run(main())
+
+
+def _cluster_launcher(address: str, device_matcher: bool, workers: int) -> None:
+    """Spawn one worker subprocess per slot, relay READY when all workers
+    serve, and shut them down when stdin closes."""
+    import os
+    import subprocess
+    import sys
+    import tempfile
+
+    from .cluster import worker_env
+
+    sock_dir = tempfile.mkdtemp(prefix="mqtt-tpu-cluster-")
+    procs = []
+    try:
+        for i in range(workers):
+            env = dict(os.environ)
+            env.update(worker_env(i, workers, sock_dir))
+            cmd = [sys.executable, "-m", "mqtt_tpu.stress", "--serve",
+                   "--broker", address]
+            if device_matcher:
+                cmd.append("--device-matcher")
+            procs.append(
+                subprocess.Popen(
+                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
+                )
+            )
+        for p in procs:
+            assert p.stdout.readline().strip() == b"READY"
+        print("READY", flush=True)
+        sys.stdin.read()  # parent closes stdin to stop us
+    finally:
+        for p in procs:
+            try:
+                p.stdin.close()
+                p.wait(timeout=10)
+            except Exception:
+                p.kill()
 
 
 def main() -> None:
@@ -239,10 +308,14 @@ def main() -> None:
     p.add_argument("--payload-size", type=int, default=64)
     p.add_argument("--serve", action="store_true", help="run the bench broker instead")
     p.add_argument("--device-matcher", action="store_true")
+    p.add_argument(
+        "--workers", type=int, default=1,
+        help="worker processes sharing the address via SO_REUSEPORT (multi-core)",
+    )
     args = p.parse_args()
     host, port = args.broker.rsplit(":", 1)
     if args.serve:
-        broker_main(args.broker, device_matcher=args.device_matcher)
+        broker_main(args.broker, device_matcher=args.device_matcher, workers=args.workers)
         return
     out = asyncio.run(
         run_stress(host, int(port), args.clients, args.messages, args.payload_size)
