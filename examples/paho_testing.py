@@ -1,0 +1,106 @@
+"""The Paho interoperability harness configuration (reference
+examples/paho.testing/main.go:29-31,77): a broker with the
+ObscureNotAuthorized / PassiveClientDisconnect /
+NoInheritedPropertiesOnAck compat flags and an ACL denying subscriptions
+to 'test/nosubscribe'.
+
+When the external Paho MQTT v5 conformance client (client_test5.py,
+reference README.md:468-471) or the paho-mqtt package is available, point
+it at this broker. Neither ships in this image, so the example also
+self-verifies the two harness-specific behaviors with an independent
+from-spec client (tests/test_interop.py carries the full version):
+the denied filter SUBACKs with the obscured unspecified-error code, and
+an allowed round trip works.
+"""
+
+import asyncio
+import os
+import struct
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks import ON_ACL_CHECK, ON_CONNECT_AUTHENTICATE, Hook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+PORT = 18895
+
+
+class PahoTestingACL(Hook):
+    """Allow everything except subscribing to test/nosubscribe
+    (examples/paho.testing/main.go:77)."""
+
+    def id(self):
+        return "paho-acl"
+
+    def provides(self, b):
+        return b in (ON_CONNECT_AUTHENTICATE, ON_ACL_CHECK)
+
+    def on_connect_authenticate(self, cl, pk):
+        return True
+
+    def on_acl_check(self, cl, topic, write):
+        return not (not write and topic == "test/nosubscribe")
+
+
+def _utf8(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+async def main() -> None:
+    opts = Options()
+    # the three compat flags the reference paho harness enables
+    opts.capabilities.compatibilities.obscure_not_authorized = True
+    opts.capabilities.compatibilities.passive_client_disconnect = True
+    opts.capabilities.compatibilities.no_inherited_properties_on_ack = True
+    server = Server(opts)
+    server.add_hook(PahoTestingACL())
+    server.add_listener(TCP(Config(type="tcp", id="paho", address=f"127.0.0.1:{PORT}")))
+    await server.serve()
+    print(f"paho-testing broker up on 127.0.0.1:{PORT}")
+
+    try:
+        import paho.mqtt.client  # noqa: F401
+
+        print("paho-mqtt detected: run the Paho v5 suite against this broker")
+    except ImportError:
+        pass
+
+    # self-verification with a from-spec v5 client
+    reader, writer = await asyncio.open_connection("127.0.0.1", PORT)
+    body = _utf8("MQTT") + b"\x05\x02" + struct.pack(">H", 60) + b"\x00" + _utf8("paho1")
+    writer.write(b"\x10" + bytes([len(body)]) + body)
+    await writer.drain()
+    connack = await reader.read(64)
+    assert connack[0] == 0x20 and connack[3] == 0, connack.hex()
+
+    # denied filter: the reason code must be the OBSCURED 0x80, not 0x87
+    var = struct.pack(">H", 1) + b"\x00" + _utf8("test/nosubscribe") + b"\x00"
+    writer.write(b"\x82" + bytes([len(var)]) + var)
+    await writer.drain()
+    suback = await reader.read(64)
+    assert suback[0] == 0x90 and suback[-1] == 0x80, suback.hex()
+    print("denied filter obscured to unspecified error:", hex(suback[-1]))
+
+    # allowed round trip still works
+    var = struct.pack(">H", 2) + b"\x00" + _utf8("test/allowed") + b"\x00"
+    writer.write(b"\x82" + bytes([len(var)]) + var)
+    await writer.drain()
+    suback = await reader.read(64)
+    assert suback[-1] == 0x00, suback.hex()
+    pub = _utf8("test/allowed") + b"\x00" + b"harness-ok"
+    writer.write(b"\x30" + bytes([len(pub)]) + pub)
+    await writer.drain()
+    echo = await asyncio.wait_for(reader.read(256), 5)
+    assert b"harness-ok" in echo, echo.hex()
+    print("allowed round trip:", echo.hex())
+
+    writer.close()
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

@@ -1,0 +1,80 @@
+"""TLS broker end to end (reference examples/tls/main.go): generate an
+ECC root + server certificate with the CLI's genecc generator, serve MQTT
+over TLS, and drive a connect/subscribe/publish round trip through a
+verifying TLS client socket."""
+
+import asyncio
+import os
+import ssl
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.tcp import TCP
+
+PORT = 18893
+
+CONNECT_V4 = bytes.fromhex("100c00044d5154540402003c0000")
+
+
+async def main() -> None:
+    workdir = tempfile.mkdtemp(prefix="mqtt-tpu-tls-")
+    cwd = os.getcwd()
+    os.chdir(workdir)
+    try:
+        from mqtt_tpu.__main__ import cmd_genecc
+
+        assert cmd_genecc(None) == 0, "certificate generation failed"
+    finally:
+        os.chdir(cwd)
+
+    server_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    server_ctx.load_cert_chain(
+        os.path.join(workdir, "cert.ec.pem"), os.path.join(workdir, "cert-key.ec.pem")
+    )
+    server = Server(Options())
+    server.add_hook(AllowHook())
+    server.add_listener(
+        TCP(
+            Config(
+                type="tcp", id="tls", address=f"127.0.0.1:{PORT}", tls_config=server_ctx
+            )
+        )
+    )
+    await server.serve()
+
+    # the client VERIFIES the server against the generated root CA
+    client_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    client_ctx.load_verify_locations(os.path.join(workdir, "root.ec.pem"))
+    reader, writer = await asyncio.open_connection(
+        "127.0.0.1", PORT, ssl=client_ctx, server_hostname="localhost"
+    )
+    writer.write(CONNECT_V4)
+    await writer.drain()
+    connack = await reader.read(64)
+    assert connack[0] == 0x20, connack.hex()
+
+    filt = b"secure/topic"
+    var = b"\x00\x01" + len(filt).to_bytes(2, "big") + filt + b"\x00"
+    writer.write(b"\x82" + bytes([len(var)]) + var)
+    await writer.drain()
+    suback = await reader.read(64)
+    assert suback[0] == 0x90, suback.hex()
+
+    body = len(filt).to_bytes(2, "big") + filt + b"over-tls"
+    writer.write(b"\x30" + bytes([len(body)]) + body)
+    await writer.drain()
+    echo = await asyncio.wait_for(reader.read(256), 5)
+    assert b"over-tls" in echo, echo.hex()
+    print("delivered over verified TLS:", echo.hex())
+
+    writer.close()
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

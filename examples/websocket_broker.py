@@ -1,0 +1,106 @@
+"""MQTT over WebSocket end to end (reference examples/websocket/main.go):
+serve the ws listener and drive connect/subscribe/publish through a
+minimal RFC 6455 client written inline — handshake, client-side masking,
+binary frames — so the example proves the whole upgrade + framing path
+without any external client."""
+
+import asyncio
+import base64
+import hashlib
+import os
+import secrets
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.hooks.auth import AllowHook
+from mqtt_tpu.listeners import Config
+from mqtt_tpu.listeners.websocket import Websocket
+
+PORT = 18894
+WS_GUID = "258EAFA5-E914-47DA-95CA-C5AB0DC85B11"
+CONNECT_V4 = bytes.fromhex("100c00044d5154540402003c0000")
+
+
+def _mask(payload: bytes) -> bytes:
+    key = secrets.token_bytes(4)
+    return key + bytes(b ^ key[i % 4] for i, b in enumerate(payload))
+
+
+def ws_frame(payload: bytes) -> bytes:
+    """One masked binary frame (client frames MUST be masked, RFC 6455 5.3)."""
+    head = b"\x82"  # FIN + binary opcode
+    n = len(payload)
+    if n < 126:
+        head += bytes([0x80 | n])
+    elif n < 65536:
+        head += bytes([0x80 | 126]) + n.to_bytes(2, "big")
+    else:
+        head += bytes([0x80 | 127]) + n.to_bytes(8, "big")
+    return head + _mask(payload)
+
+
+async def ws_read_frame(reader) -> bytes:
+    b1, b2 = await reader.readexactly(2)
+    n = b2 & 0x7F
+    if n == 126:
+        n = int.from_bytes(await reader.readexactly(2), "big")
+    elif n == 127:
+        n = int.from_bytes(await reader.readexactly(8), "big")
+    assert not (b2 & 0x80), "server frames must not be masked"
+    return await reader.readexactly(n) if n else b""
+
+
+async def main() -> None:
+    server = Server(Options())
+    server.add_hook(AllowHook())
+    server.add_listener(
+        Websocket(Config(type="ws", id="ws", address=f"127.0.0.1:{PORT}"))
+    )
+    await server.serve()
+
+    reader, writer = await asyncio.open_connection("127.0.0.1", PORT)
+    key = base64.b64encode(secrets.token_bytes(16)).decode()
+    writer.write(
+        (
+            f"GET /mqtt HTTP/1.1\r\nHost: 127.0.0.1:{PORT}\r\n"
+            "Upgrade: websocket\r\nConnection: Upgrade\r\n"
+            f"Sec-WebSocket-Key: {key}\r\nSec-WebSocket-Version: 13\r\n"
+            "Sec-WebSocket-Protocol: mqtt\r\n\r\n"
+        ).encode()
+    )
+    await writer.drain()
+    response = await reader.readuntil(b"\r\n\r\n")
+    assert b"101" in response.split(b"\r\n", 1)[0], response
+    want = base64.b64encode(
+        hashlib.sha1((key + WS_GUID).encode()).digest()
+    ).decode()
+    assert f"Sec-WebSocket-Accept: {want}".encode() in response
+    assert b"Sec-WebSocket-Protocol: mqtt" in response
+
+    writer.write(ws_frame(CONNECT_V4))
+    await writer.drain()
+    connack = await ws_read_frame(reader)
+    assert connack[0] == 0x20, connack.hex()
+
+    filt = b"ws/topic"
+    var = b"\x00\x01" + len(filt).to_bytes(2, "big") + filt + b"\x00"
+    writer.write(ws_frame(b"\x82" + bytes([len(var)]) + var))
+    await writer.drain()
+    suback = await ws_read_frame(reader)
+    assert suback[0] == 0x90, suback.hex()
+
+    body = len(filt).to_bytes(2, "big") + filt + b"over-websocket"
+    writer.write(ws_frame(b"\x30" + bytes([len(body)]) + body))
+    await writer.drain()
+    echo = await asyncio.wait_for(ws_read_frame(reader), 5)
+    assert b"over-websocket" in echo, echo.hex()
+    print("delivered over websocket:", echo.hex())
+
+    writer.close()
+    await server.close()
+
+
+if __name__ == "__main__":
+    asyncio.run(main())

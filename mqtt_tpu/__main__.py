@@ -154,8 +154,12 @@ def build_server(args) -> Server:
                 )
             server.add_hook(AuthHook(), AuthOptions(ledger=ledger))
 
-    # cluster workers share every TCP-family port via SO_REUSEPORT
+    # cluster workers share every MQTT-bearing port via SO_REUSEPORT; the
+    # HTTP side-channels (dashboard / stats / healthcheck) show per-worker
+    # state, so only worker 0 binds them — other workers binding the same
+    # plain port would EADDRINUSE-crash at serve time
     clustered = os.environ.get("MQTT_TPU_WORKER") is not None
+    primary = not clustered or os.environ.get("MQTT_TPU_WORKER") == "0"
     if not opts.listeners and len(server.listeners) == 0:
         server.add_listener(
             TCP(
@@ -184,9 +188,16 @@ def build_server(args) -> Server:
             )
         if args.ws_port:
             server.add_listener(
-                Websocket(ListenerConfig(type="ws", id="ws", address=f":{args.ws_port}"))
+                Websocket(
+                    ListenerConfig(
+                        type="ws",
+                        id="ws",
+                        address=f":{args.ws_port}",
+                        reuse_port=clustered,
+                    )
+                )
             )
-        if args.dashboard_port:
+        if args.dashboard_port and primary:
             auth_map = {}
             if args.admin_user:
                 user, _, pwd = args.admin_user.partition(":")
@@ -208,7 +219,7 @@ def build_server(args) -> Server:
                     listener_summary=f"mqtt: {args.port}; ws: {args.ws_port or '-'}",
                 )
             )
-        if args.stats_port:
+        if args.stats_port and primary:
             server.add_listener(
                 HTTPStats(
                     ListenerConfig(type="sysinfo", id="stats", address=f":{args.stats_port}"),
@@ -218,13 +229,15 @@ def build_server(args) -> Server:
     return server
 
 
-def _spawn_workers(args, n: int) -> int:
+def _spawn_workers(argv: list, n: int) -> int:
     """Launcher for --workers N: re-exec this CLI once per worker with the
     cluster env set; each worker binds the same ports with SO_REUSEPORT
-    and joins the unix-socket mesh (mqtt_tpu.cluster)."""
-    import os
+    and joins the unix-socket mesh (mqtt_tpu.cluster). ``argv`` is the
+    EFFECTIVE argument list main() parsed (not sys.argv — programmatic
+    callers pass their own)."""
     import subprocess
     import tempfile
+    import time
 
     from .cluster import worker_env
 
@@ -233,7 +246,7 @@ def _spawn_workers(args, n: int) -> int:
     # children must not recurse into the launcher
     cleaned = []
     skip = False
-    for a in sys.argv[1:]:
+    for a in argv:
         if skip:
             skip = False
             continue
@@ -251,6 +264,19 @@ def _spawn_workers(args, n: int) -> int:
             procs.append(
                 subprocess.Popen([sys.executable, "-m", "mqtt_tpu"] + cleaned, env=env)
             )
+        # readiness: a worker that dies in its first seconds (port clash,
+        # bad config) must fail the whole launch loudly, not leave a
+        # silently degraded partial mesh
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline:
+            dead = [i for i, p in enumerate(procs) if p.poll() is not None]
+            if dead:
+                print(
+                    f"worker(s) {dead} exited during startup; aborting launch",
+                    file=sys.stderr,
+                )
+                return 1
+            time.sleep(0.1)
         rc = 0
         for p in procs:
             rc = p.wait() or rc
@@ -263,14 +289,12 @@ def _spawn_workers(args, n: int) -> int:
                 p.terminate()
 
 
-def cmd_serve(args) -> int:
+def cmd_serve(args, argv: list) -> int:
     workers = getattr(args, "workers", 1)
     if workers == 0:
-        import os as _os
-
-        workers = _os.cpu_count() or 1
+        workers = os.cpu_count() or 1
     if workers > 1 and os.environ.get("MQTT_TPU_WORKER") is None:
-        return _spawn_workers(args, workers)
+        return _spawn_workers(argv, workers)
     if args.admin_user is not None:
         user, sep, pwd = args.admin_user.partition(":")
         if not user or not sep or not pwd:
@@ -366,6 +390,7 @@ def main(argv=None) -> int:
         )
         arg("--log-level", default="info")
         arg("--log2file", help="also log to this file")
+    effective_argv = list(sys.argv[1:] if argv is None else argv)
     args = parser.parse_args(argv)
 
     if args.version:
@@ -377,7 +402,7 @@ def main(argv=None) -> int:
         return cmd_code_password(args)
     if args.command == "genecc":
         return cmd_genecc(args)
-    return cmd_serve(args)
+    return cmd_serve(args, effective_argv)
 
 
 if __name__ == "__main__":

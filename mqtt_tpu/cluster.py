@@ -169,15 +169,20 @@ class Cluster:
 
     # per-peer write-buffer cap: a stalled peer must cost bounded memory.
     # Past it, forwards DROP (accounted) — the same posture as the bounded
-    # per-client outbound queue (server.py drop accounting); presence
-    # messages are exempt (tiny, and correctness depends on them)
+    # per-client outbound queue (server.py drop accounting). Presence
+    # messages get 8x headroom because peers' correctness depends on them;
+    # a peer too wedged to drain even control traffic has its link CLOSED
+    # (its interest map is stale beyond repair anyway).
     MAX_PEER_BUFFER = 8 * 1024 * 1024
 
     def _send_nowait(self, writer, mtype: int, payload: bytes) -> None:
-        if (
-            mtype != _T_PRESENCE
-            and writer.transport.get_write_buffer_size() > self.MAX_PEER_BUFFER
-        ):
+        buffered = writer.transport.get_write_buffer_size()
+        if mtype == _T_PRESENCE:
+            if buffered > 8 * self.MAX_PEER_BUFFER:
+                _log.warning("peer link wedged past the control cap; closing")
+                writer.transport.abort()
+                return
+        elif buffered > self.MAX_PEER_BUFFER:
             self.dropped_forwards += 1
             return
         writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
@@ -380,32 +385,30 @@ class Cluster:
         """Deliver a forwarded v4 QoS0 frame to local subscribers through
         the server's fast-path plans; write ACL was enforced at the origin
         worker, so only per-target read ACL applies here."""
+        from .server import publish_frame_body_offset
+
         s = self.server
         if not s.fast_deliver_frame(frame, origin):
             # a local shared/inline/v5 case: decode and take the full path
-            off = 1
-            while frame[off] & 0x80:
-                off += 1
             pk = Packet(
                 fixed_header=FixedHeader(type=PUBLISH), protocol_version=4
             )
-            pk.publish_decode(frame[off + 1 :])
+            pk.publish_decode(frame[publish_frame_body_offset(frame):])
             pk.origin = origin
             s._stamp_publish_expiry(pk)
             self._deliver_local(pk)
 
     def _deliver_packet(self, head: dict, frame: bytes) -> None:
+        from .server import publish_frame_body_offset
+
         # publish_encode produced a full frame; decode wants only the body
-        off = 1
-        while frame[off] & 0x80:
-            off += 1
         pk = Packet(
             fixed_header=FixedHeader(
                 type=PUBLISH, qos=head.get("qos", 0), retain=head.get("retain", False)
             ),
             protocol_version=5,
         )
-        pk.publish_decode(frame[off + 1 :])
+        pk.publish_decode(frame[publish_frame_body_offset(frame):])
         pk.origin = head.get("origin", "")
         pk.created = head.get("created", 0)
         pk.expiry = head.get("expiry", 0)
@@ -447,13 +450,26 @@ def worker_env(worker_id: int, n_workers: int, sock_dir: str) -> dict:
 
 def maybe_attach_from_env(server) -> Optional[Cluster]:
     """Attach a Cluster to ``server`` when worker env vars are present
-    (set by the multi-process launcher). Returns the cluster or None."""
+    (set by the multi-process launcher). Returns the cluster or None.
+
+    ``MQTT_TPU_CLUSTER_DIR`` is REQUIRED alongside ``MQTT_TPU_WORKER``:
+    the mesh protocol is unauthenticated, so the socket directory's
+    permissions ARE the access control — a predictable world-writable
+    default like /tmp would let any local user inject publishes or forge
+    presence. The launchers always create a private mkdtemp dir."""
     wid = os.environ.get("MQTT_TPU_WORKER")
     if wid is None:
         return None
+    sock_dir = os.environ.get("MQTT_TPU_CLUSTER_DIR")
+    if not sock_dir:
+        raise RuntimeError(
+            "MQTT_TPU_WORKER is set but MQTT_TPU_CLUSTER_DIR is not; the "
+            "cluster socket dir must be a private directory (the mesh "
+            "trusts every connection on it)"
+        )
     return Cluster(
         server,
         int(wid),
         int(os.environ.get("MQTT_TPU_WORKERS", "1")),
-        os.environ.get("MQTT_TPU_CLUSTER_DIR", "/tmp"),
+        sock_dir,
     )

@@ -158,7 +158,11 @@ class Websocket(StreamListener):
         self.log = log
         host, port = split_host_port(self.config.address)
         self._server = await asyncio.start_server(
-            self._on_connection, host, port, ssl=self.config.tls_config
+            self._on_connection,
+            host,
+            port,
+            ssl=self.config.tls_config,
+            reuse_port=self.config.reuse_port or None,
         )
 
     async def _handle(self, reader, writer, establish: EstablishFn) -> None:

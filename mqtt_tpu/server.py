@@ -204,6 +204,36 @@ class Options:
             self.logger = logging.getLogger("mqtt_tpu")
 
 
+def publish_frame_body_offset(frame: bytes) -> int:
+    """Offset of a raw PUBLISH frame's variable header (skips the fixed
+    header's remaining-length varint). The caller guarantees a frame the
+    scanner accepted, so the varint terminates within 4 bytes."""
+    off = 1
+    while frame[off] & 0x80:
+        off += 1
+    return off + 1
+
+
+def publish_frame_topic(frame: bytes):
+    """``(topic, body_offset)`` parsed from a raw PUBLISH frame, or None
+    when the frame is truncated or the topic is not valid UTF-8. The one
+    shared parse for every fast-path delivery leg — try_fast_publish's
+    inline gates, fast_deliver_frame, and the cluster's forwarded-frame
+    delivery (mqtt_tpu.cluster) — so framing rules change in one place."""
+    body_offset = publish_frame_body_offset(frame)
+    n = len(frame)
+    if body_offset + 2 > n:
+        return None
+    tl = (frame[body_offset] << 8) | frame[body_offset + 1]
+    t0 = body_offset + 2
+    if n < t0 + tl:
+        return None
+    try:
+        return frame[t0 : t0 + tl].decode("utf-8"), body_offset
+    except UnicodeDecodeError:
+        return None
+
+
 class _FrameCache:
     """One-encode-per-publish outbound frames for the QoS0 fan-out fast
     path: every eligible subscriber of a publish shares the same wire
@@ -1199,16 +1229,10 @@ class Server:
         Returns False when this worker needs the decode path for the topic
         (shared/inline subscribers, or a plan miss class). Write ACL was
         enforced at the origin worker."""
-        off = 1
-        while frame[off] & 0x80:
-            off += 1
-        body_offset = off + 1
-        tl = (frame[body_offset] << 8) | frame[body_offset + 1]
-        t0 = body_offset + 2
-        try:
-            topic = frame[t0 : t0 + tl].decode("utf-8")
-        except UnicodeDecodeError:
+        parsed = publish_frame_topic(frame)
+        if parsed is None:
             return True  # origin validated it; nothing deliverable here
+        topic, body_offset = parsed
         plan = self._plan_for_topic(topic)
         if plan is None:
             return False

@@ -699,7 +699,9 @@ class TestCLI:
         import mqtt_tpu.__main__ as m
 
         captured = {}
-        monkeypatch.setattr(m, "cmd_serve", lambda a: captured.update(vars(a)) or 0)
+        monkeypatch.setattr(
+            m, "cmd_serve", lambda a, argv: captured.update(vars(a)) or 0
+        )
         assert m.main(["--port", "1999", "serve"]) == 0
         assert captured["port"] == 1999
 

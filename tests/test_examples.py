@@ -34,3 +34,19 @@ def test_hooks_custom_example():
     out = _run("hooks_custom.py")
     assert "[modified] hello" in out
     assert "forbidden" not in out.split("seen:")[-1]  # veto worked
+
+
+def test_tls_example():
+    out = _run("tls_broker.py")
+    assert "delivered over verified TLS" in out
+
+
+def test_websocket_example():
+    out = _run("websocket_broker.py")
+    assert "delivered over websocket" in out
+
+
+def test_paho_testing_example():
+    out = _run("paho_testing.py")
+    assert "denied filter obscured to unspecified error: 0x80" in out
+    assert "allowed round trip" in out

@@ -61,21 +61,72 @@ class MiniV5Client:
         self.reader = None
         self.writer = None
 
-    async def connect(self, host: str, port: int, client_id: str) -> int:
+    async def connect(
+        self,
+        host: str,
+        port: int,
+        client_id: str,
+        will: tuple[str, bytes] | None = None,
+    ) -> int:
         self.reader, self.writer = await asyncio.open_connection(host, port)
+        flags = 0x02  # 3.1.2.4 clean start
+        if will is not None:
+            flags |= 0x04  # 3.1.2.5 will flag
         body = (
             _utf8("MQTT")  # 3.1.2.1 protocol name
             + b"\x05"  # 3.1.2.2 version 5
-            + b"\x02"  # 3.1.2.3 flags: clean start
+            + bytes([flags])
             + struct.pack(">H", 60)  # 3.1.2.10 keep alive
             + b"\x00"  # 3.1.2.11 no properties
             + _utf8(client_id)  # 3.1.3.1
         )
+        if will is not None:
+            # 3.1.3.2 will properties (none) + 3.1.3.3 topic + 3.1.3.4 payload
+            topic, payload = will
+            body += b"\x00" + _utf8(topic) + struct.pack(">H", len(payload)) + payload
         self.writer.write(_frame(0x10, body))
         await self.writer.drain()
         t, body = await self._read_frame()
         assert t == 0x20, f"expected CONNACK, got {t:#x}"
         return body[1]  # 3.2.2.2 connect reason code
+
+    async def publish_qos2(self, topic: str, payload: bytes, pid: int) -> None:
+        """Full QoS2 flow: PUBLISH -> PUBREC -> PUBREL -> PUBCOMP (4.3.3)."""
+        body = _utf8(topic) + struct.pack(">H", pid) + b"\x00" + payload
+        self.writer.write(_frame(0x34, body))  # qos2
+        await self.writer.drain()
+        t, rb = await self._read_frame()
+        assert t == 0x50, f"expected PUBREC, got {t:#x}"
+        assert struct.unpack(">H", rb[:2])[0] == pid
+        self.writer.write(_frame(0x62, struct.pack(">H", pid)))  # PUBREL 3.6.1
+        await self.writer.drain()
+        t, cb = await self._read_frame()
+        assert t == 0x70, f"expected PUBCOMP, got {t:#x}"
+        assert struct.unpack(">H", cb[:2])[0] == pid
+
+    async def recv_publish_qos2(self) -> tuple[str, bytes]:
+        """Receive one QoS2 PUBLISH and complete the receiver side of 4.3.3."""
+        t, body = await self._read_frame()
+        assert (t & 0xF0) == 0x30 and ((t >> 1) & 3) == 2, f"got {t:#x}"
+        tlen = struct.unpack(">H", body[:2])[0]
+        topic = body[2 : 2 + tlen].decode("utf-8")
+        off = 2 + tlen
+        pid = struct.unpack(">H", body[off : off + 2])[0]
+        off += 2
+        plen, off = self._read_varint(body, off)
+        payload = body[off + plen :]
+        self.writer.write(_frame(0x50, struct.pack(">H", pid)))  # PUBREC
+        await self.writer.drain()
+        t, rb = await self._read_frame()
+        assert t == 0x62, f"expected PUBREL, got {t:#x}"
+        assert struct.unpack(">H", rb[:2])[0] == pid
+        self.writer.write(_frame(0x70, struct.pack(">H", pid)))  # PUBCOMP
+        await self.writer.drain()
+        return topic, payload
+
+    def drop(self) -> None:
+        """Abrupt socket close (no DISCONNECT): triggers the will (3.1.2.5)."""
+        self.writer.transport.abort()
 
     async def subscribe(self, pid: int, topic: str, qos: int) -> int:
         body = struct.pack(">H", pid) + b"\x00" + _utf8(topic) + bytes([qos])
@@ -237,6 +288,58 @@ class TestInterop:
                 # ObscureNotAuthorized: 0x80 unspecified, not 0x87
                 assert code == 0x80
                 await c.disconnect()
+            finally:
+                await srv.close()
+
+        run(scenario())
+
+    def test_qos2_end_to_end(self):
+        """Exactly-once flow both directions through the broker: the
+        independent client drives PUBLISH/PUBREC/PUBREL/PUBCOMP on the
+        sender side and PUBREC/PUBREL/PUBCOMP on the receiver side
+        (spec 4.3.3; reference flow server.go:1175-1238)."""
+
+        async def scenario():
+            srv = await _broker()
+            try:
+                sub = MiniV5Client()
+                assert await sub.connect("127.0.0.1", PORT, "q2-sub") == 0
+                assert await sub.subscribe(11, "exactly/once", 2) == 2
+                pub = MiniV5Client()
+                assert await pub.connect("127.0.0.1", PORT, "q2-pub") == 0
+                await pub.publish_qos2("exactly/once", b"only-one", pid=21)
+                topic, payload = await asyncio.wait_for(sub.recv_publish_qos2(), 5)
+                assert (topic, payload) == ("exactly/once", b"only-one")
+                await pub.disconnect()
+                await sub.disconnect()
+            finally:
+                await srv.close()
+
+        run(scenario())
+
+    def test_will_delivered_on_abrupt_drop(self):
+        """A client that dies without DISCONNECT has its will published to
+        matching subscribers (3.1.2.5; reference sendLWT server.go:1515)."""
+
+        async def scenario():
+            srv = await _broker()
+            try:
+                watcher = MiniV5Client()
+                assert await watcher.connect("127.0.0.1", PORT, "watcher") == 0
+                assert await watcher.subscribe(5, "wills/+", 0) == 0
+                doomed = MiniV5Client()
+                assert (
+                    await doomed.connect(
+                        "127.0.0.1", PORT, "doomed", will=("wills/doomed", b"gone")
+                    )
+                    == 0
+                )
+                doomed.drop()
+                topic, payload, qos, retain = await asyncio.wait_for(
+                    watcher.recv_publish(), 10
+                )
+                assert (topic, payload) == ("wills/doomed", b"gone")
+                await watcher.disconnect()
             finally:
                 await srv.close()
 

@@ -1807,3 +1807,1170 @@ CASES += [
         group="decode",
     ),
 ]
+
+# ---------------------------------------------------------------------------
+# Round-5 expansion: the remaining malformed / FailFirst / validate variants
+# from the reference catalogue (tpackets.go case ids :37-234) plus varint
+# and translation boundary cases. Same conventions as above.
+# ---------------------------------------------------------------------------
+
+CASES += [
+    # ---- CONNECT: remaining malformed variants ---------------------------
+    Case(
+        "connect missing protocol version",
+        hx("1006 0004 4d515454"),
+        decode_err=codes.ERR_MALFORMED_PROTOCOL_VERSION,
+        group="decode",
+    ),
+    Case(
+        "connect truncated client id",
+        hx("100c 0004 4d515454 04 00 0014 0003 7a65"),
+        decode_err=codes.ERR_CLIENT_IDENTIFIER_NOT_VALID,
+        group="decode",
+    ),
+    Case(
+        "connect will flag truncated will payload bytes",
+        hx("101b 0004 4d515454 04 0e 0014 0003 7a656e 0003 6c7774 0009 6e6f742061"),
+        decode_err=codes.ERR_MALFORMED_WILL_PAYLOAD,
+        group="decode",
+    ),
+    Case(
+        "connect will and user flags truncated username bytes",
+        hx("1024 0004 4d515454 04 ce 0014 0003 7a656e 0003 6c7774 0009 6e6f7420616761696e 0005 6d6f63"),
+        decode_err=codes.ERR_MALFORMED_USERNAME,
+        group="decode",
+    ),
+    Case(
+        "connect oversize fixed header varint",
+        hx("10 ffffffffff"),
+        decode_err=codes.ERR_MALFORMED_VARIABLE_BYTE_INTEGER,
+        group="decode",
+    ),
+    Case(
+        "connect v5 malformed properties declared past body",
+        hx("100b 0004 4d515454 05 0e 001e 0a"),
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "connect v4 username password will qos1",
+        hx("102c 0004 4d515454 04 ce 0014 0003 7a656e 0003 6c7774 0009 6e6f7420616761696e 0005 6d6f636869 0004 31323334"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=44),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=20,
+                client_identifier="zen",
+                will_flag=True,
+                will_qos=1,
+                will_topic="lwt",
+                will_payload=b"not again",
+                username_flag=True,
+                password_flag=True,
+                username=b"mochi",
+                password=b"1234",
+            ),
+        ),
+    ),
+    Case(
+        "connect v5 server-limit properties roundtrip",
+        hx("101b 0004 4d515454 05 02 003c 0b 11 00000000 21 0005 22 000a 0003 7a656e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=27),
+            protocol_version=5,
+            properties=Properties(
+                session_expiry_interval=0,
+                session_expiry_interval_flag=True,
+                receive_maximum=5,
+                topic_alias_maximum=10,
+            ),
+            connect=ConnectParams(
+                protocol_name=b"MQTT", clean=True, keepalive=60, client_identifier="zen"
+            ),
+        ),
+    ),
+    Case(
+        "connect client id BOM not skipped [MQTT-1.5.4-3]",
+        hx("1012 0004 4d515454 04 02 003c 0006 efbbbf7a656e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=18),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="﻿zen",
+            ),
+        ),
+    ),
+    # ---- CONNACK ---------------------------------------------------------
+    Case(
+        "connack v5 min with session present",
+        hx("2003 010000"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=3),
+            protocol_version=5,
+            session_present=True,
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v4 encode drops v5 properties",
+        hx("20020000"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=2),
+            protocol_version=4,
+            properties=Properties(reason_string="ignored"),
+        ),
+        group="encode",
+    ),
+    Case(
+        "connack v5 body shorter than remaining",
+        hx("2004 000005"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    # ---- PUBLISH ---------------------------------------------------------
+    Case(
+        "publish qos1 no payload",
+        hx("3209 0005 612f622f63 000b"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=1, remaining=9),
+            protocol_version=4,
+            topic_name="a/b/c",
+            packet_id=11,
+        ),
+    ),
+    Case(
+        "publish qos1 dup",
+        hx("3a0e 0005 612f622f63 000b 68656c6c6f"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=1, dup=True, remaining=14),
+            protocol_version=4,
+            topic_name="a/b/c",
+            packet_id=11,
+            payload=b"hello",
+        ),
+    ),
+    Case(
+        "publish v5 topic alias above client maximum (validate)",
+        hx("300a 0003 612f62 03 23 ffff 78"),
+        version=5,
+        validate_err=codes.ERR_TOPIC_ALIAS_INVALID,
+        validate_arg=1024,
+        group="validate",
+    ),
+    Case(
+        "publish v5 surplus subscription identifier (validate)",
+        hx("3009 0003 612f62 02 0b 07 78"),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_SURPLUS_SUB_ID,
+        validate_arg=10,
+        group="validate",
+    ),
+]
+
+CASES += [
+    # ---- PUBACK / PUBREC / PUBREL / PUBCOMP ------------------------------
+    Case(
+        "puback v5 unexpected error",
+        hx("4004 0007 99 00"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x99,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "puback v5 not authorized",
+        hx("4004 0007 87 00"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x87,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "pubrec v5 packet identifier in use",
+        hx("5004 0007 91 00"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x91,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "pubrec v5 two byte body implies success [MQTT-3.5.2.1]",
+        hx("5002 0007"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=2),
+            protocol_version=5,
+            packet_id=7,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "pubrec v5 invalid reason decodes (validity checked at server)",
+        hx("5004 0007 99 00"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x99,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "pubrel v5 invalid reason decodes (validity checked at server)",
+        hx("6204 0007 99 00"),
+        Packet(
+            fixed_header=fhdr(PUBREL, qos=1, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x99,
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "pubcomp truncated packet id",
+        hx("7001 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    # ---- SUBSCRIBE / SUBACK ----------------------------------------------
+    Case(
+        "suback truncated packet id",
+        hx("9001 00"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "suback v5 truncated reason-string property",
+        hx("9005 0007 05 1f 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "suback v4 no grant codes",
+        hx("9002 0007"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=2),
+            protocol_version=4,
+            packet_id=7,
+        ),
+        group="decode",
+    ),
+    Case(
+        "subscribe v5 malformed subscription identifier varint",
+        hx("8206 0007 02 0b 80"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    Case(
+        "subscribe v5 shared filter with no-local option decodes",
+        hx("8210 000a 00 000a 2453484152452f672f61 05"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=16),
+            protocol_version=5,
+            packet_id=10,
+            filters=[
+                Subscription(filter="$SHARE/g/a", qos=1, no_local=True)
+            ],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe missing packet id (struct validate)",
+        b"",
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1),
+            protocol_version=5,
+            packet_id=0,
+            filters=[Subscription(filter="a/b")],
+        ),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_PACKET_ID,
+        group="validate",
+    ),
+    Case(
+        "subscribe empty filter list (struct validate)",
+        b"",
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1),
+            protocol_version=5,
+            packet_id=7,
+        ),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_FILTERS,
+        group="validate",
+    ),
+    # ---- UNSUBSCRIBE / UNSUBACK ------------------------------------------
+    Case(
+        "unsubscribe v5 truncated reason-string property",
+        hx("a206 0007 05 1f 00 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe missing packet id (struct validate)",
+        b"",
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1),
+            protocol_version=5,
+            packet_id=0,
+            filters=[Subscription(filter="a/b")],
+        ),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_PACKET_ID,
+        group="validate",
+    ),
+    Case(
+        "unsubscribe empty filter list (struct validate)",
+        b"",
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1),
+            protocol_version=5,
+            packet_id=7,
+        ),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_FILTERS,
+        group="validate",
+    ),
+    Case(
+        "unsuback v5 truncated reason-string property",
+        hx("b005 0007 05 1f 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "unsuback v4 without payload",
+        hx("b002 0007"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=2),
+            protocol_version=4,
+            packet_id=7,
+        ),
+        group="decode",
+    ),
+    # ---- DISCONNECT / AUTH / PING ----------------------------------------
+    Case(
+        "disconnect v5 truncated session-expiry property",
+        hx("e003 04 05 1f"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 message rate too high",
+        hx("e002 96 00"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=2),
+            protocol_version=5,
+            reason_code=0x96,
+        ),
+        version=5,
+    ),
+    Case(
+        "auth v5 truncated auth-method property",
+        hx("f003 18 05 15"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "auth success-code zero (struct validate ok)",
+        b"",
+        Packet(fixed_header=fhdr(AUTH), protocol_version=5, reason_code=0),
+        version=5,
+        validate_err=codes.CODE_SUCCESS,
+        group="validate",
+    ),
+    Case(
+        "pingreq tolerates nonzero remaining",
+        hx("c001 00"),
+        Packet(fixed_header=fhdr(PINGREQ, remaining=1), protocol_version=4),
+        group="decode",
+    ),
+    # ---- varint / remaining-length boundaries ----------------------------
+    Case(
+        "publish remaining length 127 single byte boundary",
+        hx("307f 0003 612f62") + b"\x00" * 122,
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=127),
+            protocol_version=4,
+            topic_name="a/b",
+            payload=b"\x00" * 122,
+        ),
+    ),
+    Case(
+        "publish remaining length 128 two byte boundary",
+        hx("308001 0003 612f62") + b"\x00" * 123,
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=128),
+            protocol_version=4,
+            topic_name="a/b",
+            payload=b"\x00" * 123,
+        ),
+    ),
+    Case(
+        "publish remaining varint above protocol maximum",
+        hx("30 ffffff7f"),
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+]
+
+CASES += [
+    # canonical short encodes for the ack family: a non-success reason
+    # emits the reason byte but omits the empty properties length
+    Case(
+        "pubrec v5 packet identifier in use canonical encode",
+        hx("5003 0007 91"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x91,
+        ),
+        version=5,
+    ),
+    Case(
+        "pubcomp v5 not authorized canonical encode",
+        hx("7003 0007 87"),
+        Packet(
+            fixed_header=fhdr(PUBCOMP, remaining=3),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x87,
+        ),
+        version=5,
+    ),
+]
+
+CASES += [
+    # ---- CONNECT variants ------------------------------------------------
+    Case(
+        "connect v5 password without username [MQTT-3.1.2-22 removed in v5]",
+        hx("1015 0004 4d515454 05 42 003c 00 0004 7a656e33 0002 7071"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=21),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                password_flag=True,
+                password=b"pq",
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect will qos2 retain",
+        hx("101f 0004 4d515454 04 36 003c 0004 7a656e33 0003 6c7774 0008 6e6f74616761696e"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=31),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+                will_flag=True,
+                will_qos=2,
+                will_retain=True,
+                will_topic="lwt",
+                will_payload=b"notagain",
+            ),
+        ),
+    ),
+    Case(
+        "connect MQIsdp name with version 4 decodes (validate flags version)",
+        hx("1012 0006 4d514973647004 02 003c 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=18),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQIsdp",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+            ),
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_PROTOCOL_VERSION,
+    ),
+    Case(
+        "connect keepalive maximum",
+        hx("1010 0004 4d515454 04 02 ffff 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=16),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=65535,
+                client_identifier="zen3",
+            ),
+        ),
+    ),
+    # ---- CONNACK variants ------------------------------------------------
+    Case(
+        "connack v4 session present with identifier rejected",
+        hx("2002 0102"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=2),
+            protocol_version=4,
+            session_present=True,
+            reason_code=2,
+        ),
+    ),
+    # ---- PUBLISH variants ------------------------------------------------
+    Case(
+        "publish remaining length 16383 two byte maximum",
+        hx("30 ff7f 0003 612f62") + b"\x00" * (16383 - 5),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=16383),
+            protocol_version=4,
+            topic_name="a/b",
+            payload=b"\x00" * (16383 - 5),
+        ),
+    ),
+    Case(
+        "publish remaining length 16384 three byte minimum",
+        hx("30 808001 0003 612f62") + b"\x00" * (16384 - 5),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=16384),
+            protocol_version=4,
+            topic_name="a/b",
+            payload=b"\x00" * (16384 - 5),
+        ),
+    ),
+    Case(
+        "publish qos2 dup retain",
+        hx("3d0c 0003 612f62 0009 7061796c64"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, qos=2, dup=True, retain=True, remaining=12),
+            protocol_version=4,
+            topic_name="a/b",
+            packet_id=9,
+            payload=b"payld",
+        ),
+    ),
+    Case(
+        "publish no topic and no alias (struct validate)",
+        b"",
+        Packet(
+            fixed_header=fhdr(PUBLISH),
+            protocol_version=5,
+            topic_name="",
+        ),
+        version=5,
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_TOPIC,
+        group="validate",
+    ),
+    # ---- SUBSCRIBE variants ----------------------------------------------
+    Case(
+        "subscribe v5 retain handling 3 decodes (server validates range)",
+        hx("820b 0007 00 0005 612f622f63 30"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=11),
+            protocol_version=5,
+            packet_id=7,
+            filters=[Subscription(filter="a/b/c", retain_handling=3)],
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe body shorter than declared remaining",
+        hx("8204 0007 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    # ---- UNSUBSCRIBE / UNSUBACK variants ---------------------------------
+    Case(
+        "unsubscribe truncated second filter",
+        hx("a20c 0007 0003 612f62 0005 632f64"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "unsuback v5 mixed grant codes",
+        hx("b006 0007 00 0011 80"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=6),
+            protocol_version=5,
+            packet_id=7,
+            reason_codes=bytes([0x00, 0x11, 0x80]),
+        ),
+        version=5,
+    ),
+    # ---- DISCONNECT / AUTH / PING variants -------------------------------
+    Case(
+        "disconnect v4 tolerates body byte",
+        hx("e001 00"),
+        Packet(fixed_header=fhdr(DISCONNECT, remaining=1), protocol_version=4),
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 use another server with server reference",
+        hx("e00d 9c 0b 1c 0008 656c736577686572"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=13),
+            protocol_version=5,
+            reason_code=0x9C,
+            properties=Properties(server_reference="elsewher"),
+        ),
+        version=5,
+    ),
+    Case(
+        "auth v5 method and binary data roundtrip",
+        hx("f00f 18 0d 15 0005 746f6b656e 16 0002 abcd"),
+        Packet(
+            fixed_header=fhdr(AUTH, remaining=15),
+            protocol_version=5,
+            reason_code=0x18,
+            properties=Properties(
+                authentication_method="token",
+                authentication_data=b"\xab\xcd",
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "pingresp tolerates nonzero remaining",
+        hx("d001 00"),
+        Packet(fixed_header=fhdr(PINGRESP, remaining=1), protocol_version=4),
+        group="decode",
+    ),
+]
+
+CASES += [
+    # ---- v5 property-validity matrix: a property invalid for the packet
+    # type must fail the properties decode (reference validPacketProperties,
+    # properties.go:46-74)
+    Case(
+        "puback v5 topic alias invalid for type",
+        hx("4007 0007 10 03 23 0005"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "suback v5 session expiry invalid for type",
+        hx("9009 0007 05 11 00000078 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "subscribe v5 reason string invalid for type",
+        hx("820e 0007 05 1f 0002 6e6f 0003 612f62 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe v5 subscription identifier invalid for type",
+        hx("a20a 0007 02 0b 07 0003 612f62"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "pubrel v5 receive maximum invalid for type",
+        hx("6207 0007 00 03 21 0005"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 will delay invalid for type",
+        hx("e008 00 06 18 00000005 00"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "connack v5 subscription identifier invalid for type",
+        hx("2005 0000 02 0b 07"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "publish v5 maximum packet size invalid for type",
+        hx("300b 0003 612f62 05 27 00000400"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    Case(
+        "connect v5 retain available invalid for type",
+        hx("1013 0004 4d515454 05 02 003c 02 25 01 0004 7a656e33"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PROPERTIES,
+        group="decode",
+    ),
+    # ---- rich-property roundtrips ----------------------------------------
+    Case(
+        "connack v5 server capability property set",
+        hx("2023 0000 20 12 0003 616263 13 003c 1c 0004 74686174 22 000a 24 01 25 00 27 00001000 28 00 29 01"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=35),
+            protocol_version=5,
+            properties=Properties(
+                assigned_client_id="abc",
+                server_keep_alive=60,
+                server_keep_alive_flag=True,
+                server_reference="that",
+                topic_alias_maximum=10,
+                maximum_qos=1,
+                maximum_qos_flag=True,
+                retain_available=0,
+                retain_available_flag=True,
+                maximum_packet_size=4096,
+                wildcard_sub_available=0,
+                wildcard_sub_available_flag=True,
+                sub_id_available=1,
+                sub_id_available_flag=True,
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect v5 full will properties",
+        hx("1032 0004 4d515454 05 06 003c 00 0003 7a656e 18 0101 02 0000003c 03 0009 746578742f6a736f6e 18 00000005 0003 6c7774 0002 686f"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=50),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen",
+                will_flag=True,
+                will_topic="lwt",
+                will_payload=b"ho",
+                will_properties=Properties(
+                    payload_format=1,
+                    payload_format_flag=True,
+                    message_expiry_interval=60,
+                    content_type="text/json",
+                    will_delay_interval=5,
+                ),
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "publish v5 two user properties",
+        hx("3016 0003 612f62 0e 26 0001 61 0001 31 26 0001 62 0001 32 7879"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=22),
+            protocol_version=5,
+            topic_name="a/b",
+            payload=b"xy",
+            properties=Properties(
+                user=[UserProperty("a", "1"), UserProperty("b", "2")]
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 session expiry and reason string",
+        hx("e011 04 0f 11 0000003c 1f 0007 676f6f64627965"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=17),
+            protocol_version=5,
+            reason_code=0x04,
+            properties=Properties(
+                session_expiry_interval=60,
+                session_expiry_interval_flag=True,
+                reason_string="goodbye",
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 user property",
+        hx("8211 0007 08 26 0001 6b 0002 7631 0003 612f62 01"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=17),
+            protocol_version=5,
+            packet_id=7,
+            properties=Properties(user=[UserProperty("k", "v1")]),
+            filters=[Subscription(filter="a/b", qos=1)],
+        ),
+        version=5,
+    ),
+    # ---- misc edge behavior ----------------------------------------------
+    Case(
+        "publish v4 empty topic decodes (server rejects at validate)",
+        hx("3004 0000 0000"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=4),
+            protocol_version=4,
+            topic_name="",
+            payload=b"\x00\x00",
+        ),
+        validate_err=codes.ERR_PROTOCOL_VIOLATION_NO_TOPIC,
+    ),
+    Case(
+        "pubrel v4 tolerates trailing byte",
+        hx("6203 0007 00"),
+        Packet(
+            fixed_header=fhdr(PUBREL, qos=1, remaining=3),
+            protocol_version=4,
+            packet_id=7,
+        ),
+        group="decode",
+    ),
+    Case(
+        "connack nonzero flags rejected at header",
+        hx("2102 0000"),
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+]
+
+CASES += [
+    # ---- empty-body decodes per type -------------------------------------
+    Case(
+        "connect empty body",
+        hx("1000"),
+        decode_err=codes.ERR_MALFORMED_PROTOCOL_NAME,
+        group="decode",
+    ),
+    Case(
+        "publish empty body",
+        hx("3000"),
+        decode_err=codes.ERR_MALFORMED_TOPIC,
+        group="decode",
+    ),
+    Case(
+        "subscribe empty body",
+        hx("8200"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe empty body",
+        hx("a200"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "puback empty body",
+        hx("4000"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "pubrel empty body",
+        hx("6200"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "suback empty body",
+        hx("9000"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "unsuback empty body",
+        hx("b000"),
+        decode_err=codes.ERR_MALFORMED_PACKET_ID,
+        group="decode",
+    ),
+    Case(
+        "auth empty body",
+        hx("f000"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_REASON_CODE,
+        group="decode",
+    ),
+    # ---- body/remaining mismatches and trailing bytes --------------------
+    Case(
+        "puback body shorter than remaining",
+        hx("4003 0007"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    Case(
+        "pubrec v4 tolerates trailing byte",
+        hx("5003 0007 00"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=3),
+            protocol_version=4,
+            packet_id=7,
+        ),
+        group="decode",
+    ),
+    Case(
+        "pubcomp v4 tolerates trailing byte",
+        hx("7003 0007 00"),
+        Packet(
+            fixed_header=fhdr(PUBCOMP, remaining=3),
+            protocol_version=4,
+            packet_id=7,
+        ),
+        group="decode",
+    ),
+    Case(
+        "disconnect v5 overlong properties length at body end tolerated",
+        hx("e002 00 05"),
+        Packet(fixed_header=fhdr(DISCONNECT, remaining=2), protocol_version=5),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "unsubscribe v5 zero length filter",
+        hx("a206 0007 00 0000"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PACKET,
+        group="decode",
+    ),
+    Case(
+        "auth nonzero flags rejected at header",
+        hx("f102 1800"),
+        version=5,
+        fail_first=codes.ERR_MALFORMED_FLAGS,
+        group="decode",
+    ),
+    # ---- more roundtrips -------------------------------------------------
+    Case(
+        "connect v5 request problem and response information",
+        hx("1015 0004 4d515454 05 02 003c 04 1700 1901 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=21),
+            protocol_version=5,
+            properties=Properties(
+                request_problem_info=0,
+                request_problem_info_flag=True,
+                request_response_info=1,
+            ),
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "connack v5 response information decodes (encode gated by mods)",
+        hx("2008 0000 05 1a 0002 7269"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=8),
+            protocol_version=5,
+            properties=Properties(response_info="ri"),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "publish v5 two subscription identifiers",
+        hx("300b 0003 612f62 04 0b 07 0b 09 78"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=11),
+            protocol_version=5,
+            topic_name="a/b",
+            payload=b"x",
+            properties=Properties(subscription_identifier=[7, 9]),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect v5 receive maximum zero decodes (encode omits zero)",
+        hx("1014 0004 4d515454 05 02 003c 03 21 0000 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=20),
+            protocol_version=5,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+            ),
+        ),
+        version=5,
+        group="decode",
+    ),
+    Case(
+        "subscribe v5 all option bits (qos2 nl rap rh2)",
+        hx("820b 0007 00 0005 612f622f63 2e"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=11),
+            protocol_version=5,
+            packet_id=7,
+            filters=[
+                Subscription(
+                    filter="a/b/c",
+                    qos=2,
+                    no_local=True,
+                    retain_as_published=True,
+                    retain_handling=2,
+                )
+            ],
+        ),
+        version=5,
+    ),
+    Case(
+        "unsubscribe v4 three filters",
+        hx("a20f 0007 0003 612f62 0003 632f64 0001 65"),
+        Packet(
+            fixed_header=fhdr(UNSUBSCRIBE, qos=1, remaining=15),
+            protocol_version=4,
+            packet_id=7,
+            filters=[
+                Subscription(filter="a/b"),
+                Subscription(filter="c/d"),
+                Subscription(filter="e"),
+            ],
+        ),
+    ),
+    Case(
+        "subscribe v4 duplicate filters decode (server dedups)",
+        hx("8212 0007 0005 612f622f63 01 0005 612f622f63 02"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=18),
+            protocol_version=4,
+            packet_id=7,
+            filters=[
+                Subscription(filter="a/b/c", qos=1),
+                Subscription(filter="a/b/c", qos=2),
+            ],
+        ),
+    ),
+    Case(
+        "publish topic with BOM roundtrip",
+        hx("3009 0005 efbbbf612f 7879"),
+        Packet(
+            fixed_header=fhdr(PUBLISH, remaining=9),
+            protocol_version=4,
+            topic_name="﻿a/",
+            payload=b"xy",
+        ),
+    ),
+]
+
+CASES += [
+    Case(
+        "connack v5 shared subscription available",
+        hx("2005 0000 02 2a 01"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=5),
+            protocol_version=5,
+            properties=Properties(
+                shared_sub_available=1, shared_sub_available_flag=True
+            ),
+        ),
+        version=5,
+    ),
+    Case(
+        "suback v5 user property",
+        hx("900c 0007 08 26 0001 78 0002 7979 01"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=12),
+            protocol_version=5,
+            packet_id=7,
+            properties=Properties(user=[UserProperty("x", "yy")]),
+            reason_codes=b"\x01",
+        ),
+        version=5,
+    ),
+    Case(
+        "unsuback v5 user property",
+        hx("b00c 0007 08 26 0001 78 0002 7979 00"),
+        Packet(
+            fixed_header=fhdr(UNSUBACK, remaining=12),
+            protocol_version=5,
+            packet_id=7,
+            properties=Properties(user=[UserProperty("x", "yy")]),
+            reason_codes=b"\x00",
+        ),
+        version=5,
+    ),
+    Case(
+        "puback v5 user property",
+        hx("400c 0007 10 08 26 0001 78 0002 7979"),
+        Packet(
+            fixed_header=fhdr(PUBACK, remaining=12),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x10,
+            properties=Properties(user=[UserProperty("x", "yy")]),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect v5 password flag but no password bytes",
+        hx("1011 0004 4d515454 05 40 003c 00 0004 7a656e33"),
+        version=5,
+        decode_err=codes.ERR_MALFORMED_PASSWORD,
+        group="decode",
+    ),
+    Case(
+        "pubrel v5 reason code with reason string",
+        hx("6209 0007 92 05 1f 0002 6e6f"),
+        Packet(
+            fixed_header=fhdr(PUBREL, qos=1, remaining=9),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x92,
+            properties=Properties(reason_string="no"),
+        ),
+        version=5,
+    ),
+    Case(
+        "disconnect v5 user property",
+        hx("e00a 00 08 26 0002 6b31 0001 76"),
+        Packet(
+            fixed_header=fhdr(DISCONNECT, remaining=10),
+            protocol_version=5,
+            properties=Properties(user=[UserProperty("k1", "v")]),
+        ),
+        version=5,
+    ),
+    Case(
+        "connect v5 maximum packet size property",
+        hx("1016 0004 4d515454 05 02 003c 05 27 00010000 0004 7a656e33"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=22),
+            protocol_version=5,
+            properties=Properties(maximum_packet_size=65536),
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=True,
+                keepalive=60,
+                client_identifier="zen3",
+            ),
+        ),
+        version=5,
+    ),
+]
