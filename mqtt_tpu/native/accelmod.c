@@ -549,11 +549,77 @@ expand_sids_list(PyObject *self, PyObject *args)
     return subs_obj;
 }
 
+/* expand_snap(snap, subscribers_cls) — materialize ONE node snapshot
+ * tuple into a fresh Subscribers result: the single-node case of the
+ * host gather, used by the exact-map fast path (wildcard-free filter
+ * sets — ops/matcher.TpuMatcher._expand_snap is the Python oracle).
+ * Each client appears at most once per node, so every client entry is
+ * the first-sighting copy; shared entries are referenced keyed on the
+ * group filter; inline entries key on identifier. */
+static PyObject *
+expand_snap(PyObject *self, PyObject *args)
+{
+    PyObject *snap, *subscribers_cls;
+    if (!PyArg_ParseTuple(args, "OO", &snap, &subscribers_cls))
+        return NULL;
+    if (!PyTuple_Check(snap) || PyTuple_GET_SIZE(snap) != 3) {
+        PyErr_SetString(PyExc_TypeError, "snap must be a 3-tuple");
+        return NULL;
+    }
+    if (!PyType_Check(subscribers_cls)) {
+        PyErr_SetString(PyExc_TypeError, "subscribers_cls must be a type");
+        return NULL;
+    }
+    ResLayout *RL = res_layout_for((PyTypeObject *)subscribers_cls);
+    PyObject *subscriptions, *shared, *inline_subs;
+    PyObject *subs_obj = new_result(subscribers_cls, RL, &subscriptions,
+                                    &shared, &inline_subs);
+    if (subs_obj == NULL)
+        return NULL;
+
+    PyObject *cli = PyTuple_GET_ITEM(snap, 0);
+    PyObject *shr = PyTuple_GET_ITEM(snap, 1);
+    PyObject *inl = PyTuple_GET_ITEM(snap, 2);
+    if (!PyTuple_Check(cli) || !PyTuple_Check(shr) || !PyTuple_Check(inl)) {
+        PyErr_SetString(PyExc_TypeError,
+                        "snap sections must be tuples (clients, shared, inline)");
+        Py_DECREF(subs_obj);
+        return NULL;
+    }
+    Py_ssize_t n_cli = PyTuple_GET_SIZE(cli);
+    Py_ssize_t n_shr = PyTuple_GET_SIZE(shr);
+    Py_ssize_t n_inl = PyTuple_GET_SIZE(inl);
+    /* the snapshot layout guarantees sid slot ordering: clients, then
+     * shared members, then inline — merge_sid resolves the same tuple by
+     * index, so one single-entry wrapper covers all three sections */
+    PyObject *snaps = PyList_New(1);
+    if (snaps == NULL)
+        goto fail;
+    Py_INCREF(snap);
+    PyList_SET_ITEM(snaps, 0, snap); /* steals the new ref */
+    Py_ssize_t total = n_cli + n_shr + n_inl;
+    for (Py_ssize_t k = 0; k < total; k++) {
+        if (merge_sid(k, snaps, 1, total + 1, subscriptions, shared,
+                      inline_subs) < 0) {
+            Py_DECREF(snaps);
+            goto fail;
+        }
+    }
+    Py_DECREF(snaps);
+    return subs_obj;
+
+fail:
+    Py_DECREF(subs_obj);
+    return NULL;
+}
+
 static PyMethodDef methods[] = {
     {"resolve_batch", resolve_batch, METH_VARARGS,
      "Expand packed device range rows into Subscribers results."},
     {"expand_sids_list", expand_sids_list, METH_VARARGS,
      "Merge an explicit sid list into an existing Subscribers instance."},
+    {"expand_snap", expand_snap, METH_VARARGS,
+     "Materialize one node snapshot tuple into a Subscribers result."},
     {NULL, NULL, 0, NULL},
 };
 

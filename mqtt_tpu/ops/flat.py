@@ -448,6 +448,11 @@ class _LazySubTable:
         materializer (native/accelmod.c) walks these directly."""
         return self._snaps
 
+    @property
+    def window(self) -> int:
+        """Slots per entry ordinal (sid = ordinal * window + slot)."""
+        return self._window
+
     def __getitem__(self, sid: int) -> SubEntry:
         entry = self.memo.get(sid)
         if entry is not None:

@@ -212,6 +212,10 @@ class TpuMatcher:
         self._fold_poisoned = False
         self.stats.rebuilds += 1
         self.stats.rebuild_seconds += time.perf_counter() - t0
+        # warm the C materializer off the publish path: its first use
+        # otherwise triggers a synchronous cc compile inside the first
+        # batch's resolve (seconds of publish latency on a cold host)
+        _accel()
 
     def fold(self, filters) -> bool:
         """Incrementally fold mutations for ``filters`` into the compiled
@@ -433,7 +437,15 @@ class TpuMatcher:
                     i for i, t in enumerate(topics) if t and route_to_host(t)
                 )
             get = flat.exact_map.get
-            expand = self._expand_snap
+            acc = _accel()
+            if acc is not None:
+                expand_c = acc.expand_snap
+
+                def expand(snap):
+                    return expand_c(snap, Subscribers)
+
+            else:
+                expand = self._expand_snap
             subscribers = self.topics.subscribers
             results = []
             results_append = results.append

@@ -63,7 +63,7 @@ from ..ops.flat import (
     flat_match_core,
 )
 from ..ops.hashing import tokenize_topics
-from ..ops.matcher import MatcherStats, expand_sids
+from ..ops.matcher import MatcherStats, _accel, expand_sids
 
 _log = logging.getLogger("mqtt_tpu.parallel")
 
@@ -531,6 +531,7 @@ class ShardedTpuMatcher:
             stats = self.stats
             stats.batches += 1
             stats.topics += b
+            acc = _accel()  # once per batch, not per topic
             for i, topic in enumerate(topics):
                 if not topic:
                     results.append(Subscribers())
@@ -541,7 +542,7 @@ class ShardedTpuMatcher:
                     stats.overflows += int(overflow[i])
                     results.append(self.topics.subscribers(topic))
                 else:
-                    results.append(self._expand(tables, out[:, i, :]))
+                    results.append(self._expand(tables, out[:, i, :], acc))
             return results
 
         return resolve
@@ -558,9 +559,18 @@ class ShardedTpuMatcher:
     def subscribers(self, topic: str) -> Subscribers:
         return self.match_topics([topic])[0]
 
-    def _expand(self, tables, shard_sids: np.ndarray) -> Subscribers:
-        """Union per-shard local sub ids into one Subscribers set."""
+    def _expand(self, tables, shard_sids: np.ndarray, acc) -> Subscribers:
+        """Union per-shard local sub ids into one Subscribers set (the C
+        materializer when given — same merge semantics, pinned by the
+        tests/test_native.py differentials; expand_sids otherwise). The
+        caller resolves ``acc`` once per batch, not per topic."""
         subs = Subscribers()
+        if acc is not None:
+            for s in range(self.n_shards):
+                acc.expand_sids_list(
+                    shard_sids[s].tolist(), tables[s].snaps, tables[s].window, subs
+                )
+            return subs
         for s in range(self.n_shards):
             expand_sids(tables[s], shard_sids[s], subs, seen=set())
         return subs

@@ -441,3 +441,28 @@ class TestResolveBatch:
         a = acc.expand_sids_list(sids, snaps, window, Subscribers())
         b = expand_sids(table, list(sids), Subscribers())
         assert _canon(a) == _canon(b)
+
+
+@needs_accel
+def test_expand_snap_matches_python():
+    from mqtt_tpu.ops.matcher import TpuMatcher
+    from mqtt_tpu.topics import Subscribers
+
+    acc = native.accel()
+    rng = random.Random(5)
+    for snap in _random_snaps(rng, 24, 8):
+        cli, shr, inl = snap
+        # a real trie node keys clients uniquely (the subscriptions map);
+        # drop the generator's forced-dup entries for this single-node case
+        seen, uniq = set(), []
+        for client, sub in cli:
+            if client not in seen:
+                seen.add(client)
+                uniq.append((client, sub))
+        snap = (tuple(uniq), shr, inl)
+        a = acc.expand_snap(snap, Subscribers)
+        b = TpuMatcher._expand_snap(snap)
+        assert _canon(a) == _canon(b)
+    # empty snapshot
+    empty = acc.expand_snap(((), (), ()), Subscribers)
+    assert not empty.subscriptions and not empty.shared
