@@ -242,6 +242,14 @@ def _spawn_workers(argv: list, n: int) -> int:
     from .cluster import worker_env
 
     sock_dir = tempfile.mkdtemp(prefix="mqtt-tpu-cluster-")
+
+    # SIGTERM kills a Python process without unwinding finally blocks:
+    # translate it to SystemExit so the cleanup below actually terminates
+    # the workers (observed: orphaned workers after a SIGTERM'd launcher)
+    def _term(_sig, _frm):
+        raise SystemExit(0)
+
+    signal.signal(signal.SIGTERM, _term)
     # strip --workers (both "--workers N" and "--workers=N" forms): the
     # children must not recurse into the launcher
     cleaned = []
@@ -284,9 +292,20 @@ def _spawn_workers(argv: list, n: int) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        # a second SIGTERM must not abort this cleanup and re-orphan the
+        # workers — ignore it for the remainder of shutdown
+        signal.signal(signal.SIGTERM, signal.SIG_IGN)
         for p in procs:
             if p.poll() is None:
                 p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        import shutil
+
+        shutil.rmtree(sock_dir, ignore_errors=True)
 
 
 def cmd_serve(args, argv: list) -> int:

@@ -298,6 +298,9 @@ def _cluster_launcher(address: str, device_matcher: bool, workers: int) -> None:
                 p.wait(timeout=10)
             except Exception:
                 p.kill()
+        import shutil
+
+        shutil.rmtree(sock_dir, ignore_errors=True)
 
 
 def main() -> None:
