@@ -83,6 +83,11 @@ class Cluster:
         server._cluster = self
         server.topics.add_observer(self._on_mutation)
 
+    @property
+    def peer_count(self) -> int:
+        """Live peer links (the $SYS gauge's public accessor)."""
+        return len(self._writers)
+
     # -- lifecycle ---------------------------------------------------------
 
     def _sock_path(self, worker: int) -> str:

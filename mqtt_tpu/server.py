@@ -1666,6 +1666,14 @@ class Server:
             # topics, host_fallbacks, overflows, rebuilds, fallback_ratio
             for key, val in self.matcher.stats.as_dict().items():
                 topics[SYS_PREFIX + "/broker/matcher/" + key] = str(val)
+        if self._cluster is not None:
+            # worker-mesh observability (mqtt_tpu.cluster)
+            c = self._cluster
+            topics[SYS_PREFIX + "/broker/cluster/worker"] = str(c.worker_id)
+            topics[SYS_PREFIX + "/broker/cluster/peers"] = str(c.peer_count)
+            topics[SYS_PREFIX + "/broker/cluster/dropped_forwards"] = str(
+                c.dropped_forwards
+            )
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,

@@ -167,3 +167,32 @@ def test_qos1_cross_worker_delivery(cluster):
         w0.close(); w1.close()
 
     asyncio.run(run())
+
+
+def test_cluster_sys_topics(cluster):
+    """$SYS exposes the worker-mesh gauges (worker id, live peers,
+    dropped forwards) alongside the broker counters."""
+
+    async def run():
+        r0, w0 = await _conn(BASE_PORT + 1)
+        await _sub(r0, w0, "$SYS/broker/cluster/#", pid=9)
+        # $SYS topics are retained; the first resend interval may not have
+        # elapsed, so poll for the retained set
+        seen = {}
+        deadline = asyncio.get_event_loop().time() + 15
+        buf = b""
+        while asyncio.get_event_loop().time() < deadline and len(seen) < 3:
+            try:
+                chunk = await asyncio.wait_for(r0.read(4096), 2)
+            except asyncio.TimeoutError:
+                continue
+            if not chunk:
+                break  # EOF: fail fast below instead of spinning
+            buf += chunk
+            for key in (b"cluster/worker", b"cluster/peers", b"cluster/dropped_forwards"):
+                if key in buf:
+                    seen[key] = True
+        assert len(seen) == 3, (seen, buf[:200])
+        w0.close()
+
+    asyncio.run(run())
