@@ -324,8 +324,6 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         and matcher.csr.exact_map is None  # exact-map configs never take
         # the device+resolve path in production; this ceiling is theirs
     ):
-        import jax.numpy as _jnp
-
         from mqtt_tpu.ops.flat import flat_match_packed, pack_tokens
         from mqtt_tpu.topics import Subscribers as _Subscribers
 
@@ -333,7 +331,7 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         tok = tokenize_topics(batches[0], flat.max_levels, flat.salt)
         packed_dev = flat_match_packed(
             *matcher.device_arrays,
-            _jnp.asarray(pack_tokens(*tok[:4])),
+            jnp.asarray(pack_tokens(*tok[:4])),
             max_levels=flat.max_levels,
         )
         packed_np = np.asarray(packed_dev)

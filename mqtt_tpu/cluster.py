@@ -80,6 +80,11 @@ class Cluster:
         self._plan_cache: dict[str, tuple[int, tuple[int, ...]]] = {}
         self._stopping = False
         self.dropped_forwards = 0  # forwards dropped at the peer-buffer cap
+        # filters each peer has announced as populated: the link-drop
+        # cleanup needs them to withdraw the peer's interest (withdrawals
+        # generated during an outage are lost, so stale entries would
+        # otherwise forward forever)
+        self._peer_filters: dict[int, set[str]] = {}
         server._cluster = self
         server.topics.add_observer(self._on_mutation)
 
@@ -129,6 +134,11 @@ class Cluster:
             pass
 
     async def _dial(self, peer: int) -> None:
+        """Connect (and RE-connect) to a lower-numbered peer: a dropped
+        link — peer restart, wedged-link abort at the control cap — heals
+        instead of staying dark until the whole mesh restarts. On
+        reconnect, _register replays full presence so the peer's interest
+        map converges."""
         path = self._sock_path(peer)
         while not self._stopping:
             try:
@@ -136,12 +146,17 @@ class Cluster:
             except OSError:
                 await asyncio.sleep(0.1)
                 continue
-            await self._send(
-                writer, _T_HELLO, json.dumps({"worker": self.worker_id}).encode()
-            )
+            try:
+                await self._send(
+                    writer, _T_HELLO, json.dumps({"worker": self.worker_id}).encode()
+                )
+            except (ConnectionError, OSError):
+                writer.close()
+                await asyncio.sleep(0.1)
+                continue
             self._register(peer, writer)
-            await self._read_loop(peer, reader)
-            return
+            await self._read_loop(peer, reader, writer)
+            await asyncio.sleep(0.1)  # link dropped: back off, then re-dial
 
     async def _on_peer_connect(self, reader, writer) -> None:
         try:
@@ -154,7 +169,7 @@ class Cluster:
             return
         peer = json.loads(payload)["worker"]
         self._register(peer, writer)
-        await self._read_loop(peer, reader)
+        await self._read_loop(peer, reader, writer)
 
     def _register(self, peer: int, writer: asyncio.StreamWriter) -> None:
         self._writers[peer] = writer
@@ -264,6 +279,11 @@ class Cluster:
             await asyncio.sleep(0)
 
     def _apply_presence(self, peer: int, filter: str, populated: bool, inline: bool) -> None:
+        announced = self._peer_filters.setdefault(peer, set())
+        if populated:
+            announced.add(filter)
+        else:
+            announced.discard(filter)
         pseudo = f"\x00w{peer}"
         if populated:
             # inline-only filters follow inline gather rules on $-topics
@@ -362,12 +382,25 @@ class Cluster:
 
     # -- delivery (receiving side) -----------------------------------------
 
-    async def _read_loop(self, peer: int, reader) -> None:
+    def _on_link_down(self, peer: int, writer) -> None:
+        """Tear down one peer link: deregister the writer (only if this
+        link still owns the slot — a reconnect may have raced the stale
+        link's teardown) and withdraw every filter the peer announced,
+        because withdrawals generated during the outage were lost and the
+        reconnect replay only carries positive presence."""
+        if self._writers.get(peer) is writer:
+            self._writers.pop(peer, None)
+        pseudo = f"\x00w{peer}"
+        for f in self._peer_filters.pop(peer, ()):
+            self.remote.unsubscribe(f, pseudo)
+            self.remote.inline_unsubscribe(peer + 1, f)
+
+    async def _read_loop(self, peer: int, reader, writer) -> None:
         while True:
             try:
                 mtype, payload = await self._recv(reader)
             except (asyncio.IncompleteReadError, ConnectionError):
-                self._writers.pop(peer, None)
+                self._on_link_down(peer, writer)
                 return
             try:
                 if mtype == _T_PRESENCE:

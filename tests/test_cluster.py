@@ -196,3 +196,51 @@ def test_cluster_sys_topics(cluster):
         w0.close()
 
     asyncio.run(run())
+
+
+def test_peer_link_reconnects_in_process(tmp_path):
+    """A dropped mesh link heals: the dialing side re-dials and replays
+    presence, so forwarding interest converges again (in-process, two
+    Cluster instances over a private socket dir)."""
+    from mqtt_tpu.cluster import Cluster
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.packets import Subscription
+    from mqtt_tpu.server import Options, Server
+
+    async def scenario():
+        s0, s1 = Server(Options()), Server(Options())
+        for s in (s0, s1):
+            s.add_hook(AllowHook())
+        c0 = Cluster(s0, 0, 2, str(tmp_path))
+        c1 = Cluster(s1, 1, 2, str(tmp_path))
+        await c0.start()
+        await c1.start()
+
+        async def wait_for(cond, timeout=10.0):
+            deadline = asyncio.get_event_loop().time() + timeout
+            while asyncio.get_event_loop().time() < deadline:
+                if cond():
+                    return True
+                await asyncio.sleep(0.05)
+            return False
+
+        assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+        # a subscription on worker 1 becomes forwarding interest at worker 0
+        s1.topics.subscribe("clA", Subscription(filter="heal/t", qos=0))
+        assert await wait_for(lambda: c0._interested_peers("heal/t") == (1,))
+
+        # sever the link from worker 0's side (the wedged-link abort path)
+        c0._writers[1].transport.abort()
+        # the dialer (worker 1) re-dials; both sides re-register
+        assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+        # new interest propagates over the healed link
+        s1.topics.subscribe("clB", Subscription(filter="heal/u", qos=0))
+        assert await wait_for(lambda: c0._interested_peers("heal/u") == (1,))
+        # withdrawals lost during the outage were cleaned at link-down:
+        # heal/t interest must have been re-announced, not leaked
+        assert await wait_for(lambda: c0._interested_peers("heal/t") == (1,))
+
+        await c0.stop()
+        await c1.stop()
+
+    asyncio.run(scenario())

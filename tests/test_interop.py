@@ -344,3 +344,80 @@ class TestInterop:
                 await srv.close()
 
         run(scenario())
+
+
+class TestHostileBytes:
+    """Socket-level robustness: random and malformed byte streams must
+    never crash the broker — connections either proceed or are closed,
+    and the broker keeps serving well-behaved clients afterwards
+    (SURVEY §5 failure detection; the reference's fault injection is
+    malformed TPacketCase bytes over net.Pipe, server_test.go)."""
+
+    def test_random_garbage_then_clean_client(self):
+        async def scenario():
+            srv = await _broker()
+            try:
+                import random as _r
+
+                rng = _r.Random(1234)
+                for i in range(30):
+                    reader, writer = await asyncio.open_connection("127.0.0.1", PORT)
+                    n = rng.randrange(1, 400)
+                    writer.write(bytes(rng.randrange(256) for _ in range(n)))
+                    try:
+                        await writer.drain()
+                        await asyncio.wait_for(reader.read(256), 0.25)
+                    except (asyncio.TimeoutError, ConnectionError):
+                        pass
+                    writer.close()
+                # mid-stream malformed continuation: valid CONNECT then junk
+                cl = MiniV5Client()
+                assert await cl.connect("127.0.0.1", PORT, "fuzz-mid") == 0
+                cl.writer.write(b"\xff\xff\xff\xff\xff\xff")
+                await cl.writer.drain()
+                try:
+                    await asyncio.wait_for(cl.reader.read(256), 1)
+                except (asyncio.TimeoutError, ConnectionError):
+                    pass
+                # the broker still serves a clean session end to end
+                good = MiniV5Client()
+                assert await good.connect("127.0.0.1", PORT, "post-fuzz") == 0
+                assert await good.subscribe(1, "ok/topic", 0) == 0
+                await good.publish("ok/topic", b"alive")
+                topic, payload, qos, retain = await asyncio.wait_for(
+                    good.recv_publish(), 5
+                )
+                assert (topic, payload) == ("ok/topic", b"alive")
+                await good.disconnect()
+            finally:
+                await srv.close()
+
+        run(scenario())
+
+    def test_oversize_remaining_length_disconnects(self):
+        """With a maximum-packet-size capability set, a header declaring a
+        200MB body is rejected instead of the broker waiting for the bytes
+        (reference ReadFixedHeader, clients.go:453)."""
+
+        async def scenario():
+            opts = Options()
+            opts.capabilities.maximum_packet_size = 1024
+            srv = Server(opts)
+            from mqtt_tpu.hooks.auth import AllowHook
+
+            srv.add_hook(AllowHook())
+            srv.add_listener(
+                TCP(ListenerConfig(type="tcp", id="big", address=f"127.0.0.1:{PORT + 1}"))
+            )
+            await srv.serve()
+            try:
+                reader, writer = await asyncio.open_connection("127.0.0.1", PORT + 1)
+                # CONNECT header declaring a 200MB body
+                writer.write(b"\x10\xff\xff\xff\x7f")
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(64), 5)
+                assert data == b"" or data[0] in (0x20, 0xE0)  # closed or rejected
+            finally:
+                await srv.close()
+
+        run(scenario())
