@@ -2974,3 +2974,89 @@ CASES += [
         version=5,
     ),
 ]
+
+CASES += [
+    Case(
+        "connack v5 maximum packet size property",
+        hx("2008 0000 05 27 00000800"),
+        Packet(
+            fixed_header=fhdr(CONNACK, remaining=8),
+            protocol_version=5,
+            properties=Properties(maximum_packet_size=2048),
+        ),
+        version=5,
+    ),
+    Case(
+        "subscribe v5 two filters with mixed options",
+        hx("820f 0007 00 0003 612f62 01 0003 632f64 2e"),
+        Packet(
+            fixed_header=fhdr(SUBSCRIBE, qos=1, remaining=15),
+            protocol_version=5,
+            packet_id=7,
+            filters=[
+                Subscription(filter="a/b", qos=1),
+                Subscription(
+                    filter="c/d",
+                    qos=2,
+                    no_local=True,
+                    retain_as_published=True,
+                    retain_handling=2,
+                ),
+            ],
+        ),
+        version=5,
+    ),
+    Case(
+        "connect v3 MQIsdp with will",
+        hx("1019 0006 4d514973647003 0e 003c 0002 7a33 0003 6c7774 0002 6279"),
+        Packet(
+            fixed_header=fhdr(CONNECT, remaining=25),
+            protocol_version=3,
+            connect=ConnectParams(
+                protocol_name=b"MQIsdp",
+                clean=True,
+                keepalive=60,
+                client_identifier="z3",
+                will_flag=True,
+                will_qos=1,
+                will_topic="lwt",
+                will_payload=b"by",
+            ),
+        ),
+        version=3,
+    ),
+    Case(
+        "pubrec v5 reason code with reason string",
+        hx("5009 0007 97 05 1f 0002 6e6f"),
+        Packet(
+            fixed_header=fhdr(PUBREC, remaining=9),
+            protocol_version=5,
+            packet_id=7,
+            reason_code=0x97,  # quota exceeded: valid for PUBREC (3.5.2.1)
+            properties=Properties(reason_string="no"),
+        ),
+        version=5,
+    ),
+    Case(
+        "auth v5 reauthenticate with method property",
+        hx("f00a 19 08 15 0005 746f6b656e"),
+        Packet(
+            fixed_header=fhdr(AUTH, remaining=10),
+            protocol_version=5,
+            reason_code=0x19,
+            properties=Properties(authentication_method="token"),
+        ),
+        version=5,
+    ),
+    Case(
+        "suback v5 quota exceeded grant",
+        hx("9004 0007 00 97"),
+        Packet(
+            fixed_header=fhdr(SUBACK, remaining=4),
+            protocol_version=5,
+            packet_id=7,
+            reason_codes=b"\x97",
+        ),
+        version=5,
+    ),
+]
