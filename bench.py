@@ -17,6 +17,10 @@ Configs (BASELINE.md "Our target"):
   4. 100k ``$share`` groups x 16 members — shared selection included
   5. 200k subs w/ v5 subscription-identifiers + retained scans under live
      subscribe/unsubscribe churn (DeltaMatcher, background rebuilds)
+  6. broker: the mqtt-stresser analog over real TCP (README.md:474-508
+     scenarios), one SO_REUSEPORT worker per core on multi-core hosts
+  7. host materializer in isolation (no device needed): the C extension
+     vs the pure-Python oracle on cfg2-shaped synthetic result rows
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "configs"}.
 The headline value is config #2's end-to-end matches/sec vs the 10M north
@@ -571,6 +575,86 @@ def run_cfg5(n_subs, batch, iters, rng):
     return out
 
 
+def run_materializer_bench(fast: bool) -> dict:
+    """Config 7: the host result materializer in isolation — NO device, no
+    jax. Synthetic snapshot tables and packed range rows shaped like cfg2's
+    (window 16, P=4, ~11 hits/topic at 1M-sub scale) feed the C extension
+    (native/accelmod.c) and the pure-Python oracle. This is the round-5
+    north-star bottleneck component (PROFILE.md §4/§8), measured in a form
+    the driver can capture even when the device tunnel is down."""
+    import random as _r
+
+    from mqtt_tpu.ops.flat import _LazySubTable
+    from mqtt_tpu.ops.matcher import _accel, expand_sids
+    from mqtt_tpu.packets import Subscription
+    from mqtt_tpu.topics import Subscribers
+    from mqtt_tpu.utils.gctune import tune_for_throughput
+
+    tune_for_throughput()
+    rng = _r.Random(7)
+    window, P = 16, 4
+    n_entries = 5_000 if fast else 80_000
+    batch = 1024 if fast else 16384
+    snaps = []
+    for e in range(n_entries):
+        n_cli = rng.randint(1, 12)
+        snaps.append(
+            (
+                tuple(
+                    (
+                        f"cl{e}_{i}",
+                        Subscription(
+                            filter=f"f/{e}", qos=rng.randint(0, 2),
+                            identifier=rng.choice([0, 0, 0, e % 200 + 1]),
+                        ),
+                    )
+                    for i in range(n_cli)
+                ),
+                (),
+                (),
+            )
+        )
+    totals = [len(s[0]) for s in snaps]
+    packed = np.zeros((batch, 2 * P + 2), dtype=np.int32)
+    for i in range(batch):
+        for p in range(P):
+            if rng.random() < 0.7:
+                e = rng.randrange(n_entries)
+                packed[i, p] = e * window
+                packed[i, P + p] = totals[e]
+    hits = int(packed[:, P : 2 * P].sum())
+    out = {"batch": batch, "avg_hits_per_topic": round(hits / batch, 2)}
+    iters = 3 if fast else 10
+    acc = _accel()
+    if acc is not None:
+        acc.resolve_batch(packed, batch, P, snaps, window, Subscribers)  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            acc.resolve_batch(packed, batch, P, snaps, window, Subscribers)
+        dt = time.perf_counter() - t0
+        out["c_materializer_topics_per_sec"] = round(iters * batch / dt)
+        out["c_materializer_subs_per_sec"] = round(iters * hits / dt)
+    # the pure-Python oracle (the pre-round-5 ceiling), on a slice to keep
+    # the config cheap
+    table = _LazySubTable(window, list(snaps), n_entries * window)
+    rows = packed[: max(256, batch // 8)].tolist()
+    t0 = time.perf_counter()
+    for row in rows:
+        sids = []
+        for p in range(P):
+            c = row[P + p]
+            if c:
+                sids.extend(range(row[p], row[p] + c))
+        expand_sids(table, sids, Subscribers())
+    dt = time.perf_counter() - t0
+    out["python_oracle_topics_per_sec"] = round(len(rows) / dt)
+    if "c_materializer_topics_per_sec" in out:
+        out["c_speedup_vs_python"] = round(
+            out["c_materializer_topics_per_sec"] / out["python_oracle_topics_per_sec"], 2
+        )
+    return out
+
+
 def run_broker_bench(fast: bool) -> dict:
     """The mqtt-stresser analog over real TCP against a broker subprocess
     (reference README.md:474-508): N clients x M QoS0 msgs on own topics,
@@ -653,7 +737,7 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", 5 if fast else 20))
     which = {
         int(c)
-        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6").split(",")
+        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7").split(",")
         if c.strip()
     }
     rng = random.Random(7)
@@ -758,6 +842,10 @@ def main() -> None:
         t0 = time.perf_counter()
         configs["broker"] = run_broker_bench(fast)
         log(f"broker bench done ({time.perf_counter()-t0:.0f}s)")
+    if 7 in which:
+        t0 = time.perf_counter()
+        configs["7_materializer_host"] = run_materializer_bench(fast)
+        log(f"cfg7 {configs['7_materializer_host']} ({time.perf_counter()-t0:.0f}s)")
     if not device_ok and device_wanted:
         # the broker bench bought the tunnel a few minutes: one more chance
         device_ok, probe_err = probe_device(2)
