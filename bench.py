@@ -750,6 +750,23 @@ def main() -> None:
     device_ok = True
     probe_err = ""
 
+    # the probe is tracked by the same breaker machinery the broker's
+    # degradation manager uses (mqtt_tpu.resilience): the artifact then
+    # carries breaker-style stats — probe attempts, failure kinds,
+    # backoff state — instead of a bare device_unreachable flag
+    from mqtt_tpu.resilience import CLOSED, Backoff, CircuitBreaker
+
+    probe_breaker = CircuitBreaker(
+        failure_threshold=1,
+        probe_successes=1,
+        backoff=Backoff(
+            initial=float(os.environ.get("BENCH_PROBE_WAIT", "60")),
+            maximum=240.0,
+            jitter=0.1,
+            seed=7,  # deterministic artifact-to-artifact schedule
+        ),
+    )
+
     def probe_device(retries: int, wait_s: int = int(os.environ.get("BENCH_PROBE_WAIT", "60"))):
         """Device liveness probe in a SUBPROCESS: a dead tunnel hangs jax
         backend init indefinitely (no timeout in the client), which would
@@ -785,7 +802,19 @@ def main() -> None:
                     e.cmd, returncode=-1, stdout=b"", stderr=b"probe timeout"
                 )
             if probe.returncode == 0:
+                if probe_breaker.state != CLOSED:
+                    # a successful retry IS the verified half-open probe:
+                    # the artifact must end state=closed (trips still
+                    # record the transient), not report a dark link for
+                    # a run that benchmarked the device
+                    probe_breaker.acquire_probe(force=True)
+                    probe_breaker.record_probe_success()
+                else:
+                    probe_breaker.record_success()
                 return True, ""
+            probe_breaker.record_failure(
+                "hang" if probe.returncode == -1 else "error"
+            )
         return False, probe.stderr.decode(errors="replace")[-300:].replace("\n", " | ")
 
     device_wanted = bool(which & {1, 2, 3, 4, 5})
@@ -882,6 +911,11 @@ def main() -> None:
         "link": link,
         "configs": configs,
     }
+    if device_wanted:
+        # breaker-style probe stats in every device-wanting artifact:
+        # attempts, failure kinds (hang vs error), trips — so a degraded
+        # run documents HOW the link failed, not just that it did
+        out["probe_breaker"] = probe_breaker.as_dict()
     if device_wanted and not device_ok:
         # an explicit flag instead of a silent 0 headline: the device was
         # unreachable for this run, the recorded value covers only what

@@ -29,9 +29,22 @@ machine:
 Known limits (documented, not hidden): shared-subscription (``$SHARE``)
 groups select one member PER WORKER holding members (the reference's
 single process selects one total); session takeover only sees clients on
-the same worker; storage hooks should be per-worker stores. These are the
-standard SO_REUSEPORT-broker trade-offs — a deployment that needs exact
+the same worker; storage hooks should be per-worker stores; and under
+peer-link backpressure, forwards to a stalled peer DROP once its write
+buffer exceeds ``MAX_PEER_BUFFER`` — **including QoS>0 packet forwards**,
+so cross-worker QoS1/2 delivery is best-effort while a peer is wedged
+(the peer's own clients still get full QoS semantics from their worker).
+Each drop is counted (``dropped_forwards`` total, ``dropped_by_peer`` per
+peer, ``dropped_qos_forwards`` for the QoS>0 subset) and surfaced as
+``$SYS/broker/cluster/...`` gauges — never silent. These are the standard
+SO_REUSEPORT-broker trade-offs — a deployment that needs exact
 single-process semantics runs one worker.
+
+Link-failure posture (mqtt_tpu.resilience machinery): dropped peer links
+re-dial with exponential backoff + jitter (a restarting peer is not
+hammered in lockstep by every worker), and every reattach replays FULL
+presence state (``_register``), so the peer's interest map converges even
+though withdrawals generated during the outage were lost.
 """
 
 from __future__ import annotations
@@ -79,7 +92,15 @@ class Cluster:
         self._tasks: list[asyncio.Task] = []
         self._plan_cache: dict[str, tuple[int, tuple[int, ...]]] = {}
         self._stopping = False
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self.dropped_forwards = 0  # forwards dropped at the peer-buffer cap
+        # backpressure accounting (module known-limits list): per-peer
+        # drop counts plus the QoS>0 subset — a wedged peer weakens
+        # cross-worker QoS1/2 to best-effort, and that MUST be visible
+        self.dropped_by_peer: dict[int, int] = {}
+        self.dropped_qos_forwards = 0
+        # per-peer re-dial counts (the $SYS reconnects gauge)
+        self.reconnects: dict[int, int] = {}
         # filters each peer has announced as populated: the link-drop
         # cleanup needs them to withdraw the peer's interest (withdrawals
         # generated during an outage are lost, so stale entries would
@@ -93,6 +114,11 @@ class Cluster:
         """Live peer links (the $SYS gauge's public accessor)."""
         return len(self._writers)
 
+    @property
+    def reconnects_total(self) -> int:
+        """Total peer-link re-dials across all peers ($SYS gauge)."""
+        return sum(self.reconnects.values())
+
     # -- lifecycle ---------------------------------------------------------
 
     def _sock_path(self, worker: int) -> str:
@@ -100,6 +126,7 @@ class Cluster:
 
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
+        self._loop = loop  # _on_mutation may fire from embedder threads
         self._presence_wake = asyncio.Event()
         path = self._sock_path(self.worker_id)
         try:
@@ -133,18 +160,34 @@ class Cluster:
         except OSError:
             pass
 
+    # re-dial backoff bounds: fast first retries for start-order races,
+    # exponential growth (+jitter, mqtt_tpu.resilience.Backoff) so N
+    # workers don't hammer a restarting peer in lockstep
+    DIAL_BACKOFF_S = 0.05
+    DIAL_BACKOFF_MAX_S = 2.0
+
     async def _dial(self, peer: int) -> None:
         """Connect (and RE-connect) to a lower-numbered peer: a dropped
         link — peer restart, wedged-link abort at the control cap — heals
-        instead of staying dark until the whole mesh restarts. On
+        instead of staying dark until the whole mesh restarts. Retries
+        use exponential backoff + jitter (reset once a link is up); on
         reconnect, _register replays full presence so the peer's interest
         map converges."""
+        from .resilience import Backoff
+
         path = self._sock_path(peer)
+        backoff = Backoff(
+            initial=self.DIAL_BACKOFF_S,
+            maximum=self.DIAL_BACKOFF_MAX_S,
+            jitter=0.2,
+            seed=self.worker_id * 131 + peer,  # deterministic, desynced
+        )
+        connected_before = False
         while not self._stopping:
             try:
                 reader, writer = await asyncio.open_unix_connection(path)
             except OSError:
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(backoff.next())
                 continue
             try:
                 await self._send(
@@ -152,11 +195,15 @@ class Cluster:
                 )
             except (ConnectionError, OSError):
                 writer.close()
-                await asyncio.sleep(0.1)
+                await asyncio.sleep(backoff.next())
                 continue
+            if connected_before:  # start-order races aren't reconnects
+                self.reconnects[peer] = self.reconnects.get(peer, 0) + 1
+            connected_before = True
+            backoff.reset()  # link is up: next outage starts fast again
             self._register(peer, writer)
             await self._read_loop(peer, reader, writer)
-            await asyncio.sleep(0.1)  # link dropped: back off, then re-dial
+            await asyncio.sleep(backoff.next())  # link dropped: re-dial
 
     async def _on_peer_connect(self, reader, writer) -> None:
         try:
@@ -195,17 +242,23 @@ class Cluster:
     # (its interest map is stale beyond repair anyway).
     MAX_PEER_BUFFER = 8 * 1024 * 1024
 
-    def _send_nowait(self, writer, mtype: int, payload: bytes) -> None:
+    def _send_nowait(self, peer: int, writer, mtype: int, payload: bytes) -> bool:
+        """Best-effort peer write; returns False when the forward was
+        dropped at the buffer cap (counted globally and per peer — the
+        caller decides whether the drop also weakens QoS>0 delivery and
+        counts that class separately)."""
         buffered = writer.transport.get_write_buffer_size()
         if mtype == _T_PRESENCE:
             if buffered > 8 * self.MAX_PEER_BUFFER:
                 _log.warning("peer link wedged past the control cap; closing")
                 writer.transport.abort()
-                return
+                return False
         elif buffered > self.MAX_PEER_BUFFER:
             self.dropped_forwards += 1
-            return
+            self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
+            return False
         writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
+        return True
 
     @staticmethod
     async def _recv(reader):
@@ -219,12 +272,32 @@ class Cluster:
     def _on_mutation(self, m) -> None:
         """Trie observer (called under the trie lock): queue the filter;
         the presence loop computes its populated state off-lock and
-        broadcasts idempotently."""
-        if m.filter:
-            self._pending_presence.add(m.filter)
-            wake = self._presence_wake
-            if wake is not None:
-                wake.set()
+        broadcasts idempotently.
+
+        Mutations can originate OFF the event loop (inline_subscribe from
+        an embedder thread, the delta matcher's rebuild thread), and
+        ``asyncio.Event.set`` is not thread-safe — a cross-thread set can
+        be lost, leaving peers with stale interest forever. The wake is
+        therefore routed through ``call_soon_threadsafe`` whenever the
+        caller is not the cluster's own loop."""
+        if not m.filter:
+            return
+        self._pending_presence.add(m.filter)
+        wake = self._presence_wake
+        if wake is None:
+            return
+        loop = self._loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or running is loop:
+            wake.set()
+        else:
+            try:
+                loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                pass  # loop already closed: shutdown race, nothing to sync
 
     def _populated_filters(self) -> list[str]:
         """Every filter with at least one subscriber, from the live trie
@@ -270,9 +343,9 @@ class Cluster:
                 msg = json.dumps(
                     {"filter": f, "populated": populated, "inline": inline_only}
                 ).encode()
-                for w in list(self._writers.values()):
+                for peer, w in list(self._writers.items()):
                     try:
-                        self._send_nowait(w, _T_PRESENCE, msg)
+                        self._send_nowait(peer, w, _T_PRESENCE, msg)
                     except (ConnectionError, RuntimeError):
                         pass
             # yield so bursts coalesce instead of one message per mutation
@@ -325,6 +398,13 @@ class Cluster:
         self._plan_cache[topic] = (version, plan)
         return plan
 
+    def _count_drop(self, peer: int) -> None:
+        """One forward lost to ``peer`` outside _send_nowait's buffer-cap
+        path — the link dropped between interest-match and write, or the
+        write itself raised. Same 'never silent' posture as the cap."""
+        self.dropped_forwards += 1
+        self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
+
     def forward_frame(self, topic: str, frame: bytes, origin: str) -> None:
         """Forward a QoS0 v4 passthrough frame to interested peers
         verbatim (the fast path's cluster leg)."""
@@ -335,11 +415,13 @@ class Cluster:
         payload = struct.pack(">H", len(ob)) + ob + frame
         for p in peers:
             w = self._writers.get(p)
-            if w is not None:
-                try:
-                    self._send_nowait(w, _T_FRAME, payload)
-                except (ConnectionError, RuntimeError):
-                    pass
+            if w is None:  # link down but interest not yet withdrawn
+                self._count_drop(p)
+                continue
+            try:
+                self._send_nowait(p, w, _T_FRAME, payload)
+            except (ConnectionError, RuntimeError):
+                self._count_drop(p)
 
     def forward_packet(self, pk: Packet) -> None:
         """Forward a decoded publish (QoS>0 / v5 / retained) to interested
@@ -372,13 +454,24 @@ class Cluster:
             }
         ).encode()
         payload = head + b"\x00" + bytes(body)
+        qos = pk.fixed_header.qos
         for p in peers:
             w = self._writers.get(p)
-            if w is not None:
+            if w is None:  # link down but interest not yet withdrawn
+                self._count_drop(p)
+                sent = False
+            else:
                 try:
-                    self._send_nowait(w, _T_PACKET, payload)
+                    sent = self._send_nowait(p, w, _T_PACKET, payload)
                 except (ConnectionError, RuntimeError):
-                    pass
+                    self._count_drop(p)
+                    sent = False
+            if not sent and qos > 0:
+                # the known-limits drop class: cross-worker QoS1/2
+                # degrades to best-effort at the buffer cap or across a
+                # dropping link — counted, never silent
+                # ($SYS dropped_qos_forwards)
+                self.dropped_qos_forwards += 1
 
     # -- delivery (receiving side) -----------------------------------------
 

@@ -152,6 +152,15 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "matcher_stage_max_batch",
         "matcher_stage_max_inflight",
         "matcher_stage_latency_budget_ms",
+        # degradation manager: breaker/backoff knobs (mqtt_tpu.resilience)
+        "matcher_resilience",
+        "breaker_failure_threshold",
+        "breaker_watchdog_ms",
+        "breaker_probe_backoff_ms",
+        "breaker_probe_backoff_max_ms",
+        "breaker_probe_jitter",
+        "breaker_probe_successes",
+        "breaker_verify_sample",
         "gc_tuning",
     ):
         if k in top:

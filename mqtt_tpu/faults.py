@@ -1,0 +1,216 @@
+"""Deterministic fault injection for the device matcher and worker mesh.
+
+The resilience layer (mqtt_tpu.resilience) exists to survive hardware
+that flaps; this module is how the chaos suite (tests/test_resilience.py)
+and the chaos hook (mqtt_tpu.hooks.chaos) make a healthy dev machine
+behave like that hardware — reproducibly, from one seed:
+
+- :class:`FaultPlan` — a seeded schedule mapping dispatch index -> fault
+  kind, either by per-kind probability or by explicit indices, so a
+  failing chaos run replays exactly from its seed.
+- :class:`FaultyMatcher` — wraps any matcher exposing
+  ``match_topics_async`` and injects the scheduled fault into the issue
+  or resolve side of each dispatch:
+
+  * ``issue_error`` — ``match_topics_async`` itself raises;
+  * ``error``       — the returned resolver raises;
+  * ``hang``        — the resolver blocks (releasable, so suites can
+    un-wedge abandoned guard threads at teardown);
+  * ``slow``        — the resolver sleeps ``slow_s`` then resolves (a
+    degraded-but-alive link: must NOT trip the breaker);
+  * ``corrupt``     — the resolver returns real results with one
+    deterministically-chosen entry falsified (must be caught by the
+    degradation manager's differential re-walk).
+
+- Mesh helpers — :func:`sever_peer_link` kills a live peer link
+  mid-traffic; :func:`stall_peer_reads` gates a worker's mesh reads
+  shut so its peers' write buffers back up against ``MAX_PEER_BUFFER``.
+
+Only test/ops tooling imports this module; nothing on the hot path
+references it.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Optional
+
+from .packets import Subscription
+
+FAULT_KINDS = ("hang", "error", "issue_error", "corrupt", "slow")
+
+# the falsified client id a corrupt fault plants; never a real client
+CHAOS_CLIENT = "\x00chaos"
+
+
+class DeviceFault(RuntimeError):
+    """The injected dispatch failure."""
+
+
+@dataclass
+class FaultPlan:
+    """A deterministic fault schedule.
+
+    ``at`` pins explicit dispatch indices to fault kinds (checked first);
+    the ``*_rate`` fields draw per-dispatch from a ``random.Random(seed)``
+    stream, so a given (seed, rates) pair always yields the same fault
+    sequence regardless of wall clock or interleaving.
+    """
+
+    seed: int = 0
+    hang_rate: float = 0.0
+    error_rate: float = 0.0
+    issue_error_rate: float = 0.0
+    corrupt_rate: float = 0.0
+    slow_rate: float = 0.0
+    hang_s: float = 30.0
+    slow_s: float = 0.05
+    at: dict[int, str] = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+        for kind in self.at.values():
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown fault kind: {kind}")
+
+    def draw(self, dispatch_index: int) -> Optional[str]:
+        """The fault for this dispatch, or None. The rng stream advances
+        exactly once per call, keeping the schedule a pure function of
+        (seed, call sequence)."""
+        r = self._rng.random()
+        pinned = self.at.get(dispatch_index)
+        if pinned is not None:
+            return pinned
+        for kind, rate in (
+            ("hang", self.hang_rate),
+            ("error", self.error_rate),
+            ("issue_error", self.issue_error_rate),
+            ("corrupt", self.corrupt_rate),
+            ("slow", self.slow_rate),
+        ):
+            if r < rate:
+                return kind
+            r -= rate
+        return None
+
+
+class FaultyMatcher:
+    """A matcher wrapper that injects :class:`FaultPlan` faults into
+    every dispatch. Unknown attributes delegate to the wrapped matcher,
+    so it interposes transparently under the degradation manager
+    (``ResilientMatcher.inner``) or directly under the staging loop."""
+
+    def __init__(self, inner, plan: FaultPlan) -> None:
+        self.inner = inner
+        self.plan = plan
+        self.dispatches = 0
+        self.injected: dict[str, int] = {}
+        self._lock = threading.Lock()
+        # hung resolvers block on this (bounded by plan.hang_s): suites
+        # release it at teardown so abandoned guard threads retire
+        self.release = threading.Event()
+
+    def __getattr__(self, name):
+        if name == "inner":
+            raise AttributeError(name)
+        return getattr(self.inner, name)
+
+    def _count(self, kind: str) -> None:
+        with self._lock:
+            self.injected[kind] = self.injected.get(kind, 0) + 1
+
+    def match_topics_async(self, topics: list[str]):
+        with self._lock:
+            i = self.dispatches
+            self.dispatches += 1
+        fault = self.plan.draw(i)
+        if fault == "issue_error":
+            self._count(fault)
+            raise DeviceFault(f"injected issue failure (dispatch {i})")
+        resolver = self.inner.match_topics_async(topics)
+        if fault is None:
+            return resolver
+        self._count(fault)
+        if fault == "error":
+
+            def failing():
+                raise DeviceFault(f"injected resolve failure (dispatch {i})")
+
+            return failing
+        if fault == "hang":
+
+            def hanging():
+                self.release.wait(self.plan.hang_s)
+                return resolver()
+
+            return hanging
+        if fault == "slow":
+
+            def slow():
+                time.sleep(self.plan.slow_s)
+                return resolver()
+
+            return slow
+
+        # corrupt: plausible results with one entry falsified — the shape
+        # a bitrotted table or torn upload produces. The corrupted index
+        # derives from the dispatch index, not the rng stream, so the
+        # schedule stays replayable.
+        def corrupting():
+            results = resolver()
+            if results:
+                j = i % len(results)
+                topic = topics[j] if j < len(topics) and topics[j] else "chaos"
+                results[j].subscriptions[CHAOS_CLIENT] = Subscription(
+                    filter=topic, qos=0
+                )
+            return results
+
+        return corrupting
+
+    def match_topics(self, topics: list[str]):
+        return self.match_topics_async(topics)()
+
+
+# -- worker-mesh faults ------------------------------------------------------
+
+
+def sever_peer_link(cluster, peer: int) -> bool:
+    """Abort the live link to ``peer`` (connection-reset mid-traffic, as
+    a crashed worker or yanked cable would). Returns False when no link
+    is up. The surviving side must withdraw the peer's presence and the
+    dial side must reconnect with backoff (cluster._dial)."""
+    writer = cluster._writers.get(peer)
+    if writer is None:
+        return False
+    writer.transport.abort()
+    return True
+
+
+def stall_peer_reads(cluster) -> Callable[[], None]:
+    """Gate ``cluster``'s mesh reads shut: frames from every peer queue
+    in the socket until the returned release() is called, so the peers'
+    write buffers climb toward MAX_PEER_BUFFER (the backpressure-drop /
+    wedged-link-close paths). Must be called on the cluster's loop."""
+    import asyncio
+
+    gate = asyncio.Event()
+    inner_recv = type(cluster)._recv
+
+    async def gated(reader):
+        await gate.wait()
+        return await inner_recv(reader)
+
+    cluster._recv = gated  # instance attribute shadows the staticmethod
+
+    def release() -> None:
+        try:
+            del cluster._recv
+        except AttributeError:
+            pass
+        gate.set()
+
+    return release

@@ -124,7 +124,13 @@ collect_object_slots(PyTypeObject *tp, Py_ssize_t *offs, int max,
                 int eq = PyObject_RichCompareBool(key, named[k], Py_EQ);
                 if (eq < 0)
                     return -1;
-                if (eq)
+                /* MRO runs subclass-first: record the offset only while
+                 * it is still unset, so a subclass slot that shadows a
+                 * base-class slot of the same name wins — matching
+                 * Python attribute lookup. (The shadowed base slot has
+                 * its own, never-written offset; reading it would
+                 * silently yield NULL.) */
+                if (eq && *named_offs[k] == -1)
                     *named_offs[k] = def->offset;
             }
         }
@@ -348,7 +354,11 @@ merge_sid(int64_t sid, PyObject *snaps, Py_ssize_t n_snaps, int64_t window,
 
 /* A fresh Subscribers result: tp_alloc + four empty dicts when the class
  * has the expected slots layout, the plain constructor otherwise. The
- * three gather dicts are returned as BORROWED pointers. */
+ * three gather dicts are returned as NEW (owned) references — a
+ * Subscribers-compatible class whose accessors are properties returning
+ * fresh objects must not leave the caller holding dangling pointers, so
+ * the caller keeps the containers alive for the whole merge loop and
+ * Py_DECREFs all three when done. */
 static PyObject *
 new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
            PyObject **shared, PyObject **inline_subs)
@@ -375,6 +385,9 @@ new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
         /* same cycle argument as the subscription copies: the result
          * object only points at its four dicts (which stay tracked) */
         PyObject_GC_UnTrack(o);
+        Py_INCREF(c);
+        Py_INCREF(a);
+        Py_INCREF(d);
         *subscriptions = c;
         *shared = a;
         *inline_subs = d;
@@ -383,7 +396,10 @@ new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
     PyObject *o = PyObject_CallNoArgs(cls);
     if (o == NULL)
         return NULL;
-    /* borrowed via the object's attributes: fetch and release */
+    /* attribute access may run arbitrary descriptors: keep the fetched
+     * references OWNED for the merge loop's duration (the caller
+     * releases them) instead of assuming the object stores and retains
+     * these exact containers */
     PyObject *c = PyObject_GetAttr(o, s_subscriptions);
     PyObject *a = PyObject_GetAttr(o, s_shared);
     PyObject *d = PyObject_GetAttr(o, s_inline_subscriptions);
@@ -394,10 +410,6 @@ new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
         Py_DECREF(o);
         return NULL;
     }
-    /* the object keeps them alive for the caller's scope */
-    Py_DECREF(c);
-    Py_DECREF(a);
-    Py_DECREF(d);
     *subscriptions = c;
     *shared = a;
     *inline_subs = d;
@@ -469,17 +481,28 @@ resolve_batch(PyObject *self, PyObject *args)
         if (subs_obj == NULL)
             goto fail;
         PyList_SET_ITEM(results, i, subs_obj); /* steals */
-        for (Py_ssize_t p = 0; p < P; p++) {
+        int merr = 0;
+        for (Py_ssize_t p = 0; p < P && !merr; p++) {
             int32_t cnt = row[P + p];
             if (cnt <= 0)
                 continue;
             int64_t start = row[p];
             for (int32_t k = 0; k < cnt; k++) {
                 if (merge_sid(start + k, snaps, n_snaps, window,
-                              subscriptions, shared, inline_subs) < 0)
-                    goto fail;
+                              subscriptions, shared, inline_subs) < 0) {
+                    merr = 1;
+                    break;
+                }
             }
         }
+        /* new_result hands the gather containers as owned refs held for
+         * the merge loop's duration (property-backed results may have
+         * returned containers the object does not itself retain) */
+        Py_DECREF(subscriptions);
+        Py_DECREF(shared);
+        Py_DECREF(inline_subs);
+        if (merr)
+            goto fail;
     }
 
     PyBuffer_Release(&view);
@@ -583,8 +606,7 @@ expand_snap(PyObject *self, PyObject *args)
     if (!PyTuple_Check(cli) || !PyTuple_Check(shr) || !PyTuple_Check(inl)) {
         PyErr_SetString(PyExc_TypeError,
                         "snap sections must be tuples (clients, shared, inline)");
-        Py_DECREF(subs_obj);
-        return NULL;
+        goto fail;
     }
     Py_ssize_t n_cli = PyTuple_GET_SIZE(cli);
     Py_ssize_t n_shr = PyTuple_GET_SIZE(shr);
@@ -606,9 +628,16 @@ expand_snap(PyObject *self, PyObject *args)
         }
     }
     Py_DECREF(snaps);
+    Py_DECREF(subscriptions);
+    Py_DECREF(shared);
+    Py_DECREF(inline_subs);
     return subs_obj;
 
 fail:
+    /* the owned gather-container refs from new_result */
+    Py_DECREF(subscriptions);
+    Py_DECREF(shared);
+    Py_DECREF(inline_subs);
     Py_DECREF(subs_obj);
     return NULL;
 }

@@ -100,6 +100,20 @@ def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None
     return subs
 
 
+def subscribers_equal(a: Subscribers, b: Subscribers) -> bool:
+    """Value equality of two match results — the differential re-walk
+    check the resilience layer (mqtt_tpu.resilience) runs between a
+    device result and the live host walk. Compares the three gather maps
+    (``Subscription`` is a dataclass, so entries compare by value);
+    ``shared_selected`` is derived during fan-out and deliberately
+    excluded."""
+    return (
+        a.subscriptions == b.subscriptions
+        and a.shared == b.shared
+        and a.inline_subscriptions == b.inline_subscriptions
+    )
+
+
 @dataclass
 class MatcherStats:
     """Observability counters for a device matcher (SURVEY §5 tracing

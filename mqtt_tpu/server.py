@@ -173,6 +173,28 @@ class Options:
     # p99 latency budget for one staged publish (staging.MatchStage adapts
     # window + batch cap to hold it); <= 0 disables adaptation
     matcher_stage_latency_budget_ms: float = 250.0
+    # degradation manager (mqtt_tpu.resilience): wrap every device dispatch
+    # in a circuit breaker + hang watchdog; timeouts/errors/corrupt results
+    # route matching to the bit-identical host trie and background probes
+    # re-admit the device once verified healthy. Default on — a flapping
+    # link must degrade, never wedge.
+    matcher_resilience: bool = True
+    # consecutive failures before the breaker trips OPEN
+    breaker_failure_threshold: int = 3
+    # per-batch hang budget: a dispatch not resolved within this is
+    # abandoned and served from the host walk. A last-resort hang bound,
+    # NOT a latency control — keep it above worst-case cold-compile time.
+    breaker_watchdog_ms: float = 5000.0
+    # half-open probe schedule: exponential backoff from the base delay up
+    # to the max, +/- the jitter fraction; this many verified-healthy
+    # probes close the breaker
+    breaker_probe_backoff_ms: float = 500.0
+    breaker_probe_backoff_max_ms: float = 30000.0
+    breaker_probe_jitter: float = 0.1
+    breaker_probe_successes: int = 2
+    # topics differentially re-walked on the host per healthy batch (the
+    # corrupt-result tripwire); 0 disables sampling outside probes
+    breaker_verify_sample: int = 1
     # raise the process-global CPython GC thresholds for broker throughput
     # (utils/gctune.py). Default on for the standalone broker; an embedding
     # application that wants its own GC cadence sets this False (the change
@@ -200,6 +222,18 @@ class Options:
             self.matcher_stage_max_inflight = 4
         if self.matcher_stage_window_ms < 0:
             self.matcher_stage_window_ms = 0.0
+        # breaker knobs are config-reachable too: zero/negative values
+        # would trip instantly or busy-probe — normalize to the defaults
+        if self.breaker_failure_threshold <= 0:
+            self.breaker_failure_threshold = 3
+        if self.breaker_watchdog_ms <= 0:
+            self.breaker_watchdog_ms = 5000.0
+        if self.breaker_probe_backoff_ms <= 0:
+            self.breaker_probe_backoff_ms = 500.0
+        if self.breaker_probe_backoff_max_ms < self.breaker_probe_backoff_ms:
+            self.breaker_probe_backoff_max_ms = max(
+                self.breaker_probe_backoff_ms, 30000.0
+            )
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 
@@ -317,6 +351,26 @@ class Server:
             from .ops.delta import DeltaMatcher
 
             self.matcher = DeltaMatcher(self.topics, **(opts.matcher_opts or {}))
+            if opts.matcher_resilience:
+                # degradation manager (mqtt_tpu.resilience): breaker +
+                # hang watchdog + half-open probes around every dispatch
+                from .resilience import BreakerConfig, ResilientMatcher
+
+                self.matcher = ResilientMatcher(
+                    self.matcher,
+                    self.topics,
+                    BreakerConfig(
+                        failure_threshold=opts.breaker_failure_threshold,
+                        watchdog_s=opts.breaker_watchdog_ms / 1e3,
+                        probe_backoff_s=opts.breaker_probe_backoff_ms / 1e3,
+                        probe_backoff_max_s=(
+                            opts.breaker_probe_backoff_max_ms / 1e3
+                        ),
+                        probe_jitter=opts.breaker_probe_jitter,
+                        probe_successes=opts.breaker_probe_successes,
+                        verify_sample=opts.breaker_verify_sample,
+                    ),
+                )
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -1666,6 +1720,14 @@ class Server:
             # topics, host_fallbacks, overflows, rebuilds, fallback_ratio
             for key, val in self.matcher.stats.as_dict().items():
                 topics[SYS_PREFIX + "/broker/matcher/" + key] = str(val)
+            gauges = getattr(self.matcher, "breaker_gauges", None)
+            if callable(gauges):
+                # degradation-manager observability (mqtt_tpu.resilience):
+                # breaker state/trips, fallback rates, probe counters
+                for key, val in gauges().items():
+                    topics[
+                        SYS_PREFIX + "/broker/matcher/breaker/" + key
+                    ] = str(val)
         if self._cluster is not None:
             # worker-mesh observability (mqtt_tpu.cluster)
             c = self._cluster
@@ -1674,6 +1736,19 @@ class Server:
             topics[SYS_PREFIX + "/broker/cluster/dropped_forwards"] = str(
                 c.dropped_forwards
             )
+            # backpressure + link-health gauges (mqtt_tpu.cluster known
+            # limits: QoS>0 forwards DROP at the peer-buffer cap — the
+            # drop is counted here, never silent)
+            topics[SYS_PREFIX + "/broker/cluster/dropped_qos_forwards"] = str(
+                c.dropped_qos_forwards
+            )
+            topics[SYS_PREFIX + "/broker/cluster/reconnects"] = str(
+                c.reconnects_total
+            )
+            for peer, n in sorted(c.dropped_by_peer.items()):
+                topics[
+                    SYS_PREFIX + f"/broker/cluster/peer/{peer}/dropped_forwards"
+                ] = str(n)
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,

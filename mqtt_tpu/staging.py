@@ -87,10 +87,17 @@ class MatchStage:
         """The adaptive accumulation sleep: a fraction of the measured
         service time (batching beyond that trades latency for nothing —
         the pipeline is already busy for that long), never exceeding the
-        configured maximum window or the latency budget's headroom."""
+        configured maximum window or the latency budget's headroom.
+
+        Headroom is depth-scaled to match what _observe_service budgets:
+        a submitted publish waits for every batch already queued, so the
+        effective latency is depth x service — once that alone exceeds
+        the budget, any window sleep is pure added wait on an already
+        over-budget pipeline, and the window collapses to 0."""
         if self.latency_budget_s is None or self._ewma_s <= 0.0:
             return self.window_s
-        headroom = self.latency_budget_s - self._ewma_s
+        depth = 1 if self._queue is None else self._queue.qsize() + 1
+        headroom = self.latency_budget_s - depth * self._ewma_s
         if headroom <= 0.0:
             return 0.0  # over budget already: dispatch immediately
         return min(self.window_s, 0.5 * self._ewma_s, headroom)

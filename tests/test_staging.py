@@ -181,6 +181,37 @@ class TestMatchStageUnit:
         run(scenario())
 
 
+class TestAdaptiveWindow:
+    def test_window_headroom_scales_with_queue_depth(self):
+        """Regression (ADVICE r5): _observe_service budgets depth x
+        service, so _window must too — with a deep queue the pipeline can
+        be over budget while one batch's service is not, and the
+        collector must stop adding window sleep on top."""
+
+        async def scenario():
+            stage = MatchStage(
+                None,
+                lambda t: Subscribers(),
+                window_s=0.01,
+                latency_budget_s=0.1,
+            )
+            stage._ewma_s = 0.04  # one batch: comfortably under budget
+            assert stage._window() > 0.0  # no queue yet: depth 1
+            stage._queue = asyncio.Queue(maxsize=8)
+            for _ in range(3):
+                stage._queue.put_nowait(None)
+            # effective latency = depth(4) x 0.04 = 0.16 > 0.1 budget:
+            # the window collapses instead of sleeping on top of it
+            assert stage._window() == 0.0
+            stage._queue.get_nowait()
+            stage._queue.get_nowait()
+            stage._queue.get_nowait()
+            # depth 1 x 0.04 leaves headroom again
+            assert stage._window() > 0.0
+
+        run(scenario())
+
+
 class TestSingleConnectionPipelining:
     def test_one_client_burst_coalesces(self):
         """All publishes in one socket write must reach the stage before
