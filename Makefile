@@ -19,7 +19,9 @@ verify:
 test: verify
 
 # slow-marked chaos smoke: seeded dispatch hang/error/corrupt/flap and
-# mesh peer kill under live traffic (tests/test_resilience.py)
+# mesh peer kill under live traffic (tests/test_resilience.py), plus the
+# sustained publish-storm overload drill (tests/test_overload.py)
 chaos-smoke:
-	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py -q -m slow \
+	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
+	  tests/test_overload.py -q -m slow \
 	  -p no:cacheprovider -p no:xdist -p no:randomly

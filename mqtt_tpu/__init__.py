@@ -17,6 +17,7 @@ __version__ = "0.1.0"
 
 from .clients import Client, Clients, Will
 from .inflight import Inflight
+from .overload import OverloadConfig, OverloadGovernor
 from .server import (
     Capabilities,
     Compatibilities,
@@ -47,6 +48,8 @@ __all__ = [
     "InlineSubscription",
     "ListenerIDExistsError",
     "Options",
+    "OverloadConfig",
+    "OverloadGovernor",
     "SHARE_PREFIX",
     "SYS_PREFIX",
     "Server",

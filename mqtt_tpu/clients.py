@@ -101,6 +101,18 @@ class ClientState:
         self.stop_cause: Optional[Exception] = None
         self.is_taken_over = False
         self.open = True
+        # monotonic ts the outbound queue was first found full (None =
+        # not full); the overload governor's slow-consumer eviction
+        # sweep compares it against the grace window (mqtt_tpu.overload)
+        self.outbound_full_since: Optional[float] = None
+        # monotonic ts the client's backlog (transport write buffer past
+        # its limit, or a still-full outbound queue) was first observed
+        # by the overload sweep; cleared the moment it drains
+        self.backlog_over_since: Optional[float] = None
+        # transport buffer size at the last overload sweep: a consumer
+        # whose buffer SHRANK since then is draining (slow, not stalled)
+        # and must not accumulate eviction grace
+        self.sweep_buffered = 0
 
 
 class Client:
@@ -117,6 +129,11 @@ class Client:
         self.net = ClientConnection(reader, writer)
         self._deadline: Optional[float] = None  # monotonic keepalive deadline
         self._writer_task: Optional[asyncio.Task] = None
+        # per-evaluation-window publish counter for the overload
+        # governor's THROTTLE read-delay verdict (mqtt_tpu.overload);
+        # the read loop counts, read_delay() resets on window roll
+        self._pub_epoch = -1
+        self._pub_count = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -293,6 +310,10 @@ class Client:
                 fend = f.body_offset + f.remaining
                 self.ops.info.bytes_received += (f.body_offset - start) + f.remaining
                 start = fend
+                if (f.first_byte >> 4) == pkts.PUBLISH:
+                    # overload-governor accounting: publishes this window
+                    # (both the fast-path and decode legs land here)
+                    self._pub_count += 1
                 # QoS0 v4 PUBLISH passthrough (flags all zero): deliver the
                 # frame bytes without materializing a Packet when the
                 # server proves nothing can observe the difference. The
@@ -349,6 +370,14 @@ class Client:
                 # progress made — extend the keepalive deadline. A trickle
                 # of partial-packet bytes deliberately does NOT extend it.
                 self.refresh_deadline(self.state.keepalive)
+            overload = self.ops.overload
+            if overload is not None and not self.net.inline:
+                # THROTTLE lever: an over-quota publisher's next socket
+                # read is delayed, so the kernel's TCP window pushes
+                # back on it — the QoS0 analog of v5 receive-maximum
+                delay = overload.read_delay(self)
+                if delay > 0:
+                    await asyncio.sleep(delay)
             data = await self._read_more(self._missing_bytes(rbuf, varint_decode))
             if not data:
                 raise ConnectionClosedError()

@@ -101,6 +101,10 @@ class Cluster:
         self.dropped_qos_forwards = 0
         # per-peer re-dial counts (the $SYS reconnects gauge)
         self.reconnects: dict[int, int] = {}
+        # QoS0 forwards shed at the overload governor's REDUCED tier cap
+        # (a strict subset of dropped_forwards): the expendable tier
+        # sheds first, QoS>0 keeps the full buffer, control never sheds
+        self.shed_qos0_forwards = 0
         # filters each peer has announced as populated: the link-drop
         # cleanup needs them to withdraw the peer's interest (withdrawals
         # generated during an outage are lost, so stale entries would
@@ -108,6 +112,12 @@ class Cluster:
         self._peer_filters: dict[int, set[str]] = {}
         server._cluster = self
         server.topics.add_observer(self._on_mutation)
+        governor = getattr(server, "overload", None)
+        if governor is not None:
+            # peer-buffer occupancy feeds the broker-wide overload
+            # governor: a mesh backing up is the same 'work is not
+            # draining' condition as a slow local subscriber
+            governor.add_source("cluster", self._buffer_pressure)
 
     @property
     def peer_count(self) -> int:
@@ -242,23 +252,61 @@ class Cluster:
     # (its interest map is stale beyond repair anyway).
     MAX_PEER_BUFFER = 8 * 1024 * 1024
 
-    def _send_nowait(self, peer: int, writer, mtype: int, payload: bytes) -> bool:
+    def _send_nowait(
+        self, peer: int, writer, mtype: int, payload: bytes, qos: int = 1
+    ) -> bool:
         """Best-effort peer write; returns False when the forward was
         dropped at the buffer cap (counted globally and per peer — the
         caller decides whether the drop also weakens QoS>0 delivery and
-        counts that class separately)."""
+        counts that class separately).
+
+        Shedding is TIERED under the overload governor (mqtt_tpu.
+        overload): QoS0 forwards shed first at a reduced fraction of the
+        cap while the broker throttles/sheds, QoS>0 forwards keep the
+        full buffer, and control traffic (presence) never sheds — it
+        gets 8x headroom and a wedged-link close instead."""
         buffered = writer.transport.get_write_buffer_size()
         if mtype == _T_PRESENCE:
             if buffered > 8 * self.MAX_PEER_BUFFER:
                 _log.warning("peer link wedged past the control cap; closing")
                 writer.transport.abort()
                 return False
-        elif buffered > self.MAX_PEER_BUFFER:
-            self.dropped_forwards += 1
-            self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
-            return False
+        else:
+            cap = self.MAX_PEER_BUFFER
+            if qos == 0:
+                governor = getattr(self.server, "overload", None)
+                if governor is not None:
+                    frac = governor.qos0_forward_fraction()
+                    if frac < 1.0:
+                        cap = int(cap * frac)
+            if buffered > cap:
+                self.dropped_forwards += 1
+                self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
+                if (
+                    qos == 0
+                    and cap < self.MAX_PEER_BUFFER
+                    and buffered <= self.MAX_PEER_BUFFER
+                ):
+                    # a governor SHED only when the REDUCED tier cap was
+                    # the deciding limit — past the full cap this drop
+                    # would have happened anyway and must not inflate
+                    # the shed gauges
+                    self.shed_qos0_forwards += 1
+                    governor.note_shed()
+                return False
         writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
         return True
+
+    def _buffer_pressure(self) -> float:
+        """Worst peer write-buffer occupancy against MAX_PEER_BUFFER —
+        the governor's cluster pressure signal."""
+        worst = 0
+        for w in list(self._writers.values()):
+            try:
+                worst = max(worst, w.transport.get_write_buffer_size())
+            except Exception:
+                continue  # racing teardown: a closed transport is empty
+        return worst / self.MAX_PEER_BUFFER
 
     @staticmethod
     async def _recv(reader):
@@ -419,7 +467,7 @@ class Cluster:
                 self._count_drop(p)
                 continue
             try:
-                self._send_nowait(p, w, _T_FRAME, payload)
+                self._send_nowait(p, w, _T_FRAME, payload, qos=0)
             except (ConnectionError, RuntimeError):
                 self._count_drop(p)
 
@@ -455,6 +503,10 @@ class Cluster:
         ).encode()
         payload = head + b"\x00" + bytes(body)
         qos = pk.fixed_header.qos
+        # retained forwards are replicated STATE (every worker's retained
+        # store must converge), not expendable fan-out: keep them out of
+        # the governor's QoS0 shed tier even at QoS0
+        tier_qos = 1 if pk.fixed_header.retain else qos
         for p in peers:
             w = self._writers.get(p)
             if w is None:  # link down but interest not yet withdrawn
@@ -462,7 +514,7 @@ class Cluster:
                 sent = False
             else:
                 try:
-                    sent = self._send_nowait(p, w, _T_PACKET, payload)
+                    sent = self._send_nowait(p, w, _T_PACKET, payload, qos=tier_qos)
                 except (ConnectionError, RuntimeError):
                     self._count_drop(p)
                     sent = False

@@ -162,6 +162,24 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "breaker_probe_successes",
         "breaker_verify_sample",
         "gc_tuning",
+        # overload control plane: admission/backpressure/shedding knobs
+        # (mqtt_tpu.overload)
+        "overload_control",
+        "overload_throttle_enter",
+        "overload_throttle_exit",
+        "overload_shed_enter",
+        "overload_shed_exit",
+        "overload_min_dwell_ms",
+        "overload_eval_interval_ms",
+        "overload_quota_window_ms",
+        "overload_publish_quota",
+        "overload_throttle_delay_ms",
+        "overload_shed_quota",
+        "overload_eviction_grace_ms",
+        "overload_stage_max_pending",
+        "overload_client_buffer_limit_bytes",
+        "overload_max_outbound_backlog",
+        "overload_memory_limit_mb",
     ):
         if k in top:
             setattr(opts, k, top[k])

@@ -26,6 +26,14 @@ behave like that hardware — reproducibly, from one seed:
   mid-traffic; :func:`stall_peer_reads` gates a worker's mesh reads
   shut so its peers' write buffers back up against ``MAX_PEER_BUFFER``.
 
+- :class:`StormPlan` — a seeded publish-storm schedule (publisher ->
+  topic/payload/qos sequence, deterministic from the seed) plus
+  :func:`drive_storm`, the async driver that blasts the schedule through
+  raw writers at an offered load far above sustainable. The chaos suite
+  (tests/test_overload.py) and the bench's storm scenario (bench.py)
+  both replay the same plans against the overload governor
+  (mqtt_tpu.overload).
+
 Only test/ops tooling imports this module; nothing on the hot path
 references it.
 """
@@ -173,6 +181,102 @@ class FaultyMatcher:
 
     def match_topics(self, topics: list[str]):
         return self.match_topics_async(topics)()
+
+
+# -- publish storms ----------------------------------------------------------
+
+
+@dataclass
+class StormPlan:
+    """A deterministic publish-storm schedule.
+
+    ``schedule()`` expands to per-publisher lists of
+    ``(seq, topic, payload, qos)`` — a pure function of the plan fields,
+    so a failing storm run replays exactly from its seed. Payloads embed
+    the publisher index and sequence number, which lets the receiving
+    side match deliveries back to offered messages (latency/loss
+    accounting without any side channel)."""
+
+    seed: int = 0
+    publishers: int = 8
+    msgs_per_publisher: int = 100
+    topic_space: int = 16
+    topic_prefix: str = "storm"
+    qos1_fraction: float = 0.5
+    payload_pad: int = 0
+
+    def schedule(self) -> list[list[tuple[int, str, bytes, int]]]:
+        rng = random.Random(self.seed)
+        plans: list[list[tuple[int, str, bytes, int]]] = []
+        pad = b"x" * self.payload_pad
+        for p in range(self.publishers):
+            msgs = []
+            for m in range(self.msgs_per_publisher):
+                topic = (
+                    f"{self.topic_prefix}/p{p}/"
+                    f"t{rng.randrange(self.topic_space)}"
+                )
+                qos = 1 if rng.random() < self.qos1_fraction else 0
+                msgs.append((m, topic, f"s{p}-{m}|".encode() + pad, qos))
+            plans.append(msgs)
+        return plans
+
+
+async def drive_storm(
+    writers,
+    plan: StormPlan,
+    burst: int = 16,
+    pause_s: float = 0.0,
+    version: int = 5,
+    stamp_times: Optional[dict] = None,
+) -> dict:
+    """Blast ``plan``'s schedule through the given per-publisher
+    ``asyncio.StreamWriter``s as fast as the sockets accept it (offered
+    load >> sustainable — the storm the overload governor exists for).
+    QoS1 packet ids are sequential per publisher starting at 1; the
+    caller owns reading the acks. ``stamp_times`` (payload tag ->
+    perf_counter) records per-message send times for latency accounting.
+    Returns offered-traffic accounting."""
+    import asyncio
+
+    from .packets import PUBLISH, FixedHeader, Packet, encode_packet
+
+    schedules = plan.schedule()
+    offered = {"qos0": 0, "qos1": 0}
+
+    async def blast(writer, msgs) -> None:
+        pid = 0
+        buf = bytearray()
+        for i, (seq, topic, payload, qos) in enumerate(msgs):
+            if qos:
+                pid += 1
+            buf += encode_packet(
+                Packet(
+                    fixed_header=FixedHeader(type=PUBLISH, qos=qos),
+                    protocol_version=version,
+                    topic_name=topic,
+                    packet_id=pid if qos else 0,
+                    payload=payload,
+                )
+            )
+            offered["qos1" if qos else "qos0"] += 1
+            if stamp_times is not None:
+                stamp_times[payload.split(b"|", 1)[0]] = time.perf_counter()
+            if (i + 1) % burst == 0:
+                writer.write(bytes(buf))
+                buf.clear()
+                await writer.drain()
+                if pause_s:
+                    await asyncio.sleep(pause_s)
+        if buf:
+            writer.write(bytes(buf))
+            await writer.drain()
+
+    await asyncio.gather(
+        *(blast(w, msgs) for w, msgs in zip(writers, schedules))
+    )
+    offered["total"] = offered["qos0"] + offered["qos1"]
+    return offered
 
 
 # -- worker-mesh faults ------------------------------------------------------

@@ -65,6 +65,11 @@ class LogKVStore(StorageHook):
         self._seg_seq = 0
         self._live_bytes = 0  # payload bytes of live records
         self._total_bytes = 0  # payload bytes appended since last compaction
+        # replay-corruption accounting: a mid-file corrupt record skips
+        # everything after it in that segment — count the events and the
+        # skipped trailing bytes so the data loss is never silent
+        self.replay_corruptions = 0
+        self.replay_skipped_bytes = 0
         self._stop_gc = threading.Event()
         self._gc_thread: Optional[threading.Thread] = None
 
@@ -111,17 +116,34 @@ class LogKVStore(StorageHook):
 
     def _replay(self, filepath: str) -> None:
         """Apply one segment's records to the in-memory map; stop at the
-        first torn or corrupt record (crash tolerance)."""
+        first torn or corrupt record (crash tolerance).
+
+        A record that simply runs past EOF is a torn tail — the expected
+        crash-mid-append shape, at most one record lost. Anything else
+        (bad op byte, CRC mismatch) is CORRUPTION mid-file: everything
+        after it in the segment is unreadable and skipped, so the event
+        is logged with the segment name and byte offset and the skipped
+        trailing bytes are counted (``replay_corruptions`` /
+        ``replay_skipped_bytes``) — data loss must never be silent."""
         with open(filepath, "rb") as f:
             data = f.read()
         pos = 0
+        corrupt = False
         while pos + _HEADER.size + _CRC.size <= len(data):
             op, klen, vlen = _HEADER.unpack_from(data, pos)
             end = pos + _HEADER.size + klen + vlen
-            if op not in (_OP_SET, _OP_DEL) or end + _CRC.size > len(data):
+            if op not in (_OP_SET, _OP_DEL):
+                corrupt = True
+                break
+            if end + _CRC.size > len(data):
+                # the record extends past EOF: the torn-tail crash shape
+                # (a flipped LENGTH field can also land here — that case
+                # is indistinguishable from a torn large append, so the
+                # CRC check below is the corruption tripwire)
                 break
             (crc,) = _CRC.unpack_from(data, end)
             if crc != zlib.crc32(data[pos:end]):
+                corrupt = True
                 break
             key = data[pos + _HEADER.size : pos + _HEADER.size + klen].decode("utf-8")
             if op == _OP_SET:
@@ -133,6 +155,18 @@ class LogKVStore(StorageHook):
             # never triggers GC until fresh appends re-accumulate
             self._total_bytes += klen + vlen
             pos = end + _CRC.size
+        if corrupt:
+            skipped = len(data) - pos
+            self.replay_corruptions += 1
+            self.replay_skipped_bytes += skipped
+            self.log.warning(
+                "logkv replay hit a corrupt record: segment=%s offset=%d "
+                "skipped_trailing_bytes=%d (records after the corruption "
+                "are lost; a later segment or compaction may re-cover them)",
+                os.path.basename(filepath),
+                pos,
+                skipped,
+            )
 
     def _append(self, op: int, key: str, value: bytes) -> None:
         kb = key.encode("utf-8")

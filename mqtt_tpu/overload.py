@@ -1,0 +1,342 @@
+"""Broker-wide overload control plane: admission, backpressure, and
+graceful load shedding under publish storms.
+
+PR 1's degradation manager (mqtt_tpu.resilience) protects the broker
+against a *faulty* device; this module protects it against *too much
+healthy traffic*. Edge-broker benchmarking shows brokers fail by OOM and
+latency collapse — not clean errors — under sustained overload (PAPERS:
+"Benchmarking Message Brokers for IoT Edge Computing"), so every layer
+that can accumulate unbounded work reports a pressure signal here and
+obeys the governor's verdict:
+
+- An explicit NORMAL -> THROTTLE -> SHED state machine driven by the MAX
+  of normalized pressure signals (staging pending depth + batch queue,
+  aggregate client outbound backlog, cluster peer-buffer occupancy,
+  RSS watermark). Transitions use hysteresis bands — escalation is
+  immediate at the ``*_enter`` thresholds, de-escalation requires the
+  pressure to fall below the lower ``*_exit`` threshold AND a minimum
+  dwell, so a storm flapping around one threshold cannot make the broker
+  oscillate between postures.
+- THROTTLE pauses reads from persistently over-quota publishers
+  (``read_delay``): the kernel's TCP window then backpressures the
+  publisher — the same lever v5 receive-maximum gives for QoS>0 flows,
+  extended to QoS0 (which receive-maximum cannot touch).
+- SHED admits a bounded per-client budget per evaluation window
+  (``admit``) and sheds the excess gracefully: QoS0 is dropped
+  (counted), QoS1/2 is acked with v5 reason 0x97 Quota Exceeded —
+  a clean error instead of latency collapse. Slow consumers whose
+  outbound queue stays full past ``eviction_grace_s`` are evicted with
+  DISCONNECT 0x97 (``evict_due`` + the server's sweep), freeing their
+  backlog. The cluster's QoS0 forward tier sheds at a reduced
+  peer-buffer cap (``qos0_forward_fraction``); control traffic
+  (presence) never sheds.
+
+State, transition counts, sheds, evictions, and per-signal pressures
+surface as ``$SYS/broker/overload/...`` gauges (server.publish_sys_topics).
+All knobs are ``Options.overload_*`` fields and config-file keys; the
+governor is ON by default — an unprotected broker wedges by OOM, a
+governed one degrades predictably.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+_log = logging.getLogger("mqtt_tpu.overload")
+
+# governor states (exported as $SYS gauges; the ints are stable codes)
+NORMAL = "normal"
+THROTTLE = "throttle"
+SHED = "shed"
+_STATE_CODES = {NORMAL: 0, THROTTLE: 1, SHED: 2}
+
+
+@dataclass
+class OverloadConfig:
+    """Knobs for the overload governor (Options / config file map the
+    ``overload_*`` keys here; see README.md)."""
+
+    # hysteresis bands over the max normalized pressure in [0, 1+):
+    # escalate at *_enter, de-escalate below *_exit (enter > exit)
+    throttle_enter: float = 0.70
+    throttle_exit: float = 0.50
+    shed_enter: float = 0.90
+    shed_exit: float = 0.65
+    # minimum seconds in a state before DE-escalating (escalation is
+    # always immediate); bounds posture flapping around a threshold
+    min_dwell_s: float = 0.5
+    # evaluation cadence: admit()/read_delay() lazily re-evaluate when
+    # the last sample is older than this (the server event loop also
+    # forces one evaluation per housekeeping tick)
+    eval_interval_s: float = 0.25
+    # per-client quota window: the wall-clock period the publish_quota /
+    # shed_quota budgets cover. 0 = same as eval_interval_s. Decoupled
+    # from evaluation frequency so sampling faster never refills budgets
+    # faster
+    quota_window_s: float = 0.0
+    # THROTTLE: publishes per client per evaluation window before the
+    # read loop starts pausing that client's socket reads
+    publish_quota: int = 2048
+    throttle_delay_s: float = 0.05
+    # SHED: publishes admitted per client per evaluation window; the
+    # excess is shed (QoS0 dropped, QoS1/2 acked 0x97)
+    shed_quota: int = 256
+    # SHED: a client whose outbound queue has been full this long is
+    # evicted with DISCONNECT 0x97 (slow-consumer eviction)
+    eviction_grace_s: float = 2.0
+    # cluster QoS0 forward tier: fraction of MAX_PEER_BUFFER at which
+    # QoS0 forwards shed while throttling/shedding (QoS>0 keeps the
+    # full cap; control traffic never sheds)
+    qos0_forward_throttle_fraction: float = 0.5
+    qos0_forward_shed_fraction: float = 0.25
+
+
+class OverloadGovernor:
+    """The broker-wide admission/backpressure/shedding state machine.
+
+    Pressure sources are registered by the layers that own the signals
+    (staging, server outbound sweep, cluster, memory watermark); each is
+    a zero-arg callable returning a normalized pressure (1.0 = at its
+    configured cap). ``evaluate`` samples them all and moves the state
+    machine; the data-plane verdict methods (``read_delay``, ``admit``,
+    ``evict_due``, ``qos0_forward_fraction``) are cheap and re-evaluate
+    lazily so a storm is noticed between housekeeping ticks.
+
+    Thread-safe (resilience.py gauge idiom): verdicts run on the event
+    loop, but embedders and the cluster may read gauges from other
+    threads.
+    """
+
+    def __init__(
+        self,
+        config: Optional[OverloadConfig] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.config = config or OverloadConfig()
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._sources: dict[str, Callable[[], float]] = {}
+        self._state = NORMAL
+        self._entered_at = clock()
+        self._last_eval = float("-inf")
+        self._last_shed_at = float("-inf")  # last evaluation spent in SHED
+        self.epoch = 0  # evaluation-window counter (per-client quotas key on it)
+        self._admitted_in_epoch: dict[str, int] = {}
+        # counters (exported via gauges)
+        self.transitions = 0
+        self.sheds = 0
+        self.evictions = 0
+        self.throttled = 0
+        self.admitted = 0
+        self.pressure = 0.0
+        self.signal_pressures: dict[str, float] = {}
+        self.peak_pressures: dict[str, float] = {}
+
+    # -- wiring ------------------------------------------------------------
+
+    def add_source(self, name: str, fn: Callable[[], float]) -> None:
+        """Register (or replace) one named pressure signal."""
+        with self._lock:
+            self._sources[name] = fn
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    # -- state machine -----------------------------------------------------
+
+    def evaluate(self, force: bool = False) -> str:
+        """Sample every pressure source and apply the hysteresis-banded
+        transitions; returns the (possibly new) state. Rate-limited to
+        ``eval_interval_s`` unless forced, so the data-plane verdict
+        methods can call it on every packet for free."""
+        now = self.clock()
+        with self._lock:
+            if not force and now - self._last_eval < self.config.eval_interval_s:
+                return self._state
+            self._last_eval = now
+            # the quota window rolls on WALL CLOCK, not per evaluation:
+            # sampling pressure more often must not refill budgets faster
+            win = self.config.quota_window_s or self.config.eval_interval_s
+            epoch = int(now / win) if win > 0 else self.epoch + 1
+            if epoch != self.epoch:
+                self.epoch = epoch
+                self._admitted_in_epoch.clear()
+            sources = list(self._sources.items())
+        pressures: dict[str, float] = {}
+        for name, fn in sources:
+            try:
+                pressures[name] = max(0.0, float(fn()))
+            except Exception:  # pragma: no cover - a signal must not wedge us
+                _log.exception("overload signal %r failed; treated as 0", name)
+                pressures[name] = 0.0
+        p = max(pressures.values(), default=0.0)
+        cfg = self.config
+        with self._lock:
+            self.pressure = p
+            self.signal_pressures = pressures
+            for name, v in pressures.items():
+                if v > self.peak_pressures.get(name, 0.0):
+                    self.peak_pressures[name] = v
+            state = self._state
+            dwell_ok = now - self._entered_at >= cfg.min_dwell_s
+            new = state
+            if p >= cfg.shed_enter:
+                new = SHED
+            elif state == SHED:
+                if p < cfg.shed_exit and dwell_ok:
+                    new = THROTTLE if p >= cfg.throttle_exit else NORMAL
+            elif p >= cfg.throttle_enter:
+                new = THROTTLE
+            elif state == THROTTLE:
+                if p < cfg.throttle_exit and dwell_ok:
+                    new = NORMAL
+            if new != state:
+                self._transition_locked(new, p)
+            if self._state == SHED:
+                self._last_shed_at = now
+            return self._state
+
+    def _transition_locked(self, new: str, pressure: float) -> None:
+        old = self._state
+        self._state = new
+        self._entered_at = self.clock()
+        self.transitions += 1
+        level = (
+            logging.WARNING
+            if _STATE_CODES[new] > _STATE_CODES[old]
+            else logging.INFO
+        )
+        _log.log(
+            level,
+            "overload governor %s -> %s (pressure=%.2f, signals=%s)",
+            old,
+            new,
+            pressure,
+            {k: round(v, 2) for k, v in self.signal_pressures.items()},
+        )
+
+    # -- data-plane verdicts -----------------------------------------------
+
+    def read_delay(self, cl) -> float:
+        """THROTTLE lever, consulted by the client read loop before each
+        socket read: a client that published more than ``publish_quota``
+        in the current window gets its next read delayed, so the kernel's
+        TCP window backpressures the socket. 0.0 everywhere else.
+
+        Same unlocked NORMAL fast-out as :meth:`admit` — this runs on
+        every pass of every client's read loop."""
+        if (
+            self._state == NORMAL
+            and self.clock() - self._last_eval < self.config.eval_interval_s
+        ):
+            return 0.0
+        self.evaluate()
+        with self._lock:
+            if self._state == NORMAL:
+                return 0.0
+            if cl._pub_epoch != self.epoch:
+                cl._pub_epoch = self.epoch
+                cl._pub_count = 0
+                return 0.0
+            if cl._pub_count <= self.config.publish_quota:
+                return 0.0
+            self.throttled += 1
+            return self.config.throttle_delay_s
+
+    def admit(self, cl) -> bool:
+        """SHED lever, consulted once per inbound PUBLISH: each client
+        gets ``shed_quota`` admissions per quota window while shedding;
+        the excess returns False and the caller sheds it gracefully
+        (QoS0 drop / QoS1-2 ack 0x97). Always True outside SHED.
+
+        Hot-path note: in NORMAL between evaluations the verdict is
+        constant, so the unlocked fast-out below keeps the QoS0
+        passthrough free of lock round-trips (the racy attribute reads
+        are benign — at worst one packet is judged by the previous
+        evaluation, the same window any lazy sampling has). The
+        ``admitted`` counter therefore counts admissions decided while
+        the governor was actively throttling/shedding."""
+        if (
+            self._state == NORMAL
+            and self.clock() - self._last_eval < self.config.eval_interval_s
+        ):
+            return True
+        self.evaluate()
+        with self._lock:
+            if self._state != SHED:
+                self.admitted += 1
+                return True
+            n = self._admitted_in_epoch.get(cl.id, 0)
+            if n < self.config.shed_quota:
+                self._admitted_in_epoch[cl.id] = n + 1
+                self.admitted += 1
+                return True
+            self.sheds += 1
+            return False
+
+    def evict_due(self, full_since: Optional[float]) -> bool:
+        """True when a slow consumer backlogged since ``full_since``
+        should be evicted: past the grace window, while SHEDDING — or
+        within one grace window of the last shed episode, so a posture
+        that flaps around the exit band between sweeps still sheds the
+        backlog it accumulated."""
+        if full_since is None:
+            return False
+        with self._lock:
+            now = self.clock()
+            shedding = (
+                self._state == SHED
+                or now - self._last_shed_at < self.config.eviction_grace_s
+            )
+            if not shedding:
+                return False
+            return now - full_since >= self.config.eviction_grace_s
+
+    def qos0_forward_fraction(self) -> float:
+        """The cluster's QoS0 forward-shedding tier: the fraction of
+        MAX_PEER_BUFFER at which QoS0 forwards drop. 1.0 in NORMAL (the
+        plain cap); reduced while throttling/shedding so the expendable
+        tier sheds first and QoS>0 forwards keep the full cap."""
+        with self._lock:
+            if self._state == SHED:
+                return self.config.qos0_forward_shed_fraction
+            if self._state == THROTTLE:
+                return self.config.qos0_forward_throttle_fraction
+            return 1.0
+
+    def note_shed(self, n: int = 1) -> None:
+        """Account sheds decided outside admit() (cluster QoS0 tier)."""
+        with self._lock:
+            self.sheds += n
+
+    def note_eviction(self) -> None:
+        with self._lock:
+            self.evictions += 1
+
+    # -- observability -----------------------------------------------------
+
+    def gauges(self) -> dict:
+        """The $SYS gauge map (server.publish_sys_topics exports it under
+        ``$SYS/broker/overload/``)."""
+        with self._lock:
+            d = {
+                "state": self._state,
+                "state_code": _STATE_CODES[self._state],
+                "pressure": round(self.pressure, 4),
+                "transitions": self.transitions,
+                "sheds": self.sheds,
+                "evictions": self.evictions,
+                "throttled": self.throttled,
+                "admitted": self.admitted,
+            }
+            for name, v in self.signal_pressures.items():
+                d[f"signal/{name}"] = round(v, 4)
+            for name, v in self.peak_pressures.items():
+                d[f"peak/{name}"] = round(v, 4)
+            return d

@@ -200,6 +200,47 @@ class Options:
     # application that wants its own GC cadence sets this False (the change
     # is process-wide and logged at info level)
     gc_tuning: bool = True
+    # broker-wide overload control plane (mqtt_tpu.overload): a NORMAL ->
+    # THROTTLE -> SHED governor over staging depth, aggregate outbound
+    # backlog, cluster peer buffers, and an optional RSS watermark.
+    # Default on — a publish storm must degrade predictably (throttled
+    # reads, 0x97 sheds, slow-consumer eviction), never OOM.
+    overload_control: bool = True
+    # hysteresis bands over the max normalized pressure (enter > exit)
+    overload_throttle_enter: float = 0.70
+    overload_throttle_exit: float = 0.50
+    overload_shed_enter: float = 0.90
+    overload_shed_exit: float = 0.65
+    # minimum ms in a state before de-escalating (escalation is instant)
+    overload_min_dwell_ms: float = 500.0
+    # governor evaluation cadence (lazy re-sample on the data plane)
+    overload_eval_interval_ms: float = 250.0
+    # per-client quota window (publish/shed budgets); 0 = eval interval
+    overload_quota_window_ms: float = 0.0
+    # THROTTLE: per-client publishes per window before reads pause, and
+    # the pause applied to each subsequent read
+    overload_publish_quota: int = 2048
+    overload_throttle_delay_ms: float = 50.0
+    # SHED: per-client publishes admitted per window (excess sheds:
+    # QoS0 dropped, QoS1/2 acked 0x97 Quota Exceeded)
+    overload_shed_quota: int = 256
+    # SHED: outbound-queue-full grace before slow-consumer eviction
+    # (DISCONNECT 0x97)
+    overload_eviction_grace_ms: float = 2000.0
+    # staging admission bound: MatchStage._pending never exceeds this
+    # (overflow resolves via the deadline-aware host walk)
+    overload_stage_max_pending: int = 8192
+    # per-client transport write-buffer watermark (bytes): a client whose
+    # buffered-but-unsent outbound bytes stay above this past the grace
+    # window is a slow consumer (asyncio buffers writes unboundedly — the
+    # broker-side OOM vector a non-reading subscriber creates)
+    overload_client_buffer_limit_bytes: int = 1024 * 1024
+    # aggregate outbound backlog (sum of queued publishes across all
+    # clients) that normalizes to pressure 1.0
+    overload_max_outbound_backlog: int = 65536
+    # RSS watermark in MB that normalizes to pressure 1.0; 0 disables
+    # the memory signal
+    overload_memory_limit_mb: float = 0.0
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -234,6 +275,38 @@ class Options:
             self.breaker_probe_backoff_max_ms = max(
                 self.breaker_probe_backoff_ms, 30000.0
             )
+        # overload knobs are config-reachable: inverted hysteresis bands
+        # would flap on every evaluation and zero caps would divide the
+        # pressure signals — normalize like the knobs above
+        if self.overload_throttle_exit > self.overload_throttle_enter:
+            self.overload_throttle_exit = self.overload_throttle_enter
+        if self.overload_shed_exit > self.overload_shed_enter:
+            self.overload_shed_exit = self.overload_shed_enter
+        if self.overload_shed_enter < self.overload_throttle_enter:
+            self.overload_shed_enter = self.overload_throttle_enter
+        if self.overload_stage_max_pending <= 0:
+            self.overload_stage_max_pending = 8192
+        if self.overload_client_buffer_limit_bytes <= 0:
+            self.overload_client_buffer_limit_bytes = 1024 * 1024
+        if self.overload_max_outbound_backlog <= 0:
+            self.overload_max_outbound_backlog = 65536
+        if self.overload_eval_interval_ms <= 0:
+            self.overload_eval_interval_ms = 250.0
+        if self.overload_quota_window_ms < 0:
+            self.overload_quota_window_ms = 0.0
+        if self.overload_min_dwell_ms < 0:
+            self.overload_min_dwell_ms = 500.0
+        if self.overload_throttle_delay_ms < 0:
+            self.overload_throttle_delay_ms = 50.0
+        if self.overload_eviction_grace_ms < 0:
+            # a negative grace would evict on the FIRST sweep after any
+            # transient backlog — mass-disconnecting healthy-but-busy
+            # consumers the moment the broker sheds
+            self.overload_eviction_grace_ms = 2000.0
+        if self.overload_publish_quota <= 0:
+            self.overload_publish_quota = 2048
+        if self.overload_shed_quota <= 0:
+            self.overload_shed_quota = 256
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 
@@ -317,6 +390,9 @@ class _Ops:
         self.log = log
         self.fast_publish = None
         self.fast_publish_eligible = None
+        # the overload governor (mqtt_tpu.overload); None = ungoverned.
+        # Clients consult it for the THROTTLE read-delay verdict.
+        self.overload = None
 
 
 class Server:
@@ -347,6 +423,37 @@ class Server:
         self._cluster = None
         self.matcher = None  # device matcher; None = host trie walk
         self._stage = None  # publish staging loop (started in serve())
+        # broker-wide overload governor (mqtt_tpu.overload): admission,
+        # backpressure, and graceful shedding under publish storms.
+        # Default on; the staging signal attaches in serve(), the
+        # cluster signal in Cluster.__init__.
+        self.overload = None
+        self._outbound_backlog = 0  # last sweep's aggregate (gauge)
+        if opts.overload_control:
+            from .overload import OverloadConfig, OverloadGovernor
+
+            self.overload = OverloadGovernor(
+                OverloadConfig(
+                    throttle_enter=opts.overload_throttle_enter,
+                    throttle_exit=opts.overload_throttle_exit,
+                    shed_enter=opts.overload_shed_enter,
+                    shed_exit=opts.overload_shed_exit,
+                    min_dwell_s=opts.overload_min_dwell_ms / 1e3,
+                    eval_interval_s=opts.overload_eval_interval_ms / 1e3,
+                    quota_window_s=opts.overload_quota_window_ms / 1e3,
+                    publish_quota=opts.overload_publish_quota,
+                    throttle_delay_s=opts.overload_throttle_delay_ms / 1e3,
+                    shed_quota=opts.overload_shed_quota,
+                    eviction_grace_s=opts.overload_eviction_grace_ms / 1e3,
+                )
+            )
+            self._ops.overload = self.overload
+            self.overload.add_source("outbound", self._outbound_pressure)
+            if opts.overload_memory_limit_mb > 0:
+                limit = opts.overload_memory_limit_mb * 1024 * 1024
+                self.overload.add_source(
+                    "memory", lambda: rss_bytes() / limit
+                )
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
@@ -476,8 +583,11 @@ class Server:
                 max_batch=self.options.matcher_stage_max_batch,
                 max_inflight=self.options.matcher_stage_max_inflight,
                 latency_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
+                max_pending=self.options.overload_stage_max_pending,
             )
             self._stage.start()
+            if self.overload is not None:
+                self.overload.add_source("staging", self._stage.pressure)
 
         for listener in list(self.listeners.internal.values()):
             await listener.init(self.log)
@@ -503,9 +613,94 @@ class Server:
             self.clear_expired_retained_messages(now)
             self.send_delayed_lwt(now)
             self.clear_expired_inflights(now)
+            self.sweep_overload()
             if time.monotonic() >= next_sys:
                 self.publish_sys_topics()
                 next_sys = time.monotonic() + sys_interval
+
+    # -- overload control plane (mqtt_tpu.overload) ------------------------
+
+    def _outbound_pressure(self) -> float:
+        """Aggregate outbound backlog — publishes parked in every
+        client's bounded outbound queue — normalized against the
+        configured cap (the governor's 'subscribers are not draining'
+        signal)."""
+        clients = self.clients
+        try:
+            # lock-free iteration: the signal is a statistical sample,
+            # and copying the whole registry per evaluation would cost
+            # an O(clients) allocation 4x/second at the target scale
+            total = sum(
+                cl.state.outbound_qty for cl in clients.internal.values()
+            )
+        except RuntimeError:  # a connect/disconnect resized mid-walk
+            total = sum(
+                cl.state.outbound_qty for cl in clients.get_all().values()
+            )
+        self._outbound_backlog = total
+        return total / self.options.overload_max_outbound_backlog
+
+    def sweep_overload(self) -> None:
+        """One governor housekeeping pass (event-loop tick, 1 Hz): force
+        a pressure evaluation, then evict slow consumers while shedding —
+        DISCONNECT 0x97 Quota Exceeded, the reference's drop-on-slow-
+        consumer posture escalated to eviction so their backlog frees.
+
+        A slow consumer shows up two ways: its bounded outbound queue
+        stays full (drops accumulate — ``outbound_full_since`` from the
+        drop paths), or its TRANSPORT write buffer stays past the
+        configured watermark (asyncio buffers unsent bytes unboundedly,
+        which is the actual OOM vector a non-reading subscriber
+        creates). Either condition persisting past the grace window
+        while SHED evicts the client."""
+        ov = self.overload
+        if ov is None:
+            return
+        ov.evaluate(force=True)
+        buf_limit = self.options.overload_client_buffer_limit_bytes
+        now = time.monotonic()
+        for cl in self.clients.get_all().values():
+            if cl.net.inline or cl.closed:
+                continue
+            buffered = 0
+            if cl.net.writer is not None:
+                try:
+                    buffered = cl.net.writer.transport.get_write_buffer_size()
+                except Exception:
+                    buffered = 0
+            qfull = cl.state.outbound.full()
+            # a consumer whose buffer SHRANK since the last sweep is
+            # draining — behind, but alive; only a backlog that never
+            # recedes marks a stalled consumer
+            draining = buffered < cl.state.sweep_buffered
+            cl.state.sweep_buffered = buffered
+            if draining or not (buffered > buf_limit or qfull):
+                cl.state.backlog_over_since = None
+            elif cl.state.backlog_over_since is None:
+                cl.state.backlog_over_since = now
+            over_since = cl.state.backlog_over_since
+            # the drop clock may predate this sweep's first observation
+            full_since = cl.state.outbound_full_since
+            if qfull and not draining and full_since is not None:
+                over_since = (
+                    full_since
+                    if over_since is None
+                    else min(over_since, full_since)
+                )
+            if over_since is not None and ov.evict_due(over_since):
+                ov.note_eviction()
+                self.log.warning(
+                    "evicting slow consumer under overload: client=%s "
+                    "backlogged_for=%.1fs buffered=%dB queue_full=%s",
+                    cl.id,
+                    now - over_since,
+                    buffered,
+                    qfull,
+                )
+                try:
+                    self.disconnect_client(cl, ERR_QUOTA_EXCEEDED)
+                except Code:
+                    pass
 
     async def establish_connection(self, listener: str, reader, writer) -> None:
         """Attach a newly accepted connection (server.go:398-401)."""
@@ -980,6 +1175,30 @@ class Server:
         if pk.fixed_header.qos > self.options.capabilities.maximum_qos:
             pk.fixed_header.qos = self.options.capabilities.maximum_qos  # [MQTT-3.2.2-9]
 
+        # overload admission (mqtt_tpu.overload): while SHEDDING, traffic
+        # past the per-client window budget is refused GRACEFULLY — QoS0
+        # drops (counted), QoS1/2 acks 0x97 Quota Exceeded (v5; v3/v4
+        # acks carry no reason code, so the excess is simply not fanned
+        # out — the reference's drop-on-overload posture). Runs after
+        # alias resolution so alias state stays coherent across sheds,
+        # and never touches $SYS/LWT/retained housekeeping (those flow
+        # through publish_to_subscribers, not here).
+        if (
+            not cl.net.inline
+            and self.overload is not None
+            and not self.overload.admit(cl)
+        ):
+            self.info.messages_dropped += 1
+            if pk.fixed_header.qos == 0:
+                return
+            ack_type = pkts.PUBREC if pk.fixed_header.qos == 2 else pkts.PUBACK
+            cl.write_packet(
+                self.build_ack(
+                    pk.packet_id, ack_type, 0, pk.properties, ERR_QUOTA_EXCEEDED
+                )
+            )
+            return
+
         try:
             pk = self.hooks.on_publish(cl, pk)
         except Code as e:
@@ -1139,8 +1358,12 @@ class Server:
         try:
             tcl.state.outbound.put_nowait(data)
             tcl.state.outbound_qty += 1
+            tcl.state.outbound_full_since = None
             return True
         except asyncio.QueueFull:
+            if tcl.state.outbound_full_since is None:
+                # slow-consumer eviction clock (overload SHED posture)
+                tcl.state.outbound_full_since = time.monotonic()
             self.info.messages_dropped += 1
             self.hooks.on_publish_dropped(tcl, pk_source())
             return False
@@ -1194,6 +1417,11 @@ class Server:
 
         self.info.packets_received += 1
         self.info.messages_received += 1
+        if self.overload is not None and not self.overload.admit(cl):
+            # overload shed (mqtt_tpu.overload): the passthrough frame is
+            # QoS0 by construction, so the shed is a counted silent drop
+            self.info.messages_dropped += 1
+            return True
         if not self.hooks.on_acl_check(cl, topic, True):
             return True  # QoS0 deny is a silent drop (server.go:879-881)
 
@@ -1425,7 +1653,11 @@ class Server:
         try:
             cl.state.outbound.put_nowait(out)
             cl.state.outbound_qty += 1
+            cl.state.outbound_full_since = None
         except asyncio.QueueFull:
+            if cl.state.outbound_full_since is None:
+                # slow-consumer eviction clock (overload SHED posture)
+                cl.state.outbound_full_since = time.monotonic()
             self.info.messages_dropped += 1
             self.hooks.on_publish_dropped(cl, pk)
             if out.fixed_header.qos > 0:
@@ -1728,6 +1960,26 @@ class Server:
                     topics[
                         SYS_PREFIX + "/broker/matcher/breaker/" + key
                     ] = str(val)
+        if self.overload is not None:
+            # overload-governor observability (mqtt_tpu.overload): state,
+            # transition/shed/eviction/throttle counters, per-signal
+            # pressures (signal/*) and their high-water marks (peak/*)
+            for key, val in self.overload.gauges().items():
+                topics[SYS_PREFIX + "/broker/overload/" + key] = str(val)
+            topics[SYS_PREFIX + "/broker/overload/outbound_backlog"] = str(
+                self._outbound_backlog
+            )
+            if self._stage is not None:
+                st = self._stage
+                topics[SYS_PREFIX + "/broker/overload/stage_pending"] = str(
+                    st.pending_depth
+                )
+                topics[
+                    SYS_PREFIX + "/broker/overload/stage_peak_pending"
+                ] = str(st.peak_pending)
+                topics[
+                    SYS_PREFIX + "/broker/overload/stage_admission_fallbacks"
+                ] = str(st.admission_fallbacks)
         if self._cluster is not None:
             # worker-mesh observability (mqtt_tpu.cluster)
             c = self._cluster
@@ -1744,6 +1996,11 @@ class Server:
             )
             topics[SYS_PREFIX + "/broker/cluster/reconnects"] = str(
                 c.reconnects_total
+            )
+            # overload tier: QoS0 forwards shed at the governor's reduced
+            # peer-buffer cap (subset of dropped_forwards, never silent)
+            topics[SYS_PREFIX + "/broker/cluster/shed_qos0_forwards"] = str(
+                c.shed_qos0_forwards
             )
             for peer, n in sorted(c.dropped_by_peer.items()):
                 topics[

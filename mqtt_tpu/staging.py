@@ -31,6 +31,11 @@ pipelined batch engine:
   order, as in the reference.
 - A matcher failure degrades, never drops: the affected futures fall back
   to the bit-identical host trie walk.
+- Admission is BOUNDED (``max_pending``): under a publish storm the
+  parked list never grows past its cap — overflow (and submissions whose
+  projected pipeline wait already exceeds the deadline) resolves via the
+  host walk immediately, and the overload governor (mqtt_tpu.overload)
+  watches the same depth as its staging pressure signal.
 """
 
 from __future__ import annotations
@@ -57,6 +62,7 @@ class MatchStage:
         max_inflight: int = 4,
         latency_budget_s: Optional[float] = 0.25,
         min_batch: int = 64,
+        max_pending: int = 8192,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
@@ -68,6 +74,13 @@ class MatchStage:
         # throughput-optimal point needs this)
         self.latency_budget_s = latency_budget_s
         self.min_batch = max(1, min_batch)
+        # bounded admission: _pending may never grow past this; overflow
+        # (and submissions whose projected pipeline wait already blows
+        # the deadline) resolves via the host walk instead of queueing —
+        # a publish storm costs bounded memory, not an OOM
+        self.max_pending = max(1, max_pending)
+        self.admission_fallbacks = 0
+        self.peak_pending = 0
         self._pending: list[tuple[str, asyncio.Future]] = []
         self._wake: Optional[asyncio.Event] = None
         self._queue: Optional[asyncio.Queue] = None
@@ -161,14 +174,59 @@ class MatchStage:
     # -- submission --------------------------------------------------------
 
     def submit(self, topic: str) -> "asyncio.Future[Subscribers]":
-        """Park one publish; the future resolves with its Subscribers."""
+        """Park one publish; the future resolves with its Subscribers.
+
+        Admission is bounded: once ``max_pending`` publishes are parked,
+        or the pipeline's projected wait already exceeds the deadline
+        (2x the latency budget), the publish resolves immediately via
+        the host walk — the degraded-but-bounded mode — instead of
+        growing the backlog."""
         fut = asyncio.get_running_loop().create_future()
         if self._stopping or self._wake is None:
             fut.set_result(self.host_fallback(topic))
             return fut
+        if len(self._pending) >= self.max_pending or self._past_deadline():
+            self.admission_fallbacks += 1
+            fut.set_result(self.host_fallback(topic))
+            return fut
         self._pending.append((topic, fut))
+        if len(self._pending) > self.peak_pending:
+            self.peak_pending = len(self._pending)
         self._wake.set()
         return fut
+
+    def _past_deadline(self) -> bool:
+        """Deadline-aware admission: a new submission waits behind every
+        queued batch plus every parked batch-worth of _pending; when that
+        projected wait exceeds twice the latency budget, queueing only
+        deepens an already-lost backlog — the host walk serves it now.
+
+        An IDLE pipeline always admits, whatever the EWMA says: the
+        service-time estimate only heals through real dispatches, so a
+        one-off spike (the first batch's cold compile) must not starve
+        the stage into a permanent host-walk detour."""
+        if self.latency_budget_s is None or self._ewma_s <= 0.0:
+            return False
+        qdepth = self._queue.qsize() if self._queue is not None else 0
+        if qdepth == 0 and not self._pending:
+            return False  # idle: admit, and let the EWMA re-learn
+        depth = 1 + qdepth + len(self._pending) // max(1, self._batch_cap)
+        return depth * self._ewma_s > 2.0 * self.latency_budget_s
+
+    @property
+    def pending_depth(self) -> int:
+        return len(self._pending)
+
+    def pressure(self) -> float:
+        """Normalized staging pressure for the overload governor: parked
+        admission depth against its cap, plus the batch queue's fill at
+        half weight (a full queue is normal pipelining; sustained
+        _pending growth is the real overload signal)."""
+        p = len(self._pending) / self.max_pending
+        q = 0.0
+        if self._queue is not None and self.max_inflight > 0:
+            q = self._queue.qsize() / self.max_inflight
+        return max(p, 0.5 * q)
 
     # -- pipeline ----------------------------------------------------------
 
@@ -194,6 +252,13 @@ class MatchStage:
             )
             if self._pending:
                 self._wake.set()  # leftovers start the next window now
+            # a caller future cancelled mid-window (client disconnected
+            # during accumulation) is dead weight: drop it here so the
+            # device never matches for it and no resolver path trips on
+            # an already-cancelled future
+            batch = [(t, f) for t, f in batch if not f.cancelled()]
+            if not batch:
+                continue
             topics = [t for t, _ in batch]
             futs = [f for _, f in batch]
             try:

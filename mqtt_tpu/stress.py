@@ -35,11 +35,11 @@ from .packets import (
 )
 
 
-def _connect_bytes(client_id: str) -> bytes:
+def _connect_bytes(client_id: str, version: int = 4) -> bytes:
     return encode_packet(
         Packet(
             fixed_header=FixedHeader(type=CONNECT),
-            protocol_version=4,
+            protocol_version=version,
             connect=ConnectParams(
                 protocol_name=b"MQTT",
                 clean=True,
@@ -88,6 +88,44 @@ async def _read_packet_type(reader) -> int:
     return first >> 4
 
 
+def _scan_frames(buf: bytearray):
+    """``(frames, consumed)`` for the COMPLETE MQTT frames at the head
+    of ``buf`` — each frame as ``(first_byte, body_start, body_end)``;
+    the caller deletes ``buf[:consumed]``. The one raw scanner every
+    bulk reader in this module shares (publish counter, ack reader,
+    storm subscriber), so the varint rules live in one place."""
+    frames = []
+    pos = 0
+    n = len(buf)
+    while True:
+        if pos + 2 > n:
+            break
+        remaining = 0
+        shift = 0
+        vend = pos + 1
+        ok = True
+        while True:
+            if vend >= n:
+                ok = False
+                break
+            b = buf[vend]
+            vend += 1
+            remaining |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+            if shift > 21:
+                # 4-continuation-byte cap, matching the broker-side
+                # scanner: a malformed stream must error, not grow
+                # remaining unboundedly and mis-frame what follows
+                raise ValueError("malformed varint in stress stream")
+        if not ok or vend + remaining > n:
+            break
+        frames.append((buf[pos], vend, vend + remaining))
+        pos = vend + remaining
+    return frames, pos
+
+
 async def _count_publishes(reader, want: int) -> None:
     """Count inbound PUBLISH frames (bulk reads, minimal parsing).
 
@@ -102,37 +140,11 @@ async def _count_publishes(reader, want: int) -> None:
         if not data:
             raise asyncio.IncompleteReadError(b"", None)
         buf += data
-        pos = 0
-        n = len(buf)
-        while True:
-            # complete fixed header?
-            if pos + 2 > n:
-                break
-            remaining = 0
-            shift = 0
-            vend = pos + 1
-            ok = True
-            while True:
-                if vend >= n:
-                    ok = False
-                    break
-                b = buf[vend]
-                vend += 1
-                remaining |= (b & 0x7F) << shift
-                if not (b & 0x80):
-                    break
-                shift += 7
-                if shift > 21:
-                    # 4-continuation-byte cap, matching the broker-side
-                    # scanner: a malformed stream must error, not grow
-                    # remaining unboundedly and mis-frame what follows
-                    raise ValueError("malformed varint in stress stream")
-            if not ok or vend + remaining > n:
-                break
-            if (buf[pos] >> 4) == PUBLISH:
+        frames, consumed = _scan_frames(buf)
+        for first, _bs, _be in frames:
+            if (first >> 4) == PUBLISH:
                 got += 1
-            pos = vend + remaining
-        del buf[:pos]
+        del buf[:consumed]
 
 
 async def _worker(
@@ -204,6 +216,192 @@ async def run_stress(
         "receive_max_per_sec": round(recv[-1]),
         "aggregate_msgs_per_sec": round(n_clients * n_msgs / wall),
         "wall_s": round(wall, 2),
+    }
+
+
+# -- publish storm (overload-governor drill) ---------------------------------
+
+
+async def _read_loop_acks(reader, want_acks: int, acks: dict, timeout: float) -> None:
+    """Count PUBACK reason codes off one publisher's stream (0x00/0x10 =
+    admitted, 0x97 = shed by the overload governor) until ``want_acks``
+    arrive or the deadline passes."""
+    deadline = time.perf_counter() + timeout
+    buf = bytearray()
+    got = 0
+    while got < want_acks:
+        budget = deadline - time.perf_counter()
+        if budget <= 0:
+            break
+        try:
+            data = await asyncio.wait_for(reader.read(65536), budget)
+        except asyncio.TimeoutError:
+            break
+        if not data:
+            acks["disconnected"] = acks.get("disconnected", 0) + 1
+            break
+        buf += data
+        frames, consumed = _scan_frames(buf)
+        for first, bs, be in frames:
+            ptype = first >> 4
+            if ptype == 4:  # PUBACK
+                got += 1
+                reason = buf[bs + 2] if be - bs > 2 else 0
+                key = "shed" if reason == 0x97 else "admitted"
+                acks[key] = acks.get(key, 0) + 1
+            elif ptype == 14:  # DISCONNECT (e.g. 0x97 eviction)
+                acks["disconnected"] = acks.get("disconnected", 0) + 1
+        del buf[:consumed]
+
+
+async def run_storm(
+    host: str,
+    port: int,
+    publishers: int = 16,
+    msgs_each: int = 2000,
+    qos1_fraction: float = 0.5,
+    payload_pad: int = 32,
+    seed: int = 7,
+    timeout: float = 120.0,
+    drain_idle_s: float = 1.0,
+) -> dict:
+    """Offered-load >> sustainable publish storm against a live broker:
+    N v5 publishers blast a seeded :class:`~mqtt_tpu.faults.StormPlan`
+    while one subscriber on ``storm/#`` measures what actually gets
+    through. Returns offered/admitted/shed/delivered accounting and the
+    admitted-traffic delivery p99 — the artifact fields the overload
+    governor is judged on (bench.py storm scenario)."""
+    from .faults import StormPlan, drive_storm
+
+    plan = StormPlan(
+        seed=seed,
+        publishers=publishers,
+        msgs_per_publisher=msgs_each,
+        qos1_fraction=qos1_fraction,
+        payload_pad=payload_pad,
+    )
+    schedules = plan.schedule()
+    t_start = time.perf_counter()
+
+    # the measuring subscriber (wildcard over every storm topic)
+    sub_r, sub_w = await asyncio.open_connection(host, port)
+    sub_w.write(_connect_bytes("storm-sub", version=5))
+    await sub_w.drain()
+    assert await _read_packet_type(sub_r) == CONNACK
+    sub_w.write(
+        encode_packet(
+            Packet(
+                fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                protocol_version=5,
+                packet_id=1,
+                filters=[Subscription(filter="storm/#", qos=0)],
+            )
+        )
+    )
+    await sub_w.drain()
+    assert await _read_packet_type(sub_r) == SUBACK
+
+    conns = []
+    send_times: dict[bytes, float] = {}
+    for p in range(publishers):
+        r, w = await asyncio.open_connection(host, port)
+        w.write(_connect_bytes(f"storm-p{p}", version=5))
+        await w.drain()
+        assert await _read_packet_type(r) == CONNACK
+        conns.append((r, w))
+
+    # delivery accounting: payload tag -> receive latency
+    latencies: list[float] = []
+    delivered = [0]
+
+    async def consume() -> None:
+        buf = bytearray()
+        while True:
+            try:
+                data = await asyncio.wait_for(sub_r.read(65536), drain_idle_s)
+            except asyncio.TimeoutError:
+                if done.is_set():
+                    return  # storm over and the stream went quiet
+                continue
+            if not data:
+                return
+            buf += data
+            frames, consumed = _scan_frames(buf)
+            for first, bs, be in frames:
+                if (first >> 4) == PUBLISH:
+                    body = bytes(buf[bs:be])
+                    # the payload tag (s<pub>-<seq>) sits right before
+                    # the first '|'; the topic never contains one
+                    sep = body.find(b"|")
+                    if sep > 0:
+                        start = body.rfind(b"s", 0, sep)
+                        t0 = send_times.get(body[start:sep]) if start >= 0 else None
+                        if t0:
+                            latencies.append(time.perf_counter() - t0)
+                    delivered[0] += 1
+            del buf[:consumed]
+
+    done = asyncio.Event()
+    consumer = asyncio.ensure_future(consume())
+
+    # per-publisher ack counters ride alongside the blast
+    acks: dict = {}
+    want_acks = [
+        sum(1 for (_s, _t, _p, q) in schedules[p] if q) for p in range(publishers)
+    ]
+    ack_tasks = [
+        asyncio.ensure_future(
+            _read_loop_acks(conns[p][0], want_acks[p], acks, timeout)
+        )
+        for p in range(publishers)
+    ]
+
+    # the intake window: blast start until the broker has acked every
+    # QoS1 publish (the blast itself is fire-and-forget socket writes,
+    # so write-time alone would overstate the offered rate wildly)
+    t0 = time.perf_counter()
+    offered = await asyncio.wait_for(
+        drive_storm([w for _r, w in conns], plan, stamp_times=send_times),
+        timeout,
+    )
+    await asyncio.wait_for(asyncio.gather(*ack_tasks), timeout)
+    storm_s = time.perf_counter() - t0
+    done.set()
+    try:
+        await asyncio.wait_for(consumer, timeout)
+    except asyncio.TimeoutError:
+        consumer.cancel()
+
+    for _r, w in conns + [(sub_r, sub_w)]:
+        try:
+            w.close()
+        except Exception:
+            pass
+
+    lat_sorted = sorted(latencies)
+    p99 = (
+        lat_sorted[min(len(lat_sorted) - 1, max(0, int(len(lat_sorted) * 0.99) - 1))]
+        if lat_sorted
+        else None
+    )
+    return {
+        "publishers": publishers,
+        "offered": offered,
+        "offered_rate_per_sec": round(offered["total"] / max(1e-9, storm_s)),
+        "storm_wall_s": round(storm_s, 2),
+        "acked_admitted_qos1": acks.get("admitted", 0),
+        "shed_qos1_0x97": acks.get("shed", 0),
+        # client-visible sheds only: QoS0 sheds are silent drops, so the
+        # broker-side governor gauge is the total (bench reads it)
+        "shed_rate_qos1": round(
+            acks.get("shed", 0) / max(1, offered["qos1"]), 4
+        ),
+        "delivered": delivered[0],
+        "delivery_p99_ms": round(p99 * 1e3, 1) if p99 is not None else None,
+        # >0 means the run was truncated (a publisher was evicted or its
+        # stream dropped mid-blast): ack/shed counts undercount
+        "publishers_disconnected": acks.get("disconnected", 0),
+        "wall_s": round(time.perf_counter() - t_start, 2),
     }
 
 
@@ -312,6 +510,11 @@ def main() -> None:
     p.add_argument("--serve", action="store_true", help="run the bench broker instead")
     p.add_argument("--device-matcher", action="store_true")
     p.add_argument(
+        "--storm", action="store_true",
+        help="publish-storm overload drill (mqtt_tpu.overload) instead of "
+        "the throughput workload",
+    )
+    p.add_argument(
         "--workers", type=int, default=1,
         help="worker processes sharing the address via SO_REUSEPORT (multi-core)",
     )
@@ -320,9 +523,14 @@ def main() -> None:
     if args.serve:
         broker_main(args.broker, device_matcher=args.device_matcher, workers=args.workers)
         return
-    out = asyncio.run(
-        run_stress(host, int(port), args.clients, args.messages, args.payload_size)
-    )
+    if args.storm:
+        out = asyncio.run(
+            run_storm(host, int(port), args.clients, args.messages)
+        )
+    else:
+        out = asyncio.run(
+            run_stress(host, int(port), args.clients, args.messages, args.payload_size)
+        )
     print(json.dumps(out))
 
 

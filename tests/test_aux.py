@@ -784,6 +784,87 @@ class TestLogKVStore:
         assert s2._get("CL_b") is None  # torn record dropped, not fatal
         s2.stop()
 
+    def test_midfile_corruption_warns_and_counts(self, tmp_path, caplog):
+        """A bit flip mid-file must not be a SILENT discard of everything
+        after it: the replay logs the segment name + byte offset and
+        counts the skipped trailing bytes in store-level counters."""
+        import logging
+        import os
+
+        from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+
+        path = str(tmp_path / "kv")
+        s = LogKVStore()
+        s.init(LogKVOptions(path=path, gc_interval=0))
+        s._set("CL_a", b"va")
+        s._set("CL_b", b"vb")
+        s._set("CL_c", b"vc")
+        s.stop()
+        # each record: header(9) + key(4) + value(2) + crc(4) = 19 bytes
+        seg = sorted(os.listdir(path))[0]
+        p = os.path.join(path, seg)
+        data = bytearray(open(p, "rb").read())
+        assert len(data) == 3 * 19
+        data[19 + 9 + 4] ^= 0xFF  # flip a bit in record b's value
+        open(p, "wb").write(bytes(data))
+
+        s2 = LogKVStore()
+        with caplog.at_level(logging.WARNING, logger="mqtt_tpu.hook"):
+            s2.init(LogKVOptions(path=path, gc_interval=0))
+        assert s2._get("CL_a") == b"va"  # before the flip: intact
+        assert s2._get("CL_b") is None  # the corrupt record
+        assert s2._get("CL_c") is None  # trailing records: skipped
+        assert s2.replay_corruptions == 1
+        assert s2.replay_skipped_bytes == 2 * 19  # records b + c
+        warn = [
+            r for r in caplog.records if "corrupt record" in r.getMessage()
+        ]
+        assert warn, caplog.records
+        msg = warn[0].getMessage()
+        assert seg in msg and "offset=19" in msg
+        s2.stop()
+
+    def test_gc_crash_between_compact_write_and_delete(self, tmp_path):
+        """Crash-safety for GC compaction: a crash AFTER writing the
+        compacted segment but BEFORE deleting the old ones leaves
+        overlapping segments on disk; replay (segment-sequence order,
+        compacted segment last) must reconverge to the same map."""
+        import os as _os
+
+        import pytest as _pytest
+
+        from mqtt_tpu.hooks.storage import logkv as logkv_mod
+        from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+
+        path = str(tmp_path / "kv")
+        s = LogKVStore()
+        s.init(LogKVOptions(path=path, gc_interval=0))
+        for i in range(20):
+            s._set(f"CL_{i}", f"v{i}".encode())
+        for i in range(5):
+            s._set(f"CL_{i}", f"w{i}".encode())  # dead versions
+        for i in range(15, 20):
+            s._del(f"CL_{i}")
+        expected = dict(s._map)
+
+        with _pytest.MonkeyPatch.context() as mp:
+            # the simulated crash: the compacted segment is written and
+            # fsynced, but the old-segment deletes never happen
+            def crash(_p):
+                raise OSError("crash injected before delete")
+
+            mp.setattr(logkv_mod.os, "unlink", crash)
+            with _pytest.raises(OSError):
+                s.compact(0.0)
+        s._file.close()  # abandon the crashed store
+
+        assert len(_os.listdir(path)) >= 2  # overlapping segments remain
+        s2 = LogKVStore()
+        s2.init(LogKVOptions(path=path, gc_interval=0))
+        assert s2._map == expected  # replay reconverged
+        assert s2.replay_corruptions == 0
+        s2.stop()
+
     def test_segment_rotation(self, tmp_path):
         import os
 

@@ -516,7 +516,9 @@ class TestSysTopics:
             topics = {p.topic_name for p in pks}
             assert "$SYS/broker/version" in topics
             assert "$SYS/broker/clients/connected" in topics
-            assert len(topics) == 20
+            assert "$SYS/broker/overload/state" in topics
+            base = {t for t in topics if not t.startswith("$SYS/broker/overload/")}
+            assert len(base) == 20
             await h.shutdown()
 
         run(scenario())

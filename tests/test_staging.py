@@ -181,6 +181,77 @@ class TestMatchStageUnit:
         run(scenario())
 
 
+class TestCancelledCallerFutures:
+    def test_cancelled_mid_window_leaks_nothing(self):
+        """A client disconnecting during the accumulation window cancels
+        its staged futures: the collector must prune them (no device
+        work for dead callers), the drainer and _fallback_all must not
+        raise InvalidStateError, and nothing leaks in _pending/_queue."""
+
+        import threading
+
+        class GatedMatcher:
+            def __init__(self):
+                self.calls = []
+                self.release = threading.Event()
+
+            def match_topics_async(self, topics):
+                self.calls.append(list(topics))
+
+                def resolve():
+                    self.release.wait(5)
+                    return [Subscribers() for _ in topics]
+
+                return resolve
+
+        async def scenario():
+            m = GatedMatcher()
+            stage = MatchStage(
+                m, lambda t: Subscribers(), window_s=0.05, max_inflight=2
+            )
+            stage.start()
+            futs = [stage.submit(f"c/{i}") for i in range(6)]
+            for f in futs[:3]:
+                f.cancel()  # disconnect during the window
+            await asyncio.sleep(0.1)  # window elapses, batch dispatches
+            assert m.calls and len(m.calls[0]) == 3  # cancelled pruned
+            m.release.set()
+            results = await asyncio.gather(*futs[3:])
+            assert all(isinstance(r, Subscribers) for r in results)
+            assert stage._pending == []
+
+            # cancel AFTER dispatch (in-flight): the drainer must skip
+            # the cancelled future without InvalidStateError
+            m.release.clear()
+            late = stage.submit("c/late")
+            await asyncio.sleep(0.08)  # dispatched, resolver gated
+            late.cancel()
+            m.release.set()
+            await asyncio.sleep(0.1)
+            assert stage._queue.empty()
+            await stage.stop()
+
+        run(scenario())
+
+    def test_stop_with_cancelled_pending_is_clean(self):
+        """_fallback_all over a mix of live and cancelled futures: the
+        cancelled ones are skipped (no InvalidStateError), the live ones
+        resolve via the host walk."""
+
+        async def scenario():
+            stage = MatchStage(None, lambda t: Subscribers())
+            stage._wake = asyncio.Event()  # park without a collector
+            futs = [stage.submit(f"x/{i}") for i in range(4)]
+            futs[0].cancel()
+            futs[2].cancel()
+            await stage.stop()
+            assert futs[1].done() and futs[3].done()
+            assert isinstance(futs[1].result(), Subscribers)
+            assert isinstance(futs[3].result(), Subscribers)
+
+        run(scenario())
+
+
 class TestAdaptiveWindow:
     def test_window_headroom_scales_with_queue_depth(self):
         """Regression (ADVICE r5): _observe_service budgets depth x
