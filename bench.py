@@ -68,6 +68,32 @@ def pctl(xs, q):
     return xs[min(len(xs) - 1, max(0, int(len(xs) * q) - 1))]
 
 
+def telemetry_block(stage_lat, stage_name, fallbacks=None, fill=None):
+    """The per-config BENCH telemetry block (ISSUE 3): stage latencies
+    folded through the broker's own log-scale histogram so the p50/p99
+    here and the live /metrics percentiles share bucket math — future
+    PRs diff stage-level regressions, not just the end-to-end rate."""
+    from mqtt_tpu.telemetry import Histogram
+
+    h = Histogram()
+    for v in stage_lat:
+        h.observe(v)
+    block = {
+        "stages": {
+            stage_name: {
+                "count": h.count,
+                "p50_ms": round(h.percentile(0.5) * 1e3, 3),
+                "p99_ms": round(h.percentile(0.99) * 1e3, 3),
+            }
+        }
+    }
+    if fill is not None:
+        block["batch_fill"] = fill
+    if fallbacks:
+        block["fallbacks"] = fallbacks
+    return block
+
+
 def probe_link():
     """Measure the host<->device link: round-trip latency and H2D/D2H
     bandwidth. Through a direct PCIe attachment these are ~10us / >8GB/s;
@@ -408,6 +434,16 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
 
     return {
         "e2e_matches_per_sec": round((iters * batch) / e2e_dt),
+        "telemetry": telemetry_block(
+            lat,
+            "device_batch",
+            fallbacks={
+                "host_fallbacks": fallbacks,
+                "overflows": overflows,
+                "host_fast": matcher.stats.host_fast,
+            },
+            fill={"p50": 1.0, "note": "fixed-size bench batches"},
+        ),
         "device_kernel_matches_per_sec": round(kernel_rate) if kernel_rate else None,
         # best of the timed windows: the tunnel's per-dispatch overhead is
         # volatile (PROFILE.md §2); median is the headline, best shows the
@@ -564,6 +600,11 @@ def run_cfg5(n_subs, batch, iters, rng):
         n_topics = m.stats.topics - s0_topics
         out = {
             "e2e_matches_per_sec": round((iters * batch) / dt),
+            "telemetry": telemetry_block(
+                lat,
+                "device_batch",
+                fallbacks={"host_fallbacks": fallbacks},
+            ),
             "p99_batch_ms": round(pctl(lat, 0.99) * 1e3, 3),
             "batch": batch,
             "mutations_during_run": mutations[0],
@@ -636,26 +677,35 @@ def run_materializer_bench(fast: bool) -> dict:
     acc = _accel()
     if acc is not None:
         acc.resolve_batch(packed, batch, P, snaps, window, Subscribers)  # warm
+        c_lat = []
         t0 = time.perf_counter()
         for _ in range(iters):
+            t1 = time.perf_counter()
             acc.resolve_batch(packed, batch, P, snaps, window, Subscribers)
+            c_lat.append(time.perf_counter() - t1)
         dt = time.perf_counter() - t0
         out["c_materializer_topics_per_sec"] = round(iters * batch / dt)
         out["c_materializer_subs_per_sec"] = round(iters * hits / dt)
+        out["telemetry"] = telemetry_block(c_lat, "materialize")
     # the pure-Python oracle (the pre-round-5 ceiling), on a slice to keep
     # the config cheap
     table = _LazySubTable(window, list(snaps), n_entries * window)
     rows = packed[: max(256, batch // 8)].tolist()
+    py_lat = []
     t0 = time.perf_counter()
     for row in rows:
+        t1 = time.perf_counter()
         sids = []
         for p in range(P):
             c = row[P + p]
             if c:
                 sids.extend(range(row[p], row[p] + c))
         expand_sids(table, sids, Subscribers())
+        py_lat.append(time.perf_counter() - t1)
     dt = time.perf_counter() - t0
     out["python_oracle_topics_per_sec"] = round(len(rows) / dt)
+    if "telemetry" not in out:  # no C module: the oracle is the stage
+        out["telemetry"] = telemetry_block(py_lat, "materialize_oracle")
     if "c_materializer_topics_per_sec" in out:
         out["c_speedup_vs_python"] = round(
             out["c_materializer_topics_per_sec"] / out["python_oracle_topics_per_sec"], 2
@@ -838,6 +888,12 @@ def run_storm_bench(fast: bool) -> dict:
                 out["peak_pending_depth"] = srv._stage.peak_pending
                 out["pending_cap"] = srv._stage.max_pending
                 out["stage_admission_fallbacks"] = srv._stage.admission_fallbacks
+            if srv.telemetry is not None:
+                # the live telemetry plane's per-stage view of the storm:
+                # sampled stage p50/p99, batch occupancy, fallback classes
+                srv.telemetry.recorder.join_writer()  # dump IO off-thread
+                out["telemetry"] = srv.telemetry.bench_block()
+                out["flight_dumps"] = srv.telemetry.recorder.dumps
             try:
                 slow_w.close()
             except Exception:

@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""CI metrics-scrape gate: boot a broker with the telemetry plane on,
+drive a short publish burst over real TCP, scrape ``GET /metrics`` from
+the stats listener, validate it with the pure-Python exposition checker
+(mqtt_tpu.telemetry.check_exposition), and write the snapshot to disk —
+the workflow uploads it as an artifact so every CI run carries a
+stage-level metrics baseline.
+
+Usage: python exp/scrape_metrics.py [--out metrics-snapshot.txt]
+Exits non-zero when the scrape fails to parse or the expected stage
+histograms are missing.
+"""
+
+import argparse
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main(out_path: str) -> int:
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+    from mqtt_tpu.telemetry import check_exposition
+
+    try:  # stage histograms need the device matcher; CPU jax suffices
+        import jax  # noqa: F401
+
+        device = True
+    except ImportError:
+        device = False
+
+    opts = Options(
+        device_matcher=device,
+        matcher_opts={"max_levels": 4, "background": False} if device else None,
+        telemetry_sample=1,  # sample everything: a 2s burst must register
+    )
+    srv = Server(opts)
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    srv.add_listener(
+        HTTPStats(
+            LConfig(type="sysinfo", id="s", address="127.0.0.1:0"),
+            srv.info,
+            telemetry=srv.telemetry,
+        )
+    )
+    await srv.serve()
+    try:
+        host, port = srv.listeners.get("t").address().rsplit(":", 1)
+
+        # one subscriber + a small publish burst (the mini bench run)
+        sr, sw = await asyncio.open_connection(host, int(port))
+        sw.write(_connect_bytes("scrape-sub", version=4))
+        await sw.drain()
+        await sr.readexactly(4)
+        sw.write(_subscribe_bytes(1, "bench/#"))
+        await sw.drain()
+        await sr.readexactly(5)
+        if srv.matcher is not None:
+            srv.matcher.flush()
+
+        pr, pw = await asyncio.open_connection(host, int(port))
+        pw.write(_connect_bytes("scrape-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        for i in range(200):
+            topic = f"bench/{i % 10}".encode()
+            payload = b"x" * 16
+            body = len(topic).to_bytes(2, "big") + topic + payload
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+        deadline = asyncio.get_event_loop().time() + 10
+        got = 0
+        while got < 200 and asyncio.get_event_loop().time() < deadline:
+            try:
+                data = await asyncio.wait_for(sr.read(65536), 1.0)
+            except asyncio.TimeoutError:
+                break
+            if not data:
+                break
+            got += data.count(b"bench/")
+        print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
+
+        srv.publish_sys_topics()
+        hr, hw = await asyncio.open_connection(
+            *srv.listeners.get("s").address().rsplit(":", 1)
+        )
+        hw.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+        await hw.drain()
+        # the listener sends Connection: close — read to EOF so a large
+        # exposition split across TCP segments never truncates the body
+        raw = b""
+        while True:
+            chunk = await asyncio.wait_for(hr.read(65536), 5)
+            if not chunk:
+                break
+            raw += chunk
+        head, body = raw.split(b"\r\n\r\n", 1)
+        assert b"200" in head.split(b"\r\n", 1)[0], head
+        text = body.decode()
+
+        samples = check_exposition(text)
+        required = [
+            "mqtt_tpu_publish_stage_seconds",
+            "mqtt_tpu_messages_received_total",
+            "mqtt_tpu_uptime_seconds",
+        ]
+        missing = [m for m in required if m not in text]
+        if missing:
+            print(f"FAIL: metrics missing {missing}", file=sys.stderr)
+            return 1
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(
+            f"OK: {samples} samples parsed; snapshot -> {out_path}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        await srv.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="metrics-snapshot.txt")
+    sys.exit(asyncio.run(main(ap.parse_args().out)))

@@ -224,6 +224,7 @@ def build_server(args) -> Server:
                 HTTPStats(
                     ListenerConfig(type="sysinfo", id="stats", address=f":{args.stats_port}"),
                     server.info,
+                    telemetry=server.telemetry,  # GET /metrics exposition
                 )
             )
     return server

@@ -12,6 +12,7 @@ drop-on-slow-consumer semantics (server.go:1099-1110).
 from __future__ import annotations
 
 import asyncio
+import collections
 import logging
 import time
 import uuid
@@ -113,6 +114,14 @@ class ClientState:
         # whose buffer SHRANK since then is draining (slow, not stalled)
         # and must not accumulate eviction grace
         self.sweep_buffered = 0
+        # outbound queue-wait sampling (mqtt_tpu.telemetry): every
+        # successful enqueue bumps out_seq (server._stamp_outbound);
+        # sampled enqueues park (seq, t) here and the write loop matches
+        # out_deq against the head to observe the wait. Bounded: evicted
+        # stamps are just lost samples.
+        self.out_seq = 0
+        self.out_deq = 0
+        self.out_stamps: collections.deque = collections.deque(maxlen=64)
 
 
 class Client:
@@ -143,8 +152,21 @@ class Client:
         self._writer_task = asyncio.get_running_loop().create_task(self._write_loop())
 
     async def _write_loop(self) -> None:
+        st = self.state
         while True:
-            pk = await self.state.outbound.get()
+            pk = await st.outbound.get()
+            st.out_deq += 1
+            stamps = st.out_stamps
+            if stamps:
+                # resync past stamps evicted by the deque bound, then
+                # observe the matching sampled enqueue's queue wait
+                while stamps and stamps[0][0] < st.out_deq:
+                    stamps.popleft()
+                if stamps and stamps[0][0] == st.out_deq:
+                    _, t0 = stamps.popleft()
+                    tele = getattr(self.ops, "telemetry", None)
+                    if tele is not None:
+                        tele.outbound_wait.observe(time.perf_counter() - t0)
             try:
                 if type(pk) is bytes:  # pre-encoded qos0 fan-out frame
                     self.write_frame(pk)
@@ -152,7 +174,7 @@ class Client:
                     self.write_packet(pk)
             except Exception as e:
                 self.ops.log.debug("failed publishing packet to %s: %s", self.id, e)
-            self.state.outbound_qty -= 1
+            st.outbound_qty -= 1
 
     def write_frame(self, data: bytes) -> None:
         """Write a pre-encoded PUBLISH frame (the server's qos0 fan-out
@@ -293,6 +315,7 @@ class Client:
         caps = self.ops.options.capabilities
         fast_eligible = self.ops.fast_publish_eligible
         fast_publish = self.ops.fast_publish
+        telemetry = getattr(self.ops, "telemetry", None)
         rbuf = bytearray()
         deferred: Optional[list] = None
         self.refresh_deadline(self.state.keepalive)
@@ -329,10 +352,19 @@ class Client:
                     body = frame[f.body_offset - fstart :]
                 else:
                     body = bytes(rbuf[f.body_offset : fend])
+                # telemetry stage clock: 1-in-N publishes get stamped
+                # through decode -> admission -> staging -> fanout
+                # (mqtt_tpu.telemetry); the clock rides on the packet
+                clock = None
+                if telemetry is not None and (f.first_byte >> 4) == pkts.PUBLISH:
+                    clock = telemetry.publish_clock()
                 fh = FixedHeader()
                 fh.decode(f.first_byte)
                 fh.remaining = f.remaining
                 pk = self._decode_body(fh, body)
+                if clock is not None:
+                    clock.stamp("decode")
+                    pk._tclock = clock
                 result = packet_handler(self, pk)
                 if asyncio.iscoroutine(result):
                     # deferred (staged-publish) completions: schedule now,

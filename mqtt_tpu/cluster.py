@@ -54,6 +54,7 @@ import json
 import logging
 import os
 import struct
+import time
 from typing import Optional
 
 from .packets import PUBLISH, FixedHeader, Packet
@@ -67,6 +68,12 @@ _T_HELLO = 0x48  # 'H' json {worker}
 _T_PRESENCE = 0x53  # 'S' json {filter, populated, inline}
 _T_FRAME = 0x46  # 'F' u16 origin_len | origin | raw v4 qos0 PUBLISH frame
 _T_PACKET = 0x50  # 'P' json header | 0x00 | encoded publish body
+# link telemetry (mqtt_tpu.telemetry): Q carries a sender timestamp, the
+# peer echoes it back as R and the sender observes the round trip — the
+# forward-latency proxy for every peer link. Unknown types are ignored
+# by the read loop, so a mixed-version mesh keeps working.
+_T_PING = 0x51  # 'Q' f64 sender perf_counter
+_T_PONG = 0x52  # 'R' echoed ping payload
 
 
 def _noop_inline(*_a) -> None:  # pragma: no cover - marker, never invoked
@@ -155,6 +162,10 @@ class Cluster:
         self._tasks.append(
             loop.create_task(self._presence_loop(), name="cluster-presence")
         )
+        if getattr(self.server, "telemetry", None) is not None:
+            self._tasks.append(
+                loop.create_task(self._ping_loop(), name="cluster-ping")
+            )
 
     async def stop(self) -> None:
         self._stopping = True
@@ -314,6 +325,44 @@ class Cluster:
         (n, mtype) = struct.unpack(">IB", head)
         payload = await reader.readexactly(n - 1)
         return mtype, payload
+
+    # -- link telemetry ----------------------------------------------------
+
+    PING_INTERVAL_S = 5.0
+
+    def _rtt_hist(self, peer: int):
+        """The per-peer forward-latency histogram on the server's
+        telemetry registry ($SYS + /metrics surface it)."""
+        return self.server.telemetry.registry.histogram(
+            "mqtt_tpu_cluster_peer_rtt_seconds",
+            "Mesh peer-link round-trip time (ping/pong over the forward "
+            "socket — the peer-forward latency proxy)",
+            peer=str(peer),
+        )
+
+    async def _ping_loop(self) -> None:
+        """Periodically time a round trip on every live peer link. The
+        ping rides the same socket as forwards, so a link backed up with
+        forward traffic shows its queueing delay here — the closest
+        observable to one-way forward latency without synced clocks."""
+        while not self._stopping:
+            await asyncio.sleep(self.PING_INTERVAL_S)
+            for peer, w in list(self._writers.items()):
+                try:
+                    w.write(
+                        struct.pack(">IB", 9, _T_PING)
+                        + struct.pack(">d", time.perf_counter())
+                    )
+                except (ConnectionError, RuntimeError):
+                    continue  # link teardown races: the dial loop heals it
+
+    def _on_pong(self, peer: int, payload: bytes) -> None:
+        if len(payload) != 8:
+            return
+        (t0,) = struct.unpack(">d", payload)
+        rtt = time.perf_counter() - t0
+        if 0 <= rtt < 60:  # a clock anomaly must not pollute the histogram
+            self._rtt_hist(peer).observe(rtt)
 
     # -- presence sync -----------------------------------------------------
 
@@ -561,6 +610,14 @@ class Cluster:
                     sep = payload.index(b"\x00")
                     head = json.loads(payload[:sep])
                     self._deliver_packet(head, payload[sep + 1 :])
+                elif mtype == _T_PING:
+                    # echo verbatim; the sender computes the RTT
+                    writer.write(
+                        struct.pack(">IB", len(payload) + 1, _T_PONG) + payload
+                    )
+                elif mtype == _T_PONG:
+                    if getattr(self.server, "telemetry", None) is not None:
+                        self._on_pong(peer, payload)
             except Exception:
                 _log.exception("cluster delivery failed (peer %d)", peer)
 

@@ -180,6 +180,13 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "overload_client_buffer_limit_bytes",
         "overload_max_outbound_backlog",
         "overload_memory_limit_mb",
+        # telemetry plane: stage-clock sampling, flight recorder, /metrics
+        # (mqtt_tpu.telemetry)
+        "telemetry",
+        "telemetry_sample",
+        "telemetry_ring",
+        "telemetry_dump_dir",
+        "telemetry_dump_min_interval_ms",
     ):
         if k in top:
             setattr(opts, k, top[k])

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import base64
 import json
-from dataclasses import asdict, is_dataclass
+from dataclasses import asdict, fields, is_dataclass
 from typing import Any, Iterable, Optional
 
 from ...packets import ERR_SESSION_TAKEN_OVER, Packet, UserProperty
@@ -189,7 +189,11 @@ def subscription_from_dict(d: dict) -> Subscription:
 
 def sys_info_from_dict(d: dict) -> SystemInfo:
     info = d.get("info") or {}
-    return SystemInfo(info=Info(**{k: info.get(k, 0) for k in Info().__dict__}))
+    # dataclass FIELDS, not __dict__: Info carries a non-field monotonic
+    # uptime anchor (system.Info.__post_init__) that must not round-trip
+    return SystemInfo(
+        info=Info(**{f.name: info.get(f.name, 0) for f in fields(Info)})
+    )
 
 
 class StorageHook(Hook):

@@ -52,8 +52,16 @@ class _HttpListener(StreamListener):
                     b"Content-Length: 0\r\nConnection: close\r\n\r\n"
                 )
             else:
+                extra = ""
                 try:
-                    status, body, ctype = self._respond(method, path)
+                    resp = self._respond(method, path)
+                    # handlers may append a dict of extra headers
+                    # (Cache-Control on stats/metrics, Allow on 405s)
+                    if len(resp) == 4:
+                        status, body, ctype, headers = resp
+                        extra = "".join(f"{k}: {v}\r\n" for k, v in headers.items())
+                    else:
+                        status, body, ctype = resp
                 except Exception:
                     self.log.exception("http handler failed: path=%s", path)
                     status, body, ctype = "500 Internal Server Error", b"", "text/plain"
@@ -62,6 +70,7 @@ class _HttpListener(StreamListener):
                         f"HTTP/1.1 {status}\r\n"
                         f"Content-Type: {ctype}\r\n"
                         f"Content-Length: {len(body)}\r\n"
+                        f"{extra}"
                         "Connection: close\r\n\r\n"
                     ).encode()
                     + body
@@ -78,31 +87,53 @@ class _HttpListener(StreamListener):
     def _authorized(self, request: bytes) -> bool:
         return True
 
-    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+    def _respond(self, method: str, path: str):
         raise NotImplementedError
+
+    @staticmethod
+    def _method_not_allowed():
+        """405 for a KNOWN path hit with a non-GET method; unknown paths
+        stay 404 whatever the method (RFC 9110 §15.5.6 semantics)."""
+        return "405 Method Not Allowed", b"", "text/plain", {"Allow": "GET"}
+
+
+# point-in-time responses must never be served from a cache
+_NO_STORE = {"Cache-Control": "no-store"}
 
 
 class HTTPHealthCheck(_HttpListener):
     """Responds 200 OK to GET /healthcheck (http_healthcheck.go:59-63)."""
 
-    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
-        if method == "GET" and path == "/healthcheck":
+    def _respond(self, method: str, path: str):
+        if path == "/healthcheck":
+            if method != "GET":
+                return self._method_not_allowed()
             return "200 OK", b"", "text/plain"
-        return "405 Method Not Allowed" if method != "GET" else "404 Not Found", b"", "text/plain"
+        return "404 Not Found", b"", "text/plain"
 
 
 class HTTPStats(_HttpListener):
-    """Serves the $SYS info values as JSON (http_sysinfo.go:112-121)."""
+    """Serves the $SYS info values as JSON (http_sysinfo.go:112-121) and,
+    when a telemetry plane is attached (mqtt_tpu.telemetry), its
+    Prometheus text exposition at ``GET /metrics``."""
 
-    def __init__(self, config: Config, sys_info: Info) -> None:
+    def __init__(self, config: Config, sys_info: Info, telemetry=None) -> None:
         super().__init__(config)
         self.sys_info = sys_info
+        self.telemetry = telemetry
 
-    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+    def _respond(self, method: str, path: str):
+        if path == "/metrics":
+            if self.telemetry is None:
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            body = self.telemetry.exposition().encode()
+            return "200 OK", body, "text/plain; version=0.0.4; charset=utf-8", _NO_STORE
         if method != "GET":
-            return "405 Method Not Allowed", b"", "text/plain"
+            return self._method_not_allowed()
         body = json.dumps(self.sys_info.clone().as_dict()).encode()
-        return "200 OK", body, "application/json"
+        return "200 OK", body, "application/json", _NO_STORE
 
 
 class Dashboard(_HttpListener):
@@ -205,13 +236,17 @@ class Dashboard(_HttpListener):
         rows.sort(key=lambda r: r[0] + r[1])
         return rows, counts
 
-    def _respond(self, method: str, path: str) -> tuple[str, bytes, str]:
+    KNOWN_PATHS = ("/information", "/clientsrawdata", "/processrecords", "/connections")
+
+    def _respond(self, method: str, path: str):
+        if path not in self.KNOWN_PATHS:
+            return "404 Not Found", b"", "text/plain"
         if method != "GET":
-            return "405 Method Not Allowed", b"", "text/plain"
+            return self._method_not_allowed()
         self._maybe_record()
         if path == "/information":
             body = json.dumps(self.sys_info.clone().as_dict(), indent=2).encode()
-            return "200 OK", body, "application/json"
+            return "200 OK", body, "application/json", _NO_STORE
         if path == "/clientsrawdata":
             out = [
                 {
@@ -227,9 +262,14 @@ class Dashboard(_HttpListener):
                 for cl in self.clients.get_all().values()
                 if cl.net.listener != "local" and cl.id != "inline"
             ]
-            return "200 OK", json.dumps(out, indent=2).encode(), "application/json"
+            return "200 OK", json.dumps(out, indent=2).encode(), "application/json", _NO_STORE
         if path == "/processrecords":
-            return "200 OK", json.dumps(list(self._records), indent=2).encode(), "application/json"
+            return (
+                "200 OK",
+                json.dumps(list(self._records), indent=2).encode(),
+                "application/json",
+                _NO_STORE,
+            )
         if path == "/connections":
             rows, counts = self._client_rows()
             uptime = self.sys_info.uptime
@@ -251,5 +291,5 @@ class Dashboard(_HttpListener):
                 "<th>ver</th><th>listener</th><th>#subs</th><th>filters</th></tr>"
                 f"{cells}</table></body></html>"
             ).encode()
-            return "200 OK", body, "text/html; charset=utf-8"
-        return "404 Not Found", b"", "text/plain"
+            return "200 OK", body, "text/html; charset=utf-8", _NO_STORE
+        return "404 Not Found", b"", "text/plain"  # pragma: no cover - gated above

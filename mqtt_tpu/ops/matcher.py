@@ -136,6 +136,19 @@ class MatcherStats:
     # topics served by the exact-map host fast path (wildcard-free filter
     # sets answer from one dict probe; no device round trip)
     host_fast: int = 0
+    # optional per-rebuild duration observer (the telemetry plane's
+    # compile/rebuild histogram — mqtt_tpu.telemetry); set by the server
+    rebuild_observer = None
+
+    def note_rebuild(self, dt: float) -> None:
+        """Account one rebuild/fold wall time (and feed the observer)."""
+        self.rebuild_seconds += dt
+        cb = self.rebuild_observer
+        if cb is not None:
+            try:
+                cb(dt)
+            except Exception:  # pragma: no cover - telemetry must not wedge
+                pass
 
     def as_dict(self) -> dict:
         out = {
@@ -225,7 +238,7 @@ class TpuMatcher:
         self._state = (flat, device_arrays, version)
         self._fold_poisoned = False
         self.stats.rebuilds += 1
-        self.stats.rebuild_seconds += time.perf_counter() - t0
+        self.stats.note_rebuild(time.perf_counter() - t0)
         # warm the C materializer off the publish path: its first use
         # otherwise triggers a synchronous cc compile inside the first
         # batch's resolve (seconds of publish latency on a cold host)
@@ -284,7 +297,7 @@ class TpuMatcher:
         self._state = (flat, (new_table, *new_pats), version)
         self._fold_poisoned = False
         self.stats.folds += 1
-        self.stats.rebuild_seconds += time.perf_counter() - t0
+        self.stats.note_rebuild(time.perf_counter() - t0)
         return True
 
     @property

@@ -135,6 +135,10 @@ class OverloadGovernor:
         self.pressure = 0.0
         self.signal_pressures: dict[str, float] = {}
         self.peak_pressures: dict[str, float] = {}
+        # optional transition observer: called as fn(old_state, new_state)
+        # AFTER the lock is released (the telemetry plane's flight
+        # recorder dumps on NORMAL/THROTTLE -> SHED; mqtt_tpu.telemetry)
+        self.on_transition: Optional[Callable[[str, str], None]] = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -200,7 +204,15 @@ class OverloadGovernor:
                 self._transition_locked(new, p)
             if self._state == SHED:
                 self._last_shed_at = now
-            return self._state
+            result = self._state
+        if new != state:
+            cb = self.on_transition
+            if cb is not None:
+                try:
+                    cb(state, new)
+                except Exception:  # an observer must not wedge the governor
+                    _log.exception("overload transition observer failed")
+        return result
 
     def _transition_locked(self, new: str, pressure: float) -> None:
         old = self._state

@@ -241,6 +241,24 @@ class Options:
     # RSS watermark in MB that normalizes to pressure 1.0; 0 disables
     # the memory signal
     overload_memory_limit_mb: float = 0.0
+    # unified telemetry plane (mqtt_tpu.telemetry): per-publish stage
+    # clock sampled 1-in-N, histogram metrics, Prometheus exposition at
+    # GET /metrics (sysinfo listener), the retained
+    # $SYS/broker/telemetry/# tree, and a flight recorder that dumps a
+    # JSON trace when the governor enters SHED or the breaker trips.
+    # Default on — sampling keeps the unsampled hot path at one integer
+    # increment per publish.
+    telemetry: bool = True
+    # stage-clock sampling: 1-in-N publishes carry a clock (0 disables
+    # stage sampling; batch/queue histograms still populate)
+    telemetry_sample: int = 64
+    # flight-recorder ring size (recent sampled stage records)
+    telemetry_ring: int = 256
+    # flight-recorder dump directory; "" = <tempdir>/mqtt_tpu_flight
+    telemetry_dump_dir: str = ""
+    # minimum ms between flight-recorder dumps (a flapping posture must
+    # not fill the disk)
+    telemetry_dump_min_interval_ms: float = 30000.0
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -307,6 +325,14 @@ class Options:
             self.overload_publish_quota = 2048
         if self.overload_shed_quota <= 0:
             self.overload_shed_quota = 256
+        # telemetry knobs are config-reachable: a negative sample rate
+        # means "default", a zero one disables stage sampling outright
+        if self.telemetry_sample < 0:
+            self.telemetry_sample = 64
+        if self.telemetry_ring <= 0:
+            self.telemetry_ring = 256
+        if self.telemetry_dump_min_interval_ms < 0:
+            self.telemetry_dump_min_interval_ms = 30000.0
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 
@@ -393,6 +419,10 @@ class _Ops:
         # the overload governor (mqtt_tpu.overload); None = ungoverned.
         # Clients consult it for the THROTTLE read-delay verdict.
         self.overload = None
+        # the telemetry plane (mqtt_tpu.telemetry); None = uninstrumented.
+        # Clients consult it for the publish stage clock and the sampled
+        # outbound queue-wait stamps.
+        self.telemetry = None
 
 
 class Server:
@@ -429,6 +459,20 @@ class Server:
         # cluster signal in Cluster.__init__.
         self.overload = None
         self._outbound_backlog = 0  # last sweep's aggregate (gauge)
+        # unified telemetry plane (mqtt_tpu.telemetry): stage clocks,
+        # histograms, /metrics exposition, $SYS tree, flight recorder
+        self.telemetry = None
+        if opts.telemetry:
+            from .telemetry import Telemetry
+
+            self.telemetry = Telemetry(
+                sample=opts.telemetry_sample,
+                ring=opts.telemetry_ring,
+                dump_dir=opts.telemetry_dump_dir,
+                dump_min_interval_s=opts.telemetry_dump_min_interval_ms / 1e3,
+            )
+            self._ops.telemetry = self.telemetry
+            self._register_core_gauges()
         if opts.overload_control:
             from .overload import OverloadConfig, OverloadGovernor
 
@@ -478,6 +522,32 @@ class Server:
                         verify_sample=opts.breaker_verify_sample,
                     ),
                 )
+        if self.telemetry is not None:
+            # degradation triggers dump the flight recorder: entering SHED
+            # (overload storm) and a breaker trip (device failure) both
+            # leave a JSON trace of the publishes that led up to them
+            if self.overload is not None:
+                self.overload.on_transition = self._overload_transition
+            if self.matcher is not None:
+                stats = getattr(self.matcher, "stats", None)
+                if stats is not None:
+                    # compile/rebuild/fold wall times -> rebuild histogram
+                    stats.rebuild_observer = self.telemetry.rebuild_hist.observe
+                breaker = getattr(self.matcher, "breaker", None)
+                if breaker is not None:
+                    prev_trip = breaker.on_trip
+
+                    def _trip_dump(_prev=prev_trip):
+                        # runs under the breaker lock: wake the probe
+                        # thread first, then dump WITHOUT re-entering any
+                        # breaker method (as_dict would deadlock)
+                        if _prev is not None:
+                            _prev()
+                        self.telemetry.trigger_dump(
+                            "breaker_trip", {"trigger": "matcher_breaker"}
+                        )
+
+                    breaker.on_trip = _trip_dump
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -527,7 +597,9 @@ class Server:
             builder = builders.get(t)
             if builder is not None:
                 if t == TYPE_SYSINFO:
-                    return builder(conf, self.info)
+                    # the stats listener also serves GET /metrics when
+                    # the telemetry plane is on (mqtt_tpu.telemetry)
+                    return builder(conf, self.info, self.telemetry)
                 return builder(conf)
         self.log.error("listener type unavailable by config: %s", conf.type)
         return None
@@ -584,6 +656,7 @@ class Server:
                 max_inflight=self.options.matcher_stage_max_inflight,
                 latency_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
                 max_pending=self.options.overload_stage_max_pending,
+                telemetry=self.telemetry,
             )
             self._stage.start()
             if self.overload is not None:
@@ -617,6 +690,90 @@ class Server:
             if time.monotonic() >= next_sys:
                 self.publish_sys_topics()
                 next_sys = time.monotonic() + sys_interval
+
+    # -- telemetry plane (mqtt_tpu.telemetry) ------------------------------
+
+    def _register_core_gauges(self) -> None:
+        """Scrape-time gauges over state other layers already maintain:
+        the $SYS Info counters, matcher stats, and governor posture all
+        surface on /metrics without a second bookkeeping path."""
+        r = self.telemetry.registry
+        info = self.info
+        # monotonic Info fields export as callback-backed COUNTERS: the
+        # _total suffix promises counter semantics (rate()/increase(),
+        # reset detection) and OpenMetrics linting rejects _total gauges
+        for name, attr in (
+            ("mqtt_tpu_messages_received_total", "messages_received"),
+            ("mqtt_tpu_messages_sent_total", "messages_sent"),
+            ("mqtt_tpu_messages_dropped_total", "messages_dropped"),
+            ("mqtt_tpu_packets_received_total", "packets_received"),
+            ("mqtt_tpu_packets_sent_total", "packets_sent"),
+            ("mqtt_tpu_bytes_received_total", "bytes_received"),
+            ("mqtt_tpu_bytes_sent_total", "bytes_sent"),
+        ):
+            r.counter(
+                name, f"$SYS mirror of Info.{attr}", fn=lambda a=attr: getattr(info, a)
+            )
+        for name, attr in (
+            ("mqtt_tpu_clients_connected", "clients_connected"),
+            ("mqtt_tpu_subscriptions", "subscriptions"),
+            ("mqtt_tpu_retained_messages", "retained"),
+            ("mqtt_tpu_inflight_messages", "inflight"),
+        ):
+            r.gauge(name, f"$SYS mirror of Info.{attr}", fn=lambda a=attr: getattr(info, a))
+        r.gauge(
+            "mqtt_tpu_uptime_seconds",
+            "Monotonic seconds since broker start (clock-step immune)",
+            fn=info.uptime_now,
+        )
+        r.gauge(
+            "mqtt_tpu_overload_state_code",
+            "Overload governor posture (0=normal 1=throttle 2=shed)",
+            fn=lambda: (
+                0 if self.overload is None else self.overload.gauges()["state_code"]
+            ),
+        )
+        r.gauge(
+            "mqtt_tpu_overload_pressure",
+            "Max normalized pressure across governor signals",
+            fn=lambda: 0.0 if self.overload is None else self.overload.pressure,
+        )
+        r.gauge(
+            "mqtt_tpu_stage_pending_depth",
+            "Publishes parked in the staging loop",
+            fn=lambda: 0 if self._stage is None else self._stage.pending_depth,
+        )
+        for name, field_ in (
+            ("mqtt_tpu_matcher_batches_total", "batches"),
+            ("mqtt_tpu_matcher_topics_total", "topics"),
+            ("mqtt_tpu_matcher_host_fallbacks_total", "host_fallbacks"),
+            ("mqtt_tpu_matcher_overflows_total", "overflows"),
+            ("mqtt_tpu_matcher_rebuilds_total", "rebuilds"),
+            ("mqtt_tpu_matcher_folds_total", "folds"),
+            ("mqtt_tpu_matcher_host_fast_total", "host_fast"),
+        ):
+            r.counter(
+                name,
+                f"MatcherStats.{field_} (0 when no device matcher)",
+                fn=lambda f=field_: (
+                    0
+                    if self.matcher is None
+                    else getattr(self.matcher.stats, f, 0)
+                ),
+            )
+
+    def _overload_transition(self, old: str, new: str) -> None:
+        """Governor transition observer: entering SHED dumps the flight
+        recorder — the storm arrives with a stage-level trace attached."""
+        from .overload import SHED
+
+        if new == SHED:
+            extra = {"from": old, "to": new}
+            try:
+                extra["gauges"] = self.overload.gauges()
+            except Exception:  # pragma: no cover - diagnostics only
+                pass
+            self.telemetry.trigger_dump("overload_shed", extra)
 
     # -- overload control plane (mqtt_tpu.overload) ------------------------
 
@@ -1199,6 +1356,13 @@ class Server:
             )
             return
 
+        # telemetry stage clock (attached by the read loop on sampled
+        # publishes): everything from decode's end to here — validation,
+        # quota, alias resolution, the overload admission verdict
+        clock = getattr(pk, "_tclock", None)
+        if clock is not None:
+            clock.stamp("admission")
+
         try:
             pk = self.hooks.on_publish(cl, pk)
         except Code as e:
@@ -1220,6 +1384,7 @@ class Server:
             if self._stage is not None and not cl.net.inline:
                 return self._staged_fan_out(cl, pk)
             self.publish_to_subscribers(pk)
+            self._finish_publish_clock(pk)
             self.hooks.on_published(cl, pk)
             return None
 
@@ -1247,8 +1412,21 @@ class Server:
         if self._stage is not None and not cl.net.inline:
             return self._staged_fan_out(cl, pk)
         self.publish_to_subscribers(pk)
+        self._finish_publish_clock(pk)
         self.hooks.on_published(cl, pk)
         return None
+
+    def _finish_publish_clock(self, pk: Packet) -> None:
+        """Close out a sampled publish's stage clock after fan-out: the
+        final stamp is the fanout write leg, then the record lands in
+        the per-stage histograms + flight-recorder ring."""
+        clock = getattr(pk, "_tclock", None)
+        if clock is not None:
+            pk._tclock = None  # a clock observes exactly once
+            clock.stamp("fanout")
+            self.telemetry.observe_publish(
+                clock, pk.topic_name, pk.fixed_header.qos
+            )
 
     async def _staged_fan_out(self, cl: Client, pk: Packet) -> None:
         """Fan out one publish through the staging loop: the device match
@@ -1256,10 +1434,13 @@ class Server:
         own result (SURVEY.md §7 stage 4; seam: server.go:984-1021)."""
         if not pk.ignore:
             self._stamp_publish_expiry(pk)
-            subscribers = await self._stage.submit(pk.topic_name)
+            subscribers = await self._stage.submit(
+                pk.topic_name, getattr(pk, "_tclock", None)
+            )
             self._fan_out(pk, subscribers)
             if self._cluster is not None:
                 self._cluster.forward_packet(pk)
+            self._finish_publish_clock(pk)
         self.hooks.on_published(cl, pk)
 
     def retain_message(self, cl: Client, pk: Packet) -> None:
@@ -1351,6 +1532,17 @@ class Server:
             and not (ids and any(v > 0 for v in ids.values()))
         )
 
+    def _stamp_outbound(self, tcl: Client) -> None:
+        """Sampled outbound queue-wait accounting: every successful
+        enqueue bumps the client's sequence; 1-in-N also records the
+        enqueue time, and the write loop (clients._write_loop) matches
+        the sequence on dequeue to observe the wait."""
+        st = tcl.state
+        st.out_seq += 1
+        tele = self.telemetry
+        if tele is not None and tele.sample_outbound():
+            st.out_stamps.append((st.out_seq, time.perf_counter()))
+
     def _enqueue_frame(self, tcl: Client, data: bytes, pk_source) -> bool:
         """Queue a pre-encoded frame on a target's bounded outbound queue;
         False = dropped (queue full) with the shared drop accounting.
@@ -1359,6 +1551,7 @@ class Server:
             tcl.state.outbound.put_nowait(data)
             tcl.state.outbound_qty += 1
             tcl.state.outbound_full_since = None
+            self._stamp_outbound(tcl)
             return True
         except asyncio.QueueFull:
             if tcl.state.outbound_full_since is None:
@@ -1415,6 +1608,16 @@ class Server:
         if plan is None:
             return False
 
+        # telemetry stage clock for the passthrough leg: its "decode"
+        # stage is near-zero BY DESIGN (the whole point of the fast path
+        # is skipping packet materialization) — sampled records make that
+        # visible next to the decode path's real cost
+        clock = None
+        if self.telemetry is not None:
+            clock = self.telemetry.publish_clock()
+            if clock is not None:
+                clock.stamp("decode")
+
         self.info.packets_received += 1
         self.info.messages_received += 1
         if self.overload is not None and not self.overload.admit(cl):
@@ -1424,6 +1627,8 @@ class Server:
             return True
         if not self.hooks.on_acl_check(cl, topic, True):
             return True  # QoS0 deny is a silent drop (server.go:879-881)
+        if clock is not None:
+            clock.stamp("admission")
 
         self._fast_fan_frame(plan, topic, frame, body_offset, cl.id)
         if self._cluster is not None:
@@ -1431,6 +1636,9 @@ class Server:
             # matching subscribers (mqtt_tpu.cluster); write ACL was
             # enforced above, peers apply per-target read ACL
             self._cluster.forward_frame(topic, frame, cl.id)
+        if clock is not None:
+            clock.stamp("fanout")
+            self.telemetry.observe_publish(clock, topic, 0)
         return True
 
     def _plan_for_topic(self, topic: str):
@@ -1654,6 +1862,7 @@ class Server:
             cl.state.outbound.put_nowait(out)
             cl.state.outbound_qty += 1
             cl.state.outbound_full_since = None
+            self._stamp_outbound(cl)
         except asyncio.QueueFull:
             if cl.state.outbound_full_since is None:
                 # slow-consumer eviction clock (overload SHED posture)
@@ -1920,7 +2129,9 @@ class Server:
         self.info.memory_alloc = rss_bytes()
         self.info.threads = threading.active_count()
         self.info.time = now
-        self.info.uptime = now - self.info.started
+        # monotonic anchor, not `now - started`: a wall-clock step (NTP,
+        # suspend) must not bend $SYS/broker/uptime (system.Info)
+        self.info.uptime = self.info.uptime_now()
         self.info.clients_total = len(self.clients)
         self.info.clients_disconnected = self.info.clients_total - self.info.clients_connected
 
@@ -1980,6 +2191,12 @@ class Server:
                 topics[
                     SYS_PREFIX + "/broker/overload/stage_admission_fallbacks"
                 ] = str(st.admission_fallbacks)
+        if self.telemetry is not None:
+            # telemetry-plane observability (mqtt_tpu.telemetry): stage
+            # histogram percentiles, batch occupancy, fallback classes,
+            # queue-wait, flight-recorder state
+            for key, val in self.telemetry.sys_tree().items():
+                topics[SYS_PREFIX + "/broker/telemetry/" + key] = str(val)
         if self._cluster is not None:
             # worker-mesh observability (mqtt_tpu.cluster)
             c = self._cluster

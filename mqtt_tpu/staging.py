@@ -63,9 +63,15 @@ class MatchStage:
         latency_budget_s: Optional[float] = 0.25,
         min_batch: int = 64,
         max_pending: int = 8192,
+        telemetry=None,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
+        # telemetry plane (mqtt_tpu.telemetry.Telemetry) or None: batch
+        # service-time + fill-ratio histograms, fallback-class counters,
+        # and the per-publish stage clock's staging_wait / device_batch
+        # stamps all flow through it
+        self.telemetry = telemetry
         self.window_s = window_s  # the MAXIMUM accumulation window
         self.max_batch = max_batch
         self.max_inflight = max_inflight
@@ -81,7 +87,8 @@ class MatchStage:
         self.max_pending = max(1, max_pending)
         self.admission_fallbacks = 0
         self.peak_pending = 0
-        self._pending: list[tuple[str, asyncio.Future]] = []
+        # parked publishes: (topic, future, stage clock or None)
+        self._pending: list[tuple] = []
         self._wake: Optional[asyncio.Event] = None
         self._queue: Optional[asyncio.Queue] = None
         self._tasks: list[asyncio.Task] = []
@@ -164,17 +171,19 @@ class MatchStage:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
-        self._fallback_all(self._pending)
+        self._fallback_all(self._pending, klass="stop")
         self._pending = []
         if self._queue is not None:
             while not self._queue.empty():
-                _resolver, futs, topics = self._queue.get_nowait()
-                self._fallback_all(list(zip(topics, futs)))
+                _resolver, futs, topics, _clocks = self._queue.get_nowait()
+                self._fallback_all(list(zip(topics, futs)), klass="stop")
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, topic: str) -> "asyncio.Future[Subscribers]":
+    def submit(self, topic: str, clock=None) -> "asyncio.Future[Subscribers]":
         """Park one publish; the future resolves with its Subscribers.
+        ``clock`` is an optional sampled stage clock (mqtt_tpu.telemetry)
+        stamped at batch issue (staging_wait) and resolve (device_batch).
 
         Admission is bounded: once ``max_pending`` publishes are parked,
         or the pipeline's projected wait already exceeds the deadline
@@ -187,9 +196,11 @@ class MatchStage:
             return fut
         if len(self._pending) >= self.max_pending or self._past_deadline():
             self.admission_fallbacks += 1
+            if self.telemetry is not None:
+                self.telemetry.note_fallback("admission")
             fut.set_result(self.host_fallback(topic))
             return fut
-        self._pending.append((topic, fut))
+        self._pending.append((topic, fut, clock))
         if len(self._pending) > self.peak_pending:
             self.peak_pending = len(self._pending)
         self._wake.set()
@@ -256,29 +267,33 @@ class MatchStage:
             # during accumulation) is dead weight: drop it here so the
             # device never matches for it and no resolver path trips on
             # an already-cancelled future
-            batch = [(t, f) for t, f in batch if not f.cancelled()]
+            batch = [(t, f, c) for t, f, c in batch if not f.cancelled()]
             if not batch:
                 continue
-            topics = [t for t, _ in batch]
-            futs = [f for _, f in batch]
+            topics = [t for t, _, _ in batch]
+            futs = [f for _, f, _ in batch]
+            clocks = [c for _, _, c in batch]
+            for c in clocks:
+                if c is not None:  # end of the accumulation/park wait
+                    c.stamp("staging_wait")
             try:
                 resolver = self.matcher.match_topics_async(topics)
             except Exception:
                 _log.exception("stage issue failed; host fallback for batch")
-                self._fallback_all(batch)
+                self._fallback_all(batch, klass="issue_error")
                 continue
             try:
-                await self._queue.put((resolver, futs, topics))
+                await self._queue.put((resolver, futs, topics, clocks))
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch in hand (in neither
                 # _pending nor the queue): resolve it before going down
-                self._fallback_all(batch)
+                self._fallback_all(batch, klass="stop")
                 raise
 
     async def _drain_loop(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            resolver, futs, topics = await self._queue.get()
+            resolver, futs, topics, clocks = await self._queue.get()
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
                 # sampled at resolve time: batches still queued waited for
@@ -286,25 +301,38 @@ class MatchStage:
                 depth = self._queue.qsize() + 1
                 t0 = loop.time()
                 results = await loop.run_in_executor(None, resolver)
-                self._observe_service(loop.time() - t0, len(topics), depth)
+                dt = loop.time() - t0
+                self._observe_service(dt, len(topics), depth)
+                if self.telemetry is not None:
+                    self.telemetry.observe_batch(dt, len(topics), self._batch_cap)
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch already popped: it is
                 # invisible to stop()'s queue drain, so resolve it here
-                self._fallback_all(list(zip(topics, futs)))
+                self._fallback_all(list(zip(topics, futs)), klass="stop")
                 raise
             except Exception:
                 _log.exception("stage resolve failed; host fallback for batch")
-                self._fallback_all(list(zip(topics, futs)))
+                self._fallback_all(list(zip(topics, futs)), klass="resolve_error")
                 continue
-            for fut, subs in zip(futs, results):
+            for fut, subs, ck in zip(futs, results, clocks):
+                if ck is not None:  # issue -> resolved (device round trip)
+                    ck.stamp("device_batch")
                 if not fut.done():
                     fut.set_result(subs)
 
-    def _fallback_all(self, items) -> None:
-        for topic, fut in items:
+    def _fallback_all(self, items, klass: str = "stop") -> None:
+        """Resolve parked items via the host walk. ``items`` yield
+        ``(topic, future, ...)`` — both the 3-tuple _pending form and the
+        2-tuple ``zip(topics, futs)`` form are accepted."""
+        n = 0
+        for item in items:
+            topic, fut = item[0], item[1]
             if fut.done():
                 continue
+            n += 1
             try:
                 fut.set_result(self.host_fallback(topic))
             except Exception as e:  # pragma: no cover - host walk is total
                 fut.set_exception(e)
+        if n and self.telemetry is not None:
+            self.telemetry.note_fallback(klass, n)

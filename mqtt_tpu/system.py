@@ -8,6 +8,7 @@ single thread and the device feeder only reads.
 
 from __future__ import annotations
 
+import time
 from dataclasses import asdict, dataclass, replace
 
 
@@ -37,9 +38,26 @@ class Info:
     memory_alloc: int = 0
     threads: int = 0
 
+    def __post_init__(self) -> None:
+        # uptime anchor on the MONOTONIC clock: `started` is a wall-clock
+        # unix ts, so `now - started` drifts when the wall clock steps
+        # (NTP slew, manual set, suspend). Not a dataclass field — stores
+        # and asdict() must not persist a monotonic reading, which is
+        # meaningless across processes.
+        self._mono_started = time.monotonic()
+
+    def uptime_now(self) -> int:
+        """Seconds since this Info was created, immune to wall-clock
+        steps ($SYS/broker/uptime's source of truth)."""
+        return int(time.monotonic() - self._mono_started)
+
     def clone(self) -> "Info":
         """Point-in-time copy (system.go:37-59)."""
-        return replace(self)
+        c = replace(self)
+        c._mono_started = self._mono_started  # keep the uptime anchor
+        return c
 
     def as_dict(self) -> dict:
-        return asdict(self)
+        d = asdict(self)
+        d["uptime"] = self.uptime_now()
+        return d

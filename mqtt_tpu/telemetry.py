@@ -1,0 +1,667 @@
+"""Unified telemetry plane: low-overhead metrics registry, per-publish
+stage clock, Prometheus text exposition, and a trigger-dumped flight
+recorder.
+
+The $SYS gauges from the overload governor (mqtt_tpu.overload) and the
+matcher breaker (mqtt_tpu.resilience) are point-in-time counters; broker
+benchmarking shows the differentiator under load is TAIL latency, not
+throughput (PAPERS: "Benchmarking Message Brokers for IoT Edge
+Computing"), and the broker itself is the right place for in-band
+introspection (MQTT+). This module therefore instruments the publish
+pipeline itself:
+
+- ``MetricsRegistry``: monotonic counters, gauges (stored or
+  callback-sampled at scrape time), and fixed-bucket log-scale
+  ``Histogram``s with p50/p95/p99 extraction. Families carry Prometheus
+  ``# HELP``/``# TYPE`` metadata and labeled children;
+  ``exposition()`` renders the text format served at ``GET /metrics``
+  (listeners/http.py) and ``sys_tree()`` renders the retained
+  ``$SYS/broker/telemetry/#`` map (server.publish_sys_topics).
+- ``StageClock``: one sampled publish's trip through the pipeline —
+  decode -> admission -> staging wait -> device batch -> fanout write —
+  stamped at each boundary and aggregated per-stage into histograms.
+  Sampling is 1-in-N (``Options.telemetry_sample``, default 64): the
+  unsampled hot path pays one integer increment and one modulo.
+- ``FlightRecorder``: a bounded ring of recent stage-clock records that
+  auto-dumps a JSON snapshot to disk when the overload governor enters
+  SHED or the matcher breaker trips — the first storm in production
+  comes with a trace, not a shrug. Dumps are rate-limited.
+
+All knobs live on ``Options`` (``telemetry_*``) and the config file; the
+plane is ON by default.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import re
+import tempfile
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Callable, Optional
+
+_log = logging.getLogger("mqtt_tpu.telemetry")
+
+# the publish pipeline's stage names, in pipeline order (the flight
+# recorder and the bench telemetry block both key on these)
+PUBLISH_STAGES = (
+    "decode",
+    "admission",
+    "staging_wait",
+    "device_batch",
+    "fanout",
+)
+
+
+def _fmt(v) -> str:
+    """A Prometheus-compatible number: integral floats render without
+    the trailing ``.0`` so counters read as counts."""
+    if isinstance(v, float):
+        if v == math.inf:
+            return "+Inf"
+        if v != v:  # NaN
+            return "NaN"
+        if v == int(v) and abs(v) < 1e15:
+            return str(int(v))
+        return repr(v)
+    return str(v)
+
+
+def escape_label_value(v: str) -> str:
+    """Prometheus label-value escaping: backslash, double-quote, and
+    newline must be escaped inside the quoted value."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def escape_help(v: str) -> str:
+    """# HELP escaping: backslash and newline only (quotes are legal)."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+class Histogram:
+    """A fixed-bucket log-scale histogram.
+
+    Bucket upper bounds are ``base * growth**i`` (defaults: 1us growing
+    x2 for 36 buckets, topping out around 34s) plus a +Inf overflow
+    bucket — Prometheus ``le`` semantics (a value equal to a boundary
+    counts in that bucket). Log-scale keeps relative error bounded at
+    every magnitude, which is what latency percentiles need.
+
+    Single-writer per instance (asyncio data plane or one worker
+    thread); cross-thread aggregation goes through ``merge`` — each
+    thread owns a shard and the scrape merges them.
+    """
+
+    __slots__ = ("bounds", "counts", "count", "sum")
+
+    def __init__(
+        self,
+        base: float = 1e-6,
+        growth: float = 2.0,
+        n_buckets: int = 36,
+        bounds: Optional[tuple] = None,
+    ) -> None:
+        if bounds is not None:
+            self.bounds = tuple(float(b) for b in bounds)
+        else:
+            self.bounds = tuple(base * growth**i for i in range(n_buckets))
+        self.counts = [0] * (len(self.bounds) + 1)  # [-1] is +Inf
+        self.count = 0
+        self.sum = 0.0
+
+    def observe(self, v: float) -> None:
+        # bisect_left(bounds, v): first bound >= v — exactly `le`
+        self.counts[bisect_left(self.bounds, v)] += 1
+        self.count += 1
+        self.sum += v
+
+    def percentile(self, q: float) -> float:
+        """The q-quantile's bucket upper bound (0.0 when empty; the
+        largest finite bound for observations past it). Rank uses the
+        ceiling so a single observation answers every quantile with its
+        own bucket."""
+        if self.count == 0:
+            return 0.0
+        rank = max(1, math.ceil(q * self.count))
+        acc = 0
+        for i, c in enumerate(self.counts):
+            acc += c
+            if acc >= rank:
+                return self.bounds[i] if i < len(self.bounds) else self.bounds[-1]
+        return self.bounds[-1]  # pragma: no cover - rank <= count
+
+    def merge(self, other: "Histogram") -> None:
+        """Fold another shard (identical bucket layout) into this one."""
+        if other.bounds != self.bounds:
+            raise ValueError("histogram bucket layouts differ; cannot merge")
+        for i, c in enumerate(other.counts):
+            self.counts[i] += c
+        self.count += other.count
+        self.sum += other.sum
+
+    def summary(self) -> dict:
+        return {
+            "count": self.count,
+            "sum": round(self.sum, 6),
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+
+class Counter:
+    """A monotonic counter (single-writer; the GIL makes ``+=`` on the
+    slot safe enough for telemetry from helper threads). Like Gauge it
+    may instead be backed by a scrape-time callback — for mirroring
+    counters another layer already maintains (system.Info,
+    MatcherStats) without a second bookkeeping path, while still
+    exposing honest ``# TYPE counter`` metadata for the ``_total``
+    series."""
+
+    __slots__ = ("_value", "fn")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None) -> None:
+        self._value = 0
+        self.fn = fn
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    @property
+    def value(self):
+        if self.fn is not None:
+            try:
+                return self.fn()
+            except Exception:  # a scrape must not take the broker down
+                _log.exception("counter callback failed")
+                return 0
+        return self._value
+
+
+class Gauge:
+    """A point-in-time value: either ``set()`` by the owner or backed by
+    a zero-arg callable sampled at scrape time."""
+
+    __slots__ = ("_value", "fn")
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None) -> None:
+        self._value = 0.0
+        self.fn = fn
+
+    def set(self, v: float) -> None:
+        self._value = v
+
+    def value(self):
+        if self.fn is not None:
+            try:
+                return self.fn()
+            except Exception:  # a scrape must not take the broker down
+                _log.exception("gauge callback failed")
+                return 0.0
+        return self._value
+
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+
+class _Family:
+    __slots__ = ("name", "mtype", "help", "children", "maker")
+
+    def __init__(self, name: str, mtype: str, help_: str, maker) -> None:
+        self.name = name
+        self.mtype = mtype
+        self.help = help_
+        self.children: dict[tuple, object] = {}
+        self.maker = maker
+
+
+class MetricsRegistry:
+    """Named metric families with labeled children and two renderers:
+    Prometheus text exposition and the flat $SYS topic map."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: dict[str, _Family] = {}
+
+    def _child(self, name: str, mtype: str, help_: str, labels: dict, maker):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = self._families[name] = _Family(name, mtype, help_, maker)
+            elif fam.mtype != mtype:
+                raise ValueError(
+                    f"metric {name!r} re-registered as {mtype} (was {fam.mtype})"
+                )
+            child = fam.children.get(key)
+            if child is None:
+                child = fam.children[key] = maker()
+            return child
+
+    def counter(
+        self, name: str, help: str = "", fn: Optional[Callable] = None, **labels
+    ) -> Counter:
+        c = self._child(name, "counter", help, labels, Counter)
+        if fn is not None:
+            c.fn = fn
+        return c
+
+    def gauge(
+        self, name: str, help: str = "", fn: Optional[Callable] = None, **labels
+    ) -> Gauge:
+        g = self._child(name, "gauge", help, labels, Gauge)
+        if fn is not None:
+            g.fn = fn
+        return g
+
+    def histogram(
+        self, name: str, help: str = "", bounds: Optional[tuple] = None, **labels
+    ) -> Histogram:
+        return self._child(
+            name, "histogram", help, labels, lambda: Histogram(bounds=bounds)
+        )
+
+    # -- rendering ---------------------------------------------------------
+
+    @staticmethod
+    def _labels_str(key: tuple, extra: str = "") -> str:
+        parts = [f'{k}="{escape_label_value(v)}"' for k, v in key]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def exposition(self) -> str:
+        """The Prometheus text exposition format (version 0.0.4) served
+        at ``GET /metrics``."""
+        with self._lock:
+            families = sorted(self._families.items())
+        out: list[str] = []
+        for name, fam in families:
+            if fam.help:
+                out.append(f"# HELP {name} {escape_help(fam.help)}")
+            out.append(f"# TYPE {name} {fam.mtype}")
+            for key, child in sorted(fam.children.items()):
+                if isinstance(child, Counter):
+                    out.append(f"{name}{self._labels_str(key)} {_fmt(child.value)}")
+                elif isinstance(child, Gauge):
+                    out.append(
+                        f"{name}{self._labels_str(key)} {_fmt(child.value())}"
+                    )
+                else:  # Histogram
+                    acc = 0
+                    for i, bound in enumerate(child.bounds):
+                        acc += child.counts[i]
+                        le = self._labels_str(key, f'le="{_fmt(float(bound))}"')
+                        out.append(f"{name}_bucket{le} {acc}")
+                    le = self._labels_str(key, 'le="+Inf"')
+                    out.append(f"{name}_bucket{le} {_fmt(child.count)}")
+                    out.append(
+                        f"{name}_sum{self._labels_str(key)} {_fmt(child.sum)}"
+                    )
+                    out.append(
+                        f"{name}_count{self._labels_str(key)} {_fmt(child.count)}"
+                    )
+        return "\n".join(out) + "\n"
+
+    def sys_tree(self) -> dict:
+        """A flat ``topic-suffix -> value`` map for the retained
+        ``$SYS/broker/telemetry/#`` tree. ``*_seconds`` histograms
+        surface their percentile summary in milliseconds (readability —
+        the raw seconds live on /metrics); dimensionless histograms
+        (fill ratios) surface the raw quantile values."""
+        with self._lock:
+            families = sorted(self._families.items())
+        out: dict[str, object] = {}
+        for name, fam in families:
+            short = name.removeprefix("mqtt_tpu_")
+            in_seconds = name.endswith("_seconds")
+            for key, child in sorted(fam.children.items()):
+                suffix = "/".join(v for _, v in key)
+                base = f"{short}/{suffix}" if suffix else short
+                if isinstance(child, Counter):
+                    out[base] = child.value
+                elif isinstance(child, Gauge):
+                    v = child.value()
+                    out[base] = round(v, 6) if isinstance(v, float) else v
+                else:
+                    s = child.summary()
+                    out[f"{base}/count"] = s["count"]
+                    for q in ("p50", "p95", "p99"):
+                        if in_seconds:
+                            out[f"{base}/{q}_ms"] = round(s[q] * 1e3, 3)
+                        else:
+                            out[f"{base}/{q}"] = round(s[q], 6)
+        return out
+
+
+class StageClock:
+    """One sampled publish's trip through the pipeline: ``stamp(stage)``
+    records the time since the previous stamp as that stage's duration.
+    Cheap by construction — two perf_counter calls and a list append per
+    stage, and only 1-in-N publishes carry one at all."""
+
+    __slots__ = ("t0", "last", "stages")
+
+    def __init__(self) -> None:
+        self.t0 = self.last = time.perf_counter()
+        self.stages: list[tuple[str, float]] = []
+
+    def stamp(self, stage: str) -> None:
+        now = time.perf_counter()
+        self.stages.append((stage, now - self.last))
+        self.last = now
+
+    def total(self) -> float:
+        return self.last - self.t0
+
+
+class FlightRecorder:
+    """A bounded ring of recent stage-clock records, JSON-dumped to disk
+    when a degradation trigger fires (overload SHED, breaker trip).
+    Dumps are rate-limited so a flapping posture cannot fill the disk."""
+
+    def __init__(
+        self,
+        size: int = 256,
+        dump_dir: str = "",
+        min_interval_s: float = 30.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.ring: deque = deque(maxlen=max(1, size))
+        # "" = a private mkdtemp created lazily at the first dump: a FIXED
+        # path in the shared tempdir would let any local user pre-create
+        # the directory (symlink-clobber the predictable filenames) and
+        # read the dumped topic names; mkdtemp is 0700 and unpredictable,
+        # and the dump log line carries the chosen path
+        self.dump_dir = dump_dir
+        self.min_interval_s = min_interval_s
+        self.clock = clock
+        self.dumps = 0
+        self.dumps_suppressed = 0
+        self._last_dump = float("-inf")
+        self._lock = threading.Lock()
+        self._writers: list[threading.Thread] = []
+
+    def add(self, record: dict) -> None:
+        # under the lock: a cross-thread dump() iterating the ring while
+        # the event loop appends would raise "deque mutated during
+        # iteration" and silently lose the trigger's trace. The critical
+        # section is one append — dump()'s file IO runs OUTSIDE the lock
+        with self._lock:
+            self.ring.append(record)
+
+    def dump_async(self, reason: str, extra: Optional[dict] = None) -> None:
+        """Fire-and-forget dump on a daemon thread: degradation triggers
+        run under the breaker lock / on the event loop's hot path, where
+        synchronous disk IO would stall the data plane at exactly peak
+        load. Rate-limiting still applies inside dump()."""
+        t = threading.Thread(
+            target=self.dump,
+            args=(reason, extra),
+            daemon=True,
+            name="mqtt-tpu-flight-dump",
+        )
+        with self._lock:
+            # track EVERY live writer, not just the newest: a rate-limited
+            # no-op thread must not mask an earlier write still on disk
+            self._writers = [w for w in self._writers if w.is_alive()]
+            self._writers.append(t)
+        t.start()
+
+    def join_writer(self, timeout: float = 5.0) -> None:
+        """Wait for all in-flight async dumps (tests, orderly shutdown)."""
+        with self._lock:
+            writers = list(self._writers)
+        for t in writers:
+            t.join(timeout)
+
+    def dump(self, reason: str, extra: Optional[dict] = None) -> Optional[str]:
+        """Write the ring (plus trigger context) to one JSON file;
+        returns the path, or None when rate-limited or the write failed.
+        Thread-safe: triggers fire from the event loop, the breaker's
+        probe thread, and sweep paths."""
+        with self._lock:
+            now = self.clock()
+            if now - self._last_dump < self.min_interval_s:
+                self.dumps_suppressed += 1
+                return None
+            self._last_dump = now
+            records = list(self.ring)
+            if not self.dump_dir:
+                # first dump: a private 0700 dir (see __init__'s note)
+                self.dump_dir = tempfile.mkdtemp(prefix="mqtt_tpu_flight_")
+        snapshot = {
+            "reason": reason,
+            "time_unix": int(time.time()),
+            "records": records,
+            "context": extra or {},
+        }
+        try:
+            os.makedirs(self.dump_dir, exist_ok=True)
+            safe = re.sub(r"[^a-zA-Z0-9_.-]", "_", reason)
+            path = os.path.join(
+                self.dump_dir,
+                f"flight_{int(time.time())}_{safe}.json",
+            )
+            with open(path, "w") as f:
+                json.dump(snapshot, f, indent=1)
+        except OSError:
+            _log.exception("flight-recorder dump failed (dir=%s)", self.dump_dir)
+            return None
+        self.dumps += 1
+        _log.warning(
+            "flight recorder dumped %d records to %s (reason=%s)",
+            len(records),
+            path,
+            reason,
+        )
+        return path
+
+
+# batch fill ratio buckets: linear deciles (a ratio is not log-shaped)
+FILL_BOUNDS = tuple(round(0.1 * i, 1) for i in range(1, 11))
+
+
+class Telemetry:
+    """The broker's telemetry facade: owns the registry, the per-stage
+    publish histograms, the flight recorder, and the sampling counters.
+    Every instrumented layer (server, staging, clients, matcher,
+    cluster) talks to this object; every exposition surface (/metrics,
+    $SYS, BENCH json) renders from it."""
+
+    def __init__(
+        self,
+        sample: int = 64,
+        ring: int = 256,
+        dump_dir: str = "",
+        dump_min_interval_s: float = 30.0,
+    ) -> None:
+        self.registry = MetricsRegistry()
+        self.sample = max(0, int(sample))  # 0 disables stage sampling
+        self._n = 0  # publish counter for 1-in-N sampling
+        self._out_n = 0  # outbound-enqueue counter (same 1-in-N rate)
+        self.recorder = FlightRecorder(
+            size=ring, dump_dir=dump_dir, min_interval_s=dump_min_interval_s
+        )
+        r = self.registry
+        self.stage_hist = {
+            s: r.histogram(
+                "mqtt_tpu_publish_stage_seconds",
+                "Sampled per-publish latency by pipeline stage",
+                stage=s,
+            )
+            for s in PUBLISH_STAGES
+        }
+        self.sampled_publishes = r.counter(
+            "mqtt_tpu_publish_sampled_total",
+            "Publishes that carried a stage clock (1-in-N sampling)",
+        )
+        self.batch_service = r.histogram(
+            "mqtt_tpu_stage_batch_service_seconds",
+            "Device match-batch resolve wall time (every batch)",
+        )
+        self.batch_fill = r.histogram(
+            "mqtt_tpu_stage_batch_fill_ratio",
+            "Match-batch occupancy against the adaptive batch cap",
+            bounds=FILL_BOUNDS,
+        )
+        self.outbound_wait = r.histogram(
+            "mqtt_tpu_outbound_queue_wait_seconds",
+            "Sampled wait of an outbound publish in a client queue",
+        )
+        self.fallback = {
+            k: r.counter(
+                "mqtt_tpu_stage_fallback_total",
+                "Publishes resolved by the host walk instead of the "
+                "device batch, by cause",
+                **{"class": k},
+            )
+            for k in ("admission", "issue_error", "resolve_error", "stop")
+        }
+        self.rebuild_hist = r.histogram(
+            "mqtt_tpu_matcher_rebuild_seconds",
+            "Device index compile/rebuild/fold wall time",
+        )
+        r.counter(
+            "mqtt_tpu_flight_dumps_total",
+            "Flight-recorder dumps written",
+            fn=lambda: self.recorder.dumps,
+        )
+
+    # -- publish stage sampling --------------------------------------------
+
+    def publish_clock(self) -> Optional[StageClock]:
+        """A StageClock for 1-in-N publishes, None for the rest. The
+        unsampled path is one increment + one modulo."""
+        if self.sample == 0:
+            return None
+        self._n += 1
+        if self._n % self.sample:
+            return None
+        return StageClock()
+
+    def observe_publish(self, clock: StageClock, topic: str = "", qos: int = 0) -> None:
+        """Fold one finished stage clock into the per-stage histograms
+        and the flight-recorder ring."""
+        hist = self.stage_hist
+        for stage, dt in clock.stages:
+            h = hist.get(stage)
+            if h is not None:
+                h.observe(dt)
+        self.sampled_publishes.inc()
+        self.recorder.add(
+            {
+                "t": round(time.time(), 3),
+                "topic": topic,
+                "qos": qos,
+                "total_ms": round(clock.total() * 1e3, 3),
+                "stages_ms": {
+                    s: round(dt * 1e3, 4) for s, dt in clock.stages
+                },
+            }
+        )
+
+    def sample_outbound(self) -> bool:
+        """1-in-N gate for outbound queue-wait stamps (same rate as the
+        stage clock)."""
+        if self.sample == 0:
+            return False
+        self._out_n += 1
+        return self._out_n % self.sample == 0
+
+    # -- batch-level observations (staging loop) ---------------------------
+
+    def observe_batch(self, service_s: float, n: int, cap: int) -> None:
+        self.batch_service.observe(service_s)
+        if cap > 0:
+            self.batch_fill.observe(min(1.0, n / cap))
+
+    def note_fallback(self, klass: str, n: int = 1) -> None:
+        c = self.fallback.get(klass)
+        if c is not None:
+            c.inc(n)
+
+    # -- degradation triggers ----------------------------------------------
+
+    def trigger_dump(self, reason: str, extra: Optional[dict] = None) -> None:
+        """Dump the flight recorder WITHOUT blocking the caller: triggers
+        fire under the breaker lock and on the governor's evaluate path
+        (both on the data plane), so the file IO moves to a daemon
+        thread. Use ``recorder.dump`` directly for a synchronous dump."""
+        self.recorder.dump_async(reason, extra)
+
+    # -- rendering ---------------------------------------------------------
+
+    def exposition(self) -> str:
+        return self.registry.exposition()
+
+    def sys_tree(self) -> dict:
+        out = self.registry.sys_tree()
+        out["flight/ring_depth"] = len(self.recorder.ring)
+        out["flight/dumps"] = self.recorder.dumps
+        out["flight/dumps_suppressed"] = self.recorder.dumps_suppressed
+        return out
+
+    def bench_block(self) -> dict:
+        """The BENCH-json telemetry block: per-stage p50/p99, batch
+        occupancy, and the host-fallback breakdown — so future PRs can
+        diff stage-level regressions, not just end-to-end rate."""
+        stages = {}
+        for s, h in self.stage_hist.items():
+            if h.count:
+                stages[s] = {
+                    "count": h.count,
+                    "p50_ms": round(h.percentile(0.5) * 1e3, 3),
+                    "p99_ms": round(h.percentile(0.99) * 1e3, 3),
+                }
+        fill = self.batch_fill.summary()
+        return {
+            "stages": stages,
+            "batch_service": {
+                "count": self.batch_service.count,
+                "p50_ms": round(self.batch_service.percentile(0.5) * 1e3, 3),
+                "p99_ms": round(self.batch_service.percentile(0.99) * 1e3, 3),
+            },
+            "batch_fill": {"count": fill["count"], "p50": fill["p50"], "p99": fill["p99"]},
+            "fallbacks": {k: c.value for k, c in self.fallback.items()},
+            "flight_dumps": self.recorder.dumps,
+        }
+
+
+def check_exposition(text: str) -> int:
+    """A minimal pure-Python Prometheus text-format checker (CI's scrape
+    gate and the test suite's oracle): every non-comment line must be a
+    well-formed sample, every # TYPE must name a known type, and at
+    least one sample must exist. Returns the sample count."""
+    sample_re = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*="
+        r'"(\\.|[^"\\])*"(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*)?\})?'
+        r" (NaN|[+-]?Inf|[+-]?[0-9.eE+-]+)( [0-9]+)?$"
+    )
+    samples = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in (
+                "counter", "gauge", "histogram", "summary", "untyped",
+            ):
+                raise ValueError(f"line {i}: bad # TYPE: {line!r}")
+        elif line.startswith("#"):
+            if not line.startswith("# HELP "):
+                raise ValueError(f"line {i}: unknown comment: {line!r}")
+        elif sample_re.match(line):
+            samples += 1
+        else:
+            raise ValueError(f"line {i}: malformed sample: {line!r}")
+    if samples == 0:
+        raise ValueError("no samples in exposition")
+    return samples

@@ -517,7 +517,13 @@ class TestSysTopics:
             assert "$SYS/broker/version" in topics
             assert "$SYS/broker/clients/connected" in topics
             assert "$SYS/broker/overload/state" in topics
-            base = {t for t in topics if not t.startswith("$SYS/broker/overload/")}
+            assert "$SYS/broker/telemetry/flight/ring_depth" in topics
+            base = {
+                t
+                for t in topics
+                if not t.startswith("$SYS/broker/overload/")
+                and not t.startswith("$SYS/broker/telemetry/")
+            }
             assert len(base) == 20
             await h.shutdown()
 
@@ -2682,7 +2688,10 @@ class TestMoreReferenceScenarios:
                     ticks.append(info.uptime)
 
             h.server.add_hook(TickWatch())
-            h.server.info.started -= 5  # pretend 5s of uptime
+            # pretend 5s of uptime: rewind the MONOTONIC anchor (uptime is
+            # clock-step immune now — rewinding wall-clock `started` would
+            # not move it, by design; see system.Info.uptime_now)
+            h.server.info._mono_started -= 5
             h.server.publish_sys_topics()
             assert ticks and ticks[0] >= 5
             msgs = {p.topic_name: p for p in h.server.topics.messages("$SYS/#")}
