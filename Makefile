@@ -1,13 +1,36 @@
 # Developer/CI entry points. `make verify` wraps the ROADMAP.md tier-1
-# command verbatim; `make chaos-smoke` runs the slow-marked chaos drills
-# (fault-injected matcher + mesh) that the default suite skips.
+# command verbatim (lint runs first — fast fail); `make chaos-smoke`
+# runs the slow-marked chaos drills (fault-injected matcher + mesh)
+# that the default suite skips; `make lint` is the static-analysis
+# bundle (brokerlint + mypy-if-installed + the C gate).
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test
+.PHONY: verify chaos-smoke test lint typecheck c-gate
 
-# the tier-1 gate: full non-slow suite on the CPU backend (ROADMAP.md)
-verify:
+# static analysis: the repo-specific concurrency/invariant lint pass
+# (tools/brokerlint, README "Static analysis"), the mypy gate over the
+# typed core modules (skipped with a notice when mypy is not installed —
+# CI always installs it), and the C analysis gate over mqtt_tpu/native/
+lint:
+	$(PY) -m tools.brokerlint mqtt_tpu
+	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
+	  $(PY) -m mypy --config-file mypy.ini; \
+	else echo "mypy not installed; skipping typecheck (CI runs it)"; fi
+	PY=$(PY) tools/c_gate.sh
+
+# hard-required mypy run (fails when mypy is absent)
+typecheck:
+	$(PY) -m mypy --config-file mypy.ini
+
+# gcc -fanalyzer (+ cppcheck when installed) over the native C sources
+c-gate:
+	PY=$(PY) tools/c_gate.sh
+
+# the tier-1 gate: full non-slow suite on the CPU backend (ROADMAP.md);
+# lint runs first so an invariant break fails in seconds, not minutes
+# (tests/test_lint.py also asserts a clean tree from inside the suite)
+verify: lint
 	set -o pipefail; rm -f /tmp/_t1.log; \
 	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \

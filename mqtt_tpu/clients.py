@@ -474,8 +474,9 @@ class Client:
         if self.net.writer is not None:
             try:
                 self.net.writer.close()
-            except Exception:
+            except Exception:  # brokerlint: ok=R4 teardown; the transport is already dead and close() has no one to report to
                 pass
+        # brokerlint: ok=R3 session-expiry bookkeeping is wall-clock (persists across restarts)
         self.state.disconnected = int(time.time())
 
     @property
@@ -552,7 +553,7 @@ class Client:
         if self.net.writer is None:
             return
         if pk.expiry > 0:
-            expiry = pk.expiry - int(time.time())
+            expiry = pk.expiry - int(time.time())  # brokerlint: ok=R3 message expiry is an absolute wall-clock stamp
             if expiry < 1:
                 expiry = 1
             pk.properties.message_expiry_interval = expiry  # [MQTT-3.3.2-6]

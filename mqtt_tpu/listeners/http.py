@@ -76,12 +76,12 @@ class _HttpListener(StreamListener):
                     + body
                 )
             await writer.drain()
-        except Exception:
+        except Exception:  # brokerlint: ok=R4 client hung up mid-response; nothing to serve and nothing to log per-scrape
             pass
         finally:
             try:
                 writer.close()
-            except Exception:
+            except Exception:  # brokerlint: ok=R4 teardown; the transport is already gone
                 pass
 
     def _authorized(self, request: bytes) -> bool:
@@ -169,13 +169,16 @@ class Dashboard(_HttpListener):
     # -- process recorder ---------------------------------------------------
 
     def _maybe_record(self) -> None:
-        now = time.time()
+        # interval gating is MONOTONIC (brokerlint R3): an NTP step must
+        # not stall or burst the recorder; only the record's own
+        # timestamp is wall-clock (operators correlate it with logs)
+        now = time.monotonic()
         if now - self._last_record < self.record_interval and self._records:
             return
         self._last_record = now
         self._records.append(
             {
-                "time": int(now),
+                "time": int(time.time()),  # brokerlint: ok=R3 record timestamp is wall-clock by design
                 "rss_bytes": rss_bytes(),
                 "cpu_seconds": round(cpu_seconds(), 3),
                 "threads": threading.active_count(),

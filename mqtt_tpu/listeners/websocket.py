@@ -67,7 +67,7 @@ class _WsWriter:
     def close(self) -> None:
         try:
             self._writer.write(_encode_frame(OP_CLOSE, b""))
-        except Exception:
+        except Exception:  # brokerlint: ok=R4 best-effort CLOSE frame; the close() below is the real teardown
             pass
         self._writer.close()
 

@@ -46,6 +46,14 @@
 #define Py_T_OBJECT_EX T_OBJECT_EX
 #endif
 
+/* 3.11+ managed-dict flag: on older CPython no type carries it, so 0 is
+ * the correct "flag never set" value — without this guard the module
+ * silently failed to COMPILE on 3.10 and every caller fell back to the
+ * slow Python materializer (caught by the C analysis gate, make c-gate) */
+#ifndef Py_TPFLAGS_MANAGED_DICT
+#define Py_TPFLAGS_MANAGED_DICT 0
+#endif
+
 /* interned attribute / key names (module-lifetime references) */
 static PyObject *s_merge, *s_filter, *s_identifier, *s_identifiers;
 static PyObject *s_subscriptions, *s_shared, *s_shared_selected;
@@ -424,7 +432,7 @@ new_result(PyObject *cls, ResLayout *L, PyObject **subscriptions,
  *             instance, or None where the row's overflow flag was set
  *             (the caller re-walks those topics on the host trie). */
 static PyObject *
-resolve_batch(PyObject *self, PyObject *args)
+resolve_batch(PyObject *Py_UNUSED(self), PyObject *args)
 {
     PyObject *packed_obj, *snaps, *subscribers_cls;
     Py_ssize_t n_topics, P;
@@ -525,7 +533,7 @@ fail:
  * without its seen-set — callers pass de-duplicated lists (ranges are
  * disjoint by construction). */
 static PyObject *
-expand_sids_list(PyObject *self, PyObject *args)
+expand_sids_list(PyObject *Py_UNUSED(self), PyObject *args)
 {
     PyObject *sids, *snaps, *subs_obj;
     long long window;
@@ -580,7 +588,7 @@ expand_sids_list(PyObject *self, PyObject *args)
  * the first-sighting copy; shared entries are referenced keyed on the
  * group filter; inline entries key on identifier. */
 static PyObject *
-expand_snap(PyObject *self, PyObject *args)
+expand_snap(PyObject *Py_UNUSED(self), PyObject *args)
 {
     PyObject *snap, *subscribers_cls;
     if (!PyArg_ParseTuple(args, "OO", &snap, &subscribers_cls))
@@ -655,6 +663,7 @@ static PyMethodDef methods[] = {
 static struct PyModuleDef moduledef = {
     PyModuleDef_HEAD_INIT, "mqtt_accel",
     "C materializer for device match results (see accelmod.c).", -1, methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC

@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 
@@ -35,18 +35,24 @@ from .flat import (
 )
 from .hashing import tokenize_topics
 
-_ACCEL_CACHE: list = []
+# write-once memo for the C materializer (immutable bindings, not a
+# mutable container singleton — brokerlint R8); a racing first resolve
+# is benign: native.accel() is itself memoized and returns one module
+_ACCEL_MEMO: Optional[object] = None
+_ACCEL_RESOLVED = False
 
 
 def _accel():
     """The C materializer module (native/accelmod.c) or None; resolved once
     and cached (the native loader itself is also memoized, this just skips
     the call overhead in the per-batch path)."""
-    if not _ACCEL_CACHE:
+    global _ACCEL_MEMO, _ACCEL_RESOLVED
+    if not _ACCEL_RESOLVED:
         from .. import native
 
-        _ACCEL_CACHE.append(native.accel())
-    return _ACCEL_CACHE[0]
+        _ACCEL_MEMO = native.accel()
+        _ACCEL_RESOLVED = True
+    return _ACCEL_MEMO
 
 
 def expand_sids(table: list, sids, subs: Subscribers, seen: Optional[set] = None) -> Subscribers:
@@ -138,7 +144,7 @@ class MatcherStats:
     host_fast: int = 0
     # optional per-rebuild duration observer (the telemetry plane's
     # compile/rebuild histogram — mqtt_tpu.telemetry); set by the server
-    rebuild_observer = None
+    rebuild_observer: Optional[Callable[[float], None]] = None
 
     def note_rebuild(self, dt: float) -> None:
         """Account one rebuild/fold wall time (and feed the observer)."""
@@ -147,7 +153,7 @@ class MatcherStats:
         if cb is not None:
             try:
                 cb(dt)
-            except Exception:  # pragma: no cover - telemetry must not wedge
+            except Exception:  # pragma: no cover  # brokerlint: ok=R4 telemetry observer must not wedge the rebuild path; histogram loss is acceptable
                 pass
 
     def as_dict(self) -> dict:
@@ -316,9 +322,12 @@ class TpuMatcher:
     @property
     def device_arrays(self) -> tuple:
         """The flat index as device arrays (built on demand)."""
-        if self._state is None or self.stale:
+        st = self._state
+        if st is None or self.stale:
             self.rebuild()
-        return self._state[1]
+            st = self._state
+        assert st is not None  # rebuild() always swaps in a state
+        return st[1]
 
     def match_tokens(self, tok1, tok2, lengths, is_dollar):
         """Raw device match over pre-tokenized topics; returns device
@@ -357,9 +366,12 @@ class TpuMatcher:
         """
         import jax.numpy as jnp
 
-        if self._state is None or self.stale:
+        st = self._state
+        if st is None or self.stale:
             self.rebuild()
-        flat, arrays, _ = self._state
+            st = self._state
+        assert st is not None  # rebuild() always swaps in a state
+        flat, arrays, _ = st
         if flat.exact_map is not None:
             # wildcard-free filter set: one host dict probe per topic beats
             # any device round trip (SURVEY §7 hard part 4) — serve

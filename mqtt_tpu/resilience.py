@@ -155,6 +155,11 @@ class CircuitBreaker:
         self._probe_ok = 0
         self._probe_inflight = False
         self._retry_at = self.clock() + self.backoff.next()
+
+    def _fire_on_trip(self) -> None:
+        """Invoke the trip observer — AFTER the breaker lock is released
+        (brokerlint R5): a slow or re-registering observer under the lock
+        would stall every record_* caller on the data plane."""
         cb = self.on_trip
         if cb is not None:
             try:
@@ -167,6 +172,7 @@ class CircuitBreaker:
         transitions: a stale in-flight batch failing after the trip (or
         during a probe) is counted but must not be mistaken for the
         probe's outcome — probes report via record_probe_failure."""
+        tripped = False
         with self._lock:
             self.failures += 1
             self.consecutive_failures += 1
@@ -183,6 +189,9 @@ class CircuitBreaker:
                     kind,
                 )
                 self._trip_locked()
+                tripped = True
+        if tripped:
+            self._fire_on_trip()
 
     def record_success(self) -> None:
         """A LIVE dispatch verified healthy. A stale batch resolving
@@ -202,6 +211,7 @@ class CircuitBreaker:
             self.last_failure = kind
             self.probe_failures += 1
             self._trip_locked()
+        self._fire_on_trip()
 
     def record_probe_success(self) -> None:
         """The HALF_OPEN probe verified healthy; enough of these in a

@@ -392,7 +392,7 @@ class _FrameCache:
                 # per publish instead of per subscriber write (the queue
                 # drains within the same tick)
                 out.properties.message_expiry_interval = max(
-                    1, out.expiry - int(time.time())
+                    1, out.expiry - int(time.time())  # brokerlint: ok=R3 message expiry is an absolute wall-clock stamp
                 )
             buf = get_buffer()
             try:
@@ -434,7 +434,7 @@ class Server:
         opts.ensure_defaults()
         self.options = opts
         self.log = opts.logger
-        self.info = Info(version=VERSION, started=int(time.time()))
+        self.info = Info(version=VERSION, started=int(time.time()))  # brokerlint: ok=R3 $SYS start stamp is wall-clock; uptime uses the monotonic anchor
         self.clients = Clients()
         self.topics = TopicsIndex()
         self.listeners = Listeners()
@@ -681,7 +681,7 @@ class Server:
                 return
             except asyncio.TimeoutError:
                 pass
-            now = int(time.time())
+            now = int(time.time())  # brokerlint: ok=R3 expiry sweeps compare against absolute wall-clock stamps
             self.clear_expired_clients(now)
             self.clear_expired_retained_messages(now)
             self.send_delayed_lwt(now)
@@ -771,7 +771,7 @@ class Server:
             extra = {"from": old, "to": new}
             try:
                 extra["gauges"] = self.overload.gauges()
-            except Exception:  # pragma: no cover - diagnostics only
+            except Exception:  # pragma: no cover  # brokerlint: ok=R4 best-effort dump context; the flight dump itself still fires
                 pass
             self.telemetry.trigger_dump("overload_shed", extra)
 
@@ -982,7 +982,7 @@ class Server:
         if cl.properties.protocol_version == 5 and code.code >= ERR_UNSPECIFIED_ERROR.code:
             try:
                 self.disconnect_client(cl, code)
-            except Exception:
+            except Exception:  # brokerlint: ok=R4 already on the error path; the warning below records the packet error
                 pass
         self.log.warning(
             "error processing packet: error=%s client=%s listener=%s",
@@ -1191,7 +1191,7 @@ class Server:
             if nxt is not None:
                 try:
                     cl.write_packet(nxt)
-                except Exception:
+                except Exception:  # brokerlint: ok=R4 client mid-teardown; the inflight store still reconciles below
                     pass
                 if cl.state.inflight.delete(nxt.packet_id):
                     self.info.inflight -= 1
@@ -1304,7 +1304,7 @@ class Server:
             return
 
         pk.origin = cl.id
-        pk.created = int(time.time())
+        pk.created = int(time.time())  # brokerlint: ok=R3 packet creation stamp is wall-clock (persists/expires across restarts)
         expiry = _minimum(
             self.options.capabilities.maximum_message_expiry_interval,
             pk.properties.message_expiry_interval,
@@ -1472,7 +1472,7 @@ class Server:
 
     def _stamp_publish_expiry(self, pk: Packet) -> None:
         if pk.created == 0:
-            pk.created = int(time.time())
+            pk.created = int(time.time())  # brokerlint: ok=R3 packet creation stamp is wall-clock (persists/expires across restarts)
         if pk.expiry == 0:
             expiry = _minimum(
                 self.options.capabilities.maximum_message_expiry_interval,
@@ -1905,7 +1905,7 @@ class Server:
             properties = Properties()
         if reason.code >= ERR_UNSPECIFIED_ERROR.code:
             properties.reason_string = reason.reason
-        now = int(time.time())
+        now = int(time.time())  # brokerlint: ok=R3 ack created/expiry stamps are wall-clock (message-expiry contract)
         return Packet(
             fixed_header=FixedHeader(type=pkt, qos=qos),
             packet_id=packet_id,  # [MQTT-2.2.1-5]
@@ -2114,8 +2114,8 @@ class Server:
             out.properties.reason_string = code.reason  # [MQTT-3.14.2-1]
         try:
             cl.write_packet(out)
-        except Exception:
-            pass  # we're already disconnecting; write errors don't matter
+        except Exception:  # brokerlint: ok=R4 we're already disconnecting; write errors don't matter
+            pass
         if not self.options.capabilities.compatibilities.passive_client_disconnect:
             cl.stop(code)
             if code.code >= ERR_UNSPECIFIED_ERROR.code:
@@ -2125,7 +2125,7 @@ class Server:
 
     def publish_sys_topics(self) -> None:
         """Publish retained $SYS values (server.go:1442-1492)."""
-        now = int(time.time())
+        now = int(time.time())  # brokerlint: ok=R3 $SYS/broker/time is wall-clock by definition
         self.info.memory_alloc = rss_bytes()
         self.info.threads = threading.active_count()
         self.info.time = now
@@ -2267,7 +2267,7 @@ class Server:
         if cl.properties.will.flag == 0:
             return
         modified = self.hooks.on_will(cl, cl.properties.will)
-        now = int(time.time())
+        now = int(time.time())  # brokerlint: ok=R3 will-message created/expiry stamps are wall-clock
         pk = Packet(
             fixed_header=FixedHeader(
                 type=pkts.PUBLISH,

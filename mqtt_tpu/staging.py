@@ -114,10 +114,11 @@ class MatchStage:
         effective latency is depth x service — once that alone exceeds
         the budget, any window sleep is pure added wait on an already
         over-budget pipeline, and the window collapses to 0."""
-        if self.latency_budget_s is None or self._ewma_s <= 0.0:
+        budget = self.latency_budget_s
+        if budget is None or self._ewma_s <= 0.0:
             return self.window_s
         depth = 1 if self._queue is None else self._queue.qsize() + 1
-        headroom = self.latency_budget_s - depth * self._ewma_s
+        headroom = budget - depth * self._ewma_s
         if headroom <= 0.0:
             return 0.0  # over budget already: dispatch immediately
         return min(self.window_s, 0.5 * self._ewma_s, headroom)
@@ -173,9 +174,10 @@ class MatchStage:
         await asyncio.gather(*self._tasks, return_exceptions=True)
         self._fallback_all(self._pending, klass="stop")
         self._pending = []
-        if self._queue is not None:
-            while not self._queue.empty():
-                _resolver, futs, topics, _clocks = self._queue.get_nowait()
+        queue = self._queue
+        if queue is not None:
+            while not queue.empty():
+                _resolver, futs, topics, _clocks = queue.get_nowait()
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
 
     # -- submission --------------------------------------------------------
@@ -191,7 +193,8 @@ class MatchStage:
         the host walk — the degraded-but-bounded mode — instead of
         growing the backlog."""
         fut = asyncio.get_running_loop().create_future()
-        if self._stopping or self._wake is None:
+        wake = self._wake
+        if self._stopping or wake is None:
             fut.set_result(self.host_fallback(topic))
             return fut
         if len(self._pending) >= self.max_pending or self._past_deadline():
@@ -203,7 +206,7 @@ class MatchStage:
         self._pending.append((topic, fut, clock))
         if len(self._pending) > self.peak_pending:
             self.peak_pending = len(self._pending)
-        self._wake.set()
+        wake.set()
         return fut
 
     def _past_deadline(self) -> bool:
@@ -216,13 +219,14 @@ class MatchStage:
         service-time estimate only heals through real dispatches, so a
         one-off spike (the first batch's cold compile) must not starve
         the stage into a permanent host-walk detour."""
-        if self.latency_budget_s is None or self._ewma_s <= 0.0:
+        budget = self.latency_budget_s
+        if budget is None or self._ewma_s <= 0.0:
             return False
         qdepth = self._queue.qsize() if self._queue is not None else 0
         if qdepth == 0 and not self._pending:
             return False  # idle: admit, and let the EWMA re-learn
         depth = 1 + qdepth + len(self._pending) // max(1, self._batch_cap)
-        return depth * self._ewma_s > 2.0 * self.latency_budget_s
+        return depth * self._ewma_s > 2.0 * budget
 
     @property
     def pending_depth(self) -> int:
@@ -242,9 +246,11 @@ class MatchStage:
     # -- pipeline ----------------------------------------------------------
 
     async def _collect_loop(self) -> None:
+        wake, queue = self._wake, self._queue
+        assert wake is not None and queue is not None  # start() created us
         while True:
-            await self._wake.wait()
-            self._wake.clear()
+            await wake.wait()
+            wake.clear()
             if not self._pending:
                 continue
             # the accumulation window: give concurrent publishers a beat to
@@ -262,7 +268,7 @@ class MatchStage:
                 self._pending[cap:],
             )
             if self._pending:
-                self._wake.set()  # leftovers start the next window now
+                wake.set()  # leftovers start the next window now
             # a caller future cancelled mid-window (client disconnected
             # during accumulation) is dead weight: drop it here so the
             # device never matches for it and no resolver path trips on
@@ -283,7 +289,7 @@ class MatchStage:
                 self._fallback_all(batch, klass="issue_error")
                 continue
             try:
-                await self._queue.put((resolver, futs, topics, clocks))
+                await queue.put((resolver, futs, topics, clocks))
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch in hand (in neither
                 # _pending nor the queue): resolve it before going down
@@ -292,13 +298,15 @@ class MatchStage:
 
     async def _drain_loop(self) -> None:
         loop = asyncio.get_running_loop()
+        queue = self._queue
+        assert queue is not None  # start() created us
         while True:
-            resolver, futs, topics, clocks = await self._queue.get()
+            resolver, futs, topics, clocks = await queue.get()
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
                 # sampled at resolve time: batches still queued waited for
                 # this one, so the controller budgets depth x service.
-                depth = self._queue.qsize() + 1
+                depth = queue.qsize() + 1
                 t0 = loop.time()
                 results = await loop.run_in_executor(None, resolver)
                 dt = loop.time() - t0

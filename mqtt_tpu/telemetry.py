@@ -43,7 +43,7 @@ import threading
 import time
 from bisect import bisect_left
 from collections import deque
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 _log = logging.getLogger("mqtt_tpu.telemetry")
 
@@ -216,7 +216,9 @@ class _Family:
         self.name = name
         self.mtype = mtype
         self.help = help_
-        self.children: dict[tuple, object] = {}
+        # Counter | Gauge | Histogram, keyed on the sorted label tuple;
+        # Any because the renderers isinstance-dispatch per child
+        self.children: dict[tuple, Any] = {}
         self.maker = maker
 
 
@@ -434,12 +436,19 @@ class FlightRecorder:
                 return None
             self._last_dump = now
             records = list(self.ring)
-            if not self.dump_dir:
-                # first dump: a private 0700 dir (see __init__'s note)
-                self.dump_dir = tempfile.mkdtemp(prefix="mqtt_tpu_flight_")
+        if not self.dump_dir:
+            # first dump: a private 0700 dir (see __init__'s note). The
+            # mkdtemp disk I/O runs OUTSIDE the lock (brokerlint R1 — the
+            # event loop appends to the ring under it); two racing first
+            # dumps each get a dir and the double-checked store below picks
+            # one winner (the loser's empty tmpdir is harmless)
+            ddir = tempfile.mkdtemp(prefix="mqtt_tpu_flight_")
+            with self._lock:
+                if not self.dump_dir:
+                    self.dump_dir = ddir
         snapshot = {
             "reason": reason,
-            "time_unix": int(time.time()),
+            "time_unix": int(time.time()),  # brokerlint: ok=R3 dump timestamps are wall-clock by design (operator-correlatable)
             "records": records,
             "context": extra or {},
         }
@@ -448,6 +457,7 @@ class FlightRecorder:
             safe = re.sub(r"[^a-zA-Z0-9_.-]", "_", reason)
             path = os.path.join(
                 self.dump_dir,
+                # brokerlint: ok=R3 dump filenames carry the wall-clock stamp
                 f"flight_{int(time.time())}_{safe}.json",
             )
             with open(path, "w") as f:
@@ -558,6 +568,7 @@ class Telemetry:
         self.sampled_publishes.inc()
         self.recorder.add(
             {
+                # brokerlint: ok=R3 flight records carry wall-clock stamps
                 "t": round(time.time(), 3),
                 "topic": topic,
                 "qos": qos,

@@ -320,6 +320,7 @@ class TopicsIndex:
 
     def _notify(self, mutation: Mutation) -> None:
         for fn in self._observers:
+            # brokerlint: ok=R5 intentional in-lock delivery: the delta overlay must observe the mutation atomically with the version bump (a gap would let a stale device snapshot serve the mutated filter); the lock is an RLock, so same-thread re-registration cannot deadlock, and observers are contract-bound to be O(1) appends
             fn(mutation)
 
     # -- mutation ----------------------------------------------------------

@@ -1,0 +1,47 @@
+"""brokerlint: the repo-specific concurrency/invariant lint pass.
+
+Usage (CLI)::
+
+    python -m tools.brokerlint mqtt_tpu/            # lint the broker tree
+    python -m tools.brokerlint --list-rules         # rule catalog
+    python -m tools.brokerlint --write-baseline ... # (discouraged) grandfather
+
+The tier-1 test suite (tests/test_lint.py) runs the same entry point and
+asserts zero findings over the live tree, so the pass is enforcing, not
+advisory. See README.md "Static analysis" for the rule rationale.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterable, Optional
+
+from .core import Finding, load_baseline, run, save_baseline
+from .rules import FILE_RULES, PROJECT_RULES, RULE_DOC
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def lint_paths(
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    baseline_path: Optional[str] = DEFAULT_BASELINE,
+) -> tuple[list[Finding], list[Finding]]:
+    """Lint ``paths`` (files or directories). Returns ``(new, baselined)``
+    findings; an enforcing caller fails when ``new`` is non-empty."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    baseline = load_baseline(baseline_path) if baseline_path else set()
+    return run(paths, root, FILE_RULES, PROJECT_RULES, baseline)
+
+
+__all__ = [
+    "Finding",
+    "FILE_RULES",
+    "PROJECT_RULES",
+    "RULE_DOC",
+    "DEFAULT_BASELINE",
+    "lint_paths",
+    "save_baseline",
+]

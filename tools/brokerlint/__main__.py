@@ -1,0 +1,80 @@
+"""CLI for brokerlint: ``python -m tools.brokerlint [paths...]``.
+
+Exit status: 0 when no un-baselined findings, 1 otherwise, 2 on usage
+error. ``--json`` emits machine-readable findings (the CI artifact)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import DEFAULT_BASELINE, RULE_DOC, lint_paths, save_baseline
+from .core import load_baseline
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="brokerlint",
+        description="repo-specific concurrency/invariant lint pass",
+    )
+    ap.add_argument("paths", nargs="*", default=["mqtt_tpu"],
+                    help="files or directories to lint (default: mqtt_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: the repo containing this tool)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: tools/brokerlint/baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report everything")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="grandfather current findings into the baseline "
+                         "(discouraged: the target baseline is empty)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON (CI artifact format)")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULE_DOC):
+            print(f"{rid}  {RULE_DOC[rid]}")
+        return 0
+
+    root = args.root or os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    paths = args.paths or ["mqtt_tpu"]
+    baseline_path = None if args.no_baseline else args.baseline
+    new, baselined = lint_paths(paths, root=root, baseline_path=baseline_path)
+
+    if args.write_baseline:
+        save_baseline(args.baseline, new + baselined)
+        print(f"baseline written: {len(new) + len(baselined)} findings "
+              f"-> {args.baseline}")
+        return 0
+
+    if args.json:
+        print(json.dumps(
+            {
+                "findings": [f.__dict__ for f in new],
+                "baselined": len(baselined),
+            },
+            indent=1,
+        ))
+    else:
+        for f in new:
+            print(f.render())
+        if baselined:
+            print(f"({len(baselined)} baselined findings suppressed)",
+                  file=sys.stderr)
+    if new:
+        print(f"brokerlint: {len(new)} finding(s)", file=sys.stderr)
+        return 1
+    if not args.json:
+        print("brokerlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

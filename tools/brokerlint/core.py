@@ -1,0 +1,223 @@
+"""brokerlint core: file loading, pragma parsing, baseline handling, and
+the runner that applies every registered rule.
+
+The tool is a repo-specific concurrency/invariant linter (see
+tools/brokerlint/rules.py for the rule catalog and README.md "Static
+analysis" for the rationale behind each rule). It is deliberately
+dependency-free — stdlib ``ast`` only — so it runs in every environment
+the broker itself runs in, including the tier-1 CI gate.
+
+Suppression pragma
+------------------
+
+A finding is suppressed by an explicit, *reasoned* pragma on the
+offending line (or the line directly above it)::
+
+    now = int(time.time())  # brokerlint: ok=R3 wall-clock expiry stamp
+
+    # brokerlint: ok=R1,R4 teardown path; the transport is already gone
+    sock.close()
+
+The reason text is mandatory: a pragma without one is itself reported
+(rule ``PRAGMA``), so every grandfathered decision is documented where
+it lives. ``ok=*`` suppresses every rule on that line (reserved for
+generated code; avoid).
+
+Baseline
+--------
+
+``baseline.json`` holds grandfathered findings keyed on
+``(rule, path, stripped source line)`` — line numbers churn, source
+lines rarely do. The checked-in baseline is EMPTY and the CI gate keeps
+it that way: new violations fail the build, they do not get baselined.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass
+from io import StringIO
+from typing import Callable, Iterable, Optional
+
+PRAGMA_RE = re.compile(r"#\s*brokerlint:\s*ok=([A-Z0-9*,]+)\s*(.*)$")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint violation: rule id, location, message, and the stripped
+    source line (the baseline key)."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    msg: str
+    context: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.msg}"
+
+    def baseline_key(self) -> tuple:
+        return (self.rule, self.path, self.context)
+
+
+class FileCtx:
+    """One parsed source file plus its pragma map."""
+
+    def __init__(self, path: str, rel: str, source: str) -> None:
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        # line -> set of rule ids allowed there ("*" = all)
+        self.allows: dict[int, set[str]] = {}
+        # pragma lines missing a reason (reported by the runner)
+        self.bad_pragmas: list[int] = []
+        self._scan_pragmas()
+
+    def _scan_pragmas(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(StringIO(self.source).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = PRAGMA_RE.search(tok.string)
+                if m is None:
+                    continue
+                rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+                if not m.group(2).strip():
+                    self.bad_pragmas.append(tok.start[0])
+                    continue
+                self.allows.setdefault(tok.start[0], set()).update(rules)
+        except tokenize.TokenError:  # unterminated strings etc: no pragmas
+            pass
+
+    def allowed(self, rule: str, line: int) -> bool:
+        """True when a pragma on this line (or the line above — for
+        statements whose pragma sits on its own comment line) covers
+        ``rule``."""
+        for ln in (line, line - 1):
+            rules = self.allows.get(ln)
+            if rules and (rule in rules or "*" in rules):
+                return True
+        return False
+
+    def context_line(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST, msg: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        return Finding(rule, self.rel, line, col, msg, self.context_line(line))
+
+
+def collect_files(paths: Iterable[str], root: str) -> list[str]:
+    """Expand the CLI paths into a sorted .py file list (skips caches and
+    the checked-in test fixture trees)."""
+    out: set[str] = set()
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            out.add(ap)
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    out.add(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def load_ctx(path: str, root: str) -> FileCtx:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, root)
+    return FileCtx(path, rel, source)
+
+
+def load_baseline(path: str) -> set[tuple]:
+    if not os.path.exists(path):
+        return set()
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return {
+        (e["rule"], e["path"], e["context"]) for e in data.get("findings", [])
+    }
+
+
+def save_baseline(path: str, findings: list[Finding]) -> None:
+    data = {
+        "comment": (
+            "Grandfathered brokerlint findings. The target state is an "
+            "EMPTY list: fix violations instead of baselining them."
+        ),
+        "findings": [
+            {"rule": f.rule, "path": f.path, "context": f.context}
+            for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+        ],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1)
+        f.write("\n")
+
+
+def run(
+    paths: Iterable[str],
+    root: str,
+    file_rules: dict[str, Callable[[FileCtx], list[Finding]]],
+    project_rules: dict[str, Callable[[list[FileCtx], str], list[Finding]]],
+    baseline: Optional[set] = None,
+) -> tuple[list[Finding], list[Finding]]:
+    """Apply every rule to every file. Returns ``(new, baselined)``:
+    findings not covered / covered by the baseline. Pragma-suppressed
+    findings are dropped entirely; a pragma without a reason is itself a
+    finding."""
+    ctxs: list[FileCtx] = []
+    findings: list[Finding] = []
+    for path in collect_files(paths, root):
+        try:
+            ctx = load_ctx(path, root)
+        except SyntaxError as e:
+            findings.append(
+                Finding("PARSE", os.path.relpath(path, root),
+                        e.lineno or 1, 0, f"syntax error: {e.msg}", "")
+            )
+            continue
+        ctxs.append(ctx)
+        for ln in ctx.bad_pragmas:
+            findings.append(
+                Finding("PRAGMA", ctx.rel, ln, 0,
+                        "suppression pragma without a reason "
+                        "(write `# brokerlint: ok=<RULES> <why>`)",
+                        ctx.context_line(ln))
+            )
+        for rule_id, fn in file_rules.items():
+            for f in fn(ctx):
+                if not ctx.allowed(f.rule, f.line):
+                    findings.append(f)
+    for rule_id, fn in project_rules.items():
+        for f in fn(ctxs, root):
+            ctx = next((c for c in ctxs if c.rel == f.path), None)
+            if ctx is None or not ctx.allowed(f.rule, f.line):
+                findings.append(f)
+    # dedupe exact repeats (msg included: one node CAN carry two distinct
+    # violations of the same rule — e.g. R7's daemon= and binding checks)
+    seen: set[tuple] = set()
+    uniq: list[Finding] = []
+    for f in findings:
+        key = (f.rule, f.path, f.line, f.col, f.msg)
+        if key not in seen:
+            seen.add(key)
+            uniq.append(f)
+    base = baseline or set()
+    new = [f for f in uniq if f.baseline_key() not in base]
+    old = [f for f in uniq if f.baseline_key() in base]
+    new.sort(key=lambda f: (f.path, f.line, f.rule))
+    return new, old
