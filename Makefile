@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate
+.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -42,9 +42,18 @@ verify: lint
 test: verify
 
 # slow-marked chaos smoke: seeded dispatch hang/error/corrupt/flap and
-# mesh peer kill under live traffic (tests/test_resilience.py), plus the
-# sustained publish-storm overload drill (tests/test_overload.py)
+# mesh peer kill under live traffic (tests/test_resilience.py), the
+# sustained publish-storm overload drill (tests/test_overload.py), the
+# partition-storm mesh drill against a flapping 2-worker broker
+# (tests/test_cluster.py + stress.py --partition), and the seeded
+# thread-schedule sweep (tests/test_race.py switch-interval fuzzing)
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
-	  tests/test_overload.py -q -m slow \
+	  tests/test_overload.py tests/test_cluster.py tests/test_race.py \
+	  -q -m slow \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
+
+# per-stage regression gate over the checked-in BENCH artifacts
+# (exp/stage_gate.py): fails on a >25% p99 regression in any stage
+stage-gate:
+	$(PY) exp/stage_gate.py

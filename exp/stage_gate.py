@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Per-stage regression gate over BENCH json artifacts (ROADMAP
+"per-stage regression gating", wired into CI by ISSUE 5).
+
+bench.py emits a ``telemetry`` block per config — per-stage p50/p99
+through the broker's own log-scale histogram buckets — so regressions
+can be judged stage-by-stage (decode/admission/staging_wait/
+device_batch/fanout/materialize) instead of only on the end-to-end
+rate. This gate diffs the two most recent ``BENCH_*.json`` files (or an
+explicit ``--current``/``--previous`` pair) and fails when any stage's
+p99 regressed by more than ``--threshold`` (default 25%).
+
+Robustness rules (a gate that cries wolf gets deleted):
+- stages are compared only when BOTH runs observed them, with at least
+  ``--min-count`` samples each (tiny samples land in log-bucket noise);
+- telemetry blocks are matched by their json path, so config 5's
+  device_batch never diffs against config 8's;
+- a run with no telemetry blocks (device-less driver hosts) passes with
+  a notice — absence of evidence is not a regression.
+
+Usage:
+    python exp/stage_gate.py                      # newest two BENCH_*.json
+    python exp/stage_gate.py --current BENCH_r06.json --previous BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+
+def find_telemetry_blocks(doc: object, path: str = "") -> dict[str, dict]:
+    """Every ``telemetry`` block in a BENCH json, keyed by its json
+    path — e.g. ``/parsed/configs/8_publish_storm/telemetry``."""
+    out: dict[str, dict] = {}
+    if isinstance(doc, dict):
+        for k, v in doc.items():
+            p = f"{path}/{k}"
+            if k == "telemetry" and isinstance(v, dict):
+                out[p] = v
+            else:
+                out.update(find_telemetry_blocks(v, p))
+    elif isinstance(doc, list):
+        for i, v in enumerate(doc):
+            out.update(find_telemetry_blocks(v, f"{path}[{i}]"))
+    return out
+
+
+def stage_rows(block: dict) -> dict[str, dict]:
+    """``stage name -> {count, p99_ms, ...}`` rows from one telemetry
+    block (the ``stages`` map plus the batch_service aggregate)."""
+    rows: dict[str, dict] = {}
+    stages = block.get("stages")
+    if isinstance(stages, dict):
+        for name, row in stages.items():
+            if isinstance(row, dict):
+                rows[name] = row
+    svc = block.get("batch_service")
+    if isinstance(svc, dict) and "p99_ms" in svc:
+        rows["batch_service"] = svc
+    return rows
+
+
+def compare(
+    current: dict, previous: dict, threshold: float = 0.25, min_count: int = 20
+) -> tuple[list[str], list[str]]:
+    """``(regressions, compared)`` between two BENCH documents: a
+    regression is a stage whose p99 grew past ``(1 + threshold)`` x the
+    previous run's, in a telemetry block present at the same json path
+    in both runs with enough samples on each side."""
+    cur_blocks = find_telemetry_blocks(current)
+    prev_blocks = find_telemetry_blocks(previous)
+    regressions: list[str] = []
+    compared: list[str] = []
+    for path, cur in sorted(cur_blocks.items()):
+        prev = prev_blocks.get(path)
+        if prev is None:
+            continue
+        prev_rows = stage_rows(prev)
+        for name, row in sorted(stage_rows(cur).items()):
+            prev_row = prev_rows.get(name)
+            if prev_row is None:
+                continue
+            try:
+                c_count = int(row.get("count", 0))
+                p_count = int(prev_row.get("count", 0))
+                c_p99 = float(row["p99_ms"])
+                p_p99 = float(prev_row["p99_ms"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if c_count < min_count or p_count < min_count:
+                continue
+            if p_p99 <= 0:
+                continue  # a zero baseline means the stage never ran
+            compared.append(f"{path}:{name}")
+            if c_p99 > p_p99 * (1.0 + threshold):
+                regressions.append(
+                    f"{path} stage {name!r}: p99 {p_p99:.3f}ms -> "
+                    f"{c_p99:.3f}ms (+{100 * (c_p99 / p_p99 - 1):.0f}%, "
+                    f"threshold +{100 * threshold:.0f}%)"
+                )
+    return regressions, compared
+
+
+def _bench_rank(path: str) -> tuple[int, str]:
+    """Order BENCH files by their round number (BENCH_r05 > BENCH_r04)."""
+    m = re.search(r"_r(\d+)", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, os.path.basename(path))
+
+
+_CANONICAL_RE = re.compile(r"^BENCH_r\d+\.json$")
+
+
+def newest_pair(repo: str) -> tuple[str, str] | None:
+    """The two newest CANONICAL round artifacts (``BENCH_rNN.json``).
+    Suffixed variants (``_local``, ``_cpu_fullscale``) are a different
+    machine/backend — diffing one against its plain sibling would gate
+    on cpu-vs-device deltas, not regressions — so they participate only
+    when fewer than two canonical rounds exist."""
+    files = glob.glob(os.path.join(repo, "BENCH_*.json"))
+    canonical = [f for f in files if _CANONICAL_RE.match(os.path.basename(f))]
+    pool = canonical if len(canonical) >= 2 else files
+    pool = sorted(pool, key=_bench_rank)
+    if len(pool) < 2:
+        return None
+    return pool[-1], pool[-2]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--current", help="newer BENCH json (default: auto-pick)")
+    ap.add_argument("--previous", help="older BENCH json (default: auto-pick)")
+    ap.add_argument("--repo", default=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ap.add_argument("--threshold", type=float, default=0.25)
+    ap.add_argument("--min-count", type=int, default=20)
+    args = ap.parse_args()
+
+    cur_path, prev_path = args.current, args.previous
+    if not (cur_path and prev_path):
+        explicit = cur_path or prev_path
+        if explicit:
+            # exactly one side given: pair it with the newest OTHER
+            # artifact — naively taking the auto-pick's slot could hand
+            # back the explicit file itself (a vacuous self-diff)
+            others = [
+                f
+                for f in glob.glob(os.path.join(args.repo, "BENCH_*.json"))
+                if os.path.abspath(f) != os.path.abspath(explicit)
+            ]
+            canonical = [
+                f for f in others if _CANONICAL_RE.match(os.path.basename(f))
+            ]
+            pool = sorted(canonical or others, key=_bench_rank)
+            if not pool:
+                print("stage-gate: no artifact to diff against; nothing to do")
+                return 0
+            cur_path = cur_path or pool[-1]
+            prev_path = prev_path or pool[-1]
+        else:
+            pair = newest_pair(args.repo)
+            if pair is None:
+                print(
+                    "stage-gate: fewer than two BENCH_*.json files; "
+                    "nothing to diff"
+                )
+                return 0
+            cur_path, prev_path = pair
+
+    with open(cur_path, encoding="utf-8") as f:
+        current = json.load(f)
+    with open(prev_path, encoding="utf-8") as f:
+        previous = json.load(f)
+
+    regressions, compared = compare(
+        current, previous, threshold=args.threshold, min_count=args.min_count
+    )
+    print(
+        f"stage-gate: {cur_path} vs {prev_path}: "
+        f"{len(compared)} stage(s) compared"
+    )
+    if not compared:
+        print(
+            "stage-gate: no comparable telemetry blocks (device-less bench "
+            "runs emit none); passing"
+        )
+        return 0
+    for line in regressions:
+        print(f"stage-gate REGRESSION: {line}")
+    if regressions:
+        return 1
+    print("stage-gate: no stage p99 regressed past the threshold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

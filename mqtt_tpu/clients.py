@@ -143,6 +143,14 @@ class Client:
         # the read loop counts, read_delay() resets on window roll
         self._pub_epoch = -1
         self._pub_count = 0
+        # priority-weighted shedding (mqtt_tpu.overload): the class and
+        # its shed/publish-quota multiplier, resolved at CONNECT from
+        # Options.overload_priority_users / overload_priority_classes
+        # (server._assign_priority_class); 1.0 = the flat default. The
+        # governor reads the weight on every admit/read_delay verdict,
+        # so it lives here as a plain attribute, not a config lookup.
+        self.priority_class = ""
+        self.priority_weight = 1.0
 
     # -- lifecycle ---------------------------------------------------------
 

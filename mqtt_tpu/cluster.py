@@ -45,17 +45,30 @@ re-dial with exponential backoff + jitter (a restarting peer is not
 hammered in lockstep by every worker), and every reattach replays FULL
 presence state (``_register``), so the peer's interest map converges even
 though withdrawals generated during the outage were lost.
+
+Mesh federation (ISSUE 5): the ping loop doubles as the PRESSURE GOSSIP
+cadence (``_T_GOSSIP`` carries each worker's overload posture; received
+adverts feed the governor's decayed ``peers`` signal AND tier forwards
+per destination) and the PEER HEALTH clock — a peer missing pongs walks
+UP -> SUSPECT (QoS>0 forwards held in a bounded park buffer, replayed
+exactly once on heal) -> PARTITIONED (park flushed into the partition
+drop counters, stale interest withdrawn, link aborted for a clean
+re-dial). Every (re)connect opens a fresh presence GENERATION
+(``_T_SYNC``), so presence frames from a raced stale link can never
+resurrect withdrawn filters.
 """
 
 from __future__ import annotations
 
 import asyncio
+import collections
 import json
 import logging
 import os
+import random
 import struct
 import time
-from typing import Optional
+from typing import Callable, Optional
 
 from .packets import PUBLISH, FixedHeader, Packet
 from .packets import Subscription
@@ -65,7 +78,7 @@ _log = logging.getLogger("mqtt_tpu.cluster")
 
 # wire: 4-byte big-endian length | 1-byte type | payload
 _T_HELLO = 0x48  # 'H' json {worker}
-_T_PRESENCE = 0x53  # 'S' json {filter, populated, inline}
+_T_PRESENCE = 0x53  # 'S' json {filter, populated, inline, gen}
 _T_FRAME = 0x46  # 'F' u16 origin_len | origin | raw v4 qos0 PUBLISH frame
 _T_PACKET = 0x50  # 'P' json header | 0x00 | encoded publish body
 # link telemetry (mqtt_tpu.telemetry): Q carries a sender timestamp, the
@@ -74,6 +87,34 @@ _T_PACKET = 0x50  # 'P' json header | 0x00 | encoded publish body
 # by the read loop, so a mixed-version mesh keeps working.
 _T_PING = 0x51  # 'Q' f64 sender perf_counter
 _T_PONG = 0x52  # 'R' echoed ping payload
+# mesh federation (ISSUE 5): G rides the ping loop and carries the
+# sender's overload-governor posture + scalar pressure; Y opens a fresh
+# presence generation on (re)connect so stale pre-heal presence frames
+# from a raced old link can never re-apply (split-brain guard)
+_T_GOSSIP = 0x47  # 'G' json {s: state_code, p: pressure}
+_T_SYNC = 0x59  # 'Y' json {gen}
+
+# per-peer health states (the link-failure posture between "up" and the
+# old binary link_down): SUSPECT holds QoS>0 forwards in a bounded park
+# buffer awaiting a quick heal; PARTITIONED gives up (park flushed into
+# the partition drop counters, link aborted so the dialer re-runs)
+PEER_UP = "up"
+PEER_SUSPECT = "suspect"
+PEER_PARTITIONED = "partitioned"
+_HEALTH_CODES = {PEER_UP: 0, PEER_SUSPECT: 1, PEER_PARTITIONED: 2}
+
+
+class _PeerHealth:
+    """One peer's health record: the UP -> SUSPECT -> PARTITIONED state
+    machine plus the bounded QoS>0 park buffer SUSPECT accumulates."""
+
+    __slots__ = ("state", "outstanding", "park", "park_bytes")
+
+    def __init__(self) -> None:
+        self.state = PEER_UP
+        self.outstanding = 0  # pings sent (or aged) without a pong
+        self.park: collections.deque = collections.deque()
+        self.park_bytes = 0
 
 
 def _noop_inline(*_a) -> None:  # pragma: no cover - marker, never invoked
@@ -117,6 +158,44 @@ class Cluster:
         # generated during an outage are lost, so stale entries would
         # otherwise forward forever)
         self._peer_filters: dict[int, set[str]] = {}
+        # drop-class split (ISSUE 5 satellite): partition-time drops
+        # (link down / peer partitioned / park overflow) vs backlog
+        # drops (peer-buffer cap, write faults on a live link).
+        # dropped_forwards stays the total of both classes.
+        self.dropped_partition = 0
+        self.dropped_backlog = 0
+        # partition-tolerance state: per-peer health records, the
+        # presence generation counter, and the last (boot, generation)
+        # each peer's sync opened (stale presence below it is
+        # discarded). The boot id is a per-INCARNATION nonce: a
+        # restarted peer's generation counter begins again at 1, and
+        # without the nonce its fresh sync would compare below the old
+        # incarnation's stored generation and be rejected forever.
+        self._health: dict[int, _PeerHealth] = {}
+        self.presence_generation = 0
+        self.boot_id = random.getrandbits(48)
+        self._peer_gen: dict[int, tuple[Optional[int], int]] = {}
+        self.parked_forwards = 0  # currently parked QoS>0 frames
+        self.replayed_forwards = 0  # parked frames replayed on heal
+        # pressure gossip: each peer's last advertised (state_code,
+        # pressure, monotonic) — forward tiering consults the
+        # DESTINATION's posture, the governor's peers signal the max
+        self._peer_adverts: dict[int, tuple[int, float, float]] = {}
+        # live read loops per peer (reconnect-discipline observability:
+        # a flapping link must never leave two loops draining one peer)
+        self._live_read_loops: dict[int, int] = {}
+        # fault-injection seam (mqtt_tpu.faults): when set, inbound
+        # frames it returns False for are dropped before dispatch
+        self._rx_filter: Optional[Callable[[int, int, bytes], bool]] = None
+        opts = getattr(server, "options", None)
+        self.suspect_pings = getattr(opts, "cluster_peer_health_suspect_pings", 2)
+        self.partition_pings = getattr(
+            opts, "cluster_peer_health_partition_pings", 5
+        )
+        self.park_max_bytes = getattr(
+            opts, "cluster_peer_park_max_bytes", 1 << 20
+        )
+        self.advert_ttl_s = getattr(opts, "overload_federation_ttl_ms", 15000.0) / 1e3
         server._cluster = self
         server.topics.add_observer(self._on_mutation)
         governor = getattr(server, "overload", None)
@@ -125,6 +204,50 @@ class Cluster:
             # governor: a mesh backing up is the same 'work is not
             # draining' condition as a slow local subscriber
             governor.add_source("cluster", self._buffer_pressure)
+            if getattr(opts, "overload_federation", True) and hasattr(
+                governor, "enable_federation"
+            ):
+                # mesh federation: gossip observations feed the decayed
+                # peers signal, and a transition gossips immediately so
+                # a SHED propagates within one gossip interval
+                governor.enable_federation(
+                    weight=getattr(opts, "overload_federation_weight", 0.9),
+                    ttl_s=self.advert_ttl_s,
+                )
+                prev_transition = governor.on_transition
+
+                def _gossip_transition(old, new, _prev=prev_transition):
+                    if _prev is not None:
+                        _prev(old, new)
+                    self._gossip_soon()
+
+                governor.on_transition = _gossip_transition
+        tele = getattr(server, "telemetry", None)
+        if tele is not None:
+            r = tele.registry
+            r.counter(
+                "mqtt_tpu_cluster_peer_drops_partition_total",
+                "Forwards dropped because the peer link was down/partitioned "
+                "(incl. park-buffer overflow)",
+                fn=lambda: self.dropped_partition,
+            )
+            r.counter(
+                "mqtt_tpu_cluster_peer_drops_backlog_total",
+                "Overload-class drops on a LIVE link: the peer write-buffer "
+                "cap, a destination-advertised shed (see "
+                "shed_qos0_forwards), or a write fault",
+                fn=lambda: self.dropped_backlog,
+            )
+            r.counter(
+                "mqtt_tpu_cluster_peer_replays_total",
+                "Parked QoS>0 forwards replayed after a peer-link heal",
+                fn=lambda: self.replayed_forwards,
+            )
+            r.gauge(
+                "mqtt_tpu_cluster_parked_bytes",
+                "Bytes currently held in SUSPECT peers' park buffers",
+                fn=lambda: sum(h.park_bytes for h in self._health.values()),
+            )
 
     @property
     def peer_count(self) -> int:
@@ -162,10 +285,11 @@ class Cluster:
         self._tasks.append(
             loop.create_task(self._presence_loop(), name="cluster-presence")
         )
-        if getattr(self.server, "telemetry", None) is not None:
-            self._tasks.append(
-                loop.create_task(self._ping_loop(), name="cluster-ping")
-            )
+        # the ping loop is also the peer-health clock and the gossip
+        # cadence, so it always runs (RTT recording alone needs telemetry)
+        self._tasks.append(
+            loop.create_task(self._ping_loop(), name="cluster-ping")
+        )
 
     async def stop(self) -> None:
         self._stopping = True
@@ -241,12 +365,120 @@ class Cluster:
 
     def _register(self, peer: int, writer: asyncio.StreamWriter) -> None:
         self._writers[peer] = writer
+        # open a fresh presence generation on the new link: the peer
+        # clears everything it knew about us and rebuilds from the full
+        # re-advertisement below, so a stale presence frame still in
+        # flight on a raced old link can never re-apply (split-brain
+        # guard; the generation rides every presence message)
+        self.presence_generation += 1
+        try:
+            self._send_nowait(
+                peer,
+                writer,
+                _T_SYNC,
+                json.dumps(
+                    {"gen": self.presence_generation, "boot": self.boot_id}
+                ).encode(),
+            )
+        except (ConnectionError, RuntimeError):
+            pass  # the link died mid-register: the dial loop heals it
         # announce every currently-populated filter to the new peer: walk
         # the live trie terminals (late-joining workers must converge)
         for f in self._populated_filters():
             self._pending_presence.add(f)
         if self._presence_wake is not None:
             self._presence_wake.set()
+        self._heal_peer(peer, writer)
+
+    # -- peer health (UP -> SUSPECT -> PARTITIONED -> resync) --------------
+
+    def _health_for(self, peer: int) -> _PeerHealth:
+        ph = self._health.get(peer)
+        if ph is None:
+            ph = self._health[peer] = _PeerHealth()
+            tele = getattr(self.server, "telemetry", None)
+            if tele is not None:
+                tele.registry.gauge(
+                    "mqtt_tpu_cluster_peer_health_code",
+                    "Mesh peer-link health (0=up 1=suspect 2=partitioned)",
+                    fn=lambda p=peer: _HEALTH_CODES[
+                        self._health[p].state
+                    ] if p in self._health else 0,
+                    peer=str(peer),
+                )
+        return ph
+
+    def _park(self, peer: int, mtype: int, payload: bytes) -> None:
+        """Hold one QoS>0 forward for a SUSPECT peer in its bounded park
+        buffer; the oldest frames spill into the partition drop counters
+        once the byte budget is exceeded (bounded memory, never silent)."""
+        ph = self._health_for(peer)
+        ph.park.append((mtype, payload))
+        ph.park_bytes += len(payload)
+        self.parked_forwards += 1
+        while ph.park_bytes > self.park_max_bytes and len(ph.park) > 1:
+            _m, old = ph.park.popleft()
+            ph.park_bytes -= len(old)
+            self.parked_forwards -= 1
+            self._count_drop(peer, partition=True)
+            self.dropped_qos_forwards += 1
+
+    def _heal_peer(self, peer: int, writer) -> None:
+        """A (re)connected link: reset the health record to UP and replay
+        everything parked while the peer was SUSPECT — exactly once; a
+        replay that fails on the fresh link is a counted drop, never a
+        duplicate."""
+        ph = self._health.get(peer)
+        if ph is None:
+            return
+        ph.state = PEER_UP
+        ph.outstanding = 0
+        while ph.park:
+            mtype, payload = ph.park.popleft()
+            ph.park_bytes -= len(payload)
+            self.parked_forwards -= 1
+            try:
+                sent = self._send_nowait(peer, writer, mtype, payload, qos=1)
+            except (ConnectionError, RuntimeError):
+                sent = False
+            if sent:
+                self.replayed_forwards += 1
+            else:
+                self._count_drop(peer, partition=False)
+                self.dropped_qos_forwards += 1
+
+    def _mark_partitioned(self, peer: int) -> None:
+        """Give up on a peer: flush its park buffer into the partition
+        drop counters, forget its pressure advert, and abort any live
+        writer so the link-down cleanup + re-dial machinery runs."""
+        ph = self._health_for(peer)
+        if ph.state == PEER_PARTITIONED:
+            return
+        ph.state = PEER_PARTITIONED
+        n = len(ph.park)
+        while ph.park:
+            _m, payload = ph.park.popleft()
+            ph.park_bytes -= len(payload)
+            self.parked_forwards -= 1
+            self._count_drop(peer, partition=True)
+            self.dropped_qos_forwards += 1
+        self._peer_adverts.pop(peer, None)
+        governor = getattr(self.server, "overload", None)
+        sig = getattr(governor, "peer_signal", None)
+        if sig is not None:
+            sig.forget(peer)
+        # the SUSPECT grace is over: the peer's announced interest is
+        # stale beyond repair — withdraw it (a heal re-advertises)
+        self._withdraw_peer(peer)
+        _log.warning(
+            "peer %d marked PARTITIONED (%d parked forwards flushed)", peer, n
+        )
+        w = self._writers.get(peer)
+        if w is not None:
+            try:
+                w.transport.abort()
+            except Exception:  # brokerlint: ok=R4 transport already torn down; the dial loop re-runs either way
+                pass
 
     # -- wire helpers ------------------------------------------------------
 
@@ -263,6 +495,30 @@ class Cluster:
     # (its interest map is stale beyond repair anyway).
     MAX_PEER_BUFFER = 8 * 1024 * 1024
 
+    def _qos0_fraction_for(self, peer: int) -> float:
+        """The effective QoS0 forward-tier fraction for one destination:
+        the LOCAL governor's tier, further reduced by the destination
+        peer's own advertised posture (pressure gossip) — a forward to a
+        shedding peer would be shed on arrival, so don't spend buffer on
+        it here. 0.0 means shed outright."""
+        frac = 1.0
+        governor = getattr(self.server, "overload", None)
+        if governor is not None:
+            frac = governor.qos0_forward_fraction()
+        adv = self._peer_adverts.get(peer)
+        if adv is not None:
+            state_code, _p, t = adv
+            if time.monotonic() - t < self.advert_ttl_s:
+                if state_code >= 2:  # destination advertises SHED
+                    return 0.0
+                if state_code == 1 and governor is not None:
+                    frac = min(
+                        frac, governor.config.qos0_forward_throttle_fraction
+                    )
+                elif state_code == 1:
+                    frac = min(frac, 0.5)
+        return frac
+
     def _send_nowait(
         self, peer: int, writer, mtype: int, payload: bytes, qos: int = 1
     ) -> bool:
@@ -273,11 +529,12 @@ class Cluster:
 
         Shedding is TIERED under the overload governor (mqtt_tpu.
         overload): QoS0 forwards shed first at a reduced fraction of the
-        cap while the broker throttles/sheds, QoS>0 forwards keep the
-        full buffer, and control traffic (presence) never sheds — it
-        gets 8x headroom and a wedged-link close instead."""
+        cap while the broker throttles/sheds — or outright when the
+        DESTINATION peer's gossip advertises SHED — QoS>0 forwards keep
+        the full buffer, and control traffic (presence/sync) never
+        sheds: it gets 8x headroom and a wedged-link close instead."""
         buffered = writer.transport.get_write_buffer_size()
-        if mtype == _T_PRESENCE:
+        if mtype in (_T_PRESENCE, _T_SYNC):
             if buffered > 8 * self.MAX_PEER_BUFFER:
                 _log.warning("peer link wedged past the control cap; closing")
                 writer.transport.abort()
@@ -285,14 +542,20 @@ class Cluster:
         else:
             cap = self.MAX_PEER_BUFFER
             if qos == 0:
-                governor = getattr(self.server, "overload", None)
-                if governor is not None:
-                    frac = governor.qos0_forward_fraction()
-                    if frac < 1.0:
-                        cap = int(cap * frac)
+                frac = self._qos0_fraction_for(peer)
+                if frac <= 0.0:
+                    # destination-advertised SHED: an expendable forward
+                    # its governor would drop on arrival sheds HERE
+                    self._count_drop(peer, partition=False)
+                    self.shed_qos0_forwards += 1
+                    governor = getattr(self.server, "overload", None)
+                    if governor is not None:
+                        governor.note_shed()
+                    return False
+                if frac < 1.0:
+                    cap = int(cap * frac)
             if buffered > cap:
-                self.dropped_forwards += 1
-                self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
+                self._count_drop(peer, partition=False)
                 if (
                     qos == 0
                     and cap < self.MAX_PEER_BUFFER
@@ -303,7 +566,9 @@ class Cluster:
                     # would have happened anyway and must not inflate
                     # the shed gauges
                     self.shed_qos0_forwards += 1
-                    governor.note_shed()
+                    governor = getattr(self.server, "overload", None)
+                    if governor is not None:
+                        governor.note_shed()
                 return False
         writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
         return True
@@ -344,25 +609,132 @@ class Cluster:
         """Periodically time a round trip on every live peer link. The
         ping rides the same socket as forwards, so a link backed up with
         forward traffic shows its queueing delay here — the closest
-        observable to one-way forward latency without synced clocks."""
+        observable to one-way forward latency without synced clocks.
+
+        This loop is also (1) the GOSSIP cadence: every tick each peer
+        receives this worker's governor posture + pressure, and (2) the
+        peer-HEALTH clock: a peer that misses ``suspect_pings``
+        consecutive pongs goes SUSPECT (QoS>0 forwards park), and at
+        ``partition_pings`` it is PARTITIONED (park flushed, link
+        aborted so the dial machinery re-runs) — asymmetric partitions,
+        where writes still succeed but nothing comes back, are caught
+        here rather than waiting for a socket error that never comes."""
         while not self._stopping:
             await asyncio.sleep(self.PING_INTERVAL_S)
-            for peer, w in list(self._writers.items()):
-                try:
-                    w.write(
-                        struct.pack(">IB", 9, _T_PING)
-                        + struct.pack(">d", time.perf_counter())
+            self._gossip_now()
+            for peer in set(self._writers) | set(self._health):
+                w = self._writers.get(peer)
+                ph = self._health_for(peer)
+                if w is not None:
+                    try:
+                        w.write(
+                            struct.pack(">IB", 9, _T_PING)
+                            + struct.pack(">d", time.perf_counter())
+                        )
+                    except (ConnectionError, RuntimeError):
+                        pass  # link teardown races: aged below anyway
+                elif ph.state == PEER_UP and not ph.park:
+                    continue  # no link, nothing held: nothing to age
+                ph.outstanding += 1
+                if ph.outstanding >= self.partition_pings:
+                    self._mark_partitioned(peer)
+                elif (
+                    ph.outstanding >= self.suspect_pings
+                    and ph.state == PEER_UP
+                ):
+                    ph.state = PEER_SUSPECT
+                    _log.warning(
+                        "peer %d marked SUSPECT (%d unanswered pings)",
+                        peer,
+                        ph.outstanding,
                     )
-                except (ConnectionError, RuntimeError):
-                    continue  # link teardown races: the dial loop heals it
 
     def _on_pong(self, peer: int, payload: bytes) -> None:
+        ph = self._health_for(peer)
+        ph.outstanding = 0
+        if ph.state == PEER_SUSPECT:
+            # the link answered after all: heal in place, replay the park
+            w = self._writers.get(peer)
+            if w is not None:
+                self._heal_peer(peer, w)
+            else:
+                ph.state = PEER_UP
+        if getattr(self.server, "telemetry", None) is None:
+            return
         if len(payload) != 8:
             return
         (t0,) = struct.unpack(">d", payload)
         rtt = time.perf_counter() - t0
         if 0 <= rtt < 60:  # a clock anomaly must not pollute the histogram
             self._rtt_hist(peer).observe(rtt)
+
+    # -- pressure gossip ---------------------------------------------------
+
+    def _gossip_payload(self) -> Optional[bytes]:
+        governor = getattr(self.server, "overload", None)
+        if governor is None:
+            return None
+        from .overload import _STATE_CODES
+
+        return json.dumps(
+            {
+                "s": _STATE_CODES.get(governor.state, 0),
+                "p": round(governor.pressure, 4),
+            }
+        ).encode()
+
+    def _gossip_now(self) -> None:
+        """Advertise this worker's governor posture to every live peer
+        (must run on the cluster's loop — writers are loop-affine)."""
+        payload = self._gossip_payload()
+        if payload is None:
+            return
+        for _peer, w in list(self._writers.items()):
+            try:
+                w.write(struct.pack(">IB", len(payload) + 1, _T_GOSSIP) + payload)
+            except (ConnectionError, RuntimeError):
+                continue  # link teardown races: the dial loop heals it
+
+    def _dispatch_on_loop(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` on the cluster's loop from ANY thread: inline when
+        already there (or before start, when nothing loop-affine exists
+        yet), else through ``call_soon_threadsafe`` — a cross-thread
+        callback touching writers/events directly can be lost or corrupt
+        loop state (the brokerlint R2 contract). The presence wake and
+        the transition gossip both route through here."""
+        loop = self._loop
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if loop is None or running is loop:
+            fn()
+        else:
+            try:
+                loop.call_soon_threadsafe(fn)
+            except RuntimeError:
+                pass  # loop already closed: shutdown race, nothing to run
+
+    def _gossip_soon(self) -> None:
+        """Schedule an immediate gossip round from any thread: governor
+        transitions fire wherever evaluate() ran, and writers may only
+        be touched on the cluster's loop."""
+        if self._loop is None:
+            return  # not started: no writers to gossip to
+        self._dispatch_on_loop(self._gossip_now)
+
+    def _on_gossip(self, peer: int, payload: bytes) -> None:
+        try:
+            d = json.loads(payload)
+            state_code = int(d.get("s", 0))
+            pressure = float(d.get("p", 0.0))
+        except (ValueError, TypeError):
+            return  # a malformed advert must not kill the read loop
+        self._peer_adverts[peer] = (state_code, pressure, time.monotonic())
+        governor = getattr(self.server, "overload", None)
+        sig = getattr(governor, "peer_signal", None)
+        if sig is not None:
+            sig.observe(peer, state_code, pressure)
 
     # -- presence sync -----------------------------------------------------
 
@@ -383,18 +755,7 @@ class Cluster:
         wake = self._presence_wake
         if wake is None:
             return
-        loop = self._loop
-        try:
-            running = asyncio.get_running_loop()
-        except RuntimeError:
-            running = None
-        if loop is None or running is loop:
-            wake.set()
-        else:
-            try:
-                loop.call_soon_threadsafe(wake.set)
-            except RuntimeError:
-                pass  # loop already closed: shutdown race, nothing to sync
+        self._dispatch_on_loop(wake.set)
 
     def _populated_filters(self) -> list[str]:
         """Every filter with at least one subscriber, from the live trie
@@ -438,7 +799,16 @@ class Cluster:
             for f in pending:
                 populated, inline_only = self._probe_populated(f)
                 msg = json.dumps(
-                    {"filter": f, "populated": populated, "inline": inline_only}
+                    {
+                        "filter": f,
+                        "populated": populated,
+                        "inline": inline_only,
+                        # the split-brain guard: presence below the last
+                        # sync's generation (same incarnation) is stale
+                        # and discarded
+                        "gen": self.presence_generation,
+                        "boot": self.boot_id,
+                    }
                 ).encode()
                 for peer, w in list(self._writers.items()):
                     try:
@@ -447,6 +817,37 @@ class Cluster:
                         pass
             # yield so bursts coalesce instead of one message per mutation
             await asyncio.sleep(0)
+
+    def _apply_sync(self, peer: int, gen: int, boot: Optional[int] = None) -> None:
+        """A peer opened a fresh presence generation (it (re)connected):
+        clear everything it previously announced — the full
+        re-advertisement that follows rebuilds it — and refuse any
+        later-arriving presence stamped below this generation (a raced
+        stale link's frames must not resurrect withdrawn filters).
+
+        Generations compare only within one peer INCARNATION (the boot
+        nonce): a restarted peer's counter begins again at 1, and its
+        sync must win, not be rejected against the dead incarnation's
+        high-water mark."""
+        stored = self._peer_gen.get(peer)
+        if stored is not None and boot == stored[0] and gen <= stored[1]:
+            return  # an older link's sync arriving late: ignore
+        self._peer_gen[peer] = (boot, gen)
+        self._withdraw_peer(peer)
+
+    def _presence_stale(self, peer: int, d: dict) -> bool:
+        """True when a presence frame predates the peer's last sync:
+        same incarnation with a lower generation (a raced stale link's
+        leftovers), or a DIFFERENT incarnation than the one the last
+        sync opened (frames from a dead process). A frame without a
+        boot id (older peer version) only checks the generation."""
+        stored = self._peer_gen.get(peer)
+        if stored is None:
+            return False
+        boot = d.get("boot")
+        if boot is not None and stored[0] is not None and boot != stored[0]:
+            return True  # a dead incarnation's leftovers
+        return d.get("gen", 0) < stored[1]
 
     def _apply_presence(self, peer: int, filter: str, populated: bool, inline: bool) -> None:
         announced = self._peer_filters.setdefault(peer, set())
@@ -495,12 +896,19 @@ class Cluster:
         self._plan_cache[topic] = (version, plan)
         return plan
 
-    def _count_drop(self, peer: int) -> None:
-        """One forward lost to ``peer`` outside _send_nowait's buffer-cap
-        path — the link dropped between interest-match and write, or the
-        write itself raised. Same 'never silent' posture as the cap."""
+    def _count_drop(self, peer: int, partition: bool = False) -> None:
+        """One forward lost to ``peer``, classed: ``partition`` drops
+        (link down / peer partitioned / park overflow) vs backlog drops
+        (buffer cap, write faults on a live link) count separately so
+        the park buffer's effect is observable — but both still feed the
+        ``dropped_forwards`` total and the per-peer counter. Same 'never
+        silent' posture as ever."""
         self.dropped_forwards += 1
         self.dropped_by_peer[peer] = self.dropped_by_peer.get(peer, 0) + 1
+        if partition:
+            self.dropped_partition += 1
+        else:
+            self.dropped_backlog += 1
 
     def forward_frame(self, topic: str, frame: bytes, origin: str) -> None:
         """Forward a QoS0 v4 passthrough frame to interested peers
@@ -513,7 +921,7 @@ class Cluster:
         for p in peers:
             w = self._writers.get(p)
             if w is None:  # link down but interest not yet withdrawn
-                self._count_drop(p)
+                self._count_drop(p, partition=True)
                 continue
             try:
                 self._send_nowait(p, w, _T_FRAME, payload, qos=0)
@@ -558,8 +966,19 @@ class Cluster:
         tier_qos = 1 if pk.fixed_header.retain else qos
         for p in peers:
             w = self._writers.get(p)
-            if w is None:  # link down but interest not yet withdrawn
-                self._count_drop(p)
+            ph = self._health.get(p)
+            if tier_qos > 0 and (
+                (ph is not None and ph.state == PEER_SUSPECT)
+                or (w is None and (ph is None or ph.state != PEER_PARTITIONED))
+            ):
+                # partition tolerance: a SUSPECT peer (missed pongs, or a
+                # just-dropped link inside the heal window) holds QoS>0
+                # forwards in the bounded park buffer instead of dropping
+                # them — the heal replays them exactly once
+                self._park(p, _T_PACKET, payload)
+                continue
+            if w is None:  # down past the heal window / partitioned
+                self._count_drop(p, partition=True)
                 sent = False
             else:
                 try:
@@ -576,29 +995,54 @@ class Cluster:
 
     # -- delivery (receiving side) -----------------------------------------
 
-    def _on_link_down(self, peer: int, writer) -> None:
-        """Tear down one peer link: deregister the writer (only if this
-        link still owns the slot — a reconnect may have raced the stale
-        link's teardown) and withdraw every filter the peer announced,
-        because withdrawals generated during the outage were lost and the
-        reconnect replay only carries positive presence."""
-        if self._writers.get(peer) is writer:
-            self._writers.pop(peer, None)
+    def _withdraw_peer(self, peer: int) -> None:
+        """Withdraw every filter the peer announced: withdrawals
+        generated during an outage are lost, so stale entries would
+        otherwise forward forever. Runs when the peer is declared
+        PARTITIONED — and on heal via the generation sync, where the
+        full re-advertisement rebuilds the map from scratch."""
         pseudo = f"\x00w{peer}"
         for f in self._peer_filters.pop(peer, ()):
             self.remote.unsubscribe(f, pseudo)
             self.remote.inline_unsubscribe(peer + 1, f)
 
+    def _on_link_down(self, peer: int, writer) -> None:
+        """One peer link dropped: deregister the writer (only if this
+        link still owns the slot — a reconnect may have raced the stale
+        link's teardown) and mark the peer SUSPECT, NOT gone: its
+        announced interest stays live and QoS>0 forwards for it park
+        (bounded) awaiting a quick heal. Only the ping loop's partition
+        threshold withdraws the interest and flushes the park into the
+        drop counters — replacing the old binary link_down handling
+        that silently dropped everything the moment the socket died."""
+        if self._writers.get(peer) is writer:
+            self._writers.pop(peer, None)
+        ph = self._health_for(peer)
+        if ph.state == PEER_UP:
+            ph.state = PEER_SUSPECT
+
     async def _read_loop(self, peer: int, reader, writer) -> None:
+        self._live_read_loops[peer] = self._live_read_loops.get(peer, 0) + 1
+        try:
+            await self._read_loop_inner(peer, reader, writer)
+        finally:
+            self._live_read_loops[peer] -= 1
+
+    async def _read_loop_inner(self, peer: int, reader, writer) -> None:
         while True:
             try:
                 mtype, payload = await self._recv(reader)
             except (asyncio.IncompleteReadError, ConnectionError):
                 self._on_link_down(peer, writer)
                 return
+            rx_filter = self._rx_filter
+            if rx_filter is not None and not rx_filter(peer, mtype, payload):
+                continue  # fault injection (mqtt_tpu.faults): frame lost
             try:
                 if mtype == _T_PRESENCE:
                     d = json.loads(payload)
+                    if self._presence_stale(peer, d):
+                        continue  # pre-sync / dead-incarnation: discard
                     self._apply_presence(
                         peer, d["filter"], d["populated"], d.get("inline", False)
                     )
@@ -616,8 +1060,12 @@ class Cluster:
                         struct.pack(">IB", len(payload) + 1, _T_PONG) + payload
                     )
                 elif mtype == _T_PONG:
-                    if getattr(self.server, "telemetry", None) is not None:
-                        self._on_pong(peer, payload)
+                    self._on_pong(peer, payload)
+                elif mtype == _T_GOSSIP:
+                    self._on_gossip(peer, payload)
+                elif mtype == _T_SYNC:
+                    d = json.loads(payload)
+                    self._apply_sync(peer, int(d["gen"]), d.get("boot"))
             except Exception:
                 _log.exception("cluster delivery failed (peer %d)", peer)
 

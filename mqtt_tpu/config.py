@@ -180,6 +180,19 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "overload_client_buffer_limit_bytes",
         "overload_max_outbound_backlog",
         "overload_memory_limit_mb",
+        # mesh federation: cross-worker pressure gossip, per-listener
+        # CONNECT admission, priority-weighted shedding, peer health
+        # (mqtt_tpu.cluster + mqtt_tpu.overload)
+        "overload_federation",
+        "overload_federation_weight",
+        "overload_federation_ttl_ms",
+        "overload_admission",
+        "overload_admission_reserve",
+        "overload_priority_classes",
+        "overload_priority_users",
+        "cluster_peer_health_suspect_pings",
+        "cluster_peer_health_partition_pings",
+        "cluster_peer_park_max_bytes",
         # telemetry plane: stage-clock sampling, flight recorder, /metrics
         # (mqtt_tpu.telemetry)
         "telemetry",
@@ -198,6 +211,8 @@ def from_bytes(b: bytes) -> Optional[Options]:
             type=conf.get("type", ""),
             id=conf.get("id", ""),
             address=conf.get("address", ""),
+            # per-listener CONNECT admission opt-out (mqtt_tpu.overload)
+            admission=bool(conf.get("admission", True)),
         )
         for conf in (raw.get("listeners") or [])
     ]

@@ -24,7 +24,11 @@ behave like that hardware — reproducibly, from one seed:
 
 - Mesh helpers — :func:`sever_peer_link` kills a live peer link
   mid-traffic; :func:`stall_peer_reads` gates a worker's mesh reads
-  shut so its peers' write buffers back up against ``MAX_PEER_BUFFER``.
+  shut so its peers' write buffers back up against ``MAX_PEER_BUFFER``;
+  :func:`asymmetric_partition` loses one peer's return path only (the
+  peer-health SUSPECT/PARTITIONED drill); :func:`lose_gossip` drops a
+  seeded fraction of inbound pressure-gossip frames (the federation
+  signal's decay/TTL drill).
 
 - :class:`StormPlan` — a seeded publish-storm schedule (publisher ->
   topic/payload/qos sequence, deterministic from the seed) plus
@@ -292,6 +296,55 @@ def sever_peer_link(cluster, peer: int) -> bool:
         return False
     writer.transport.abort()
     return True
+
+
+def asymmetric_partition(cluster, peer: int) -> Callable[[], None]:
+    """An ASYMMETRIC partition of one link: ``cluster`` silently loses
+    everything ``peer`` sends it (pongs included) while its own writes
+    keep succeeding — the lost-return-path failure a dead switch port or
+    a one-way firewall rule produces. ``cluster``'s ping loop then sees
+    unanswered pings and must walk the peer through SUSPECT (QoS>0
+    forwards parked) toward PARTITIONED; a plain severed link would
+    instead error the socket immediately. Returns release()."""
+    prev = cluster._rx_filter
+
+    def drop_from_peer(p: int, mtype: int, payload: bytes) -> bool:
+        if p == peer:
+            return False
+        return prev is None or prev(p, mtype, payload)
+
+    cluster._rx_filter = drop_from_peer
+
+    def release() -> None:
+        if cluster._rx_filter is drop_from_peer:
+            cluster._rx_filter = prev
+
+    return release
+
+
+def lose_gossip(cluster, rate: float, seed: int = 0) -> Callable[[], None]:
+    """Seeded gossip loss: ``cluster`` drops each inbound pressure-gossip
+    frame with probability ``rate`` (deterministic from the seed), while
+    data/presence/ping traffic flows untouched — the degraded-telemetry
+    plan the federation signal's decay/TTL machinery exists for. Returns
+    release()."""
+    from .cluster import _T_GOSSIP
+
+    rng = random.Random(seed)
+    prev = cluster._rx_filter
+
+    def drop_gossip(p: int, mtype: int, payload: bytes) -> bool:
+        if mtype == _T_GOSSIP and rng.random() < rate:
+            return False
+        return prev is None or prev(p, mtype, payload)
+
+    cluster._rx_filter = drop_gossip
+
+    def release() -> None:
+        if cluster._rx_filter is drop_gossip:
+            cluster._rx_filter = prev
+
+    return release
 
 
 def stall_peer_reads(cluster) -> Callable[[], None]:

@@ -32,13 +32,20 @@ class Config:
 
     ``reuse_port`` enables SO_REUSEPORT binding so multiple broker worker
     processes share one address with kernel load-balancing — the
-    multi-core data plane's listener mode (mqtt_tpu.cluster)."""
+    multi-core data plane's listener mode (mqtt_tpu.cluster).
+
+    ``admission`` gates this listener through the overload governor's
+    per-listener CONNECT admission (mqtt_tpu.overload): while the broker
+    throttles/sheds, new CONNECTs on admitting listeners refuse with
+    CONNACK 0x97. Set False for an ops/debug listener (e.g. a private
+    unix socket) that must stay reachable mid-storm."""
 
     type: str = ""
     id: str = ""
     address: str = ""
     tls_config: Optional[ssl.SSLContext] = None
     reuse_port: bool = False
+    admission: bool = True
 
 
 class Listener:

@@ -31,6 +31,15 @@ obeys the governor's verdict:
   peer-buffer cap (``qos0_forward_fraction``); control traffic
   (presence) never sheds.
 
+Mesh federation (ISSUE 5) extends the plane across workers: peer
+gossip observations fold into a decayed-max ``peers`` pressure signal
+(:class:`PeerPressureSignal` — a shedding peer raises this worker's
+posture too), new CONNECTs are refused at the listener while
+THROTTLE/SHED (``admit_connect``, CONNACK 0x97, with a small
+always-admit reserve for admin-ACL clients), and the per-client shed
+and publish quotas are weighted by a config-driven priority class
+(``priority_weights`` — storming low-priority publishers shed first).
+
 State, transition counts, sheds, evictions, and per-signal pressures
 surface as ``$SYS/broker/overload/...`` gauges (server.publish_sys_topics).
 All knobs are ``Options.overload_*`` fields and config-file keys; the
@@ -43,7 +52,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 _log = logging.getLogger("mqtt_tpu.overload")
@@ -53,6 +62,76 @@ NORMAL = "normal"
 THROTTLE = "throttle"
 SHED = "shed"
 _STATE_CODES = {NORMAL: 0, THROTTLE: 1, SHED: 2}
+
+
+class PeerPressureSignal:
+    """The mesh-federation pressure signal (mqtt_tpu.cluster gossip):
+    each peer worker's advertised governor state + scalar pressure is
+    folded into ONE normalized signal — the decayed max over recent
+    gossip — so a shedding peer raises this whole worker's posture.
+
+    - A peer advertising SHED/THROTTLE contributes at least the state's
+      floor (a peer deep in SHED may report a pressure its own signals
+      have already shed back down; the STATE is the stronger fact).
+    - Contributions decay linearly to zero over ``ttl_s`` and stale
+      entries age out entirely, so a worker that stopped gossiping
+      (dead, partitioned) cannot pin the mesh's posture forever.
+    - The whole signal is scaled by ``weight`` < 1: one shedding peer
+      raises the mesh to THROTTLE, not to a full sympathetic SHED
+      cascade (the defaults put a SHED advert at 0.9 * 0.95 = 0.855 —
+      above throttle_enter, below shed_enter).
+
+    Thread-safe: gossip arrives on the cluster's read loops, the
+    governor samples from evaluate().
+    """
+
+    # minimum advertised-state contributions (keyed by state code)
+    STATE_FLOORS = {1: 0.75, 2: 0.95}
+
+    def __init__(
+        self,
+        weight: float = 0.9,
+        ttl_s: float = 15.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.weight = weight
+        self.ttl_s = max(1e-3, ttl_s)
+        self.clock = clock
+        self._lock = threading.Lock()
+        # peer -> (contribution, observed-at monotonic)
+        self._peers: dict[int, tuple[float, float]] = {}
+        self.observations = 0
+
+    def observe(self, peer: int, state_code: int, pressure: float) -> None:
+        """Fold one gossip advert from ``peer`` into the signal."""
+        contribution = max(
+            max(0.0, float(pressure)), self.STATE_FLOORS.get(int(state_code), 0.0)
+        )
+        with self._lock:
+            self._peers[peer] = (contribution, self.clock())
+            self.observations += 1
+
+    def forget(self, peer: int) -> None:
+        """Drop a peer's advert immediately (link torn down)."""
+        with self._lock:
+            self._peers.pop(peer, None)
+
+    def value(self) -> float:
+        """The decayed max over live adverts, scaled by ``weight`` —
+        the governor's ``peers`` pressure source."""
+        now = self.clock()
+        worst = 0.0
+        with self._lock:
+            stale = []
+            for peer, (c, t) in self._peers.items():
+                age = now - t
+                if age >= self.ttl_s:
+                    stale.append(peer)
+                    continue
+                worst = max(worst, c * (1.0 - age / self.ttl_s))
+            for peer in stale:
+                del self._peers[peer]
+        return worst * self.weight
 
 
 @dataclass
@@ -93,6 +172,15 @@ class OverloadConfig:
     # full cap; control traffic never sheds)
     qos0_forward_throttle_fraction: float = 0.5
     qos0_forward_shed_fraction: float = 0.25
+    # per-listener CONNECT admission: while THROTTLE/SHED new CONNECTs
+    # refuse with CONNACK 0x97 (0x89 while the server drains), except a
+    # small always-admit reserve per quota window for $SYS/admin-ACL
+    # clients (the operator's monitoring session must get in)
+    admission_reserve: int = 2
+    # priority-weighted shedding: priority class -> quota multiplier
+    # applied to BOTH shed_quota and publish_quota (a class at 0 sheds
+    # everything past zero budget; unknown classes weigh 1.0)
+    priority_weights: dict = field(default_factory=dict)
 
 
 class OverloadGovernor:
@@ -126,12 +214,18 @@ class OverloadGovernor:
         self._last_shed_at = float("-inf")  # last evaluation spent in SHED
         self.epoch = 0  # evaluation-window counter (per-client quotas key on it)
         self._admitted_in_epoch: dict[str, int] = {}
+        self._reserve_in_epoch = 0  # admin-reserve CONNECTs this window
+        # mesh-federation peer-pressure signal (None until a Cluster
+        # enables federation via enable_federation)
+        self.peer_signal: Optional[PeerPressureSignal] = None
         # counters (exported via gauges)
         self.transitions = 0
         self.sheds = 0
         self.evictions = 0
         self.throttled = 0
         self.admitted = 0
+        self.connects_refused = 0
+        self.reserve_admits = 0
         self.pressure = 0.0
         self.signal_pressures: dict[str, float] = {}
         self.peak_pressures: dict[str, float] = {}
@@ -146,6 +240,20 @@ class OverloadGovernor:
         """Register (or replace) one named pressure signal."""
         with self._lock:
             self._sources[name] = fn
+
+    def enable_federation(
+        self, weight: float = 0.9, ttl_s: float = 15.0
+    ) -> PeerPressureSignal:
+        """Create (or return) the mesh peer-pressure signal and register
+        it as the ``peers`` source: evaluate() then folds the decayed max
+        over recent gossip into the posture, so a shedding peer raises
+        this worker too (mqtt_tpu.cluster feeds the observations)."""
+        sig = self.peer_signal
+        if sig is None:
+            sig = PeerPressureSignal(weight=weight, ttl_s=ttl_s, clock=self.clock)
+            self.peer_signal = sig
+            self.add_source("peers", sig.value)
+        return sig
 
     @property
     def state(self) -> str:
@@ -171,6 +279,7 @@ class OverloadGovernor:
             if epoch != self.epoch:
                 self.epoch = epoch
                 self._admitted_in_epoch.clear()
+                self._reserve_in_epoch = 0
             sources = list(self._sources.items())
         pressures: dict[str, float] = {}
         for name, fn in sources:
@@ -235,6 +344,13 @@ class OverloadGovernor:
 
     # -- data-plane verdicts -----------------------------------------------
 
+    @staticmethod
+    def _priority_weight(cl) -> float:
+        """The client's shed-quota multiplier, cached on the client at
+        CONNECT (server.attach_client maps username/client id -> class ->
+        weight via ``priority_weights``). Unweighted clients read 1.0."""
+        return getattr(cl, "priority_weight", 1.0)
+
     def read_delay(self, cl) -> float:
         """THROTTLE lever, consulted by the client read loop before each
         socket read: a client that published more than ``publish_quota``
@@ -256,7 +372,7 @@ class OverloadGovernor:
                 cl._pub_epoch = self.epoch
                 cl._pub_count = 0
                 return 0.0
-            if cl._pub_count <= self.config.publish_quota:
+            if cl._pub_count <= self.config.publish_quota * self._priority_weight(cl):
                 return 0.0
             self.throttled += 1
             return self.config.throttle_delay_s
@@ -285,11 +401,47 @@ class OverloadGovernor:
                 self.admitted += 1
                 return True
             n = self._admitted_in_epoch.get(cl.id, 0)
-            if n < self.config.shed_quota:
+            # priority-weighted budget: a high-priority class multiplies
+            # its per-window quota, a zero-weight class sheds everything
+            # — storming low-priority publishers shed first
+            if n < int(self.config.shed_quota * self._priority_weight(cl)):
                 self._admitted_in_epoch[cl.id] = n + 1
                 self.admitted += 1
                 return True
             self.sheds += 1
+            return False
+
+    def admit_connect(self, admin: "bool | Callable[[], bool]" = False) -> bool:
+        """Per-listener CONNECT admission (mesh-federation tentpole):
+        while THROTTLE/SHED a new CONNECT is refused — the caller sends
+        CONNACK 0x97 Quota Exceeded — except a small always-admit
+        reserve per quota window for ``admin`` callers ($SYS/admin-ACL
+        clients: the operator must be able to connect and watch the
+        storm). Always True in NORMAL.
+
+        ``admin`` may be a zero-arg callable: it is consulted LAZILY,
+        only when a refusal is actually on the table and reserve budget
+        remains — the common NORMAL-state CONNECT never pays the ACL
+        walk — and it runs outside the governor lock (it may be a hook
+        chain)."""
+        if (
+            self._state == NORMAL
+            and self.clock() - self._last_eval < self.config.eval_interval_s
+        ):
+            return True
+        self.evaluate()
+        with self._lock:
+            if self._state == NORMAL:
+                return True
+            reserve_open = self._reserve_in_epoch < self.config.admission_reserve
+        if reserve_open and (admin() if callable(admin) else admin):
+            with self._lock:
+                if self._reserve_in_epoch < self.config.admission_reserve:
+                    self._reserve_in_epoch += 1
+                    self.reserve_admits += 1
+                    return True
+        with self._lock:
+            self.connects_refused += 1
             return False
 
     def evict_due(self, full_since: Optional[float]) -> bool:
@@ -327,6 +479,14 @@ class OverloadGovernor:
         with self._lock:
             self.sheds += n
 
+    def note_connect_refused(self) -> None:
+        """Account CONNECT refusals decided outside admit_connect()
+        (the server's drain-time 0x89 path) — the connects_refused
+        gauge must count every turned-away client, whatever the
+        reason code."""
+        with self._lock:
+            self.connects_refused += 1
+
     def note_eviction(self) -> None:
         with self._lock:
             self.evictions += 1
@@ -346,6 +506,8 @@ class OverloadGovernor:
                 "evictions": self.evictions,
                 "throttled": self.throttled,
                 "admitted": self.admitted,
+                "connects_refused": self.connects_refused,
+                "reserve_admits": self.reserve_admits,
             }
             for name, v in self.signal_pressures.items():
                 d[f"signal/{name}"] = round(v, 4)

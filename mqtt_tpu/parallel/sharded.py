@@ -50,6 +50,7 @@ def shard_map(*args, disable_rep_check=False, **kwargs):
         kwargs[_REP_KWARG] = False
     return _shard_map(*args, **kwargs)
 
+from ..telemetry import Histogram
 from ..topics import Mutation, Subscribers, TopicsIndex
 from ..ops.flat import (
     KIND_CLIENT,
@@ -145,6 +146,12 @@ class ShardedTpuMatcher:
         self._dirty = [False] * self.n_shards
         self._salt = 0
         self._step: Optional[Callable] = None
+        # per-shard compile-time histogram SHARDS (mqtt_tpu.telemetry):
+        # the thread compiling shard s records into shard s's local
+        # histogram — no cross-thread write sharing — and the scrape
+        # merges them on demand (merged_shard_compile), the merge()-at-
+        # scrape pattern the telemetry plane's Histogram documents
+        self.shard_compile_hists = [Histogram() for _ in range(self.n_shards)]
         if incremental:
             topics.add_observer(self._on_mutation)
 
@@ -343,13 +350,54 @@ class ShardedTpuMatcher:
         # on fault: see _full_rebuild — the caller retries, boundedly
         return not fault
 
+    def merged_shard_compile(self) -> Histogram:
+        """One merged snapshot of the per-shard compile-time histogram
+        shards (scrape-time callback for the telemetry registry)."""
+        merged = Histogram()
+        for h in self.shard_compile_hists:
+            merged.merge(h)
+        return merged
+
     def _compile_shard(
-        self, s: int, replicas, salt: Optional[int] = None, min_buckets: int = 1024
+        self,
+        s: int,
+        replicas,
+        salt: Optional[int] = None,
+        min_buckets: int = 1024,
+        retry_tears: bool = True,
+    ):
+        t0 = time.perf_counter()
+        try:
+            return self._compile_shard_inner(
+                s, replicas, salt, min_buckets, retry_tears
+            )
+        finally:
+            # shard-local: only the thread compiling shard s writes here
+            self.shard_compile_hists[s].observe(time.perf_counter() - t0)
+
+    def _compile_shard_inner(
+        self,
+        s: int,
+        replicas,
+        salt: Optional[int] = None,
+        min_buckets: int = 1024,
+        retry_tears: bool = True,
     ):
         rep = replicas[s]
         salt = self._salt if salt is None else salt
-        for _ in range(8):
-            try:
+        if retry_tears:
+            for _ in range(8):
+                try:
+                    return build_flat_index(
+                        rep,
+                        max_levels=self.max_levels,
+                        salt=salt,
+                        window=self.window,
+                        min_buckets=min_buckets,
+                    )
+                except (RuntimeError, KeyError):
+                    continue  # replica mutated mid-walk; retry
+            with rep._lock:  # mutation storm on this shard: build quiesced
                 return build_flat_index(
                     rep,
                     max_levels=self.max_levels,
@@ -357,16 +405,14 @@ class ShardedTpuMatcher:
                     window=self.window,
                     min_buckets=min_buckets,
                 )
-            except (RuntimeError, KeyError):
-                continue  # replica mutated mid-walk; retry
-        with rep._lock:  # mutation storm on this shard: build quiesced
-            return build_flat_index(
-                rep,
-                max_levels=self.max_levels,
-                salt=salt,
-                window=self.window,
-                min_buckets=min_buckets,
-            )
+        # fresh, unpublished replicas can't tear: no retry wrapper
+        return build_flat_index(
+            rep,
+            max_levels=self.max_levels,
+            salt=salt,
+            window=self.window,
+            min_buckets=min_buckets,
+        )
 
     def _compile_all(self, replicas: list[TopicsIndex], retry_tears: bool = False):
         """Compile every shard at a uniform salt and bucket count. With
@@ -375,14 +421,9 @@ class ShardedTpuMatcher:
         propagates to the caller (fresh, unpublished replicas can't tear)."""
 
         def compile_one(s: int, salt: int, min_buckets: int = 1024):
-            if retry_tears:
-                return self._compile_shard(s, replicas, salt=salt, min_buckets=min_buckets)
-            return build_flat_index(
-                replicas[s],
-                max_levels=self.max_levels,
-                salt=salt,
-                window=self.window,
-                min_buckets=min_buckets,
+            return self._compile_shard(
+                s, replicas, salt=salt, min_buckets=min_buckets,
+                retry_tears=retry_tears,
             )
 
         flats = [compile_one(s, self._salt) for s in range(len(replicas))]

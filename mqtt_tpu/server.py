@@ -241,6 +241,39 @@ class Options:
     # RSS watermark in MB that normalizes to pressure 1.0; 0 disables
     # the memory signal
     overload_memory_limit_mb: float = 0.0
+    # mesh federation (mqtt_tpu.cluster gossip -> mqtt_tpu.overload):
+    # fold peer workers' advertised governor postures into this worker's
+    # pressure as a decayed-max "peers" signal, so one shedding worker
+    # raises the whole mesh to THROTTLE instead of the rest pumping
+    # publishes into it
+    overload_federation: bool = True
+    # scale applied to the peers signal (< 1 so a SHED advert lands the
+    # mesh in THROTTLE, not a sympathetic full-mesh SHED cascade)
+    overload_federation_weight: float = 0.9
+    # gossip adverts decay linearly to zero over this TTL and then age
+    # out entirely (a dead worker must not pin the mesh's posture)
+    overload_federation_ttl_ms: float = 15000.0
+    # per-listener CONNECT admission: while THROTTLE/SHED, new CONNECTs
+    # on admission-gated listeners are refused with CONNACK 0x97 (0x89
+    # while the server drains); False disables the gate entirely
+    overload_admission: bool = True
+    # always-admit reserve per quota window for $SYS/admin-ACL clients
+    # (the operator's monitoring session must get in mid-storm)
+    overload_admission_reserve: int = 2
+    # priority-weighted shedding: class name -> quota multiplier applied
+    # to both the shed and publish quotas (None = every client weighs 1)
+    overload_priority_classes: Optional[dict] = None
+    # username-or-client-id -> class name (assigned at CONNECT; embedders
+    # can also set cl.priority_weight directly from an on_connect hook)
+    overload_priority_users: Optional[dict] = None
+    # mesh peer health (mqtt_tpu.cluster): consecutive unanswered pings
+    # before a peer goes SUSPECT (QoS>0 forwards park in a bounded
+    # buffer) and before it is declared PARTITIONED (park flushed into
+    # the partition drop counters, link aborted for a clean re-dial)
+    cluster_peer_health_suspect_pings: int = 2
+    cluster_peer_health_partition_pings: int = 5
+    # byte budget of each SUSPECT peer's park buffer (oldest spill first)
+    cluster_peer_park_max_bytes: int = 1 << 20
     # unified telemetry plane (mqtt_tpu.telemetry): per-publish stage
     # clock sampled 1-in-N, histogram metrics, Prometheus exposition at
     # GET /metrics (sysinfo listener), the retained
@@ -325,6 +358,40 @@ class Options:
             self.overload_publish_quota = 2048
         if self.overload_shed_quota <= 0:
             self.overload_shed_quota = 256
+        # federation/admission/health knobs are config-reachable too
+        if self.overload_priority_classes:
+            # sanitize ONCE at startup: _assign_priority_class runs on
+            # the CONNECT path, where a non-numeric weight from a config
+            # typo would otherwise raise mid-handshake and take out the
+            # whole class's connects (no CONNACK at all)
+            clean = {}
+            for klass, weight in self.overload_priority_classes.items():
+                try:
+                    clean[klass] = float(weight)
+                except (TypeError, ValueError):
+                    logging.getLogger("mqtt_tpu").warning(
+                        "overload_priority_classes[%r]=%r is not a number; "
+                        "class falls back to weight 1.0",
+                        klass,
+                        weight,
+                    )
+            self.overload_priority_classes = clean
+        if self.overload_federation_weight <= 0:
+            self.overload_federation_weight = 0.9
+        if self.overload_federation_ttl_ms <= 0:
+            self.overload_federation_ttl_ms = 15000.0
+        if self.overload_admission_reserve < 0:
+            self.overload_admission_reserve = 0
+        if self.cluster_peer_health_suspect_pings <= 0:
+            self.cluster_peer_health_suspect_pings = 2
+        if self.cluster_peer_health_partition_pings <= self.cluster_peer_health_suspect_pings:
+            # PARTITIONED must come strictly after SUSPECT, or the park
+            # buffer never gets a heal window at all
+            self.cluster_peer_health_partition_pings = (
+                self.cluster_peer_health_suspect_pings + 3
+            )
+        if self.cluster_peer_park_max_bytes <= 0:
+            self.cluster_peer_park_max_bytes = 1 << 20
         # telemetry knobs are config-reachable: a negative sample rate
         # means "default", a zero one disables stage sampling outright
         if self.telemetry_sample < 0:
@@ -451,6 +518,9 @@ class Server:
         self._fastpub_plans: dict = {}  # topic -> (trie version, fan-out plan)
         # multi-core worker fabric (mqtt_tpu.cluster); None = single process
         self._cluster = None
+        # set at the top of close(): CONNECTs arriving mid-drain are
+        # refused with CONNACK 0x89 Server Busy instead of 0x97
+        self._draining = False
         self.matcher = None  # device matcher; None = host trie walk
         self._stage = None  # publish staging loop (started in serve())
         # broker-wide overload governor (mqtt_tpu.overload): admission,
@@ -489,6 +559,8 @@ class Server:
                     throttle_delay_s=opts.overload_throttle_delay_ms / 1e3,
                     shed_quota=opts.overload_shed_quota,
                     eviction_grace_s=opts.overload_eviction_grace_ms / 1e3,
+                    admission_reserve=opts.overload_admission_reserve,
+                    priority_weights=dict(opts.overload_priority_classes or {}),
                 )
             )
             self._ops.overload = self.overload
@@ -533,6 +605,18 @@ class Server:
                 if stats is not None:
                     # compile/rebuild/fold wall times -> rebuild histogram
                     stats.rebuild_observer = self.telemetry.rebuild_hist.observe
+                # mesh-sharded snapshot: per-shard compile times land in
+                # shard-local histograms on the rebuild path; the scrape
+                # merges them on demand (telemetry callback histogram)
+                snap = getattr(self.matcher, "_snap", None)
+                merged = getattr(snap, "merged_shard_compile", None)
+                if merged is not None:
+                    self.telemetry.registry.histogram(
+                        "mqtt_tpu_matcher_shard_compile_seconds",
+                        "Per-shard flat-index compile wall time (shard-local "
+                        "histogram shards, merged at scrape)",
+                        fn=merged,
+                    )
                 breaker = getattr(self.matcher, "breaker", None)
                 if breaker is not None:
                     prev_trip = breaker.on_trip
@@ -859,6 +943,58 @@ class Server:
                 except Code:
                     pass
 
+    def _assign_priority_class(self, cl: Client) -> None:
+        """Resolve the client's shed-priority class at CONNECT
+        (mqtt_tpu.overload priority-weighted shedding): the config map
+        keys on username first, then client id; the resolved class's
+        quota multiplier is cached on the client so the admit/read-delay
+        hot paths pay one attribute read. Embedders may overwrite
+        ``cl.priority_weight`` from an on_connect hook."""
+        users = self.options.overload_priority_users
+        if not users:
+            return
+        username = cl.properties.username
+        if isinstance(username, (bytes, bytearray)):
+            username = username.decode("utf-8", "replace")
+        klass = users.get(username) or users.get(cl.id)
+        if klass is None:
+            return
+        cl.priority_class = klass
+        weights = self.options.overload_priority_classes or {}
+        cl.priority_weight = float(weights.get(klass, 1.0))
+
+    def _connect_admission(self, cl: Client, listener: str) -> Optional[Code]:
+        """The per-listener CONNECT admission verdict: None admits; a
+        Code refuses (the caller CONNACKs it and drops the connection).
+        Local/inline attachments and listeners configured with
+        ``admission=False`` are exempt; admin-ACL clients (read access
+        to the $SYS tree) draw from the governor's always-admit
+        reserve."""
+        ov = self.overload
+        if (
+            ov is None
+            or not self.options.overload_admission
+            or cl.net.inline
+            or listener == LOCAL_LISTENER
+        ):
+            return None
+        lst = self.listeners.get(listener)
+        if lst is not None and not getattr(lst.config, "admission", True):
+            return None
+        if self._draining:
+            ov.note_connect_refused()  # the gauge counts 0x89s too
+            return ERR_SERVER_BUSY  # 0x89: drain, not quota
+        # the ACL walk runs LAZILY inside admit_connect: only when the
+        # governor would otherwise refuse and reserve budget remains —
+        # the steady-state NORMAL CONNECT never pays it
+        if ov.admit_connect(
+            admin=lambda: self.hooks.on_acl_check(
+                cl, SYS_PREFIX + "/broker/overload/state", False
+            )
+        ):
+            return None
+        return ERR_QUOTA_EXCEEDED  # 0x97
+
     async def establish_connection(self, listener: str, reader, writer) -> None:
         """Attach a newly accepted connection (server.go:398-401)."""
         task = asyncio.current_task()
@@ -895,6 +1031,25 @@ class Server:
             if not self.hooks.on_connect_authenticate(cl, pk):  # [MQTT-3.1.4-2]
                 self.send_connack(cl, ERR_BAD_USERNAME_OR_PASSWORD, False, None)
                 raise ERR_BAD_USERNAME_OR_PASSWORD()
+
+            self._assign_priority_class(cl)
+            # per-listener admission (mqtt_tpu.overload federation): a
+            # broker in THROTTLE/SHED refuses NEW connections up front —
+            # CONNACK 0x97 Quota Exceeded (0x89 while draining) — except
+            # the small always-admit reserve for admin-ACL clients.
+            # AFTER authentication, deliberately: an unauthenticated
+            # client claiming the admin identity must not be able to
+            # burn the operator's reserve slots
+            refusal = self._connect_admission(cl, listener)
+            if refusal is not None:
+                if cl.properties.protocol_version < 5:
+                    # v3 CONNACK codes stop at 5: 0x97/0x89 have no
+                    # translation, so the v3 wire answer is the same
+                    # one the maximum_clients refusal uses
+                    self.send_connack(cl, ERR_SERVER_UNAVAILABLE, False, None)
+                else:
+                    self.send_connack(cl, refusal, False, None)
+                raise refusal()
 
             self.info.clients_connected += 1
             connected = True
@@ -2219,10 +2374,28 @@ class Server:
             topics[SYS_PREFIX + "/broker/cluster/shed_qos0_forwards"] = str(
                 c.shed_qos0_forwards
             )
+            # partition-tolerance gauges (ISSUE 5): the drop-class split
+            # (partition-time vs backlog), the park buffer, and replays
+            topics[SYS_PREFIX + "/broker/cluster/peer_drops_partition"] = str(
+                c.dropped_partition
+            )
+            topics[SYS_PREFIX + "/broker/cluster/peer_drops_backlog"] = str(
+                c.dropped_backlog
+            )
+            topics[SYS_PREFIX + "/broker/cluster/parked_forwards"] = str(
+                c.parked_forwards
+            )
+            topics[SYS_PREFIX + "/broker/cluster/replayed_forwards"] = str(
+                c.replayed_forwards
+            )
             for peer, n in sorted(c.dropped_by_peer.items()):
                 topics[
                     SYS_PREFIX + f"/broker/cluster/peer/{peer}/dropped_forwards"
                 ] = str(n)
+            for peer, ph in sorted(c._health.items()):
+                topics[
+                    SYS_PREFIX + f"/broker/cluster/peer/{peer}/health"
+                ] = ph.state
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,
@@ -2237,6 +2410,7 @@ class Server:
     async def close(self) -> None:
         """Gracefully stop the server, listeners, clients, and hooks
         (server.go:1495-1504)."""
+        self._draining = True  # late CONNECTs now refuse with 0x89
         self.done.set()
         self.log.info("gracefully stopping server")
         await self.listeners.close_all(self._close_listener_clients)

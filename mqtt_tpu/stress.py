@@ -405,7 +405,83 @@ async def run_storm(
     }
 
 
-def broker_main(address: str, device_matcher: bool = False, workers: int = 1) -> None:
+# -- partition storm (mesh-federation drill) ---------------------------------
+
+
+async def _read_cluster_sys(host: str, port: int, wait_s: float = 3.0) -> dict:
+    """Subscribe ``$SYS/broker/cluster/#`` on one worker and collect the
+    retained mesh gauges (topic suffix -> payload string) — the
+    partition drill's observability leg: parked/replayed forwards and
+    the split drop counters must be visible from the outside."""
+    reader, writer = await asyncio.open_connection(host, port)
+    gauges: dict = {}
+    try:
+        writer.write(_connect_bytes("partition-sys", version=4))
+        await writer.drain()
+        assert await _read_packet_type(reader) == CONNACK
+        writer.write(_subscribe_bytes(1, "$SYS/broker/cluster/#"))
+        await writer.drain()
+        deadline = time.perf_counter() + wait_s
+        buf = bytearray()
+        while time.perf_counter() < deadline:
+            budget = deadline - time.perf_counter()
+            try:
+                data = await asyncio.wait_for(reader.read(65536), max(0.05, budget))
+            except asyncio.TimeoutError:
+                continue
+            if not data:
+                break
+            buf += data
+            frames, consumed = _scan_frames(buf)
+            for first, bs, be in frames:
+                if (first >> 4) != PUBLISH:
+                    continue
+                body = bytes(buf[bs:be])
+                if len(body) < 2:
+                    continue
+                tl = (body[0] << 8) | body[1]
+                topic = body[2 : 2 + tl].decode("utf-8", "replace")
+                rest = body[2 + tl :]
+                # v4 QoS0: payload follows the topic directly
+                gauges[topic.removeprefix("$SYS/broker/cluster/")] = (
+                    rest.decode("utf-8", "replace")
+                )
+            del buf[:consumed]
+    finally:
+        writer.close()
+    return gauges
+
+
+async def run_partition(
+    host: str,
+    port: int,
+    publishers: int = 8,
+    msgs_each: int = 1000,
+    seed: int = 11,
+    sys_port: int = 0,
+    **storm_kw,
+) -> dict:
+    """The partition-storm scenario (``--partition``): a seeded publish
+    storm against a multi-worker mesh whose peer links are being severed
+    mid-traffic (serve-side ``--flap-peer-s``), then a $SYS scrape of
+    the mesh gauges. The pass criterion is LIVENESS plus accounting:
+    delivery continues, nothing wedges, and every partition-time loss
+    shows up in the parked/replayed/split-drop counters instead of
+    vanishing."""
+    out = await run_storm(
+        host, port, publishers=publishers, msgs_each=msgs_each, seed=seed,
+        **storm_kw,
+    )
+    out["cluster_sys"] = await _read_cluster_sys(host, sys_port or port)
+    return out
+
+
+def broker_main(
+    address: str,
+    device_matcher: bool = False,
+    workers: int = 1,
+    flap_peer_s: float = 0.0,
+) -> None:
     """Run a bench broker on ``address`` until stdin closes (the bench
     driver's subprocess entry; prints READY once serving).
 
@@ -421,7 +497,7 @@ def broker_main(address: str, device_matcher: bool = False, workers: int = 1) ->
 
     wid_env = os.environ.get("MQTT_TPU_WORKER")
     if workers > 1 and wid_env is None:
-        _cluster_launcher(address, device_matcher, workers)
+        _cluster_launcher(address, device_matcher, workers, flap_peer_s)
         return
 
     from .hooks.auth.allow_all import AllowHook
@@ -449,10 +525,32 @@ def broker_main(address: str, device_matcher: bool = False, workers: int = 1) ->
         await srv.serve()
         if cluster is not None:
             await cluster.start()
+        flap_task = None
+        if cluster is not None and flap_peer_s > 0:
+            # chaos self-injection (the --partition drill's server side):
+            # this worker severs one seeded-random live peer link every
+            # interval, so the mesh spends the whole run healing
+            from .faults import sever_peer_link
+
+            async def _flap_loop() -> None:
+                import random as _random
+
+                rng = _random.Random(1234 + cluster.worker_id)
+                while True:
+                    await asyncio.sleep(flap_peer_s)
+                    peers = list(cluster._writers)
+                    if peers:
+                        sever_peer_link(cluster, rng.choice(peers))
+
+            flap_task = asyncio.get_running_loop().create_task(
+                _flap_loop(), name="stress-peer-flap"
+            )
         print("READY", flush=True)
         loop = asyncio.get_running_loop()
         # exit when the parent closes our stdin (robust to parent death)
         await loop.run_in_executor(None, sys.stdin.read)
+        if flap_task is not None:
+            flap_task.cancel()
         if cluster is not None:
             await cluster.stop()
         await srv.close()
@@ -460,7 +558,9 @@ def broker_main(address: str, device_matcher: bool = False, workers: int = 1) ->
     asyncio.run(main())
 
 
-def _cluster_launcher(address: str, device_matcher: bool, workers: int) -> None:
+def _cluster_launcher(
+    address: str, device_matcher: bool, workers: int, flap_peer_s: float = 0.0
+) -> None:
     """Spawn one worker subprocess per slot, relay READY when all workers
     serve, and shut them down when stdin closes."""
     import os
@@ -480,6 +580,10 @@ def _cluster_launcher(address: str, device_matcher: bool, workers: int) -> None:
                    "--broker", address]
             if device_matcher:
                 cmd.append("--device-matcher")
+            if flap_peer_s > 0 and i == 0:
+                # one flapping worker is a partition drill; every worker
+                # flapping is a mesh that never converges
+                cmd += ["--flap-peer-s", str(flap_peer_s)]
             procs.append(
                 subprocess.Popen(
                     cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
@@ -515,15 +619,45 @@ def main() -> None:
         "the throughput workload",
     )
     p.add_argument(
+        "--partition", action="store_true",
+        help="partition-storm mesh drill: the storm workload plus a $SYS "
+        "scrape of the cluster's parked/replayed/drop gauges (run the "
+        "broker with --workers N --flap-peer-s S)",
+    )
+    p.add_argument(
+        "--flap-peer-s", type=float, default=0.0,
+        help="serve mode: sever one random live peer link every S seconds "
+        "(the --partition drill's chaos source; worker 0 only)",
+    )
+    p.add_argument(
+        "--sys-port", type=int, default=0,
+        help="--partition: port for the $SYS mesh-gauge scrape (pin a "
+        "specific worker's private port — re-dial counters live on the "
+        "DIALING side, so the shared REUSEPORT port reads 0 half the time); "
+        "0 = the storm port",
+    )
+    p.add_argument(
         "--workers", type=int, default=1,
         help="worker processes sharing the address via SO_REUSEPORT (multi-core)",
     )
     args = p.parse_args()
     host, port = args.broker.rsplit(":", 1)
     if args.serve:
-        broker_main(args.broker, device_matcher=args.device_matcher, workers=args.workers)
+        broker_main(
+            args.broker,
+            device_matcher=args.device_matcher,
+            workers=args.workers,
+            flap_peer_s=args.flap_peer_s,
+        )
         return
-    if args.storm:
+    if args.partition:
+        out = asyncio.run(
+            run_partition(
+                host, int(port), args.clients, args.messages,
+                sys_port=args.sys_port,
+            )
+        )
+    elif args.storm:
         out = asyncio.run(
             run_storm(host, int(port), args.clients, args.messages)
         )

@@ -94,10 +94,14 @@ class Histogram:
 
     Single-writer per instance (asyncio data plane or one worker
     thread); cross-thread aggregation goes through ``merge`` — each
-    thread owns a shard and the scrape merges them.
+    thread owns a shard and the scrape merges them. A registry child
+    may instead be backed by a scrape-time callback returning a merged
+    snapshot (``fn``, see :meth:`live`): the sharded matcher's
+    per-shard compile histograms render this way without the workers
+    ever sharing a hot write path.
     """
 
-    __slots__ = ("bounds", "counts", "count", "sum")
+    __slots__ = ("bounds", "counts", "count", "sum", "fn")
 
     def __init__(
         self,
@@ -113,6 +117,21 @@ class Histogram:
         self.counts = [0] * (len(self.bounds) + 1)  # [-1] is +Inf
         self.count = 0
         self.sum = 0.0
+        self.fn: Optional[Callable[[], "Histogram"]] = None
+
+    def live(self) -> "Histogram":
+        """The histogram to render at scrape time: the callback's merged
+        snapshot when one is attached, else this instance. A failing
+        callback renders the (empty) stored instance — a scrape must
+        never take the broker down."""
+        if self.fn is None:
+            return self
+        try:
+            merged = self.fn()
+        except Exception:
+            _log.exception("histogram callback failed")
+            return self
+        return merged if isinstance(merged, Histogram) else self
 
     def observe(self, v: float) -> None:
         # bisect_left(bounds, v): first bound >= v — exactly `le`
@@ -264,11 +283,21 @@ class MetricsRegistry:
         return g
 
     def histogram(
-        self, name: str, help: str = "", bounds: Optional[tuple] = None, **labels
+        self,
+        name: str,
+        help: str = "",
+        bounds: Optional[tuple] = None,
+        fn: Optional[Callable] = None,
+        **labels,
     ) -> Histogram:
-        return self._child(
+        h = self._child(
             name, "histogram", help, labels, lambda: Histogram(bounds=bounds)
         )
+        if fn is not None:
+            # scrape-time snapshot callback (per-thread shard merging):
+            # the renderers resolve through Histogram.live()
+            h.fn = fn
+        return h
 
     # -- rendering ---------------------------------------------------------
 
@@ -296,7 +325,8 @@ class MetricsRegistry:
                     out.append(
                         f"{name}{self._labels_str(key)} {_fmt(child.value())}"
                     )
-                else:  # Histogram
+                else:  # Histogram (callback-backed ones snapshot here)
+                    child = child.live()
                     acc = 0
                     for i, bound in enumerate(child.bounds):
                         acc += child.counts[i]
@@ -333,7 +363,7 @@ class MetricsRegistry:
                     v = child.value()
                     out[base] = round(v, 6) if isinstance(v, float) else v
                 else:
-                    s = child.summary()
+                    s = child.live().summary()
                     out[f"{base}/count"] = s["count"]
                     for q in ("p50", "p95", "p99"):
                         if in_seconds:

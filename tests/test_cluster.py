@@ -198,6 +198,116 @@ def test_cluster_sys_topics(cluster):
     asyncio.run(run())
 
 
+def test_flapping_peer_keeps_reconnect_discipline(tmp_path):
+    """A peer link flapping FASTER than the backoff floor (seeded abort
+    every ~10ms against a 50ms dial floor): the reconnect counter stays
+    monotonic, the mesh converges once the flapping stops, and no
+    duplicate ``_read_loop`` survives per peer (the R7 thread/task
+    discipline applied to the mesh — a flap must never leave two loops
+    draining one peer's frames)."""
+    import random
+
+    from mqtt_tpu.cluster import Cluster
+    from mqtt_tpu.server import Options, Server
+
+    async def scenario():
+        s0, s1 = Server(Options()), Server(Options())
+        c0 = Cluster(s0, 0, 2, str(tmp_path))
+        c1 = Cluster(s1, 1, 2, str(tmp_path))
+        for c in (c0, c1):
+            c.PING_INTERVAL_S = 0.1
+        await c0.start()
+        await c1.start()
+
+        async def wait_for(cond, timeout=10.0):
+            deadline = asyncio.get_event_loop().time() + timeout
+            while asyncio.get_event_loop().time() < deadline:
+                if cond():
+                    return True
+                await asyncio.sleep(0.02)
+            return False
+
+        assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+
+        rng = random.Random(77)
+        samples = []
+        for _ in range(30):
+            w = c0._writers.get(1) or c1._writers.get(0)
+            if w is not None:
+                w.transport.abort()
+            samples.append(c1.reconnects_total + c0.reconnects_total)
+            await asyncio.sleep(rng.uniform(0.005, 0.015))
+        # monotonic: a flap may only ever GROW the reconnect counters
+        assert samples == sorted(samples)
+
+        # the mesh settles after the abuse
+        assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+        assert c1.reconnects_total >= 1
+        await asyncio.sleep(0.2)  # let raced teardowns drain
+
+        # exactly one live read loop per peer on each side — a flap must
+        # never leave a zombie loop double-draining frames
+        for c in (c0, c1):
+            for peer, n in c._live_read_loops.items():
+                assert n <= 1, (c.worker_id, peer, n, c._live_read_loops)
+        assert c0._live_read_loops.get(1) == 1
+        assert c1._live_read_loops.get(0) == 1
+
+        await c0.stop()
+        await c1.stop()
+
+    asyncio.run(scenario())
+
+
+@pytest.mark.slow
+def test_partition_storm_subprocess(tmp_path):
+    """Nightly chaos drill (stress.py --partition): a 2-worker mesh whose
+    worker 0 severs a peer link every 0.4s while a seeded storm blasts
+    through the shared port; the broker must stay live, keep delivering,
+    and account every partition-time loss in the $SYS mesh gauges."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["MQTT_TPU_WORKER_PORTS"] = "1"
+    port = BASE_PORT + 40
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
+         f"127.0.0.1:{port}", "--workers", "2", "--flap-peer-s", "0.4"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env, cwd=REPO,
+    )
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        from mqtt_tpu.stress import run_partition
+
+        out = asyncio.run(
+            run_partition(
+                "127.0.0.1", port, publishers=6, msgs_each=400,
+                # worker 1's private port: re-dial counters live on the
+                # DIALING side, and only higher-numbered workers dial
+                sys_port=port + 2,
+            )
+        )
+        # liveness: the storm completed and traffic flowed end to end
+        assert out["offered"]["total"] == 6 * 400
+        assert out["delivered"] > 0
+        assert out["publishers_disconnected"] == 0
+        # accounting: the mesh gauges are present and parse as integers
+        sys_gauges = out["cluster_sys"]
+        for key in (
+            "peer_drops_partition", "peer_drops_backlog",
+            "parked_forwards", "replayed_forwards", "reconnects",
+        ):
+            assert key in sys_gauges, (key, sorted(sys_gauges))
+            int(sys_gauges[key])
+        # the flapping link forced at least one re-dial
+        assert int(sys_gauges["reconnects"]) >= 1
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+
+
 def test_peer_link_reconnects_in_process(tmp_path):
     """A dropped mesh link heals: the dialing side re-dials and replays
     presence, so forwarding interest converges again (in-process, two

@@ -1,0 +1,845 @@
+"""Mesh federation suite (ISSUE 5): cross-worker pressure gossip, the
+per-listener CONNECT admission gate, priority-weighted shedding, and the
+partition-tolerant peer health machinery (SUSPECT park buffers, heal
+replay, generation-stamped presence resync).
+
+The acceptance drill: a 3-worker mesh where worker 0 is driven into SHED
+by a seeded storm must raise its peers to >= THROTTLE via gossip within
+one gossip interval, refuse new CONNECTs with CONNACK 0x97 while shed,
+and shed zero high-priority-class publishes while low-priority quota
+remains; a severed-then-healed peer link must replay parked QoS>0
+forwards exactly once and converge presence filters against a
+single-worker oracle.
+"""
+
+import asyncio
+import time
+
+import pytest
+
+from mqtt_tpu.cluster import (
+    _T_FRAME,
+    _T_GOSSIP,
+    _T_PACKET,
+    PEER_PARTITIONED,
+    PEER_SUSPECT,
+    PEER_UP,
+    Cluster,
+)
+from mqtt_tpu.faults import (
+    FaultPlan,
+    FaultyMatcher,
+    StormPlan,
+    asymmetric_partition,
+    lose_gossip,
+)
+from mqtt_tpu.overload import SHED, THROTTLE, PeerPressureSignal
+from mqtt_tpu.packets import PUBACK, PUBLISH, SUBACK, Subscription
+from mqtt_tpu.server import Options
+from mqtt_tpu.topics import TopicsIndex
+
+from tests.test_overload import (
+    FakeClock,
+    StubClient,
+    make_governor,
+    run_publish_storm,
+    storm_options,
+)
+from tests.test_server import (
+    Harness,
+    pub_packet,
+    read_wire_packet,
+    run,
+    sub_packet,
+)
+
+
+# -- unit: the decayed peer-pressure signal ----------------------------------
+
+
+class TestPeerPressureSignal:
+    def test_state_floors_and_weight(self):
+        clock = FakeClock()
+        sig = PeerPressureSignal(weight=0.9, ttl_s=10.0, clock=clock)
+        assert sig.value() == 0.0
+        sig.observe(1, 0, 0.2)  # NORMAL peer: raw pressure only
+        assert sig.value() == pytest.approx(0.9 * 0.2)
+        sig.observe(2, 1, 0.1)  # THROTTLE floor beats a low raw pressure
+        assert sig.value() == pytest.approx(0.9 * 0.75)
+        sig.observe(3, 2, 0.3)  # SHED floor: lands the mesh in THROTTLE
+        assert sig.value() == pytest.approx(0.9 * 0.95)
+        # ...but NOT in SHED (no sympathetic full-mesh cascade)
+        assert sig.value() < 0.90
+
+    def test_decay_and_ageing(self):
+        clock = FakeClock()
+        sig = PeerPressureSignal(weight=1.0, ttl_s=10.0, clock=clock)
+        sig.observe(1, 2, 1.0)
+        assert sig.value() == pytest.approx(1.0)
+        clock.t += 5  # half the TTL: linear decay to half
+        assert sig.value() == pytest.approx(0.5)
+        clock.t += 5  # TTL reached: aged out entirely AND purged
+        assert sig.value() == 0.0
+        assert not sig._peers
+
+    def test_forget_drops_immediately(self):
+        sig = PeerPressureSignal(weight=1.0, ttl_s=60.0)
+        sig.observe(1, 2, 1.0)
+        sig.forget(1)
+        assert sig.value() == 0.0
+
+    def test_governor_folds_peers_signal(self):
+        gov, clock, pressure = make_governor()
+        sig = gov.enable_federation(weight=0.9, ttl_s=10.0)
+        assert gov.enable_federation() is sig  # idempotent
+        sig.observe(7, 2, 0.4)  # one shedding peer
+        assert gov.evaluate(force=True) == THROTTLE
+        assert gov.signal_pressures["peers"] == pytest.approx(0.9 * 0.95)
+
+
+# -- unit: CONNECT admission + priority-weighted quotas ----------------------
+
+
+class TestConnectAdmission:
+    def test_refuses_while_shedding_with_admin_reserve(self):
+        gov, clock, pressure = make_governor(
+            admission_reserve=2, eval_interval_s=1000.0, quota_window_s=10.0
+        )
+        assert gov.admit_connect()  # NORMAL: always
+        pressure[0] = 2.0
+        gov.evaluate(force=True)
+        assert gov.state == SHED
+        assert not gov.admit_connect(admin=False)
+        assert gov.admit_connect(admin=True)  # reserve slot 1
+        assert gov.admit_connect(admin=True)  # reserve slot 2
+        assert not gov.admit_connect(admin=True)  # reserve exhausted
+        assert gov.connects_refused == 2
+        assert gov.reserve_admits == 2
+        clock.t += 10  # window rolls: the reserve refills
+        gov.evaluate(force=True)
+        assert gov.admit_connect(admin=True)
+
+    def test_refuses_while_throttling_too(self):
+        gov, clock, pressure = make_governor(admission_reserve=0)
+        pressure[0] = 0.8
+        gov.evaluate(force=True)
+        assert gov.state == THROTTLE
+        assert not gov.admit_connect(admin=True)  # reserve 0: nobody
+
+    def test_failed_auth_cannot_burn_the_reserve(self):
+        """The admission gate runs AFTER on_connect_authenticate: a
+        client claiming an admin identity with bad credentials is
+        rejected 0x86 before the reserve accounting ever runs."""
+
+        async def scenario():
+            from mqtt_tpu.hooks import ON_CONNECT_AUTHENTICATE, Hook
+
+            class Deny(Hook):
+                def id(self):
+                    return "deny"
+
+                def provides(self, b):
+                    return b == ON_CONNECT_AUTHENTICATE
+
+                def on_connect_authenticate(self, cl, pk):
+                    return False
+
+            h = Harness(Options(inline_client=True), allow=False)
+            h.server.add_hook(Deny())
+            await h.server.serve()
+            gov = h.server.overload
+            gov.add_source("t", lambda: 2.0)
+            gov.evaluate(force=True)
+            assert gov.state == SHED
+            await h.connect("admin-wannabe", version=5, expect_code=0x86)
+            assert gov.reserve_admits == 0
+            assert gov.connects_refused == 0  # auth failed first
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_gauges_carry_admission_counters(self):
+        gov, clock, pressure = make_governor(admission_reserve=0)
+        pressure[0] = 2.0
+        gov.evaluate(force=True)
+        gov.admit_connect()
+        g = gov.gauges()
+        assert g["connects_refused"] == 1
+        assert g["reserve_admits"] == 0
+
+
+class TestPriorityWeightedShedding:
+    def _shed_governor(self, **weights):
+        gov, clock, pressure = make_governor(
+            shed_quota=4,
+            eval_interval_s=1000.0,
+            quota_window_s=10.0,
+            priority_weights=weights,
+        )
+        pressure[0] = 2.0
+        gov.evaluate(force=True)
+        assert gov.state == SHED
+        return gov, clock
+
+    def test_low_priority_sheds_first(self):
+        gov, clock = self._shed_governor(low=0.25, high=4.0)
+        low, high, flat = StubClient("lo"), StubClient("hi"), StubClient("fl")
+        low.priority_weight = 0.25
+        high.priority_weight = 4.0
+        admitted = {"lo": 0, "hi": 0, "fl": 0}
+        for cl, key in ((low, "lo"), (high, "hi"), (flat, "fl")):
+            for _ in range(20):
+                if gov.admit(cl):
+                    admitted[key] += 1
+        assert admitted["lo"] == 1  # int(4 * 0.25)
+        assert admitted["fl"] == 4  # the flat default quota
+        assert admitted["hi"] == 16  # int(4 * 4.0)
+
+    def test_zero_weight_class_sheds_everything(self):
+        gov, clock = self._shed_governor(junk=0.0)
+        cl = StubClient("junk-1")
+        cl.priority_weight = 0.0
+        assert not gov.admit(cl)
+
+    def test_read_delay_quota_is_weighted(self):
+        gov, clock, pressure = make_governor(
+            publish_quota=10, throttle_delay_s=0.02, eval_interval_s=1000.0
+        )
+        pressure[0] = 0.8
+        gov.evaluate(force=True)
+        hi = StubClient("hi")
+        hi.priority_weight = 10.0
+        gov.read_delay(hi)  # sync the window
+        hi._pub_count = 50  # over the flat quota, under 10x
+        assert gov.read_delay(hi) == 0.0
+        lo = StubClient("lo")
+        lo.priority_weight = 0.5
+        gov.read_delay(lo)
+        lo._pub_count = 8  # under the flat quota, over 0.5x
+        assert gov.read_delay(lo) == pytest.approx(0.02)
+
+    def test_server_assigns_class_at_connect(self):
+        async def scenario():
+            h = Harness(
+                Options(
+                    inline_client=True,
+                    overload_priority_classes={"high": 8.0},
+                    overload_priority_users={"vip": "high"},
+                )
+            )
+            await h.server.serve()
+            await h.connect("vip")
+            await h.connect("pleb")
+            assert h.server.clients.get("vip").priority_weight == 8.0
+            assert h.server.clients.get("vip").priority_class == "high"
+            assert h.server.clients.get("pleb").priority_weight == 1.0
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+
+# -- unit: gossip application + destination-aware forward tiering ------------
+
+
+class _FakeTransport:
+    def __init__(self, buffered: int = 0) -> None:
+        self.buffered = buffered
+        self.aborted = False
+
+    def get_write_buffer_size(self) -> int:
+        return self.buffered
+
+    def abort(self) -> None:
+        self.aborted = True
+
+
+class _FakeWriter:
+    def __init__(self, buffered: int = 0) -> None:
+        self.transport = _FakeTransport(buffered)
+        self.sent = []
+
+    def write(self, data: bytes) -> None:
+        self.sent.append(data)
+
+
+def _bare_cluster(tmp_path, with_governor=True):
+    class FakeServer:
+        pass
+
+    srv = FakeServer()
+    srv.topics = TopicsIndex()
+    gov = None
+    if with_governor:
+        gov, _clock, pressure = make_governor()
+        srv.overload = gov
+    c = Cluster(srv, 0, 2, str(tmp_path))
+    return c, gov
+
+
+class TestGossip:
+    def test_on_gossip_feeds_adverts_and_governor(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        c._on_gossip(1, b'{"s": 2, "p": 0.4}')
+        assert c._peer_adverts[1][0] == 2
+        assert gov.peer_signal is not None
+        assert gov.peer_signal.value() == pytest.approx(0.9 * 0.95)
+        # malformed gossip is ignored, never raises
+        c._on_gossip(1, b"not json")
+
+    def test_qos0_sheds_outright_to_shed_destination(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        c._on_gossip(1, b'{"s": 2, "p": 1.0}')
+        w = _FakeWriter(buffered=0)  # empty buffer: only the advert decides
+        assert not c._send_nowait(1, w, _T_FRAME, b"f", qos=0)
+        assert c.shed_qos0_forwards == 1
+        assert c.dropped_backlog == 1
+        assert gov.sheds == 1
+        # QoS>0 still flows: the peer's governor handles it on arrival
+        assert c._send_nowait(1, w, _T_PACKET, b"p", qos=1)
+        # an un-advertised peer is untouched
+        assert c._send_nowait(2, _FakeWriter(), _T_FRAME, b"f", qos=0)
+
+    def test_throttle_advert_reduces_the_cap(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        c._on_gossip(1, b'{"s": 1, "p": 0.5}')
+        # 60% of the buffer: fine at the full cap, over the 0.5 tier
+        w = _FakeWriter(int(0.6 * Cluster.MAX_PEER_BUFFER))
+        assert not c._send_nowait(1, w, _T_FRAME, b"f", qos=0)
+        assert c.shed_qos0_forwards == 1
+
+    def test_stale_advert_ages_out(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        c._on_gossip(1, b'{"s": 2, "p": 1.0}')
+        c._peer_adverts[1] = (2, 1.0, time.monotonic() - c.advert_ttl_s - 1)
+        assert c._qos0_fraction_for(1) == 1.0
+
+    def test_lose_gossip_filter(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        release = lose_gossip(c, rate=1.0, seed=3)
+        assert not c._rx_filter(1, _T_GOSSIP, b"{}")
+        assert c._rx_filter(1, _T_PACKET, b"{}")  # data untouched
+        release()
+        assert c._rx_filter is None
+
+
+# -- unit: peer health, park buffer, partition flush -------------------------
+
+
+class TestPeerHealth:
+    def _interested(self, c, peer, filter="park/#"):
+        c._apply_presence(peer, filter, True, False)
+
+    def _packet(self, topic="park/t", qos=1, payload=b"x"):
+        from mqtt_tpu.packets import FixedHeader, Packet
+
+        pk = Packet(
+            fixed_header=FixedHeader(type=PUBLISH, qos=qos),
+            protocol_version=5,
+            topic_name=topic,
+            packet_id=qos,
+            payload=payload,
+        )
+        pk.origin = "pub"
+        return pk
+
+    def test_suspect_parks_qos1_and_partition_flushes(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        self._interested(c, 1)
+        # no writer, no health record yet: the first QoS>0 forward parks
+        c.forward_packet(self._packet())
+        assert c.parked_forwards == 1
+        assert c._health[1].park_bytes > 0
+        c.forward_packet(self._packet(payload=b"y"))
+        assert c.parked_forwards == 2
+        assert c.dropped_qos_forwards == 0  # held, not dropped
+        # the partition verdict flushes the park into the drop counters
+        c._mark_partitioned(1)
+        assert c._health[1].state == PEER_PARTITIONED
+        assert c.parked_forwards == 0
+        assert c.dropped_partition == 2
+        assert c.dropped_qos_forwards == 2
+        assert c.dropped_forwards == 2
+        # PARTITIONED also withdrew the peer's stale interest: further
+        # publishes simply stop matching it (no forward, no drop)
+        assert c._interested_peers("park/t") == ()
+        c.forward_packet(self._packet(payload=b"z"))
+        assert c.dropped_partition == 2
+
+    def test_qos0_never_parks(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        self._interested(c, 1)
+        c.forward_frame("park/t", b"\x30\x02..", "pub")
+        assert c.parked_forwards == 0
+        assert c.dropped_partition == 1  # link-down drop, counted
+
+    def test_park_buffer_is_bounded(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        self._interested(c, 1)
+        c.park_max_bytes = 400
+        for i in range(10):
+            c.forward_packet(self._packet(payload=bytes(100)))
+        ph = c._health[1]
+        assert ph.park_bytes <= c.park_max_bytes + 200  # one frame slack
+        assert c.dropped_partition > 0  # the spill is counted
+        assert c.parked_forwards == len(ph.park)
+
+    def test_heal_replays_exactly_once(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        self._interested(c, 1)
+        c.forward_packet(self._packet())
+        c.forward_packet(self._packet(payload=b"y"))
+        assert c.parked_forwards == 2
+        w = _FakeWriter()
+        c._heal_peer(1, w)
+        assert c._health[1].state == PEER_UP
+        assert c.replayed_forwards == 2
+        assert c.parked_forwards == 0
+        assert len(w.sent) == 2
+        # a second heal replays nothing (the park is empty)
+        c._heal_peer(1, w)
+        assert c.replayed_forwards == 2
+
+    def test_ping_loop_thresholds(self, tmp_path):
+        """Synthetic missed-pong aging: suspect at the suspect threshold,
+        partitioned (with a link abort) at the partition threshold."""
+
+        async def scenario():
+            c, gov = _bare_cluster(tmp_path)
+            c.PING_INTERVAL_S = 0.01
+            c.suspect_pings = 2
+            c.partition_pings = 4
+            w = _FakeWriter()
+            c._writers[1] = w
+            c._loop = asyncio.get_running_loop()
+            task = asyncio.get_running_loop().create_task(c._ping_loop())
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                ph = c._health.get(1)
+                if ph is not None and ph.state == PEER_PARTITIONED:
+                    break
+                await asyncio.sleep(0.01)
+            ph = c._health[1]
+            assert ph.state == PEER_PARTITIONED
+            assert w.transport.aborted  # the link is forced down for re-dial
+            task.cancel()
+
+        run(scenario())
+
+    def test_pong_resets_and_heals(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        ph = c._health_for(1)
+        ph.state = PEER_SUSPECT
+        ph.outstanding = 3
+        c._writers[1] = _FakeWriter()
+        self._interested(c, 1)
+        c._park(1, _T_PACKET, b"held")
+        c._on_pong(1, b"\x00" * 8)
+        assert ph.outstanding == 0
+        assert ph.state == PEER_UP
+        assert c.replayed_forwards == 1  # the park replayed on heal
+
+    def test_sync_clears_stale_presence(self, tmp_path):
+        c, gov = _bare_cluster(tmp_path)
+        c._apply_presence(1, "old/t", True, False)
+        assert c._interested_peers("old/t") == (1,)
+        c._apply_sync(1, gen=5)
+        assert c._interested_peers("old/t") == ()
+        # an older generation's sync arriving late is ignored
+        c._apply_presence(1, "new/t", True, False)
+        c._apply_sync(1, gen=3)
+        assert c._interested_peers("new/t") == (1,)
+
+    def test_restarted_peer_generation_wins(self, tmp_path):
+        """A RESTARTED peer's generation counter begins again at 1; its
+        fresh sync must win against the dead incarnation's high-water
+        mark (the boot nonce distinguishes incarnations), and the dead
+        incarnation's leftover presence must stay discarded."""
+        c, gov = _bare_cluster(tmp_path)
+        c._apply_sync(1, gen=5, boot=111)
+        c._apply_presence(1, "old/t", True, False)
+        assert not c._presence_stale(1, {"gen": 5, "boot": 111})
+        # the peer process restarts: new boot id, counter back at 1
+        c._apply_sync(1, gen=1, boot=222)
+        assert c._interested_peers("old/t") == ()  # cleared by the sync
+        assert not c._presence_stale(1, {"gen": 1, "boot": 222})
+        # the dead incarnation's frames never re-apply, whatever the gen
+        assert c._presence_stale(1, {"gen": 99, "boot": 111})
+        # a peer too old to send boot ids only checks the generation
+        assert c._presence_stale(1, {"gen": 0})
+        assert not c._presence_stale(1, {"gen": 1})
+
+
+# -- e2e: severed-then-healed link replays parked QoS>0 exactly once ---------
+
+
+class TestSeverHealReplay:
+    def test_park_replay_and_presence_convergence(self, tmp_path):
+        async def scenario():
+            h0 = Harness(Options(inline_client=True))
+            h1 = Harness(Options(inline_client=True))
+            c0 = Cluster(h0.server, 0, 2, str(tmp_path))
+            c1 = Cluster(h1.server, 1, 2, str(tmp_path))
+            for c in (c0, c1):
+                c.PING_INTERVAL_S = 0.2
+            c1.DIAL_BACKOFF_S = 0.3  # a parking window before the re-dial
+            await h0.server.serve()
+            await h1.server.serve()
+            await c0.start()
+            await c1.start()
+
+            async def wait_for(cond, timeout=10.0):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if cond():
+                        return True
+                    await asyncio.sleep(0.02)
+                return False
+
+            assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+
+            sr, sw, _ = await h1.connect("sub", version=5)
+            sw.write(sub_packet(1, [Subscription(filter="park/t", qos=1)], version=5))
+            await sw.drain()
+            assert (await read_wire_packet(sr, 5)).fixed_header.type == SUBACK
+            assert await wait_for(lambda: c0._interested_peers("park/t") == (1,))
+
+            pr, pw, _ = await h0.connect("pub", version=5)
+
+            got: list[bytes] = []
+
+            async def collect():
+                while True:
+                    try:
+                        pk = await asyncio.wait_for(read_wire_packet(sr, 5), 0.5)
+                    except asyncio.TimeoutError:
+                        if done.is_set():
+                            return
+                        continue
+                    if pk.fixed_header.type == PUBLISH:
+                        got.append(bytes(pk.payload))
+
+            done = asyncio.Event()
+            collector = asyncio.ensure_future(collect())
+
+            # sanity: the live link forwards
+            pw.write(pub_packet("park/t", b"pre", qos=1, pid=1, version=5))
+            await pw.drain()
+            assert (await read_wire_packet(pr, 5)).fixed_header.type == PUBACK
+            assert await wait_for(lambda: b"pre" in got)
+
+            # sever mid-traffic and park five QoS1 publishes
+            c0._writers[1].transport.abort()
+            assert await wait_for(lambda: c0._writers.get(1) is None)
+            assert c0._health[1].state == PEER_SUSPECT
+            for i in range(5):
+                pw.write(
+                    pub_packet("park/t", f"held-{i}".encode(), qos=1,
+                               pid=2 + i, version=5)
+                )
+            await pw.drain()
+            for _ in range(5):
+                assert (await read_wire_packet(pr, 5)).fixed_header.type == PUBACK
+            assert c0.parked_forwards == 5
+            assert c0.dropped_qos_forwards == 0
+
+            # heal: the dialer reconnects, the park replays exactly once
+            assert await wait_for(lambda: c0.peer_count == 1)
+            assert await wait_for(lambda: c0.replayed_forwards == 5)
+            assert await wait_for(
+                lambda: sum(1 for p in got if p.startswith(b"held-")) >= 5
+            )
+            await asyncio.sleep(0.3)  # a duplicate would land here
+            done.set()
+            await collector
+            for i in range(5):
+                assert got.count(b"held-%d" % i) == 1, (i, got)
+            assert c0.parked_forwards == 0
+
+            # presence converges against the single-worker oracle: the
+            # healed mesh's interest map must mirror worker 1's live trie
+            # high packet id: ids 1..6 are inflight (the unacked QoS1
+            # deliveries above), and a SUBSCRIBE on an inflight id is
+            # refused with 0x91 packet-identifier-in-use
+            sw.write(sub_packet(600, [Subscription(filter="late/+", qos=0)], version=5))
+            await sw.drain()
+            assert await wait_for(lambda: c0._interested_peers("late/x") == (1,))
+            oracle = h1.server.topics
+            for topic in ("park/t", "late/x", "nobody/here"):
+                expect = (1,) if oracle.subscribers(topic).subscriptions else ()
+                assert await wait_for(
+                    lambda t=topic, e=expect: c0._interested_peers(t) == e
+                ), topic
+
+            await c0.stop()
+            await c1.stop()
+            await h0.server.close()
+            await h1.server.close()
+            await h0.shutdown()
+            await h1.shutdown()
+
+        run(scenario())
+
+    def test_asymmetric_partition_parks_then_heals(self, tmp_path):
+        """One-way loss (pongs vanish, writes still succeed): the health
+        clock walks the peer to SUSPECT and QoS>0 forwards park; when the
+        return path heals, the next pong replays them."""
+
+        async def scenario():
+            h0 = Harness(Options(inline_client=True))
+            h1 = Harness(Options(inline_client=True))
+            c0 = Cluster(h0.server, 0, 2, str(tmp_path))
+            c1 = Cluster(h1.server, 1, 2, str(tmp_path))
+            for c in (c0, c1):
+                c.PING_INTERVAL_S = 0.05
+            c0.suspect_pings = 2
+            c0.partition_pings = 60  # keep the drill inside SUSPECT
+            await h0.server.serve()
+            await h1.server.serve()
+            await c0.start()
+            await c1.start()
+
+            async def wait_for(cond, timeout=10.0):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if cond():
+                        return True
+                    await asyncio.sleep(0.02)
+                return False
+
+            assert await wait_for(lambda: c0.peer_count == 1 and c1.peer_count == 1)
+            c0._apply_presence(1, "asym/t", True, False)
+
+            release = asymmetric_partition(c0, 1)
+            assert await wait_for(
+                lambda: c0._health.get(1) is not None
+                and c0._health[1].state == PEER_SUSPECT
+            )
+            from tests.test_federation import TestPeerHealth
+
+            c0.forward_packet(TestPeerHealth()._packet(topic="asym/t"))
+            assert c0.parked_forwards == 1
+
+            release()  # the return path heals; the next pong replays
+            assert await wait_for(lambda: c0.replayed_forwards == 1)
+            assert c0._health[1].state == PEER_UP
+            assert c0.parked_forwards == 0
+
+            await c0.stop()
+            await c1.stop()
+            await h0.server.close()
+            await h1.server.close()
+            await h0.shutdown()
+            await h1.shutdown()
+
+        run(scenario())
+
+
+# -- e2e: the 3-worker gossip acceptance drill -------------------------------
+
+
+class TestMeshFederationStorm:
+    def test_shed_worker_raises_mesh_refuses_connects_and_weights_sheds(
+        self, tmp_path
+    ):
+        """Worker 0 is stormed into SHED (seeded): its peers reach >=
+        THROTTLE via gossip within one (shortened) gossip interval, a new
+        CONNECT to worker 0 gets CONNACK 0x97, and the high-priority
+        client sheds NOTHING while low-priority publishers do."""
+
+        async def scenario():
+            low_users = {f"storm-p{i}": "low" for i in range(5)}
+            h0 = Harness(
+                storm_options(
+                    dwell_ms=4000.0,  # sticky SHED for the probes below
+                    shed_exit=0.02,
+                    shed_quota=10,
+                    overload_admission_reserve=0,
+                    overload_priority_classes={"low": 0.1, "high": 50.0},
+                    overload_priority_users={**low_users, "vip": "high"},
+                )
+            )
+            h0.server.matcher = FaultyMatcher(
+                h0.server.matcher, FaultPlan(seed=5, slow_rate=1.0, slow_s=0.02)
+            )
+            h1 = Harness(Options(inline_client=True))
+            h2 = Harness(Options(inline_client=True))
+            clusters = [
+                Cluster(h.server, i, 3, str(tmp_path))
+                for i, h in enumerate((h0, h1, h2))
+            ]
+            for c in clusters:
+                c.PING_INTERVAL_S = 0.1  # the shortened gossip interval
+            for h in (h0, h1, h2):
+                await h.server.serve()
+            for c in clusters:
+                await c.start()
+
+            async def wait_for(cond, timeout=10.0):
+                deadline = time.monotonic() + timeout
+                while time.monotonic() < deadline:
+                    if cond():
+                        return True
+                    await asyncio.sleep(0.02)
+                return False
+
+            assert await wait_for(
+                lambda: all(c.peer_count == 2 for c in clusters)
+            )
+
+            gov0 = h0.server.overload
+            # the vip connects BEFORE the storm (a high-priority session
+            # surviving the blast, not racing the admission gate)
+            vip_r, vip_w, _ = await h0.connect("vip", version=5)
+
+            plan = StormPlan(
+                seed=42, publishers=5, msgs_per_publisher=60,
+                topic_space=8, qos1_fraction=0.5,
+            )
+            admitted, shed, _ack_times, collector, _ = await run_publish_storm(
+                h0, plan
+            )
+            await collector.finish()
+            assert shed, "the storm never shed: offered load too low"
+            assert gov0.state == SHED  # dwell keeps the posture sticky
+
+            # (1) gossip raises the peers within one gossip interval:
+            # poll well inside ONE production interval; the transition
+            # gossip plus the 0.1s cadence deliver the advert, and the
+            # peers' own evaluation folds it into their posture
+            t0 = time.monotonic()
+            for gov in (h1.server.overload, h2.server.overload):
+                assert await wait_for(
+                    lambda g=gov: g.evaluate(force=True) in (THROTTLE, SHED),
+                    timeout=2.0,
+                ), "peer governor never left NORMAL"
+                assert gov.signal_pressures.get("peers", 0.0) >= 0.7
+                assert gov.state == THROTTLE  # raised, NOT a SHED cascade
+            assert time.monotonic() - t0 < 2.0
+
+            # (2) a new CONNECT is refused with CONNACK 0x97 while shed
+            await h0.connect("late-joiner", version=5, expect_code=0x97)
+            assert gov0.connects_refused >= 1
+
+            # (3) priority-weighted shedding: the vip's weighted quota
+            # (10 x 50) admits everything it sends while low-priority
+            # budgets (10 x 0.1 = 1/window) are already shedding
+            assert gov0.state == SHED
+            vip_acks = []
+            for i in range(20):
+                vip_w.write(
+                    pub_packet("storm/vip/t", b"vip", qos=1, pid=1 + i, version=5)
+                )
+            await vip_w.drain()
+            while len(vip_acks) < 20:
+                pk = await asyncio.wait_for(read_wire_packet(vip_r, 5), 10)
+                if pk.fixed_header.type == PUBACK:
+                    vip_acks.append(pk.reason_code)
+            assert all(code != 0x97 for code in vip_acks), vip_acks
+            # ...and the shed set really was low-priority traffic
+            assert shed and all(tag[:1] == b"s" for tag in shed)
+
+            for c in clusters:
+                await c.stop()
+            for h in (h0, h1, h2):
+                await h.server.close()
+                await h.shutdown()
+
+        run(scenario())
+
+
+# -- config plumbing ---------------------------------------------------------
+
+
+class TestFederationConfig:
+    def test_knob_normalization(self):
+        o = Options(
+            overload_federation_weight=-1.0,
+            overload_federation_ttl_ms=0,
+            overload_admission_reserve=-3,
+            cluster_peer_health_suspect_pings=0,
+            cluster_peer_health_partition_pings=0,
+            cluster_peer_park_max_bytes=-1,
+        )
+        o.ensure_defaults()
+        assert o.overload_federation_weight > 0
+        assert o.overload_federation_ttl_ms > 0
+        assert o.overload_admission_reserve == 0
+        assert o.cluster_peer_health_suspect_pings > 0
+        assert (
+            o.cluster_peer_health_partition_pings
+            > o.cluster_peer_health_suspect_pings
+        )
+        assert o.cluster_peer_park_max_bytes > 0
+
+    def test_config_file_passthrough(self):
+        from mqtt_tpu.config import from_bytes
+
+        opts = from_bytes(
+            b"""
+options:
+  overload_federation: false
+  overload_federation_weight: 0.8
+  overload_admission_reserve: 5
+  overload_priority_classes: {low: 0.2, high: 4.0}
+  overload_priority_users: {sensor-fleet: low}
+  cluster_peer_health_suspect_pings: 3
+  cluster_peer_park_max_bytes: 65536
+listeners:
+  - type: tcp
+    id: ops
+    address: 127.0.0.1:0
+    admission: false
+"""
+        )
+        assert opts.overload_federation is False
+        assert opts.overload_federation_weight == 0.8
+        assert opts.overload_admission_reserve == 5
+        assert opts.overload_priority_classes == {"low": 0.2, "high": 4.0}
+        assert opts.overload_priority_users == {"sensor-fleet": "low"}
+        assert opts.cluster_peer_health_suspect_pings == 3
+        assert opts.cluster_peer_park_max_bytes == 65536
+        assert opts.listeners[0].admission is False
+
+    def test_drain_refuses_with_0x89(self):
+        async def scenario():
+            h = Harness(Options(inline_client=True))
+            await h.server.serve()
+            h.server._draining = True
+            await h.connect("late", version=5, expect_code=0x89)
+            h.server._draining = False
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_admission_exempt_listener(self):
+        async def scenario():
+            from mqtt_tpu.listeners import MockListener
+
+            h = Harness(
+                Options(inline_client=True, overload_admission_reserve=0)
+            )
+            lst = MockListener("ops", "1")
+            lst.config.admission = False
+            h.server.add_listener(lst)
+            await h.server.serve()
+            pressure = [2.0]
+            h.server.overload.add_source("test", lambda: pressure[0])
+            h.server.overload.evaluate(force=True)
+            assert h.server.overload.state == SHED
+            # the exempt listener admits; the default path refuses
+            assert h.server._connect_admission(
+                h.server.new_client(None, None, "ops", "x", False), "ops"
+            ) is None
+            refusal = h.server._connect_admission(
+                h.server.new_client(None, None, "t1", "y", False), "t1"
+            )
+            assert refusal is not None and refusal.code == 0x97
+            pressure[0] = 0.0
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+

@@ -101,6 +101,34 @@ def test_incremental_rebuild_touches_one_shard():
         matcher.close()
 
 
+def test_per_shard_compile_histograms_merge_at_scrape():
+    """ISSUE 5 satellite: every shard compile records into its own
+    shard-local Histogram (no cross-thread write sharing) and
+    ``merged_shard_compile`` folds them into one scrape-time snapshot
+    whose count equals the sum of the shards'."""
+    index = TopicsIndex()
+    for i in range(40):
+        index.subscribe(f"cl{i}", Subscription(filter=f"a/{i}/+", qos=0))
+    matcher = ShardedTpuMatcher(index, mesh=make_mesh(jax.devices()[:4]))
+    try:
+        matcher.rebuild()  # full build: every shard compiles at least once
+        per_shard = [h.count for h in matcher.shard_compile_hists]
+        assert sum(per_shard) >= matcher.n_shards
+        assert all(n >= 1 for n in per_shard)
+        merged = matcher.merged_shard_compile()
+        assert merged.count == sum(per_shard)
+        assert merged.sum == pytest.approx(
+            sum(h.sum for h in matcher.shard_compile_hists)
+        )
+        # an incremental rebuild only touches the dirty shard's histogram
+        index.subscribe("late", Subscription(filter="z/z", qos=0))
+        matcher.rebuild()
+        after = [h.count for h in matcher.shard_compile_hists]
+        assert sum(after) == sum(per_shard) + 1, (per_shard, after)
+    finally:
+        matcher.close()
+
+
 def test_stable_hash_assignment_is_churn_invariant():
     """The shard owning a subscription must not depend on what else is in
     the index (round-robin regression guard)."""

@@ -18,8 +18,10 @@ after, with all raced filters routed). Deadlock shows up as the
 ``timeout`` marker killing the test.
 """
 
+import contextlib
 import faulthandler
 import random
+import sys
 import threading
 import time
 
@@ -28,6 +30,22 @@ import pytest
 from mqtt_tpu.ops.delta import DeltaMatcher
 from mqtt_tpu.packets import Subscription
 from mqtt_tpu.topics import SHARE_PREFIX, TopicsIndex
+
+
+@contextlib.contextmanager
+def switch_interval(interval_s: float):
+    """Thread-schedule fuzzing fixture (ROADMAP "Correctness tooling"):
+    pin ``sys.setswitchinterval`` for the block's duration — a tiny
+    interval preempts threads mid-bytecode-run orders of magnitude more
+    often than the 5ms default, shaking out interleavings the default
+    schedule practically never produces — and ALWAYS restore the
+    original, or the whole session runs degraded afterwards."""
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(interval_s)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(prev)
 
 SEGS = ["alpha", "beta", "gamma", "delta", "x"]
 
@@ -142,6 +160,82 @@ def test_churn_while_matching_two_writers():
     # the run must have exercised the incremental machinery, not just
     # full rebuilds
     assert m.stats.rebuilds + m.stats.folds > 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("interval_s", [1e-6, 1e-5, 1e-4])
+def test_churn_switch_interval_sweep(interval_s):
+    """The nightly thread-schedule sweep: the two-writer churn drill
+    re-run under seeded switch intervals far below the 5ms default
+    (1us/10us/100us), so the GIL hands over at pathological points —
+    torn trie walks, observer re-entries, fold/rebuild interleavings the
+    default schedule essentially never exercises. Each leg is a
+    shortened copy of the main churn test: every batch served, final
+    parity bit-identical under a writer pause."""
+    index = TopicsIndex()
+    seed = int(interval_s * 1e7) or 1
+    r0 = random.Random(seed)
+    for i in range(800):
+        index.subscribe(f"base{i}", Subscription(filter=_rand_filter(r0), qos=i % 3))
+    faulthandler.dump_traceback_later(110, exit=True)
+    stop = threading.Event()
+    errors: list = []
+
+    def writer(wseed: int) -> None:
+        r = random.Random(wseed)
+        i = 0
+        try:
+            while not stop.is_set():
+                flt = _rand_filter(r)
+                if r.random() < 0.5:
+                    index.subscribe(f"w{wseed}_{i}", Subscription(filter=flt, qos=1))
+                else:
+                    index.unsubscribe(flt, f"w{wseed}_{r.randint(0, max(1, i))}")
+                i += 1
+                time.sleep(0.0005)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    try:
+        with switch_interval(interval_s):
+            m = DeltaMatcher(
+                index, max_levels=4, rebuild_after=64, rebuild_interval=0.05,
+                background=True,
+            )
+            writers = [
+                threading.Thread(target=writer, args=(s,), daemon=True)
+                for s in (seed + 1, seed + 2)
+            ]
+            for t in writers:
+                t.start()
+            r = random.Random(42)
+            t_end = time.time() + 3.0
+            batches = 0
+            try:
+                while time.time() < t_end:
+                    topics = [_rand_topic(r) for _ in range(128)]
+                    results = m.match_topics(topics)
+                    assert len(results) == len(topics)
+                    batches += 1
+            finally:
+                stop.set()
+                for t in writers:
+                    t.join(timeout=10)
+            # final parity once the writers stopped (trie quiescent)
+            m.flush()
+            try:
+                for topic in [_rand_topic(r) for _ in range(48)]:
+                    assert canon(m.subscribers(topic)) == canon(
+                        index.subscribers(topic)
+                    ), topic
+            finally:
+                m.close()
+    finally:
+        # disarm even on a failed leg: a still-armed exit=True timer
+        # would hard-kill the whole nightly session 110s later
+        faulthandler.cancel_dump_traceback_later()
+    assert not errors, errors
+    assert batches >= 2, f"matcher starved under {interval_s}s switch interval"
 
 
 def test_fold_lock_order_regression():

@@ -1,0 +1,159 @@
+"""The per-stage regression gate (exp/stage_gate.py): bench telemetry
+blocks diff stage-by-stage against the previous BENCH artifact, failing
+only on real p99 regressions — path-matched blocks, sample-count floors,
+and graceful pass-through when a run carries no telemetry at all."""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "stage_gate", os.path.join(REPO, "exp", "stage_gate.py")
+)
+stage_gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(stage_gate)
+
+
+def bench_doc(p99_ms, count=100, stage="device_batch", config="5"):
+    return {
+        "parsed": {
+            "configs": {
+                config: {
+                    "telemetry": {
+                        "stages": {
+                            stage: {
+                                "count": count,
+                                "p50_ms": p99_ms / 2,
+                                "p99_ms": p99_ms,
+                            }
+                        }
+                    }
+                }
+            }
+        }
+    }
+
+
+class TestCompare:
+    def test_regression_detected_past_threshold(self):
+        reg, cmp_ = stage_gate.compare(bench_doc(2.0), bench_doc(1.0))
+        assert len(cmp_) == 1
+        assert len(reg) == 1
+        assert "device_batch" in reg[0]
+
+    def test_within_threshold_passes(self):
+        reg, cmp_ = stage_gate.compare(
+            bench_doc(1.2), bench_doc(1.0), threshold=0.25
+        )
+        assert cmp_ and not reg
+
+    def test_improvement_passes(self):
+        reg, _ = stage_gate.compare(bench_doc(0.5), bench_doc(1.0))
+        assert not reg
+
+    def test_small_samples_are_ignored(self):
+        reg, cmp_ = stage_gate.compare(
+            bench_doc(10.0, count=5), bench_doc(1.0, count=5), min_count=20
+        )
+        assert not cmp_ and not reg
+
+    def test_blocks_match_by_path_not_position(self):
+        # config 8's regression must not diff against config 5's numbers
+        cur = bench_doc(9.0, config="8")
+        prev = bench_doc(1.0, config="5")
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not cmp_ and not reg
+
+    def test_new_stage_without_baseline_passes(self):
+        cur = bench_doc(9.0, stage="fanout")
+        prev = bench_doc(1.0, stage="decode")
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not cmp_ and not reg
+
+    def test_zero_baseline_is_skipped(self):
+        reg, cmp_ = stage_gate.compare(bench_doc(1.0), bench_doc(0.0))
+        assert not cmp_ and not reg
+
+    def test_batch_service_row_compares(self):
+        cur = {"telemetry": {"stages": {}, "batch_service": {"count": 50, "p99_ms": 4.0}}}
+        prev = {"telemetry": {"stages": {}, "batch_service": {"count": 50, "p99_ms": 1.0}}}
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert cmp_ == ["/telemetry:batch_service"]
+        assert len(reg) == 1
+
+
+class TestBenchRanking:
+    def test_newest_pair_orders_by_round(self, tmp_path):
+        for name in ("BENCH_r02.json", "BENCH_r10.json", "BENCH_r09.json"):
+            (tmp_path / name).write_text("{}")
+        pair = stage_gate.newest_pair(str(tmp_path))
+        assert os.path.basename(pair[0]) == "BENCH_r10.json"
+        assert os.path.basename(pair[1]) == "BENCH_r09.json"
+
+    def test_suffixed_variants_never_diff_against_their_round(self, tmp_path):
+        """A _local/_cpu_fullscale variant is a different machine or
+        backend: the auto-pick must compare canonical rounds (r05 vs
+        r04), never a variant against its plain sibling."""
+        for name in (
+            "BENCH_r04.json", "BENCH_r05.json",
+            "BENCH_r05_cpu_fullscale.json", "BENCH_r04_local.json",
+        ):
+            (tmp_path / name).write_text("{}")
+        pair = stage_gate.newest_pair(str(tmp_path))
+        assert os.path.basename(pair[0]) == "BENCH_r05.json"
+        assert os.path.basename(pair[1]) == "BENCH_r04.json"
+
+    def test_variants_used_only_without_canonical_rounds(self, tmp_path):
+        for name in ("BENCH_r05_local.json", "BENCH_r05_cpu.json"):
+            (tmp_path / name).write_text("{}")
+        assert stage_gate.newest_pair(str(tmp_path)) is not None
+
+    def test_fewer_than_two_files(self, tmp_path):
+        (tmp_path / "BENCH_r01.json").write_text("{}")
+        assert stage_gate.newest_pair(str(tmp_path)) is None
+
+
+class TestCli:
+    def _run(self, *args):
+        return subprocess.run(
+            [sys.executable, os.path.join(REPO, "exp", "stage_gate.py"), *args],
+            capture_output=True, text=True, cwd=REPO,
+        )
+
+    def test_cli_fails_on_regression(self, tmp_path):
+        cur = tmp_path / "BENCH_r02.json"
+        prev = tmp_path / "BENCH_r01.json"
+        cur.write_text(json.dumps(bench_doc(5.0)))
+        prev.write_text(json.dumps(bench_doc(1.0)))
+        r = self._run("--current", str(cur), "--previous", str(prev))
+        assert r.returncode == 1
+        assert "REGRESSION" in r.stdout
+
+    def test_cli_passes_clean_pair(self, tmp_path):
+        cur = tmp_path / "BENCH_r02.json"
+        prev = tmp_path / "BENCH_r01.json"
+        cur.write_text(json.dumps(bench_doc(1.0)))
+        prev.write_text(json.dumps(bench_doc(1.0)))
+        r = self._run("--current", str(cur), "--previous", str(prev))
+        assert r.returncode == 0, r.stdout
+
+    def test_single_flag_never_self_diffs(self, tmp_path):
+        """--current alone must pair against the newest OTHER round,
+        never against itself (a self-diff passes vacuously)."""
+        cur = tmp_path / "BENCH_r05.json"
+        prev = tmp_path / "BENCH_r04.json"
+        cur.write_text(json.dumps(bench_doc(9.0)))
+        prev.write_text(json.dumps(bench_doc(1.0)))
+        r = self._run("--current", str(cur), "--repo", str(tmp_path))
+        assert r.returncode == 1, r.stdout  # 9x regression vs r04 caught
+        assert "BENCH_r04.json" in r.stdout
+
+    def test_cli_passes_repo_artifacts(self):
+        """The checked-in BENCH history must pass the gate as wired in CI
+        (device-less driver runs carry no telemetry blocks: notice+pass)."""
+        r = self._run()
+        assert r.returncode == 0, r.stdout

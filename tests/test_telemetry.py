@@ -106,6 +106,42 @@ class TestHistogram:
         with pytest.raises(ValueError):
             a.merge(b)
 
+    def test_callback_histogram_renders_merged_snapshot(self):
+        """A registry histogram may be backed by a scrape-time callback
+        returning a merged snapshot (the sharded matcher's per-thread
+        shard pattern): exposition and sys_tree render the snapshot, and
+        a failing callback degrades to the empty stored child instead of
+        killing the scrape."""
+        from mqtt_tpu.telemetry import MetricsRegistry, check_exposition
+
+        shards = [Histogram(), Histogram()]
+        shards[0].observe(1e-5)
+        shards[1].observe(2e-5)
+        shards[1].observe(4e-5)
+
+        def merged():
+            out = Histogram()
+            for s in shards:
+                out.merge(s)
+            return out
+
+        r = MetricsRegistry()
+        r.histogram("mqtt_tpu_shardy_seconds", "merged shards", fn=merged)
+        text = r.exposition()
+        check_exposition(text)
+        assert "mqtt_tpu_shardy_seconds_count 3" in text
+        tree = r.sys_tree()
+        assert tree["shardy_seconds/count"] == 3
+        shards[0].observe(8e-5)  # live: the next scrape sees new data
+        assert r.sys_tree()["shardy_seconds/count"] == 4
+
+        def boom():
+            raise RuntimeError("shard walk failed")
+
+        r2 = MetricsRegistry()
+        r2.histogram("mqtt_tpu_shardy_seconds", "merged shards", fn=boom)
+        assert "mqtt_tpu_shardy_seconds_count 0" in r2.exposition()
+
     def test_linear_bounds_for_ratios(self):
         h = Histogram(bounds=FILL_BOUNDS)
         h.observe(0.05)
