@@ -274,6 +274,15 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         matcher.stats.overflows,
         matcher.stats.topics,
     )
+    # device pipeline profiler (mqtt_tpu.tracing): duty cycle / overlap /
+    # idle-gap over the pipelined loop — the exact numbers ROADMAP item
+    # 1's overlapped-staging work must move, baselined per round.
+    # Attached AFTER warmup so the cold compile doesn't skew the windows.
+    from mqtt_tpu.tracing import DeviceProfiler
+
+    profiler = DeviceProfiler()
+    if hasattr(matcher, "profiler"):
+        matcher.profiler = profiler
     hits = 0
     t_start = time.perf_counter()
     pending = matcher.match_topics_async(batches[0])
@@ -295,6 +304,9 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
             )
         pending = nxt
     e2e_dt = time.perf_counter() - t_start
+    device_pipeline = profiler.bench_block()
+    if hasattr(matcher, "profiler"):
+        matcher.profiler = None  # the latency loops below stay unprofiled
     n_topics = matcher.stats.topics - s0_topics
     fallbacks = matcher.stats.host_fallbacks - s0_fall
     overflows = matcher.stats.overflows - s0_ovf
@@ -434,6 +446,10 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
 
     return {
         "e2e_matches_per_sec": round((iters * batch) / e2e_dt),
+        # kernel duty cycle / transfer-compute overlap / idle gaps over
+        # the pipelined e2e loop (mqtt_tpu.tracing.DeviceProfiler) — the
+        # ROADMAP item 1 gap, measured per round
+        "device_pipeline": device_pipeline,
         "telemetry": telemetry_block(
             lat,
             "device_batch",
@@ -894,6 +910,11 @@ def run_storm_bench(fast: bool) -> dict:
                 srv.telemetry.recorder.join_writer()  # dump IO off-thread
                 out["telemetry"] = srv.telemetry.bench_block()
                 out["flight_dumps"] = srv.telemetry.recorder.dumps
+            if srv.profiler is not None:
+                # the live broker's device duty-cycle / overlap / idle-gap
+                # numbers under storm load (mqtt_tpu.tracing) — ROADMAP
+                # item 1's per-round baseline of the staging gap
+                out["device_pipeline"] = srv.profiler.bench_block()
             try:
                 slow_w.close()
             except Exception:

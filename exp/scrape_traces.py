@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""CI trace-scrape gate: boot a broker with the trace plane on (sample
+every publish), drive a short publish burst over real TCP, fetch ``GET
+/traces`` from the stats listener, validate it with the pure-Python
+trace-event checker (mqtt_tpu.tracing.check_trace_events), assert the
+publish span trees actually recorded, and write the snapshot to disk —
+the workflow uploads it as an artifact, so every CI run carries a
+Perfetto-loadable trace of its own publish burst.
+
+Usage: python exp/scrape_traces.py [--out traces-snapshot.json]
+Exits non-zero when the export fails to parse or the expected spans are
+missing.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def main(out_path: str) -> int:
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+    from mqtt_tpu.tracing import check_trace_events
+
+    try:  # the device sub-stage spans need the device matcher; CPU jax works
+        import jax  # noqa: F401
+
+        device = True
+    except ImportError:
+        device = False
+
+    opts = Options(
+        device_matcher=device,
+        matcher_opts={"max_levels": 4, "background": False} if device else None,
+        telemetry_sample=1,
+        trace_sample=1,  # trace everything: a 2s burst must register
+    )
+    srv = Server(opts)
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    srv.add_listener(
+        HTTPStats(
+            LConfig(type="sysinfo", id="s", address="127.0.0.1:0"),
+            srv.info,
+            telemetry=srv.telemetry,
+        )
+    )
+    await srv.serve()
+    try:
+        host, port = srv.listeners.get("t").address().rsplit(":", 1)
+
+        sr, sw = await asyncio.open_connection(host, int(port))
+        sw.write(_connect_bytes("trace-sub", version=4))
+        await sw.drain()
+        await sr.readexactly(4)
+        sw.write(_subscribe_bytes(1, "bench/#"))
+        await sw.drain()
+        await sr.readexactly(5)
+        if srv.matcher is not None:
+            srv.matcher.flush()
+
+        pr, pw = await asyncio.open_connection(host, int(port))
+        pw.write(_connect_bytes("trace-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        for i in range(200):
+            topic = f"bench/{i % 10}".encode()
+            payload = b"x" * 16
+            body = len(topic).to_bytes(2, "big") + topic + payload
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+        # a cold first batch pays the JIT compile (seconds on a fresh
+        # XLA cache): keep waiting to the deadline instead of bailing on
+        # the first quiet read — the span tree only exists once fan-out
+        # completed, so leaving early reads an empty ring
+        deadline = asyncio.get_event_loop().time() + 60
+        got = 0
+        while got < 200 and asyncio.get_event_loop().time() < deadline:
+            try:
+                data = await asyncio.wait_for(sr.read(65536), 1.0)
+            except asyncio.TimeoutError:
+                if got >= 200:
+                    break
+                continue
+            if not data:
+                break
+            got += data.count(b"bench/")
+        print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
+
+        hr, hw = await asyncio.open_connection(
+            *srv.listeners.get("s").address().rsplit(":", 1)
+        )
+        hw.write(b"GET /traces HTTP/1.1\r\nHost: x\r\n\r\n")
+        await hw.drain()
+        # Connection: close — read to EOF so a large export never truncates
+        raw = b""
+        while True:
+            chunk = await asyncio.wait_for(hr.read(65536), 5)
+            if not chunk:
+                break
+            raw += chunk
+        head, body = raw.split(b"\r\n\r\n", 1)
+        assert b"200" in head.split(b"\r\n", 1)[0], head
+        doc = json.loads(body.decode())
+
+        events = check_trace_events(doc)
+        names = {e["name"] for e in doc["traceEvents"]}
+        required = {"publish", "decode", "admission", "fanout"}
+        if device:
+            required |= {"staging_wait"}
+        missing = sorted(required - names)
+        if missing:
+            print(f"FAIL: trace export missing spans {missing}", file=sys.stderr)
+            return 1
+        roots = sum(1 for e in doc["traceEvents"] if e["name"] == "publish")
+        with open(out_path, "w") as f:
+            json.dump(doc, f)
+        print(
+            f"OK: {events} trace events ({roots} publish roots) parsed; "
+            f"snapshot -> {out_path}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        await srv.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="traces-snapshot.json")
+    sys.exit(asyncio.run(main(ap.parse_args().out)))

@@ -4,17 +4,23 @@
 
 bench.py emits a ``telemetry`` block per config — per-stage p50/p99
 through the broker's own log-scale histogram buckets — so regressions
-can be judged stage-by-stage (decode/admission/staging_wait/
-device_batch/fanout/materialize) instead of only on the end-to-end
-rate. This gate diffs the two most recent ``BENCH_*.json`` files (or an
-explicit ``--current``/``--previous`` pair) and fails when any stage's
-p99 regressed by more than ``--threshold`` (default 25%).
+can be judged stage-by-stage (decode/admission/staging_wait/h2d/
+device_dispatch/d2h/device_batch/fanout/materialize) instead of only on
+the end-to-end rate. This gate diffs the two most recent
+``BENCH_*.json`` files (or an explicit ``--current``/``--previous``
+pair) and fails when any stage's p99 regressed by more than
+``--threshold`` (default 25%).
 
 Robustness rules (a gate that cries wolf gets deleted):
 - stages are compared only when BOTH runs observed them, with at least
   ``--min-count`` samples each (tiny samples land in log-bucket noise);
 - telemetry blocks are matched by their json path, so config 5's
   device_batch never diffs against config 8's;
+- stage names present only in the CURRENT run — e.g. the trace plane's
+  h2d/device_dispatch/d2h sub-stages against a round recorded before
+  the device_batch split — pass through with a notice, never a
+  failure: a new sub-stage has no baseline to regress against
+  (``device_batch`` stays populated as their sum for continuity);
 - a run with no telemetry blocks (device-less driver hosts) passes with
   a notice — absence of evidence is not a regression.
 
@@ -106,6 +112,26 @@ def compare(
     return regressions, compared
 
 
+def new_stage_names(current: dict, previous: dict) -> list[str]:
+    """Stage names the current run observed that the previous run (at
+    the same json path) never did — the trace plane's sub-stage split
+    lands here on its first round. Reported as a notice by main(); by
+    construction compare() never diffs them, so a new stage can never
+    fail the gate vacuously."""
+    cur_blocks = find_telemetry_blocks(current)
+    prev_blocks = find_telemetry_blocks(previous)
+    out: set[str] = set()
+    for path, cur in cur_blocks.items():
+        prev = prev_blocks.get(path)
+        if prev is None:
+            continue
+        prev_rows = stage_rows(prev)
+        for name in stage_rows(cur):
+            if name not in prev_rows:
+                out.add(name)
+    return sorted(out)
+
+
 def _bench_rank(path: str) -> tuple[int, str]:
     """Order BENCH files by their round number (BENCH_r05 > BENCH_r04)."""
     m = re.search(r"_r(\d+)", os.path.basename(path))
@@ -182,6 +208,12 @@ def main() -> int:
         f"stage-gate: {cur_path} vs {prev_path}: "
         f"{len(compared)} stage(s) compared"
     )
+    fresh = new_stage_names(current, previous)
+    if fresh:
+        print(
+            "stage-gate: new stage(s) without a baseline (not diffed): "
+            + ", ".join(fresh)
+        )
     if not compared:
         print(
             "stage-gate: no comparable telemetry blocks (device-less bench "

@@ -93,6 +93,14 @@ _T_PONG = 0x52  # 'R' echoed ping payload
 # from a raced old link can never re-apply (split-brain guard)
 _T_GOSSIP = 0x47  # 'G' json {s: state_code, p: pressure}
 _T_SYNC = 0x59  # 'Y' json {gen}
+# trace plane (mqtt_tpu.tracing): a TRACED v4 qos0 passthrough frame —
+# _T_FRAME plus an embedded trace context so the peer's remote-fanout
+# span joins the origin's trace. A NEW type rather than a _T_FRAME
+# layout change: an older peer ignores it (losing only the 1-in-N
+# sampled forwards in a mixed-version mesh) instead of misparsing
+# every frame. Traced _T_PACKET forwards need no new type — the json
+# head just grows a "trace" key older peers ignore.
+_T_TFRAME = 0x54  # 'T' u16 origin_len | origin | u16 tlen | trace json | frame
 
 # per-peer health states (the link-failure posture between "up" and the
 # old binary link_down): SUSPECT holds QoS>0 forwards in a bounded park
@@ -224,6 +232,11 @@ class Cluster:
                 governor.on_transition = _gossip_transition
         tele = getattr(server, "telemetry", None)
         if tele is not None:
+            tracer = getattr(tele, "tracer", None)
+            if tracer is not None:
+                # merged multi-worker trace exports keep one Chrome-trace
+                # process group per worker
+                tracer.pid = worker_id
             r = tele.registry
             r.counter(
                 "mqtt_tpu_cluster_peer_drops_partition_total",
@@ -910,23 +923,84 @@ class Cluster:
         else:
             self.dropped_backlog += 1
 
-    def forward_frame(self, topic: str, frame: bytes, origin: str) -> None:
+    def _tracer(self):
+        """The server's trace plane (mqtt_tpu.tracing.Tracer) or None."""
+        tele = getattr(self.server, "telemetry", None)
+        return getattr(tele, "tracer", None) if tele is not None else None
+
+    def _remote_span(self, name: str, tr, t0: float, args: dict) -> None:
+        """Record the receiving-side span of a forwarded traced publish:
+        the trace context parsed off the wire parents it on the origin
+        worker's forward span, so merged exports read as one trace."""
+        tracer = self._tracer()
+        if tracer is None or not isinstance(tr, dict):
+            return
+        tid = tr.get("tid")
+        if not isinstance(tid, str) or not tid:
+            return
+        tracer.add_span(
+            name,
+            "cluster",
+            tid,
+            tracer.new_span_id(),
+            tr.get("sid"),
+            t0,
+            time.perf_counter() - t0,
+            args,
+        )
+
+    def forward_frame(
+        self, topic: str, frame: bytes, origin: str, clock=None
+    ) -> None:
         """Forward a QoS0 v4 passthrough frame to interested peers
-        verbatim (the fast path's cluster leg)."""
+        verbatim (the fast path's cluster leg). A traced publish's clock
+        (mqtt_tpu.tracing.PublishTrace) switches the wire type to
+        _T_TFRAME so the trace context rides along, and records one
+        ``forward`` span per peer."""
         peers = self._interested_peers(topic)
         if not peers:
             return
         ob = origin.encode()
-        payload = struct.pack(">H", len(ob)) + ob + frame
+        tracer = self._tracer()
+        if tracer is None or getattr(clock, "trace_id", None) is None:
+            payload = struct.pack(">H", len(ob)) + ob + frame
+            for p in peers:
+                w = self._writers.get(p)
+                if w is None:  # link down but interest not yet withdrawn
+                    self._count_drop(p, partition=True)
+                    continue
+                try:
+                    self._send_nowait(p, w, _T_FRAME, payload, qos=0)
+                except (ConnectionError, RuntimeError):
+                    self._count_drop(p)
+            return
+        prefix = struct.pack(">H", len(ob)) + ob
         for p in peers:
+            # a fresh forward-span id per peer rides the wire: the
+            # peer's remote_fanout span parents on exactly this one
+            fsid = tracer.new_span_id()
+            tj = json.dumps({"tid": clock.trace_id, "sid": fsid}).encode()
+            payload = prefix + struct.pack(">H", len(tj)) + tj + frame
+            t0 = time.perf_counter()
+            sent = False
             w = self._writers.get(p)
-            if w is None:  # link down but interest not yet withdrawn
+            if w is None:
                 self._count_drop(p, partition=True)
-                continue
-            try:
-                self._send_nowait(p, w, _T_FRAME, payload, qos=0)
-            except (ConnectionError, RuntimeError):
-                self._count_drop(p)
+            else:
+                try:
+                    sent = self._send_nowait(p, w, _T_TFRAME, payload, qos=0)
+                except (ConnectionError, RuntimeError):
+                    self._count_drop(p)
+            tracer.add_span(
+                "forward",
+                "cluster",
+                clock.trace_id,
+                fsid,
+                clock.span_id,
+                t0,
+                time.perf_counter() - t0,
+                {"peer": p, "topic": topic, "sent": bool(sent)},
+            )
 
     def forward_packet(self, pk: Packet) -> None:
         """Forward a decoded publish (QoS>0 / v5 / retained) to interested
@@ -949,22 +1023,34 @@ class Cluster:
         c.packet_id = pk.packet_id or pk.fixed_header.qos  # encoder guard
         body = bytearray()
         c.publish_encode(body)
-        head = json.dumps(
-            {
-                "origin": pk.origin,
-                "created": pk.created,
-                "expiry": pk.expiry,
-                "retain": bool(pk.fixed_header.retain),
-                "qos": pk.fixed_header.qos,
-            }
-        ).encode()
-        payload = head + b"\x00" + bytes(body)
+        head = {
+            "origin": pk.origin,
+            "created": pk.created,
+            "expiry": pk.expiry,
+            "retain": bool(pk.fixed_header.retain),
+            "qos": pk.fixed_header.qos,
+        }
+        body_b = bytes(body)
+        # trace plane: a traced publish's context rides the json head
+        # ("trace" key — older peers ignore it) with a DISTINCT forward
+        # span id per peer; untraced publishes encode the payload once
+        tracer = self._tracer()
+        clock = getattr(pk, "_tclock", None)
+        traced = tracer is not None and getattr(clock, "trace_id", None) is not None
+        payload = b"" if traced else json.dumps(head).encode() + b"\x00" + body_b
         qos = pk.fixed_header.qos
         # retained forwards are replicated STATE (every worker's retained
         # store must converge), not expendable fan-out: keep them out of
         # the governor's QoS0 shed tier even at QoS0
         tier_qos = 1 if pk.fixed_header.retain else qos
         for p in peers:
+            fsid = ""
+            t_f0 = 0.0
+            if traced:
+                fsid = tracer.new_span_id()
+                head["trace"] = {"tid": clock.trace_id, "sid": fsid}
+                payload = json.dumps(head).encode() + b"\x00" + body_b
+                t_f0 = time.perf_counter()
             w = self._writers.get(p)
             ph = self._health.get(p)
             if tier_qos > 0 and (
@@ -976,6 +1062,12 @@ class Cluster:
                 # forwards in the bounded park buffer instead of dropping
                 # them — the heal replays them exactly once
                 self._park(p, _T_PACKET, payload)
+                if traced:
+                    tracer.add_span(
+                        "forward", "cluster", clock.trace_id, fsid,
+                        clock.span_id, t_f0, time.perf_counter() - t_f0,
+                        {"peer": p, "topic": topic, "parked": True},
+                    )
                 continue
             if w is None:  # down past the heal window / partitioned
                 self._count_drop(p, partition=True)
@@ -986,6 +1078,12 @@ class Cluster:
                 except (ConnectionError, RuntimeError):
                     self._count_drop(p)
                     sent = False
+            if traced:
+                tracer.add_span(
+                    "forward", "cluster", clock.trace_id, fsid,
+                    clock.span_id, t_f0, time.perf_counter() - t_f0,
+                    {"peer": p, "topic": topic, "sent": bool(sent)},
+                )
             if not sent and qos > 0:
                 # the known-limits drop class: cross-worker QoS1/2
                 # degrades to best-effort at the buffer cap or across a
@@ -1050,10 +1148,30 @@ class Cluster:
                     (olen,) = struct.unpack(">H", payload[:2])
                     origin = payload[2 : 2 + olen].decode()
                     self._deliver_frame(payload[2 + olen :], origin)
+                elif mtype == _T_TFRAME:
+                    # a traced passthrough frame: same delivery as
+                    # _T_FRAME plus the remote-fanout span joining the
+                    # origin's trace (mqtt_tpu.tracing)
+                    (olen,) = struct.unpack(">H", payload[:2])
+                    origin = payload[2 : 2 + olen].decode()
+                    off = 2 + olen
+                    (tlen,) = struct.unpack(">H", payload[off : off + 2])
+                    tr = json.loads(payload[off + 2 : off + 2 + tlen])
+                    t0 = time.perf_counter()
+                    self._deliver_frame(payload[off + 2 + tlen :], origin)
+                    self._remote_span(
+                        "remote_fanout", tr, t0, {"from_peer": peer}
+                    )
                 elif mtype == _T_PACKET:
                     sep = payload.index(b"\x00")
                     head = json.loads(payload[:sep])
+                    t0 = time.perf_counter()
                     self._deliver_packet(head, payload[sep + 1 :])
+                    tr = head.get("trace")
+                    if tr:
+                        self._remote_span(
+                            "remote_fanout", tr, t0, {"from_peer": peer}
+                        )
                 elif mtype == _T_PING:
                     # echo verbatim; the sender computes the RTT
                     writer.write(

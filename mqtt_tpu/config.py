@@ -200,6 +200,15 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "telemetry_ring",
         "telemetry_dump_dir",
         "telemetry_dump_min_interval_ms",
+        # trace plane: per-publish span trees, mesh trace propagation,
+        # exemplars, device profiler deep-dive hook (mqtt_tpu.tracing)
+        "trace",
+        "trace_sample",
+        "trace_ring",
+        "trace_exemplars",
+        "trace_user_property",
+        "trace_adopt_max_per_s",
+        "trace_jax_profiler_dir",
     ):
         if k in top:
             setattr(opts, k, top[k])

@@ -134,7 +134,7 @@ class FaultyMatcher:
         with self._lock:
             self.injected[kind] = self.injected.get(kind, 0) + 1
 
-    def match_topics_async(self, topics: list[str]):
+    def match_topics_async(self, topics: list[str], profile=None):
         with self._lock:
             i = self.dispatches
             self.dispatches += 1
@@ -142,7 +142,13 @@ class FaultyMatcher:
         if fault == "issue_error":
             self._count(fault)
             raise DeviceFault(f"injected issue failure (dispatch {i})")
-        resolver = self.inner.match_topics_async(topics)
+        # forward the per-batch profile record (mqtt_tpu.tracing) only
+        # when one was passed — inner doubles without the kwarg keep
+        # working
+        if profile is None:
+            resolver = self.inner.match_topics_async(topics)
+        else:
+            resolver = self.inner.match_topics_async(topics, profile=profile)
         if fault is None:
             return resolver
         self._count(fault)

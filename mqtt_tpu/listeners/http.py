@@ -115,7 +115,9 @@ class HTTPHealthCheck(_HttpListener):
 class HTTPStats(_HttpListener):
     """Serves the $SYS info values as JSON (http_sysinfo.go:112-121) and,
     when a telemetry plane is attached (mqtt_tpu.telemetry), its
-    Prometheus text exposition at ``GET /metrics``."""
+    Prometheus text exposition at ``GET /metrics`` plus the trace
+    plane's Chrome trace-event export at ``GET /traces``
+    (mqtt_tpu.tracing; load the body straight into Perfetto)."""
 
     def __init__(self, config: Config, sys_info: Info, telemetry=None) -> None:
         super().__init__(config)
@@ -130,6 +132,14 @@ class HTTPStats(_HttpListener):
                 return self._method_not_allowed()
             body = self.telemetry.exposition().encode()
             return "200 OK", body, "text/plain; version=0.0.4; charset=utf-8", _NO_STORE
+        if path == "/traces":
+            tracer = getattr(self.telemetry, "tracer", None)
+            if tracer is None:  # telemetry off, or tracing disabled
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            body = tracer.export_json().encode()
+            return "200 OK", body, "application/json", _NO_STORE
         if method != "GET":
             return self._method_not_allowed()
         body = json.dumps(self.sys_info.clone().as_dict()).encode()

@@ -307,14 +307,16 @@ class DeltaMatcher:
 
     # -- matching ------------------------------------------------------------
 
-    def match_topics_async(self, topics: list[str]):
+    def match_topics_async(self, topics: list[str], profile=None):
         """Issue one batch; the returned resolver yields the results.
         The generation (snapshot + overlay) is captured at issue time; the
         generation object itself is the route-to-host authority (it
         exposes both the per-topic ``affected`` predicate and the batch
-        form the C materializer prefers)."""
+        form the C materializer prefers). ``profile`` is the caller's
+        optional per-batch BatchProfile (mqtt_tpu.tracing), forwarded to
+        the snapshot matcher."""
         gen = self._gen  # atomic read: one generation per call
-        return gen.snap.match_topics_async(topics, route_to_host=gen)
+        return gen.snap.match_topics_async(topics, route_to_host=gen, profile=profile)
 
     def match_topics(self, topics: list[str]) -> list[Subscribers]:
         """Match a batch of topics, bit-identical to the live host trie."""

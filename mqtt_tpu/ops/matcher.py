@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 import numpy as np
 
@@ -208,6 +208,11 @@ class TpuMatcher:
         # per-probe ranges — complete results at 2P+2 ints/topic
         self.transfer_slots = min(transfer_slots or out_slots, out_slots)
         self.stats = MatcherStats()
+        # device pipeline profiler (mqtt_tpu.tracing.DeviceProfiler) or
+        # None; set by the server (or bench.py). match_topics_async
+        # feeds it the dispatch window, the resolver the D2H sync —
+        # duty cycle / overlap / idle-gap accounting lives there.
+        self.profiler: Optional[Any] = None
         # one (flat_index, device_arrays, built_version) tuple, swapped
         # atomically by rebuild() so a concurrent match never mixes
         # arrays and salt from different generations
@@ -348,7 +353,7 @@ class TpuMatcher:
 
     # -- matching ----------------------------------------------------------
 
-    def match_topics_async(self, topics: list[str], route_to_host=None):
+    def match_topics_async(self, topics: list[str], route_to_host=None, profile=None):
         """Issue one device match batch and return a zero-arg resolver.
 
         The device call is dispatched asynchronously (JAX async dispatch);
@@ -363,6 +368,15 @@ class TpuMatcher:
         delta overlay, ops/delta._Gen) — the batch form lets the C
         materializer skip the per-topic Python predicate loop entirely
         when no mutations are pending.
+
+        ``profile`` is an optional per-batch
+        :class:`mqtt_tpu.tracing.BatchProfile` the caller (the staging
+        loop) holds; with a profiler attached this method fills its
+        dispatch window and the resolver its D2H window — the batch's
+        own record, immune to concurrent/out-of-order resolution. When
+        the profiler is attached but no record is passed (bench,
+        resilience probes), a private one is opened so the duty-cycle
+        aggregates still see the batch.
         """
         import jax.numpy as jnp
 
@@ -380,6 +394,11 @@ class TpuMatcher:
         # pad ragged batches (the staging loop's windows) to a power-of-two
         # bucket so every batch size reuses one jitted executable; padded
         # rows are ignored at resolve time
+        prof = self.profiler
+        rec = None
+        if prof is not None:
+            rec = profile if profile is not None else prof.open_batch()
+            t_issue0 = time.perf_counter()
         b = len(topics)
         padded = topics + [""] * (_bucket(max(1, b), minimum=16) - b)
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
@@ -397,6 +416,10 @@ class TpuMatcher:
             packed_dev.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax arrays
             pass
+        if prof is not None:
+            # device pipeline profiler: the issue leg (tokenize + H2D +
+            # async dispatch) ends here; the device window opens now
+            prof.note_dispatch(rec, t_issue0, time.perf_counter())
         P = flat.pat_depth.shape[0]
         if route_to_host is None:
             pred = batch_pred = None
@@ -408,7 +431,12 @@ class TpuMatcher:
             batch_pred = None
 
         def resolve() -> list[Subscribers]:
+            t_sync0 = time.perf_counter() if prof is not None else 0.0
             packed = np.asarray(packed_dev)  # ONE D2H: [B, 2P+2]
+            if prof is not None:
+                # the blocking D2H sync just completed: close the device
+                # window (kernel + transfer) on this batch's record
+                prof.note_resolve(rec, t_sync0, time.perf_counter())
             packed = packed[: len(topics)]  # drop bucket-padding rows
             stats = self.stats
             stats.batches += 1

@@ -128,6 +128,11 @@ class ShardedTpuMatcher:
         self.n_batch = self.mesh.shape["batch"]
         self.incremental = incremental
         self.stats = MatcherStats()
+        # device pipeline profiler (mqtt_tpu.tracing.DeviceProfiler) or
+        # None; same seam as TpuMatcher.profiler (ops/matcher.py) — the
+        # SPMD step's dispatch and D2H windows feed duty-cycle/overlap/
+        # idle-gap accounting when the server (or bench) attaches one
+        self.profiler = None
         # one (arrays, tables, salt, step) tuple swapped atomically so a
         # concurrent match never mixes generations
         self._compiled: Optional[tuple] = None
@@ -531,17 +536,24 @@ class ShardedTpuMatcher:
 
     # -- matching ----------------------------------------------------------
 
-    def match_topics_async(self, topics: list[str], route_to_host=None):
+    def match_topics_async(self, topics: list[str], route_to_host=None, profile=None):
         """Issue one SPMD match step and return a zero-arg resolver.
 
         Mirrors ``TpuMatcher.match_topics_async`` (ops/matcher.py): the
         step is dispatched asynchronously; the resolver performs the D2H
         sync plus host-side expansion and returns ``list[Subscribers]``.
         The delta overlay (ops/delta.py) relies on this API existing on
-        every snapshot kind."""
+        every snapshot kind. ``profile`` is the caller's optional
+        per-batch BatchProfile (mqtt_tpu.tracing), same contract as
+        TpuMatcher."""
         if self._compiled is None or self.stale:
             self.rebuild()
         arrays, tables, salt, step = self._compiled
+        prof = self.profiler
+        rec = None
+        if prof is not None:
+            rec = profile if profile is not None else prof.open_batch()
+            t_issue0 = time.perf_counter()
         b = len(topics)
         # pad ragged batches to a power-of-two bucket (one jitted executable
         # across the staging loop's window sizes), rounded up to a multiple
@@ -560,14 +572,20 @@ class ShardedTpuMatcher:
                 for a in (tok1, tok2, lengths, is_dollar)
             ),
         )
+        if prof is not None:
+            # device pipeline profiler: the SPMD issue leg ends here
+            prof.note_dispatch(rec, t_issue0, time.perf_counter())
         # accept both route forms (ops/matcher.py): a plain predicate or
         # the delta overlay object exposing .affected
         if route_to_host is not None and hasattr(route_to_host, "affected"):
             route_to_host = route_to_host.affected
 
         def resolve() -> list[Subscribers]:
+            t_sync0 = time.perf_counter() if prof is not None else 0.0
             out = np.asarray(out_dev)  # [S, B, K]
             overflow = np.asarray(overflow_dev).any(axis=0) | len_overflow  # [B]
+            if prof is not None:
+                prof.note_resolve(rec, t_sync0, time.perf_counter())
             results = []
             stats = self.stats
             stats.batches += 1

@@ -530,9 +530,13 @@ class ResilientMatcher:
         walk = self.host_walk
         return [walk(t) if t else Subscribers() for t in topics]
 
-    def match_topics_async(self, topics: list[str]):
+    def match_topics_async(self, topics: list[str], profile=None):
         """Issue one guarded batch; returns a zero-arg resolver whose
-        wait is bounded by the watchdog budget."""
+        wait is bounded by the watchdog budget. ``profile`` is the
+        caller's optional per-batch BatchProfile (mqtt_tpu.tracing),
+        forwarded to the wrapped matcher — the record rides WITH the
+        batch, so eager guard-thread resolution can never attribute its
+        device windows to another batch."""
         if topics:
             with self._recent_lock:
                 self._recent.append(topics[0])
@@ -552,7 +556,16 @@ class ResilientMatcher:
             # caller (the event loop issues, the drainer resolves). The
             # submit happens NOW, so batch N+1's dispatch overlaps batch
             # N's resolve exactly as the unguarded pipeline did.
-            task = self.pool.submit(lambda: inner.match_topics_async(topics)())
+            if profile is None:
+                # no kwarg when no record: wrapped matchers that predate
+                # the profile contract (fault doubles, embedder shims)
+                # keep working untouched
+                issue = lambda: inner.match_topics_async(topics)()  # noqa: E731
+            else:
+                issue = lambda: inner.match_topics_async(  # noqa: E731
+                    topics, profile=profile
+                )()
+            task = self.pool.submit(issue)
         except RuntimeError:  # pool closed (shutdown race)
             return lambda: self._host_batch(topics)
 

@@ -83,6 +83,7 @@ from .packets import (
     PacketStore,
     Properties,
     Subscription,
+    UserProperty,
 )
 from .system import Info
 from .utils.mempool import get_buffer, put_buffer
@@ -292,6 +293,37 @@ class Options:
     # minimum ms between flight-recorder dumps (a flapping posture must
     # not fill the disk)
     telemetry_dump_min_interval_ms: float = 30000.0
+    # trace plane (mqtt_tpu.tracing): 1-in-N publishes carry a full
+    # trace context — a span tree through decode -> admission ->
+    # staging_wait -> h2d -> device_dispatch -> d2h -> fanout plus
+    # per-peer forward spans, joined across the worker mesh by the
+    # trace id riding cluster frames. Exported as Chrome trace-event
+    # JSON at GET /traces and in trigger dumps. Default on (requires
+    # telemetry); the unsampled hot path pays one extra modulo.
+    trace: bool = True
+    # 1-in-N publishes carry a trace (0 disables tracing outright)
+    trace_sample: int = 64
+    # span-ring size (finished spans retained for /traces and dumps)
+    trace_ring: int = 4096
+    # per-bucket (value, trace_id) exemplars on the stage histograms,
+    # rendered OpenMetrics-style on /metrics — links a p99 bucket to a
+    # concrete recorded trace. NOTE: plain Prometheus text-format
+    # scrapers that reject exemplar suffixes need this off.
+    trace_exemplars: bool = True
+    # stamp traced publishes with a v5 `trace-id` user property so
+    # subscribers see the trace id (default OFF: it mutates the wire
+    # bytes of sampled publishes). Inbound v5 publishes carrying the
+    # property ADOPT the client's trace id regardless, rate-bounded by
+    # trace_adopt_max_per_s.
+    trace_user_property: bool = False
+    # client-driven adoptions admitted per second (a client stamping
+    # every publish must not bypass trace_sample or flood the span
+    # ring); 0 disables adoption entirely
+    trace_adopt_max_per_s: int = 64
+    # when set, serve() starts a jax.profiler trace into this directory
+    # and close() stops it — the deep-dive companion to the host-side
+    # duty-cycle numbers ("" disables; requires a device matcher)
+    trace_jax_profiler_dir: str = ""
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -400,6 +432,14 @@ class Options:
             self.telemetry_ring = 256
         if self.telemetry_dump_min_interval_ms < 0:
             self.telemetry_dump_min_interval_ms = 30000.0
+        # trace knobs are config-reachable: a negative sample rate means
+        # "default", zero disables tracing; the ring must hold something
+        if self.trace_sample < 0:
+            self.trace_sample = 64
+        if self.trace_ring <= 0:
+            self.trace_ring = 4096
+        if self.trace_adopt_max_per_s < 0:
+            self.trace_adopt_max_per_s = 64
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 
@@ -523,6 +563,7 @@ class Server:
         self._draining = False
         self.matcher = None  # device matcher; None = host trie walk
         self._stage = None  # publish staging loop (started in serve())
+        self._jax_trace_active = False  # trace_jax_profiler_dir capture
         # broker-wide overload governor (mqtt_tpu.overload): admission,
         # backpressure, and graceful shedding under publish storms.
         # Default on; the staging signal attaches in serve(), the
@@ -532,6 +573,9 @@ class Server:
         # unified telemetry plane (mqtt_tpu.telemetry): stage clocks,
         # histograms, /metrics exposition, $SYS tree, flight recorder
         self.telemetry = None
+        # trace plane (mqtt_tpu.tracing): span ring + device profiler
+        self.tracer = None
+        self.profiler = None
         if opts.telemetry:
             from .telemetry import Telemetry
 
@@ -543,6 +587,18 @@ class Server:
             )
             self._ops.telemetry = self.telemetry
             self._register_core_gauges()
+            if opts.trace and opts.trace_sample > 0:
+                from .tracing import Tracer
+
+                self.tracer = Tracer(
+                    sample=opts.trace_sample,
+                    ring=opts.trace_ring,
+                    registry=self.telemetry.registry,
+                )
+                self.tracer.adopt_max_per_s = opts.trace_adopt_max_per_s
+                self.telemetry.attach_tracer(
+                    self.tracer, exemplars=opts.trace_exemplars
+                )
         if opts.overload_control:
             from .overload import OverloadConfig, OverloadGovernor
 
@@ -605,6 +661,20 @@ class Server:
                 if stats is not None:
                     # compile/rebuild/fold wall times -> rebuild histogram
                     stats.rebuild_observer = self.telemetry.rebuild_hist.observe
+                if self.tracer is not None:
+                    # device pipeline profiler (mqtt_tpu.tracing): the
+                    # innermost matcher feeds its dispatch/D2H windows
+                    # into duty-cycle / overlap / idle-gap accounting,
+                    # and the staging drain loop reads the same object
+                    # to sub-stamp sampled traces
+                    from .tracing import DeviceProfiler
+
+                    self.profiler = DeviceProfiler(
+                        registry=self.telemetry.registry
+                    )
+                    snap = getattr(self.matcher, "_snap", None)
+                    if snap is not None and hasattr(snap, "profiler"):
+                        snap.profiler = self.profiler
                 # mesh-sharded snapshot: per-shard compile times land in
                 # shard-local histograms on the rebuild path; the scrape
                 # merges them on demand (telemetry callback histogram)
@@ -741,10 +811,29 @@ class Server:
                 latency_budget_s=(budget_ms / 1e3) if budget_ms > 0 else None,
                 max_pending=self.options.overload_stage_max_pending,
                 telemetry=self.telemetry,
+                profiler=self.profiler,
             )
             self._stage.start()
             if self.overload is not None:
                 self.overload.add_source("staging", self._stage.pressure)
+            if self.options.trace_jax_profiler_dir:
+                # deep-dive capture hook (mqtt_tpu.tracing): the host-side
+                # duty-cycle numbers say WHETHER the device idles; a
+                # jax.profiler trace says WHY. Failure to start must
+                # never block serving.
+                try:
+                    import jax
+
+                    jax.profiler.start_trace(
+                        self.options.trace_jax_profiler_dir
+                    )
+                    self._jax_trace_active = True
+                    self.log.info(
+                        "jax.profiler trace started (dir=%s)",
+                        self.options.trace_jax_profiler_dir,
+                    )
+                except Exception:
+                    self.log.exception("jax.profiler trace failed to start")
 
         for listener in list(self.listeners.internal.values()):
             await listener.init(self.log)
@@ -1515,8 +1604,30 @@ class Server:
         # publishes): everything from decode's end to here — validation,
         # quota, alias resolution, the overload admission verdict
         clock = getattr(pk, "_tclock", None)
+        tele = self.telemetry
+        if (
+            tele is not None
+            and tele.tracer is not None
+            and pk.properties.user
+        ):
+            # an inbound v5 `trace-id` user property adopts the client's
+            # trace id (mqtt_tpu.tracing); off the adopted path this is
+            # one empty-list check
+            clock = tele.adopt_trace(pk)
         if clock is not None:
             clock.stamp("admission")
+            trace_id = getattr(clock, "trace_id", None)
+            if trace_id is not None and self.options.trace_user_property:
+                # client-visible traces: subscribers (and peers on the
+                # packet leg) see the trace id as a v5 user property
+                from .telemetry import TRACE_USER_PROPERTY
+
+                if not any(
+                    u.key == TRACE_USER_PROPERTY for u in pk.properties.user
+                ):
+                    pk.properties.user.append(
+                        UserProperty(TRACE_USER_PROPERTY, trace_id)
+                    )
 
         try:
             pk = self.hooks.on_publish(cl, pk)
@@ -1789,8 +1900,9 @@ class Server:
         if self._cluster is not None:
             # cluster leg: relay the frame verbatim to peer workers with
             # matching subscribers (mqtt_tpu.cluster); write ACL was
-            # enforced above, peers apply per-target read ACL
-            self._cluster.forward_frame(topic, frame, cl.id)
+            # enforced above, peers apply per-target read ACL. A traced
+            # clock rides along so the forward carries the trace id.
+            self._cluster.forward_frame(topic, frame, cl.id, clock)
         if clock is not None:
             clock.stamp("fanout")
             self.telemetry.observe_publish(clock, topic, 0)
@@ -2420,6 +2532,14 @@ class Server:
         if self._stage is not None:
             await self._stage.stop()
             self._stage = None
+        if self._jax_trace_active:
+            self._jax_trace_active = False
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:  # brokerlint: ok=R4 teardown; a failed profiler stop must not abort the drain
+                self.log.exception("jax.profiler trace failed to stop")
         if self.matcher is not None:
             self.matcher.close()
         self.hooks.on_stopped()

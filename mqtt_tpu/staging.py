@@ -64,6 +64,7 @@ class MatchStage:
         min_batch: int = 64,
         max_pending: int = 8192,
         telemetry=None,
+        profiler=None,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
@@ -72,6 +73,11 @@ class MatchStage:
         # and the per-publish stage clock's staging_wait / device_batch
         # stamps all flow through it
         self.telemetry = telemetry
+        # device pipeline profiler (mqtt_tpu.tracing.DeviceProfiler) or
+        # None. When attached (and the matcher feeds it), sampled stage
+        # clocks resolve device_batch into h2d / device_dispatch / d2h
+        # using the boundaries the matcher recorded for this batch.
+        self.profiler = profiler
         self.window_s = window_s  # the MAXIMUM accumulation window
         self.max_batch = max_batch
         self.max_inflight = max_inflight
@@ -177,7 +183,7 @@ class MatchStage:
         queue = self._queue
         if queue is not None:
             while not queue.empty():
-                _resolver, futs, topics, _clocks = queue.get_nowait()
+                _resolver, futs, topics, _clocks, _rec = queue.get_nowait()
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
 
     # -- submission --------------------------------------------------------
@@ -283,13 +289,26 @@ class MatchStage:
                 if c is not None:  # end of the accumulation/park wait
                     c.stamp("staging_wait")
             try:
-                resolver = self.matcher.match_topics_async(topics)
+                if self.profiler is not None:
+                    # per-batch device-timing record (mqtt_tpu.tracing):
+                    # the matcher fills its dispatch/D2H windows, the
+                    # drain loop sub-stamps sampled clocks from it — the
+                    # batch's OWN record, so concurrent or out-of-order
+                    # resolution (the resilience guard pool) can never
+                    # cross-attribute boundaries
+                    rec = self.profiler.open_batch()
+                    resolver = self.matcher.match_topics_async(
+                        topics, profile=rec
+                    )
+                else:
+                    rec = None
+                    resolver = self.matcher.match_topics_async(topics)
             except Exception:
                 _log.exception("stage issue failed; host fallback for batch")
                 self._fallback_all(batch, klass="issue_error")
                 continue
             try:
-                await queue.put((resolver, futs, topics, clocks))
+                await queue.put((resolver, futs, topics, clocks, rec))
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch in hand (in neither
                 # _pending nor the queue): resolve it before going down
@@ -301,7 +320,7 @@ class MatchStage:
         queue = self._queue
         assert queue is not None  # start() created us
         while True:
-            resolver, futs, topics, clocks = await queue.get()
+            resolver, futs, topics, clocks, rec = await queue.get()
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
                 # sampled at resolve time: batches still queued waited for
@@ -322,9 +341,23 @@ class MatchStage:
                 _log.exception("stage resolve failed; host fallback for batch")
                 self._fallback_all(list(zip(topics, futs)), klass="resolve_error")
                 continue
+            # this batch's own device-timing record: both windows are
+            # set only when the batch actually dispatched AND synced —
+            # the exact-map fast path and host fallbacks leave them
+            # None, and then the coarse device_batch stamp applies (no
+            # phantom h2d for batches that never touched the device)
+            dispatch = rec.dispatch if rec is not None else None
+            d2h = rec.d2h if rec is not None else None
             for fut, subs, ck in zip(futs, results, clocks):
                 if ck is not None:  # issue -> resolved (device round trip)
-                    ck.stamp("device_batch")
+                    if dispatch is not None and d2h is not None:
+                        # tokenize + device dispatch; then kernel queue +
+                        # execution; then the blocking result transfer
+                        ck.stamp_until("h2d", dispatch[1])
+                        ck.stamp_until("device_dispatch", d2h[0])
+                        ck.stamp_until("d2h", d2h[1])
+                    else:
+                        ck.stamp("device_batch")
                 if not fut.done():
                     fut.set_result(subs)
 

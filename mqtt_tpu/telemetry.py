@@ -48,14 +48,29 @@ from typing import Any, Callable, Optional
 _log = logging.getLogger("mqtt_tpu.telemetry")
 
 # the publish pipeline's stage names, in pipeline order (the flight
-# recorder and the bench telemetry block both key on these)
+# recorder and the bench telemetry block both key on these). The trace
+# plane (mqtt_tpu.tracing) resolves ``device_batch`` into the three
+# device sub-stages when the device profiler is wired; ``device_batch``
+# stays populated as their sum so rounds diff across the split
+# (exp/stage_gate.py).
 PUBLISH_STAGES = (
     "decode",
     "admission",
     "staging_wait",
+    "h2d",
+    "device_dispatch",
+    "d2h",
     "device_batch",
     "fanout",
 )
+
+# the device sub-stages the staging drain loop stamps when a device
+# profiler is attached (canonical here — mqtt_tpu.tracing re-exports)
+DEVICE_SUBSTAGES = ("h2d", "device_dispatch", "d2h")
+
+# the MQTT v5 user-property key a trace id rides on (client-visible
+# traces, and adoption of client-supplied ids — mqtt_tpu.tracing)
+TRACE_USER_PROPERTY = "trace-id"
 
 
 def _fmt(v) -> str:
@@ -70,6 +85,15 @@ def _fmt(v) -> str:
             return str(int(v))
         return repr(v)
     return str(v)
+
+
+def _exemplar_str(exemplars: Optional[list], i: int) -> str:
+    """The OpenMetrics-style exemplar suffix for one bucket line —
+    ``# {trace_id="..."} <value>`` — or "" when the bucket has none."""
+    if exemplars is None or exemplars[i] is None:
+        return ""
+    v, trace_id = exemplars[i]
+    return f' # {{trace_id="{escape_label_value(trace_id)}"}} {_fmt(float(v))}'
 
 
 def escape_label_value(v: str) -> str:
@@ -101,7 +125,7 @@ class Histogram:
     ever sharing a hot write path.
     """
 
-    __slots__ = ("bounds", "counts", "count", "sum", "fn")
+    __slots__ = ("bounds", "counts", "count", "sum", "fn", "exemplars")
 
     def __init__(
         self,
@@ -118,6 +142,18 @@ class Histogram:
         self.count = 0
         self.sum = 0.0
         self.fn: Optional[Callable[[], "Histogram"]] = None
+        # per-bucket (value, trace_id) exemplars, last-write-wins; None
+        # until enable_exemplars() — the off-trace observe() path pays
+        # one is-None check (mqtt_tpu.tracing / OpenMetrics exemplars)
+        self.exemplars: Optional[list] = None
+
+    def enable_exemplars(self) -> None:
+        """Retain the last sampled (value, trace_id) per bucket; the
+        exposition cross-links a p99 bucket to a concrete recorded
+        trace. Merge() deliberately ignores exemplars (shard merges are
+        scrape-time aggregates; the shards keep their own)."""
+        if self.exemplars is None:
+            self.exemplars = [None] * (len(self.bounds) + 1)
 
     def live(self) -> "Histogram":
         """The histogram to render at scrape time: the callback's merged
@@ -133,11 +169,14 @@ class Histogram:
             return self
         return merged if isinstance(merged, Histogram) else self
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, trace_id: Optional[str] = None) -> None:
         # bisect_left(bounds, v): first bound >= v — exactly `le`
-        self.counts[bisect_left(self.bounds, v)] += 1
+        i = bisect_left(self.bounds, v)
+        self.counts[i] += 1
         self.count += 1
         self.sum += v
+        if trace_id is not None and self.exemplars is not None:
+            self.exemplars[i] = (v, trace_id)
 
     def percentile(self, q: float) -> float:
         """The q-quantile's bucket upper bound (0.0 when empty; the
@@ -248,6 +287,9 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._families: dict[str, _Family] = {}
+        # render per-bucket trace exemplars in exposition() (OpenMetrics
+        # style; set via Telemetry.attach_tracer — Options.trace_exemplars)
+        self.emit_exemplars = False
 
     def _child(self, name: str, mtype: str, help_: str, labels: dict, maker):
         if not _NAME_RE.match(name):
@@ -327,13 +369,19 @@ class MetricsRegistry:
                     )
                 else:  # Histogram (callback-backed ones snapshot here)
                     child = child.live()
+                    ex = child.exemplars if self.emit_exemplars else None
                     acc = 0
                     for i, bound in enumerate(child.bounds):
                         acc += child.counts[i]
                         le = self._labels_str(key, f'le="{_fmt(float(bound))}"')
-                        out.append(f"{name}_bucket{le} {acc}")
+                        out.append(
+                            f"{name}_bucket{le} {acc}" + _exemplar_str(ex, i)
+                        )
                     le = self._labels_str(key, 'le="+Inf"')
-                    out.append(f"{name}_bucket{le} {_fmt(child.count)}")
+                    out.append(
+                        f"{name}_bucket{le} {_fmt(child.count)}"
+                        + _exemplar_str(ex, -1)
+                    )
                     out.append(
                         f"{name}_sum{self._labels_str(key)} {_fmt(child.sum)}"
                     )
@@ -390,6 +438,17 @@ class StageClock:
         self.stages.append((stage, now - self.last))
         self.last = now
 
+    def stamp_until(self, stage: str, t: float) -> None:
+        """Stamp a stage ending at an EXPLICIT perf_counter time (the
+        staging drain loop splits device_batch into h2d/dispatch/d2h
+        using boundaries measured on the resolver's thread). Clamped so
+        a boundary that raced behind the previous stamp records a
+        zero-length stage instead of corrupting the running total."""
+        if t < self.last:
+            t = self.last
+        self.stages.append((stage, t - self.last))
+        self.last = t
+
     def total(self) -> float:
         return self.last - self.t0
 
@@ -429,14 +488,29 @@ class FlightRecorder:
         with self._lock:
             self.ring.append(record)
 
-    def dump_async(self, reason: str, extra: Optional[dict] = None) -> None:
+    def dump_async(
+        self,
+        reason: str,
+        extra: Optional[dict] = None,
+        after: Optional[Callable[[str, str], None]] = None,
+    ) -> None:
         """Fire-and-forget dump on a daemon thread: degradation triggers
         run under the breaker lock / on the event loop's hot path, where
         synchronous disk IO would stall the data plane at exactly peak
-        load. Rate-limiting still applies inside dump()."""
+        load. Rate-limiting still applies inside dump(); ``after`` runs
+        on the writer thread with (path, reason) only when a dump was
+        actually written (the trace-plane sibling dump rides it)."""
+
+        def _write() -> None:
+            path = self.dump(reason, extra)
+            if path is not None and after is not None:
+                try:
+                    after(path, reason)
+                except Exception:
+                    _log.exception("post-dump hook failed (reason=%s)", reason)
+
         t = threading.Thread(
-            target=self.dump,
-            args=(reason, extra),
+            target=_write,
             daemon=True,
             name="mqtt-tpu-flight-dump",
         )
@@ -481,6 +555,15 @@ class FlightRecorder:
             "time_unix": int(time.time()),  # brokerlint: ok=R3 dump timestamps are wall-clock by design (operator-correlatable)
             "records": records,
             "context": extra or {},
+            # the trace cross-link: every trace id active in the ring at
+            # trigger time, deduped (records keep their own trace_id too)
+            "trace_ids": sorted(
+                {
+                    r["trace_id"]
+                    for r in records
+                    if isinstance(r, dict) and "trace_id" in r
+                }
+            ),
         }
         try:
             os.makedirs(self.dump_dir, exist_ok=True)
@@ -527,6 +610,10 @@ class Telemetry:
         self.sample = max(0, int(sample))  # 0 disables stage sampling
         self._n = 0  # publish counter for 1-in-N sampling
         self._out_n = 0  # outbound-enqueue counter (same 1-in-N rate)
+        # the trace plane (mqtt_tpu.tracing.Tracer) or None; attached by
+        # the server via attach_tracer() — publish_clock consults it so
+        # 1-in-trace_sample publishes carry a full trace context
+        self.tracer: Any = None
         self.recorder = FlightRecorder(
             size=ring, dump_dir=dump_dir, min_interval_s=dump_min_interval_s
         )
@@ -577,37 +664,107 @@ class Telemetry:
 
     # -- publish stage sampling --------------------------------------------
 
+    def attach_tracer(self, tracer: Any, exemplars: bool = True) -> None:
+        """Attach the trace plane (mqtt_tpu.tracing.Tracer): sampled
+        publish clocks become trace contexts, finished clocks emit span
+        trees, and (when ``exemplars``) the stage histograms retain
+        per-bucket trace exemplars rendered on /metrics."""
+        self.tracer = tracer
+        if exemplars:
+            for h in self.stage_hist.values():
+                h.enable_exemplars()
+            self.registry.emit_exemplars = True
+
     def publish_clock(self) -> Optional[StageClock]:
-        """A StageClock for 1-in-N publishes, None for the rest. The
-        unsampled path is one increment + one modulo."""
-        if self.sample == 0:
-            return None
+        """A StageClock for 1-in-N publishes, None for the rest; when
+        the trace plane is attached, 1-in-trace_sample publishes get a
+        PublishTrace (a StageClock that also carries a trace id). The
+        unsampled path is one increment and two modulos."""
         self._n += 1
-        if self._n % self.sample:
+        tracer = self.tracer
+        if (
+            tracer is not None
+            and tracer.sample
+            and self._n % tracer.sample == 0
+        ):
+            return tracer.publish_trace()
+        if self.sample == 0 or self._n % self.sample:
             return None
         return StageClock()
 
+    def adopt_trace(self, pk: Any) -> Optional[StageClock]:
+        """Adopt a client-supplied trace id: an inbound v5 PUBLISH whose
+        user properties carry ``trace-id`` gets a trace context with
+        THAT id (TD-MQTT-style transparent tracing — the client picks
+        the id, the broker's spans join it), keeping any stamps the read
+        loop already recorded. Returns the packet's (possibly new)
+        clock; cost off the adopted path is the caller's empty-list
+        check."""
+        tracer = self.tracer
+        clock = getattr(pk, "_tclock", None)
+        if tracer is None or getattr(clock, "trace_id", None) is not None:
+            return clock
+        tid = ""
+        for u in pk.properties.user:
+            if u.key == TRACE_USER_PROPERTY and u.val:
+                tid = u.val
+                break
+        if not tid or not tracer.allow_adopt():
+            # adoption is rate-bounded (Tracer.allow_adopt): a client
+            # stamping every publish cannot bypass trace_sample or
+            # flood the ring; over-budget publishes flow untraced
+            return clock
+        trace = tracer.publish_trace(tid)
+        if clock is not None:  # graft the read loop's decode stamp over
+            trace.t0 = clock.t0
+            trace.last = clock.last
+            trace.stages = clock.stages
+        pk._tclock = trace
+        return trace
+
     def observe_publish(self, clock: StageClock, topic: str = "", qos: int = 0) -> None:
         """Fold one finished stage clock into the per-stage histograms
-        and the flight-recorder ring."""
+        and the flight-recorder ring; a traced clock additionally emits
+        its span tree into the trace ring and stamps bucket exemplars."""
+        trace_id = getattr(clock, "trace_id", None)
         hist = self.stage_hist
+        sub_total = 0.0
+        have_sub = False
+        explicit_batch = False
         for stage, dt in clock.stages:
             h = hist.get(stage)
             if h is not None:
-                h.observe(dt)
+                h.observe(dt, trace_id)
+            if stage in DEVICE_SUBSTAGES:
+                sub_total += dt
+                have_sub = True
+            elif stage == "device_batch":
+                explicit_batch = True
+        if have_sub and not explicit_batch:
+            # continuity across the sub-stage split: device_batch stays
+            # populated as the sum, so stage_gate diffs old rounds (an
+            # explicitly-stamped device_batch — the exact-map / host
+            # fallback path — must not be observed twice)
+            hist["device_batch"].observe(sub_total, trace_id)
         self.sampled_publishes.inc()
-        self.recorder.add(
-            {
-                # brokerlint: ok=R3 flight records carry wall-clock stamps
-                "t": round(time.time(), 3),
-                "topic": topic,
-                "qos": qos,
-                "total_ms": round(clock.total() * 1e3, 3),
-                "stages_ms": {
-                    s: round(dt * 1e3, 4) for s, dt in clock.stages
-                },
-            }
-        )
+        record = {
+            # brokerlint: ok=R3 flight records carry wall-clock stamps
+            "t": round(time.time(), 3),
+            "topic": topic,
+            "qos": qos,
+            "total_ms": round(clock.total() * 1e3, 3),
+            "stages_ms": {
+                s: round(dt * 1e3, 4) for s, dt in clock.stages
+            },
+        }
+        if trace_id is not None:
+            # the flight-dump <-> trace cross-link: a SHED dump's records
+            # name the concrete traces active at trigger time
+            record["trace_id"] = trace_id
+        self.recorder.add(record)
+        tracer = self.tracer
+        if trace_id is not None and tracer is not None:
+            tracer.finish_publish(clock, topic, qos)
 
     def sample_outbound(self) -> bool:
         """1-in-N gate for outbound queue-wait stamps (same rate as the
@@ -635,8 +792,29 @@ class Telemetry:
         """Dump the flight recorder WITHOUT blocking the caller: triggers
         fire under the breaker lock and on the governor's evaluate path
         (both on the data plane), so the file IO moves to a daemon
-        thread. Use ``recorder.dump`` directly for a synchronous dump."""
-        self.recorder.dump_async(reason, extra)
+        thread. When the trace plane is attached, the same thread also
+        writes a sibling ``traces_*.json`` (Perfetto-loadable) next to
+        the flight dump — the dump's trace_ids point into it. Use
+        ``recorder.dump`` directly for a synchronous dump."""
+        after = self._dump_traces if self.tracer is not None else None
+        self.recorder.dump_async(reason, extra, after=after)
+
+    def _dump_traces(self, dump_path: str, reason: str) -> None:
+        """Write the trace ring beside a just-written flight dump (runs
+        on the recorder's daemon writer thread, never on a data-plane
+        path)."""
+        base = os.path.basename(dump_path)
+        name = "traces_" + (
+            base[len("flight_"):] if base.startswith("flight_") else base
+        )
+        path = os.path.join(os.path.dirname(dump_path), name)
+        try:
+            with open(path, "w") as f:
+                f.write(self.tracer.export_json())
+        except OSError:
+            _log.exception("trace dump failed (path=%s)", path)
+            return
+        _log.warning("trace ring dumped to %s (reason=%s)", path, reason)
 
     # -- rendering ---------------------------------------------------------
 
@@ -680,11 +858,15 @@ def check_exposition(text: str) -> int:
     """A minimal pure-Python Prometheus text-format checker (CI's scrape
     gate and the test suite's oracle): every non-comment line must be a
     well-formed sample, every # TYPE must name a known type, and at
-    least one sample must exist. Returns the sample count."""
+    least one sample must exist. OpenMetrics-style bucket exemplars
+    (``... 5 # {trace_id="..."} 0.003``) are accepted. Returns the
+    sample count."""
     sample_re = re.compile(
         r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{([a-zA-Z_][a-zA-Z0-9_]*="
         r'"(\\.|[^"\\])*"(,[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*")*)?\})?'
-        r" (NaN|[+-]?Inf|[+-]?[0-9.eE+-]+)( [0-9]+)?$"
+        r" (NaN|[+-]?Inf|[+-]?[0-9.eE+-]+)( [0-9]+)?"
+        r'( # \{[a-zA-Z_][a-zA-Z0-9_]*="(\\.|[^"\\])*"\}'
+        r" (NaN|[+-]?Inf|[+-]?[0-9.eE+-]+)( [0-9.eE+-]+)?)?$"
     )
     samples = 0
     for i, line in enumerate(text.splitlines(), 1):

@@ -1,0 +1,413 @@
+"""End-to-end trace plane: sampled per-publish span trees, Chrome
+trace-event export, and the device pipeline profiler.
+
+PR 3's telemetry histograms answer "what is the p99 of each stage" —
+they cannot answer "where did THIS slow publish spend its time", which
+is the question ROADMAP item 1 (the 40-100x kernel->e2e gap, owned by
+host<->device staging) actually needs, and the per-message latency
+decomposition the IoT broker benchmarking study treats as the primary
+comparison axis (PAPERS.md). This module adds:
+
+- ``Tracer``: a lock-cheap bounded ring of finished spans plus seeded
+  trace/span id generation. 1-in-N publishes (``Options.trace_sample``,
+  same knob family as ``telemetry_sample``) carry a ``PublishTrace`` —
+  a :class:`~mqtt_tpu.telemetry.StageClock` that also owns a trace id —
+  and at fan-out the clock's stamps become one span tree: a root
+  ``publish`` span with one child per pipeline stage
+  (decode -> admission -> staging_wait -> h2d -> device_dispatch ->
+  d2h -> fanout), plus per-peer ``forward`` spans at the origin worker
+  and a ``remote_fanout`` span at each receiving worker (the trace id
+  rides the cluster frames — TD-MQTT-style transparent cross-broker
+  tracing). The ring exports as Chrome trace-event JSON
+  (Perfetto-loadable) at ``GET /traces`` and in trigger dumps.
+- ``DeviceProfiler``: sub-stamps every device batch (tokenize+dispatch
+  issue, blocking D2H sync) and folds the windows into the numbers that
+  gate ROADMAP item 1's 3-deep-pipeline work: kernel **duty cycle**
+  (union of device-busy windows over wall time), **overlap ratio**
+  (how much of the summed busy time was pipelined under another
+  batch's window), and the **staging idle-gap** histogram (device
+  sitting idle between batches — the time the pipeline work must
+  reclaim).
+- ``check_trace_events``: a ~20-line pure-Python validator for the
+  exported JSON (the /traces analog of ``telemetry.check_exposition``),
+  used by CI's trace-scrape gate and the test suite.
+
+The unsampled hot path pays one modulo; everything else is on by
+default behind ``Options.trace`` / the ``trace_*`` config knobs.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import random
+import threading
+import time
+import zlib
+from typing import Any, Optional
+
+# DEVICE_SUBSTAGES / TRACE_USER_PROPERTY are canonical in telemetry.py
+# (this module imports telemetry, never the reverse); re-exported here
+# because they are trace-plane concepts callers look for in this module
+from .telemetry import (  # noqa: F401  (re-exports)
+    DEVICE_SUBSTAGES,
+    TRACE_USER_PROPERTY,
+    Histogram,
+    StageClock,
+)
+
+
+class PublishTrace(StageClock):
+    """A stage clock that is also a trace context: carries the trace id
+    and the pre-allocated root span id, so spans recorded BEFORE the
+    clock finishes (per-peer forwards) can already parent on the root.
+    Rides the pipeline exactly like a plain StageClock — every layer
+    that stamps a StageClock stamps this unchanged."""
+
+    __slots__ = ("tracer", "trace_id", "span_id")
+
+    def __init__(self, tracer: "Tracer", trace_id: Optional[str] = None) -> None:
+        super().__init__()
+        self.tracer = tracer
+        self.trace_id = trace_id if trace_id else tracer.new_trace_id()
+        self.span_id = tracer.new_span_id()
+
+
+class Tracer:
+    """Bounded span ring + id generation + Chrome trace-event export.
+
+    Spans are stored as plain tuples ``(name, cat, trace_id, span_id,
+    parent_id, t0_perf, dur_s, args)``; the ring append is the only
+    hot-path cost and runs under a lock held for one append (the same
+    posture as the flight recorder's ring). Export converts perf_counter
+    times to wall-anchored microseconds, so two workers' exports merge
+    into one coherent timeline (same machine, same anchor source).
+    ``seed`` makes trace/span ids deterministic for tests."""
+
+    def __init__(
+        self,
+        sample: int = 64,
+        ring: int = 4096,
+        seed: Optional[int] = None,
+        registry: Any = None,
+    ) -> None:
+        self.sample = max(0, int(sample))
+        self._lock = threading.Lock()
+        self.ring: collections.deque = collections.deque(maxlen=max(16, int(ring)))
+        self._rng = random.Random(seed)
+        # worker id in a mesh (mqtt_tpu.cluster sets it); the export's
+        # Chrome-trace pid, so merged multi-worker files keep one track
+        # group per worker
+        self.pid = 0
+        self.spans_total = 0
+        self.publishes_total = 0
+        # client-driven adoption (v5 trace-id user property) is rate-
+        # bounded: a client stamping EVERY publish must not buy itself
+        # 100% tracing (bypassing trace_sample) and flood the ring,
+        # evicting the organic samples. 0 disables adoption entirely.
+        self.adopt_max_per_s = 64
+        self._adopt_window = 0.0  # monotonic second the count belongs to
+        self._adopt_count = 0
+        # wall anchor for export: perf_counter + anchor = unix seconds.
+        # brokerlint: ok=R3 a one-shot wall anchor so exported trace timestamps are operator-correlatable; all durations stay monotonic
+        self._anchor = time.time() - time.perf_counter()
+        if registry is not None:
+            registry.counter(
+                "mqtt_tpu_trace_spans_total",
+                "Spans recorded into the trace ring",
+                fn=lambda: self.spans_total,
+            )
+            registry.counter(
+                "mqtt_tpu_trace_publishes_total",
+                "Publishes that carried a sampled trace context",
+                fn=lambda: self.publishes_total,
+            )
+
+    # -- ids ----------------------------------------------------------------
+
+    def new_trace_id(self) -> str:
+        with self._lock:
+            return f"{self._rng.getrandbits(64):016x}"
+
+    def new_span_id(self) -> str:
+        with self._lock:
+            return f"{self._rng.getrandbits(48):012x}"
+
+    # -- recording ----------------------------------------------------------
+
+    def publish_trace(self, trace_id: Optional[str] = None) -> PublishTrace:
+        """A trace context for one publish (the caller owns the 1-in-N
+        sampling verdict — mqtt_tpu.telemetry.Telemetry.publish_clock)."""
+        return PublishTrace(self, trace_id)
+
+    def allow_adopt(self) -> bool:
+        """The rate verdict for one client-supplied trace-id adoption:
+        at most ``adopt_max_per_s`` per wall second, excess publishes
+        stay untraced (they still flow normally)."""
+        if self.adopt_max_per_s <= 0:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if now - self._adopt_window >= 1.0:
+                self._adopt_window = now
+                self._adopt_count = 0
+            if self._adopt_count >= self.adopt_max_per_s:
+                return False
+            self._adopt_count += 1
+            return True
+
+    def add_span(
+        self,
+        name: str,
+        cat: str,
+        trace_id: str,
+        span_id: str,
+        parent_id: Optional[str],
+        t0: float,
+        dur: float,
+        args: Optional[dict] = None,
+    ) -> None:
+        """Record one finished span (``t0`` in perf_counter seconds)."""
+        with self._lock:
+            self.ring.append(
+                (name, cat, trace_id, span_id, parent_id, t0, dur, args)
+            )
+            self.spans_total += 1
+
+    def finish_publish(self, trace: PublishTrace, topic: str = "", qos: int = 0) -> None:
+        """Fold one finished publish trace into the ring: the root
+        ``publish`` span plus one child span per stamped stage, laid out
+        back-to-back from the clock's start (a StageClock records each
+        stage's duration since the previous stamp, so the absolute
+        boundaries reconstruct exactly)."""
+        spans = []
+        t = trace.t0
+        for stage, dt in trace.stages:
+            spans.append(
+                (stage, "stage", trace.trace_id, self.new_span_id(),
+                 trace.span_id, t, dt, None)
+            )
+            t += dt
+        spans.append(
+            ("publish", "publish", trace.trace_id, trace.span_id, None,
+             trace.t0, trace.total(), {"topic": topic, "qos": qos})
+        )
+        with self._lock:
+            self.ring.extend(spans)
+            self.spans_total += len(spans)
+            self.publishes_total += 1
+
+    # -- export -------------------------------------------------------------
+
+    def export(self) -> dict:
+        """The ring as a Chrome trace-event document (Perfetto loads it
+        directly: open ui.perfetto.dev and drop the JSON in). Spans of
+        one trace share a ``tid`` derived from the trace id, so
+        concurrent traces render as separate nested tracks."""
+        with self._lock:
+            spans = list(self.ring)
+        events = []
+        for name, cat, trace_id, span_id, parent_id, t0, dur, args in spans:
+            a = {"trace_id": trace_id, "span_id": span_id}
+            if parent_id is not None:
+                a["parent_id"] = parent_id
+            if args:
+                a.update(args)
+            events.append(
+                {
+                    "name": name,
+                    "cat": cat,
+                    "ph": "X",
+                    "ts": round((t0 + self._anchor) * 1e6, 3),
+                    "dur": round(dur * 1e6, 3),
+                    "pid": self.pid,
+                    # stable per-trace track id; crc so ADOPTED ids (any
+                    # client-chosen string) never break the export
+                    "tid": zlib.crc32(trace_id.encode()) % 1_000_000,
+                    "args": a,
+                }
+            )
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_json(self) -> str:
+        return json.dumps(self.export())
+
+
+def check_trace_events(doc) -> int:
+    """A minimal pure-Python Chrome trace-event checker (the /traces
+    analog of ``telemetry.check_exposition``): the document must carry a
+    non-empty ``traceEvents`` list of well-formed complete events.
+    Unresolved parent ids are allowed — one worker's export of a
+    cross-worker trace legitimately references the peer's spans.
+    Accepts a JSON string or a parsed dict; returns the event count."""
+    if isinstance(doc, (str, bytes)):
+        doc = json.loads(doc)
+    events = doc.get("traceEvents") if isinstance(doc, dict) else None
+    if not isinstance(events, list) or not events:
+        raise ValueError("no traceEvents")
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict) or not isinstance(ev.get("name"), str) or not ev["name"]:
+            raise ValueError(f"event {i}: missing name")
+        if ev.get("ph") != "X":
+            raise ValueError(f"event {i}: ph must be 'X' (complete)")
+        for k in ("ts", "dur"):
+            if not isinstance(ev.get(k), (int, float)) or ev[k] < 0:
+                raise ValueError(f"event {i}: bad {k}: {ev.get(k)!r}")
+        if not isinstance(ev.get("pid"), int) or not isinstance(ev.get("tid"), int):
+            raise ValueError(f"event {i}: pid/tid must be ints")
+        args = ev.get("args")
+        if args is not None and not isinstance(args, dict):
+            raise ValueError(f"event {i}: args must be a dict")
+    return len(events)
+
+
+class BatchProfile:
+    """One batch's device-timing record, created at issue and carried
+    WITH the batch (the resolver closure and the staging queue both hold
+    it), so profile boundaries can never be attributed to a different
+    batch — the resilience wrapper resolves batches eagerly on guard
+    threads, concurrently and potentially out of order, which rules out
+    any "most recent resolve" pairing. Tuple assignments are atomic
+    under the GIL; a reader sees either None or a complete window."""
+
+    __slots__ = ("dispatch", "d2h")
+
+    def __init__(self) -> None:
+        # (start, end) of the tokenize+dispatch issue leg; None until
+        # the batch actually dispatched to the device (the exact-map
+        # fast path and host fallbacks never set it)
+        self.dispatch: Optional[tuple[float, float]] = None
+        # (start, end) of the blocking D2H result sync
+        self.d2h: Optional[tuple[float, float]] = None
+
+
+class DeviceProfiler:
+    """Host-side device pipeline profiler: each batch's dispatch and
+    D2H windows land on its own :class:`BatchProfile` record and fold
+    into duty-cycle / overlap / idle-gap aggregates.
+
+    A batch's **device window** runs from dispatch-return (the kernel is
+    queued and the host moves on) to the end of the blocking D2H sync —
+    kernel execution plus result transfer, the best host-observable
+    proxy without a device-side profiler (``Options.
+    trace_jax_profiler_dir`` hooks ``jax.profiler`` for the real
+    timeline). Aggregates:
+
+    - ``duty_cycle`` = union of device windows / wall time since the
+      first dispatch — how busy the device actually is (ROADMAP item 1:
+      "the kernel is idle most of the wall clock").
+    - ``overlap_ratio`` = overlapped window time / summed window time —
+      how deep the staging pipeline actually runs (0 = strictly serial,
+      approaching (depth-1)/depth for a depth-N pipeline).
+    - ``idle_gap`` histogram = device-idle stretches between windows —
+      exactly the gaps a 3-deep pipeline must close.
+
+    Dispatches and resolves may come from different threads (the
+    staging loop issues on the event loop; resolves run in an executor
+    or on resilience guard threads); everything mutates under one lock,
+    held for arithmetic only."""
+
+    def __init__(self, registry: Any = None) -> None:
+        self._lock = threading.Lock()
+        self.batches = 0
+        self._first_t: Optional[float] = None
+        self._last_t = 0.0
+        self._busy_until = 0.0
+        self._busy_s = 0.0  # union of device windows
+        self._window_s = 0.0  # sum of device windows
+        self._overlap_s = 0.0
+        if registry is not None:
+            self.issue_hist = registry.histogram(
+                "mqtt_tpu_device_issue_seconds",
+                "Per-batch host tokenize + device dispatch (H2D issue) wall time",
+            )
+            self.d2h_hist = registry.histogram(
+                "mqtt_tpu_device_d2h_seconds",
+                "Per-batch blocking D2H result-sync wall time",
+            )
+            self.idle_gap_hist = registry.histogram(
+                "mqtt_tpu_device_idle_gap_seconds",
+                "Device-idle stretches between consecutive batch windows",
+            )
+            registry.gauge(
+                "mqtt_tpu_device_duty_cycle_ratio",
+                "Union of device-busy windows over wall time since first dispatch",
+                fn=self.duty_cycle,
+            )
+            registry.gauge(
+                "mqtt_tpu_device_overlap_ratio",
+                "Overlapped device-window time over summed window time "
+                "(pipeline depth proxy)",
+                fn=self.overlap_ratio,
+            )
+        else:
+            self.issue_hist = Histogram()
+            self.d2h_hist = Histogram()
+            self.idle_gap_hist = Histogram()
+
+    # -- recording (matcher hooks) -----------------------------------------
+
+    def open_batch(self) -> BatchProfile:
+        """A fresh per-batch record; the matcher fills it and whoever
+        holds the batch (staging drain loop, bench) reads it."""
+        return BatchProfile()
+
+    def note_dispatch(self, rec: BatchProfile, t0: float, t1: float) -> None:
+        """One batch issued: tokenize + device dispatch ran [t0, t1];
+        the device window opens at t1."""
+        rec.dispatch = (t0, t1)
+        self.issue_hist.observe(t1 - t0)
+
+    def note_resolve(self, rec: BatchProfile, sync_start: float, sync_end: float) -> None:
+        """One batch's blocking D2H sync ran [sync_start, sync_end];
+        fold its device window (dispatch-return -> sync end) into the
+        busy/overlap/idle accounting. Pairing is exact — the window
+        boundaries live on the batch's own record."""
+        rec.d2h = (sync_start, sync_end)
+        self.d2h_hist.observe(sync_end - sync_start)
+        if rec.dispatch is None:
+            return  # never dispatched (shouldn't happen): histogram only
+        t_disp = rec.dispatch[1]
+        with self._lock:
+            end = max(sync_end, t_disp)
+            self.batches += 1
+            if self._first_t is None:
+                self._first_t = t_disp
+            self._last_t = max(self._last_t, end)
+            self._window_s += end - t_disp
+            if t_disp >= self._busy_until:
+                if self._busy_until > 0.0:
+                    self.idle_gap_hist.observe(t_disp - self._busy_until)
+                self._busy_s += end - t_disp
+            else:
+                self._overlap_s += max(0.0, min(self._busy_until, end) - t_disp)
+                self._busy_s += max(0.0, end - self._busy_until)
+            self._busy_until = max(self._busy_until, end)
+
+    # -- aggregates ---------------------------------------------------------
+
+    def duty_cycle(self) -> float:
+        with self._lock:
+            if self._first_t is None or self._last_t <= self._first_t:
+                return 0.0
+            return self._busy_s / (self._last_t - self._first_t)
+
+    def overlap_ratio(self) -> float:
+        with self._lock:
+            return self._overlap_s / self._window_s if self._window_s > 0 else 0.0
+
+    def bench_block(self) -> dict:
+        """The BENCH-json device-pipeline block (configs 2 and 8): the
+        exact numbers ROADMAP item 1's overlapped-staging work must
+        move, baselined per round so the gap is diffable."""
+        return {
+            "batches": self.batches,
+            "duty_cycle": round(self.duty_cycle(), 4),
+            "overlap_ratio": round(self.overlap_ratio(), 4),
+            "issue_p99_ms": round(self.issue_hist.percentile(0.99) * 1e3, 3),
+            "d2h_p99_ms": round(self.d2h_hist.percentile(0.99) * 1e3, 3),
+            "idle_gap_p99_ms": round(
+                self.idle_gap_hist.percentile(0.99) * 1e3, 3
+            ),
+            "idle_gap_count": self.idle_gap_hist.count,
+        }

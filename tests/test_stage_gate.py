@@ -86,6 +86,72 @@ class TestCompare:
         assert len(reg) == 1
 
 
+def _multi_stage_doc(stages: dict, config="2"):
+    return {
+        "parsed": {
+            "configs": {
+                config: {
+                    "telemetry": {
+                        "stages": {
+                            name: {"count": 100, "p50_ms": p99 / 2, "p99_ms": p99}
+                            for name, p99 in stages.items()
+                        }
+                    }
+                }
+            }
+        }
+    }
+
+
+class TestDeviceSubStages:
+    """The trace plane split device_batch into h2d/device_dispatch/d2h
+    (PR 6): a prior round recorded before the split must pass through
+    with a notice — never a vacuous failure — while the still-shared
+    device_batch row keeps diffing."""
+
+    def test_substages_pass_through_without_baseline(self):
+        cur = _multi_stage_doc(
+            {"device_batch": 1.0, "h2d": 0.2, "device_dispatch": 0.5, "d2h": 0.3}
+        )
+        prev = _multi_stage_doc({"device_batch": 1.0})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not reg
+        # only the shared stage was diffed; no sub-stage failed vacuously
+        assert cmp_ == ["/parsed/configs/2/telemetry:device_batch"]
+        assert stage_gate.new_stage_names(cur, prev) == [
+            "d2h", "device_dispatch", "h2d",
+        ]
+
+    def test_substages_diff_once_both_rounds_have_them(self):
+        cur = _multi_stage_doc({"h2d": 2.0, "d2h": 0.3})
+        prev = _multi_stage_doc({"h2d": 1.0, "d2h": 0.3})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert len(reg) == 1 and "h2d" in reg[0]
+        assert stage_gate.new_stage_names(cur, prev) == []
+
+    def test_device_batch_regression_still_caught_across_split(self):
+        # the sum row regressed even though only the new rounds carry
+        # sub-stages: the shared device_batch row catches it
+        cur = _multi_stage_doc({"device_batch": 5.0, "d2h": 4.0})
+        prev = _multi_stage_doc({"device_batch": 1.0})
+        reg, _ = stage_gate.compare(cur, prev)
+        assert len(reg) == 1 and "device_batch" in reg[0]
+
+    def test_cli_prints_new_stage_notice(self, tmp_path):
+        cur = tmp_path / "BENCH_r02.json"
+        prev = tmp_path / "BENCH_r01.json"
+        cur.write_text(json.dumps(_multi_stage_doc({"device_batch": 1.0, "h2d": 0.2})))
+        prev.write_text(json.dumps(_multi_stage_doc({"device_batch": 1.0})))
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "exp", "stage_gate.py"),
+             "--current", str(cur), "--previous", str(prev)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stdout
+        assert "new stage(s) without a baseline" in r.stdout
+        assert "h2d" in r.stdout
+
+
 class TestBenchRanking:
     def test_newest_pair_orders_by_round(self, tmp_path):
         for name in ("BENCH_r02.json", "BENCH_r10.json", "BENCH_r09.json"):

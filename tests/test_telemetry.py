@@ -333,11 +333,15 @@ class TestFlightRecorder:
         state = srv.overload.evaluate(force=True)
         assert state == "shed"
         srv.telemetry.recorder.join_writer()  # dump IO is off-thread
-        dumps = list(tmp_path.iterdir())
-        assert len(dumps) == 1 and "overload_shed" in dumps[0].name
-        snap = json.load(open(dumps[0]))
+        flights = sorted(tmp_path.glob("flight_*.json"))
+        assert len(flights) == 1 and "overload_shed" in flights[0].name
+        snap = json.load(open(flights[0]))
         assert snap["context"]["to"] == "shed"
         assert snap["context"]["gauges"]["state"] == "shed"
+        # the trace plane (on by default) writes its sibling export on
+        # the same writer thread (mqtt_tpu.tracing)
+        traces = sorted(tmp_path.glob("traces_*.json"))
+        assert len(traces) == 1 and "overload_shed" in traces[0].name
 
     def test_breaker_trip_dumps(self, tmp_path):
         """A matcher breaker trip dumps the ring (server chains the
@@ -356,8 +360,10 @@ class TestFlightRecorder:
             breaker.record_failure("error")
             assert breaker.trips == 1
             srv.telemetry.recorder.join_writer()  # dump IO is off-thread
-            dumps = list(tmp_path.iterdir())
-            assert len(dumps) == 1 and "breaker_trip" in dumps[0].name
+            flights = sorted(tmp_path.glob("flight_*.json"))
+            assert len(flights) == 1 and "breaker_trip" in flights[0].name
+            # the trace plane's sibling export rides the same trigger
+            assert len(sorted(tmp_path.glob("traces_*.json"))) == 1
         finally:
             srv.matcher.close()
 
