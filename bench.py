@@ -793,6 +793,84 @@ def run_broker_bench(fast: bool) -> dict:
     return out
 
 
+async def _flatness_profile_block(fast: bool) -> dict:
+    """Config 8's host-observatory leg (mqtt_tpu.profiling): the
+    per-client receive-rate flatness ratio (10 vs 100 clients — ROADMAP
+    item 3's success criterion), the host-profile artifact at the
+    100-client point (top contended locks + fan-out amplification), and
+    an A/B overhead probe — the same 100-client workload with the
+    profiler+lock plane enabled vs disabled (the acceptance bar is
+    <=2% aggregate msgs/s; both numbers land in the artifact so the
+    claim is re-checkable every round). Device matcher off: the
+    collapse under study is the pure broker write path."""
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import run_flatness, run_stress
+
+    small, large = (4, 20) if fast else (10, 100)
+    m_small, m_large = (300, 120) if fast else (2000, 600)
+
+    from mqtt_tpu.utils.locked import DEFAULT_PLANE
+
+    async def one_round(port: int, profile_on: bool) -> tuple[dict, dict, int]:
+        # the lock plane aggregates process-wide by name: reset so this
+        # round's top-contended list reflects THIS workload, not the
+        # storm phase that ran earlier in the same process
+        DEFAULT_PLANE.reset()
+        srv = Server(
+            Options(
+                device_matcher=False,
+                profile=profile_on,
+                profile_locks=profile_on,
+                # broker and load generator share one process+loop here:
+                # the generator's starved reads look like slow consumers
+                # and the governor would evict the probe itself — this
+                # leg measures the write path, not overload control
+                overload_control=False,
+            )
+        )
+        srv.add_hook(AllowHook())
+        srv.add_listener(
+            TCP(LConfig(type="tcp", id="flat", address=f"127.0.0.1:{port}"))
+        )
+        await srv.serve()
+        try:
+            # a short warmup so neither arm pays first-connection costs
+            await run_stress("127.0.0.1", port, 2, 100)
+            flat = await run_flatness(
+                "127.0.0.1", port,
+                clients_small=small, clients_large=large,
+                msgs_small=m_small, msgs_large=m_large,
+            )
+            # best-of-2 on the large leg for the overhead A/B: a single
+            # sub-second round is scheduler noise, not a measurement
+            rerun = await run_stress("127.0.0.1", port, large, m_large)
+            best = max(
+                flat["large"]["aggregate_msgs_per_sec"],
+                rerun["aggregate_msgs_per_sec"],
+            )
+            return flat, srv.host_profile_block(), best
+        finally:
+            await srv.close()
+
+    flat_on, profile, on_rate = await one_round(18843, True)
+    flat_off, _, off_rate = await one_round(18844, False)
+    return {
+        "clients": flat_on["clients"],
+        "receive_flatness_ratio": flat_on["receive_flatness_ratio"],
+        "small": flat_on["small"],
+        "large": flat_on["large"],
+        "host_profile": profile,
+        "profiler_overhead": {
+            "enabled_msgs_per_sec": on_rate,
+            "disabled_msgs_per_sec": off_rate,
+            "overhead_pct": round((off_rate - on_rate) / max(1, off_rate) * 100, 2),
+        },
+    }
+
+
 def run_storm_bench(fast: bool) -> dict:
     """Config 8: the publish-storm overload drill. An in-process broker
     (tight overload caps, a deliberately slow consumer, the staging loop
@@ -915,6 +993,9 @@ def run_storm_bench(fast: bool) -> dict:
                 # numbers under storm load (mqtt_tpu.tracing) — ROADMAP
                 # item 1's per-round baseline of the staging gap
                 out["device_pipeline"] = srv.profiler.bench_block()
+            # the storm broker's own host-profile block (stacks, locks,
+            # amplification under STORM load, mqtt_tpu.profiling)
+            out["host_profile_storm"] = srv.host_profile_block()
             try:
                 slow_w.close()
             except Exception:
@@ -923,7 +1004,13 @@ def run_storm_bench(fast: bool) -> dict:
         finally:
             await srv.close()
 
-    return asyncio.run(main())
+    out = asyncio.run(main())
+    # the flatness + amplification + overhead leg runs on fresh
+    # default-cap brokers AFTER the storm broker is fully closed: its
+    # deliberately tiny quotas would shed the probe itself, and its
+    # still-armed lock plane would contaminate the disabled A/B arm
+    out["receive_flatness"] = asyncio.run(_flatness_profile_block(fast))
+    return out
 
 
 def main() -> None:

@@ -87,20 +87,9 @@ async def main(out_path: str) -> int:
         print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
 
         srv.publish_sys_topics()
-        hr, hw = await asyncio.open_connection(
-            *srv.listeners.get("s").address().rsplit(":", 1)
-        )
-        hw.write(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
-        await hw.drain()
-        # the listener sends Connection: close — read to EOF so a large
-        # exposition split across TCP segments never truncates the body
-        raw = b""
-        while True:
-            chunk = await asyncio.wait_for(hr.read(65536), 5)
-            if not chunk:
-                break
-            raw += chunk
-        head, body = raw.split(b"\r\n\r\n", 1)
+        from scrapelib import http_get
+
+        head, body = await http_get(srv.listeners.get("s").address(), "/metrics")
         assert b"200" in head.split(b"\r\n", 1)[0], head
         text = body.decode()
 

@@ -94,19 +94,9 @@ async def main(out_path: str) -> int:
             got += data.count(b"bench/")
         print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
 
-        hr, hw = await asyncio.open_connection(
-            *srv.listeners.get("s").address().rsplit(":", 1)
-        )
-        hw.write(b"GET /traces HTTP/1.1\r\nHost: x\r\n\r\n")
-        await hw.drain()
-        # Connection: close — read to EOF so a large export never truncates
-        raw = b""
-        while True:
-            chunk = await asyncio.wait_for(hr.read(65536), 5)
-            if not chunk:
-                break
-            raw += chunk
-        head, body = raw.split(b"\r\n\r\n", 1)
+        from scrapelib import http_get
+
+        head, body = await http_get(srv.listeners.get("s").address(), "/traces")
         assert b"200" in head.split(b"\r\n", 1)[0], head
         doc = json.loads(body.decode())
 

@@ -122,6 +122,12 @@ class ClientState:
         self.out_seq = 0
         self.out_deq = 0
         self.out_stamps: collections.deque = collections.deque(maxlen=64)
+        # write-path accounting (mqtt_tpu.profiling / ROADMAP item 3):
+        # bytes and socket-write calls this client's outbound legs have
+        # issued — the per-client face of the aggregate
+        # mqtt_tpu_outbound_{bytes,writes}_total counters
+        self.out_bytes = 0
+        self.out_writes = 0
 
 
 class Client:
@@ -197,6 +203,17 @@ class Client:
         self.ops.info.bytes_sent += len(data)
         self.ops.info.packets_sent += 1
         self.ops.info.messages_sent += 1
+        st = self.state
+        st.out_bytes += len(data)
+        st.out_writes += 1
+        tele = getattr(self.ops, "telemetry", None)
+        if tele is not None:
+            # io accounting only here: the DELIVERY count for a shared
+            # frame is stamped by server._enqueue_frame, which still
+            # knows the topic (this pre-encoded frame does not) and so
+            # can keep $SYS housekeeping out of the amplification math
+            tele.outbound_bytes.inc(len(data))
+            tele.outbound_writes.inc()
 
     def parse_connect(self, lid: str, pk: Packet) -> None:
         """Absorb CONNECT parameters into client state (clients.go:208-257)."""
@@ -598,13 +615,37 @@ class Client:
 
         self.ops.info.bytes_sent += len(data)
         self.ops.info.packets_sent += 1
+        st = self.state
+        st.out_bytes += len(data)
+        st.out_writes += 1
+        tele = getattr(self.ops, "telemetry", None)
+        if tele is not None:
+            tele.outbound_bytes.inc(len(data))
+            tele.outbound_writes.inc()
         if pk.fixed_header.type == pkts.PUBLISH:
             self.ops.info.messages_sent += 1
+            if tele is not None and not pk.topic_name.startswith("$SYS"):
+                # a per-subscriber encode: the amplification numerator
+                # (ROADMAP item 3's encode-once rewrite drives this to
+                # ~1 per inbound publish). $SYS housekeeping fan-out is
+                # excluded — it recurs every interval with no inbound
+                # publish behind it and would inflate the ratio without
+                # bound; retained deliveries and QoS retransmits DO
+                # count (they are real write-path encode work).
+                tele.publish_encodes.inc()
+                tele.fanout_deliveries.inc()
         self.ops.hooks.on_packet_sent(self, pk, data)
 
 
 class Clients(LockedMap[str, Client]):
-    """Clients known by the broker, keyed on client id (clients.go:36-100)."""
+    """Clients known by the broker, keyed on client id (clients.go:36-100).
+
+    Lock-plane adopted (mqtt_tpu.utils.locked): every fan-out delivery
+    does a ``get`` per subscriber, so this is the hottest single lock in
+    the broker."""
+
+    def __init__(self) -> None:
+        super().__init__(name="clients")
 
     def add_client(self, cl: Client) -> None:
         self.add(cl.id, cl)

@@ -139,8 +139,11 @@ class Cluster:
         self.n_workers = n_workers
         self.sock_dir = sock_dir
         # pseudo-subscribers: client f"\x00w{peer}" per (peer, filter) —
-        # matching remote interest IS a trie walk on this index
-        self.remote = TopicsIndex()
+        # matching remote interest IS a trie walk on this index. Its
+        # trie lock carries its own lock-plane name (mqtt_tpu.utils.
+        # locked) so forward-path contention never hides inside the
+        # local trie's numbers.
+        self.remote = TopicsIndex(lock_name="cluster_remote_trie")
         self._writers: dict[int, asyncio.StreamWriter] = {}
         self._unix_server: Optional[asyncio.base_events.Server] = None
         self._pending_presence: set[str] = set()

@@ -209,6 +209,14 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "trace_user_property",
         "trace_adopt_max_per_s",
         "trace_jax_profiler_dir",
+        # host hot-path observatory: sampling wall profiler, lock
+        # contention plane, topic-cardinality sketch (mqtt_tpu.profiling
+        # + mqtt_tpu.utils.locked)
+        "profile",
+        "profile_hz",
+        "profile_ring",
+        "profile_locks",
+        "profile_topics",
     ):
         if k in top:
             setattr(opts, k, top[k])

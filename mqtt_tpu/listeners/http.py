@@ -115,9 +115,12 @@ class HTTPHealthCheck(_HttpListener):
 class HTTPStats(_HttpListener):
     """Serves the $SYS info values as JSON (http_sysinfo.go:112-121) and,
     when a telemetry plane is attached (mqtt_tpu.telemetry), its
-    Prometheus text exposition at ``GET /metrics`` plus the trace
-    plane's Chrome trace-event export at ``GET /traces``
-    (mqtt_tpu.tracing; load the body straight into Perfetto)."""
+    Prometheus text exposition at ``GET /metrics``, the trace plane's
+    Chrome trace-event export at ``GET /traces`` (mqtt_tpu.tracing;
+    load the body straight into Perfetto), and the host profiler's
+    exports at ``GET /profile`` (mqtt_tpu.profiling) — collapsed
+    flamegraph text by default, ``?format=trace`` for the
+    Perfetto-loadable flame chart."""
 
     def __init__(self, config: Config, sys_info: Info, telemetry=None) -> None:
         super().__init__(config)
@@ -125,6 +128,20 @@ class HTTPStats(_HttpListener):
         self.telemetry = telemetry
 
     def _respond(self, method: str, path: str):
+        # known paths match on the bare path; the query string only
+        # selects an export format (/profile?format=trace)
+        path, _, query = path.partition("?")
+        if path == "/profile":
+            profiler = getattr(self.telemetry, "host_profiler", None)
+            if profiler is None:  # telemetry off, or the profiler disabled
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            if "format=trace" in query:
+                body = json.dumps(profiler.trace_events()).encode()
+                return "200 OK", body, "application/json", _NO_STORE
+            body = profiler.collapsed().encode()
+            return "200 OK", body, "text/plain; charset=utf-8", _NO_STORE
         if path == "/metrics":
             if self.telemetry is None:
                 return "404 Not Found", b"", "text/plain"
@@ -271,6 +288,11 @@ class Dashboard(_HttpListener):
                     "subscriptions": sorted(cl.state.subscriptions.get_all()),
                     "inflight": len(cl.state.inflight),
                     "done": cl.closed,
+                    # per-client write-path accounting (mqtt_tpu.profiling):
+                    # the client-level face of outbound_{bytes,writes}_total
+                    "outbound_queue_depth": cl.state.outbound_qty,
+                    "outbound_bytes": cl.state.out_bytes,
+                    "outbound_writes": cl.state.out_writes,
                 }
                 for cl in self.clients.get_all().values()
                 if cl.net.listener != "local" and cl.id != "inline"

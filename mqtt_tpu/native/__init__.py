@@ -349,13 +349,22 @@ def frame_scan(
     else:
         holder = buf
         ptr = ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value if buf else None
-    n = l.mqtt_frame_scan(
-        ptr, len(buf), max_frames, max_packet_size,
-        body_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        first_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
-        remainings.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        ctypes.byref(consumed), ctypes.byref(err),
-    )
+    try:
+        n = l.mqtt_frame_scan(
+            ptr, len(buf), max_frames, max_packet_size,
+            body_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            first_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            remainings.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            ctypes.byref(consumed), ctypes.byref(err),
+        )
+    finally:
+        # release the from_buffer export DETERMINISTICALLY: anything that
+        # pins this frame past return (the sampling wall profiler,
+        # mqtt_tpu.profiling, holds sys._current_frames() references
+        # briefly; a debugger does too) would otherwise keep the export
+        # alive and make the caller's `del rbuf[:consumed]` raise
+        # BufferError("Existing exports of data") mid-read-loop
+        del holder
     frames = [
         Frame(int(first_bytes[i]), int(body_offsets[i]), int(remainings[i]))
         for i in range(n)

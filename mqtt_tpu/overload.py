@@ -97,7 +97,11 @@ class PeerPressureSignal:
         self.weight = weight
         self.ttl_s = max(1e-3, ttl_s)
         self.clock = clock
-        self._lock = threading.Lock()
+        # lock-plane adoption: gossip observes from the cluster's read
+        # loops while the governor samples value() per evaluation
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("overload_peer_pressure")
         # peer -> (contribution, observed-at monotonic)
         self._peers: dict[int, tuple[float, float]] = {}
         self.observations = 0
@@ -206,7 +210,12 @@ class OverloadGovernor:
     ) -> None:
         self.config = config or OverloadConfig()
         self.clock = clock
-        self._lock = threading.Lock()
+        # lock-plane adoption (mqtt_tpu.utils.locked): admit()/
+        # read_delay() verdicts from every client read loop serialize
+        # here, so governor-lock contention is measured, not guessed
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("overload_governor")
         self._sources: dict[str, Callable[[], float]] = {}
         self._state = NORMAL
         self._entered_at = clock()

@@ -46,7 +46,7 @@ import random
 import threading
 import time
 from dataclasses import dataclass
-from typing import Callable, Optional
+from typing import Any, Callable, Optional
 
 from .topics import Subscribers, TopicsIndex
 
@@ -124,7 +124,12 @@ class CircuitBreaker:
         self.probe_successes = max(1, probe_successes)
         self.clock = clock
         self.on_trip = on_trip
-        self._lock = threading.Lock()
+        # lock-plane adoption (mqtt_tpu.utils.locked): executor resolve
+        # threads record outcomes here while the probe thread acquires
+        # probe slots — a measured contention point under storms
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("matcher_breaker")
         self._state = CLOSED
         self._retry_at = 0.0
         self._probe_inflight = False
@@ -291,12 +296,12 @@ class _GuardTask:
     def __init__(self) -> None:
         self._done = threading.Event()
         self._lock = threading.Lock()
-        self._result = None
+        self._result: Any = None
         self._exc: Optional[BaseException] = None
         self.abandoned = False
         self.counted = False
 
-    def wait(self, timeout: Optional[float]):
+    def wait(self, timeout: Optional[float]) -> Any:
         if not self._done.wait(timeout):
             with self._lock:
                 if not self._done.is_set():
@@ -350,7 +355,7 @@ class GuardPool:
 
     def _run(self) -> None:
         while True:
-            item = self._q.get()
+            item: Optional[tuple[_GuardTask, Callable[[], object]]] = self._q.get()
             if item is None:
                 with self._lock:
                     self._live -= 1
@@ -473,7 +478,7 @@ class ResilientMatcher:
 
     def __init__(
         self,
-        matcher,
+        matcher: Any,
         topics: TopicsIndex,
         config: Optional[BreakerConfig] = None,
         host_walk: Optional[Callable[[str], Subscribers]] = None,
@@ -515,7 +520,7 @@ class ResilientMatcher:
 
     # -- delegation --------------------------------------------------------
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         # only consulted for attributes not found on self: delegate the
         # wrapped matcher's surface (stats, flush, pending_deltas, ...)
         if name == "inner":  # not yet bound (partially-initialized self)
@@ -530,7 +535,9 @@ class ResilientMatcher:
         walk = self.host_walk
         return [walk(t) if t else Subscribers() for t in topics]
 
-    def match_topics_async(self, topics: list[str], profile=None):
+    def match_topics_async(
+        self, topics: list[str], profile: Any = None
+    ) -> Callable[[], list[Subscribers]]:
         """Issue one guarded batch; returns a zero-arg resolver whose
         wait is bounded by the watchdog budget. ``profile`` is the
         caller's optional per-batch BatchProfile (mqtt_tpu.tracing),

@@ -324,6 +324,24 @@ class Options:
     # and close() stops it — the deep-dive companion to the host-side
     # duty-cycle numbers ("" disables; requires a device matcher)
     trace_jax_profiler_dir: str = ""
+    # host hot-path observatory (mqtt_tpu.profiling): an always-on
+    # sampling wall profiler over every broker thread (sys._current_
+    # frames at profile_hz, zero per-call cost on the profiled paths),
+    # collapsed-stack + Perfetto exports at GET /profile and beside
+    # trigger dumps. Default on (requires telemetry).
+    profile: bool = True
+    # profiler sweep rate; each sweep walks every live thread's stack
+    profile_hz: float = 29.0
+    # raw samples retained for the /profile?format=trace flame chart
+    profile_ring: int = 2048
+    # lock-contention plane (mqtt_tpu.utils.locked): arm the named-lock
+    # wait/hold instrumentation and export it on /metrics. Opt-out knob
+    # — a disarmed lock costs one bool test over the bare acquire.
+    profile_locks: bool = True
+    # topic-cardinality space-saving sketch capacity (top-K hot topics
+    # + avg-hits-per-topic, observed on stage-clock-sampled publishes);
+    # 0 disables the sketch
+    profile_topics: int = 512
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -440,6 +458,12 @@ class Options:
             self.trace_ring = 4096
         if self.trace_adopt_max_per_s < 0:
             self.trace_adopt_max_per_s = 64
+        if self.profile_hz <= 0:
+            self.profile_hz = 29.0
+        if self.profile_ring <= 0:
+            self.profile_ring = 2048
+        if self.profile_topics < 0:
+            self.profile_topics = 512
         if self.logger is None:
             self.logger = logging.getLogger("mqtt_tpu")
 
@@ -481,16 +505,21 @@ class _FrameCache:
     drops inbound topic aliases exactly like the per-subscriber slow path
     ([MQTT-3.3.2-7] via ``Packet.copy``)."""
 
-    __slots__ = ("pk", "frames")
+    __slots__ = ("pk", "frames", "telemetry")
 
-    def __init__(self, pk: "Packet") -> None:
+    def __init__(self, pk: "Packet", telemetry=None) -> None:
         self.pk = pk
         self.frames: dict = {}
+        self.telemetry = telemetry
 
     def get(self, version: int, retain: bool) -> bytes:
         key = (version, bool(retain))
         data = self.frames.get(key)
         if data is None:
+            # a real encode (cache hits share the bytes): fan-out
+            # amplification accounting counts exactly these
+            if self.telemetry is not None:
+                self.telemetry.publish_encodes.inc()
             out = self.pk.copy(False)
             out.fixed_header.retain = bool(retain)
             out.protocol_version = version
@@ -576,6 +605,11 @@ class Server:
         # trace plane (mqtt_tpu.tracing): span ring + device profiler
         self.tracer = None
         self.profiler = None
+        # host hot-path observatory (mqtt_tpu.profiling): sampling wall
+        # profiler + topic-cardinality sketch; lock plane armed below
+        self.host_profiler = None
+        self.topic_sketch = None
+        self._lock_plane_armed = False
         if opts.telemetry:
             from .telemetry import Telemetry
 
@@ -599,6 +633,46 @@ class Server:
                 self.telemetry.attach_tracer(
                     self.tracer, exemplars=opts.trace_exemplars
                 )
+            if opts.profile:
+                # host hot-path observatory (mqtt_tpu.profiling): the
+                # sampling thread starts in serve(), so an embedder that
+                # builds but never serves a Server spawns no thread
+                from .profiling import SamplingProfiler, TopicSketch
+
+                self.host_profiler = SamplingProfiler(
+                    hz=opts.profile_hz,
+                    ring=opts.profile_ring,
+                    registry=self.telemetry.registry,
+                )
+                self.telemetry.attach_profiler(self.host_profiler)
+                if opts.profile_topics > 0:
+                    self.topic_sketch = TopicSketch(k=opts.profile_topics)
+                    sk = self.topic_sketch
+                    r = self.telemetry.registry
+                    r.gauge(
+                        "mqtt_tpu_topic_sketch_tracked",
+                        "Topics currently tracked by the space-saving sketch",
+                        fn=lambda: sk.tracked,
+                    )
+                    r.gauge(
+                        "mqtt_tpu_topic_sketch_avg_hits",
+                        "Observed average hits per admitted topic (device "
+                        "compaction-buffer sizing; sampled publishes)",
+                        fn=sk.avg_hits_per_topic,
+                    )
+                    r.counter(
+                        "mqtt_tpu_topic_sketch_evictions_total",
+                        "Space-saving evictions (sketch churn under high "
+                        "topic cardinality)",
+                        fn=lambda: sk.evictions,
+                    )
+            if opts.profile_locks:
+                # export the per-lock wait/hold families now; ARMING
+                # waits for serve() so a constructed-but-never-served
+                # Server (embedder probes, test harnesses) costs nothing
+                from .utils.locked import DEFAULT_PLANE
+
+                self.telemetry.attach_lock_plane(DEFAULT_PLANE)
         if opts.overload_control:
             from .overload import OverloadConfig, OverloadGovernor
 
@@ -835,6 +909,21 @@ class Server:
                 except Exception:
                     self.log.exception("jax.profiler trace failed to start")
 
+        if self.host_profiler is not None:
+            # the sampling thread is a daemon and samples off every
+            # broker lock path (it only reads sys._current_frames), so
+            # it starts before traffic and runs for the broker's life
+            self.host_profiler.start()
+        if (
+            self.telemetry is not None
+            and self.telemetry.lock_plane is not None
+            and not self._lock_plane_armed
+        ):
+            # arm the lock-contention plane for this broker's lifetime
+            # (refcounted: concurrent in-process brokers cannot disarm
+            # each other; close() releases this server's hold)
+            self.telemetry.lock_plane.arm()
+            self._lock_plane_armed = True
         for listener in list(self.listeners.internal.values()):
             await listener.init(self.log)
         self._event_loop_task = asyncio.get_running_loop().create_task(self._event_loop())
@@ -916,6 +1005,21 @@ class Server:
             "Publishes parked in the staging loop",
             fn=lambda: 0 if self._stage is None else self._stage.pending_depth,
         )
+        r.gauge(
+            "mqtt_tpu_outbound_backlog",
+            "Aggregate publishes parked in client outbound queues "
+            "(last overload-sweep sample)",
+            fn=lambda: self._outbound_backlog,
+        )
+        r.gauge(
+            "mqtt_tpu_fanout_amplification_ratio",
+            "Outbound PUBLISH encodes per inbound PUBLISH — the "
+            "per-subscriber re-encode waste (ROADMAP item 3)",
+            fn=lambda: (
+                self.telemetry.publish_encodes.value
+                / max(1, info.messages_received)
+            ),
+        )
         for name, field_ in (
             ("mqtt_tpu_matcher_batches_total", "batches"),
             ("mqtt_tpu_matcher_topics_total", "topics"),
@@ -947,6 +1051,25 @@ class Server:
             except Exception:  # pragma: no cover  # brokerlint: ok=R4 best-effort dump context; the flight dump itself still fires
                 pass
             self.telemetry.trigger_dump("overload_shed", extra)
+
+    def host_profile_block(self) -> dict:
+        """The BENCH-json host-profile block: profiler aggregates, the
+        topic sketch, the fan-out amplification numbers, and the top-3
+        contended locks — config 8's artifact fields (the ROADMAP item 3
+        success criteria, measured per round)."""
+        out: dict = {}
+        if self.host_profiler is not None:
+            out["profiler"] = self.host_profiler.bench_block()
+        if self.topic_sketch is not None:
+            out["topics"] = self.topic_sketch.bench_block()
+        if self.telemetry is not None:
+            out["fanout"] = self.telemetry.fanout_block(
+                self.info.messages_received
+            )
+            plane = self.telemetry.lock_plane
+            if plane is not None:
+                out["top_contended_locks"] = plane.top_contended(3)
+        return out
 
     # -- overload control plane (mqtt_tpu.overload) ------------------------
 
@@ -1616,6 +1739,11 @@ class Server:
             clock = tele.adopt_trace(pk)
         if clock is not None:
             clock.stamp("admission")
+            if self.topic_sketch is not None:
+                # topic-cardinality sketch rides the sampling verdict:
+                # the same 1-in-N publishes that carry a clock feed the
+                # top-K/avg-hits estimate (mqtt_tpu.profiling)
+                self.topic_sketch.observe(pk.topic_name)
             trace_id = getattr(clock, "trace_id", None)
             if trace_id is not None and self.options.trace_user_property:
                 # client-visible traces: subscribers (and peers on the
@@ -1809,15 +1937,24 @@ class Server:
         if tele is not None and tele.sample_outbound():
             st.out_stamps.append((st.out_seq, time.perf_counter()))
 
-    def _enqueue_frame(self, tcl: Client, data: bytes, pk_source) -> bool:
+    def _enqueue_frame(
+        self, tcl: Client, data: bytes, pk_source, count_delivery: bool = True
+    ) -> bool:
         """Queue a pre-encoded frame on a target's bounded outbound queue;
         False = dropped (queue full) with the shared drop accounting.
-        ``pk_source()`` materializes the Packet for on_publish_dropped."""
+        ``pk_source()`` materializes the Packet for on_publish_dropped.
+        ``count_delivery`` keeps $SYS housekeeping fan-out out of the
+        amplification accounting (the caller knows the topic; the
+        pre-encoded frame does not)."""
         try:
             tcl.state.outbound.put_nowait(data)
             tcl.state.outbound_qty += 1
             tcl.state.outbound_full_since = None
             self._stamp_outbound(tcl)
+            if count_delivery and self.telemetry is not None:
+                # shared-frame delivery WITHOUT an encode — exactly what
+                # keeps fan-out amplification near 1
+                self.telemetry.fanout_deliveries.inc()
             return True
         except asyncio.QueueFull:
             if tcl.state.outbound_full_since is None:
@@ -1895,6 +2032,8 @@ class Server:
             return True  # QoS0 deny is a silent drop (server.go:879-881)
         if clock is not None:
             clock.stamp("admission")
+            if self.topic_sketch is not None:
+                self.topic_sketch.observe(topic)
 
         self._fast_fan_frame(plan, topic, frame, body_offset, cl.id)
         if self._cluster is not None:
@@ -2029,7 +2168,16 @@ class Server:
         if pk.fixed_header.qos == 0 and not self.hooks.provides(
             ON_PACKET_ENCODE, ON_PACKET_SENT
         ):
-            fast = _FrameCache(pk)
+            # $SYS housekeeping republishes every interval with no
+            # inbound publish behind it: keep it out of the encode/
+            # delivery amplification accounting (ROADMAP item 3's metric
+            # must measure client fan-out, not the $SYS tick)
+            fast = _FrameCache(
+                pk,
+                None
+                if pk.topic_name.startswith("$SYS")
+                else self.telemetry,
+            )
 
         for id_, subs in subscribers.subscriptions.items():
             cl = self.clients.get(id_)
@@ -2060,7 +2208,12 @@ class Server:
             data = fast.get(cl.properties.protocol_version, retain)
             if cl.net.writer is None or cl.closed:
                 raise CODE_DISCONNECT()
-            if not self._enqueue_frame(cl, data, lambda: pk):
+            if not self._enqueue_frame(
+                cl,
+                data,
+                lambda: pk,
+                count_delivery=not pk.topic_name.startswith("$SYS"),
+            ):
                 raise ERR_PENDING_CLIENT_WRITES_EXCEEDED()
             return pk
 
@@ -2542,6 +2695,11 @@ class Server:
                 self.log.exception("jax.profiler trace failed to stop")
         if self.matcher is not None:
             self.matcher.close()
+        if self.host_profiler is not None:
+            self.host_profiler.stop()
+        if self._lock_plane_armed:
+            self._lock_plane_armed = False
+            self.telemetry.lock_plane.disarm()
         self.hooks.on_stopped()
         self.hooks.stop()
         if self._event_loop_task is not None:

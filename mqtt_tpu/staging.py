@@ -42,6 +42,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 from .topics import Subscribers
@@ -98,6 +99,11 @@ class MatchStage:
         self._wake: Optional[asyncio.Event] = None
         self._queue: Optional[asyncio.Queue] = None
         self._tasks: list[asyncio.Task] = []
+        # the resolve leg's dedicated executor: NAMED threads
+        # ("mqtt-tpu-resolve-N") so the host sampling profiler
+        # (mqtt_tpu.profiling) attributes the blocking D2H sync to the
+        # staging pipeline instead of an anonymous default-executor slot
+        self._executor: Optional[ThreadPoolExecutor] = None
         self._stopping = False
         self._ewma_s = 0.0  # per-batch service-time EWMA (drainer-updated)
         self._batch_cap = max_batch if latency_budget_s is None else max(
@@ -161,6 +167,10 @@ class MatchStage:
         """Create the collector/drainer tasks on the running loop."""
         loop = asyncio.get_running_loop()
         self._wake = asyncio.Event()
+        self._executor = ThreadPoolExecutor(
+            max_workers=max(2, self.max_inflight),
+            thread_name_prefix="mqtt-tpu-resolve",
+        )
         # bounded: if resolution falls behind, collection backpressures
         # instead of queueing unbounded device batches
         self._queue = asyncio.Queue(maxsize=self.max_inflight)
@@ -185,6 +195,11 @@ class MatchStage:
             while not queue.empty():
                 _resolver, futs, topics, _clocks, _rec = queue.get_nowait()
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
+        if self._executor is not None:
+            # in-flight resolves may finish on their own time; queued
+            # ones are dead (their futures just resolved via fallback)
+            self._executor.shutdown(wait=False, cancel_futures=True)
+            self._executor = None
 
     # -- submission --------------------------------------------------------
 
@@ -327,7 +342,7 @@ class MatchStage:
                 # this one, so the controller budgets depth x service.
                 depth = queue.qsize() + 1
                 t0 = loop.time()
-                results = await loop.run_in_executor(None, resolver)
+                results = await loop.run_in_executor(self._executor, resolver)
                 dt = loop.time() - t0
                 self._observe_service(dt, len(topics), depth)
                 if self.telemetry is not None:

@@ -219,6 +219,37 @@ async def run_stress(
     }
 
 
+async def run_flatness(
+    host: str,
+    port: int,
+    clients_small: int = 10,
+    clients_large: int = 100,
+    msgs_small: int = 1000,
+    msgs_large: int = 500,
+    **kw,
+) -> dict:
+    """The per-client receive-rate FLATNESS probe (ROADMAP item 3's
+    success criterion as one number): run the stresser workload at a
+    small and a large client count against the same broker and report
+    the ratio of per-client receive medians. A flat broker holds ~1.0;
+    today's thread-per-connection re-encode path collapses toward 0
+    as clients grow (8.3k -> 879 msgs/s going 10 -> 100 in BENCH_r05).
+    bench.py config 8 embeds this block so the stage gate can watch the
+    number per round."""
+    small = await run_stress(host, port, clients_small, msgs_small, **kw)
+    large = await run_stress(host, port, clients_large, msgs_large, **kw)
+    return {
+        "clients": [clients_small, clients_large],
+        "small": small,
+        "large": large,
+        "receive_flatness_ratio": round(
+            large["receive_median_per_sec"]
+            / max(1e-9, small["receive_median_per_sec"]),
+            4,
+        ),
+    }
+
+
 # -- publish storm (overload-governor drill) ---------------------------------
 
 
@@ -619,6 +650,12 @@ def main() -> None:
         "the throughput workload",
     )
     p.add_argument(
+        "--flatness", action="store_true",
+        help="per-client receive-rate flatness probe: the stress workload "
+        "at 10 clients and at --clients, reporting the receive-median "
+        "ratio (ROADMAP item 3's success criterion)",
+    )
+    p.add_argument(
         "--partition", action="store_true",
         help="partition-storm mesh drill: the storm workload plus a $SYS "
         "scrape of the cluster's parked/replayed/drop gauges (run the "
@@ -655,6 +692,14 @@ def main() -> None:
             run_partition(
                 host, int(port), args.clients, args.messages,
                 sys_port=args.sys_port,
+            )
+        )
+    elif args.flatness:
+        out = asyncio.run(
+            run_flatness(
+                host, int(port),
+                clients_large=args.clients,
+                msgs_small=args.messages, msgs_large=args.messages,
             )
         )
     elif args.storm:

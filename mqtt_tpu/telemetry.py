@@ -285,7 +285,13 @@ class MetricsRegistry:
     Prometheus text exposition and the flat $SYS topic map."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        # lock-plane adoption (mqtt_tpu.utils.locked): every scrape
+        # walks this lock against concurrent child registration, so it
+        # is itself a measured contention point. Lazy import — locked.py
+        # imports this module's Histogram.
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("metrics_registry")
         self._families: dict[str, _Family] = {}
         # render per-bucket trace exemplars in exposition() (OpenMetrics
         # style; set via Telemetry.attach_tracer — Options.trace_exemplars)
@@ -477,7 +483,11 @@ class FlightRecorder:
         self.dumps = 0
         self.dumps_suppressed = 0
         self._last_dump = float("-inf")
-        self._lock = threading.Lock()
+        # lock-plane adoption: the event loop appends to the ring under
+        # this lock on every sampled publish while dump threads snapshot
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("flight_ring")
         self._writers: list[threading.Thread] = []
 
     def add(self, record: dict) -> None:
@@ -614,6 +624,13 @@ class Telemetry:
         # the server via attach_tracer() — publish_clock consults it so
         # 1-in-trace_sample publishes carry a full trace context
         self.tracer: Any = None
+        # the host profiler (mqtt_tpu.profiling.SamplingProfiler) or
+        # None; attached by the server via attach_profiler() — serves
+        # GET /profile and rides trigger dumps
+        self.host_profiler: Any = None
+        # the lock-contention plane (mqtt_tpu.utils.locked.LockPlane)
+        # or None; attached via attach_lock_plane()
+        self.lock_plane: Any = None
         self.recorder = FlightRecorder(
             size=ring, dump_dir=dump_dir, min_interval_s=dump_min_interval_s
         )
@@ -661,6 +678,28 @@ class Telemetry:
             "Flight-recorder dumps written",
             fn=lambda: self.recorder.dumps,
         )
+        # write-path / fan-out amplification accounting (ROADMAP item 3:
+        # the per-subscriber re-encode waste the encode-once rewrite will
+        # eliminate — encodes / inbound publishes is its success metric)
+        self.publish_encodes = r.counter(
+            "mqtt_tpu_publish_encodes_total",
+            "Outbound PUBLISH packet encodes (clients.write_packet + "
+            "the fan-out frame cache's per-variant encodes)",
+        )
+        self.fanout_deliveries = r.counter(
+            "mqtt_tpu_fanout_deliveries_total",
+            "Outbound PUBLISH deliveries written (shared-frame and "
+            "per-subscriber legs)",
+        )
+        self.outbound_bytes = r.counter(
+            "mqtt_tpu_outbound_bytes_total",
+            "Bytes written to client transports by the outbound write "
+            "paths",
+        )
+        self.outbound_writes = r.counter(
+            "mqtt_tpu_outbound_writes_total",
+            "Socket write calls issued by the outbound write paths",
+        )
 
     # -- publish stage sampling --------------------------------------------
 
@@ -674,6 +713,77 @@ class Telemetry:
             for h in self.stage_hist.values():
                 h.enable_exemplars()
             self.registry.emit_exemplars = True
+
+    def attach_profiler(self, profiler: Any) -> None:
+        """Attach the host sampling profiler
+        (mqtt_tpu.profiling.SamplingProfiler): GET /profile serves its
+        exports and trigger dumps grow a ``profile_*.txt`` sibling."""
+        self.host_profiler = profiler
+
+    def attach_lock_plane(self, plane: Any) -> None:
+        """Attach the lock-contention plane
+        (mqtt_tpu.utils.locked.LockPlane): every canonical lock name
+        exports wait/hold histograms, acquisition/contention counters,
+        and the wait-share gauge set (the top-K contended-locks view is
+        this family sorted by share)."""
+        self.lock_plane = plane
+        # local import: utils.locked imports telemetry.Histogram, so the
+        # reverse edge must resolve lazily
+        from .utils.locked import LOCK_NAMES
+
+        r = self.registry
+        for name in LOCK_NAMES:
+            st = plane.stats(name)
+            r.histogram(
+                "mqtt_tpu_lock_wait_seconds",
+                "Time acquirers spent blocked on a named broker lock",
+                lock=name,
+                fn=lambda s=st: s.wait_hist,
+            )
+            r.histogram(
+                "mqtt_tpu_lock_hold_seconds",
+                "Time holders kept a named broker lock",
+                lock=name,
+                fn=lambda s=st: s.hold_hist,
+            )
+            r.counter(
+                "mqtt_tpu_lock_acquisitions_total",
+                "Acquisitions of a named broker lock",
+                lock=name,
+                fn=lambda s=st: s.acquisitions,
+            )
+            r.counter(
+                "mqtt_tpu_lock_contended_total",
+                "Acquisitions that actually blocked on a named broker lock",
+                lock=name,
+                fn=lambda s=st: s.contended,
+            )
+            r.gauge(
+                "mqtt_tpu_lock_wait_share_ratio",
+                "This lock's share of all measured lock wait time "
+                "(sort descending for the top-K contended locks)",
+                lock=name,
+                fn=lambda n=name: plane.wait_share(n),
+            )
+
+    def fanout_block(self, inbound_publishes: int) -> dict:
+        """The BENCH-json fan-out amplification block: encodes and
+        deliveries per inbound PUBLISH — the number ROADMAP item 3's
+        encode-once rewrite must drive toward ~1 encode/publish."""
+        inbound = max(1, int(inbound_publishes))
+        return {
+            "inbound_publishes": int(inbound_publishes),
+            "publish_encodes": self.publish_encodes.value,
+            "fanout_deliveries": self.fanout_deliveries.value,
+            "outbound_bytes": self.outbound_bytes.value,
+            "outbound_writes": self.outbound_writes.value,
+            "encode_amplification": round(
+                self.publish_encodes.value / inbound, 4
+            ),
+            "delivery_amplification": round(
+                self.fanout_deliveries.value / inbound, 4
+            ),
+        }
 
     def publish_clock(self) -> Optional[StageClock]:
         """A StageClock for 1-in-N publishes, None for the rest; when
@@ -794,10 +904,38 @@ class Telemetry:
         (both on the data plane), so the file IO moves to a daemon
         thread. When the trace plane is attached, the same thread also
         writes a sibling ``traces_*.json`` (Perfetto-loadable) next to
-        the flight dump — the dump's trace_ids point into it. Use
-        ``recorder.dump`` directly for a synchronous dump."""
-        after = self._dump_traces if self.tracer is not None else None
+        the flight dump — the dump's trace_ids point into it — and when
+        the host profiler is attached, a ``profile_*.txt`` collapsed
+        snapshot of where every broker thread was spending wall time
+        as the trigger fired. Use ``recorder.dump`` directly for a
+        synchronous dump."""
+        after = (
+            self._dump_siblings
+            if self.tracer is not None or self.host_profiler is not None
+            else None
+        )
         self.recorder.dump_async(reason, extra, after=after)
+
+    def _dump_siblings(self, dump_path: str, reason: str) -> None:
+        """Write the trace ring and the profiler's collapsed stacks
+        beside a just-written flight dump (recorder writer thread)."""
+        if self.tracer is not None:
+            self._dump_traces(dump_path, reason)
+        if self.host_profiler is not None:
+            self._dump_profile(dump_path, reason)
+
+    def _dump_profile(self, dump_path: str, reason: str) -> None:
+        base = os.path.basename(dump_path)
+        stem = base[len("flight_"):] if base.startswith("flight_") else base
+        name = "profile_" + os.path.splitext(stem)[0] + ".txt"
+        path = os.path.join(os.path.dirname(dump_path), name)
+        try:
+            with open(path, "w") as f:
+                f.write(self.host_profiler.collapsed())
+        except OSError:
+            _log.exception("profile dump failed (path=%s)", path)
+            return
+        _log.warning("profiler stacks dumped to %s (reason=%s)", path, reason)
 
     def _dump_traces(self, dump_path: str, reason: str) -> None:
         """Write the trace ring beside a just-written flight dump (runs

@@ -293,10 +293,18 @@ class TopicsIndex:
     """A trie of topic filters with subscriber scan and retained-message
     walks (reference TopicsIndex, topics.go:350+)."""
 
-    def __init__(self) -> None:
-        self.retained = PacketStore()
+    def __init__(self, lock_name: str = "topics_trie") -> None:
+        # lock-plane adoption (mqtt_tpu.utils.locked): every host-walk
+        # fallback, subscribe/unsubscribe, and retained-store mutation
+        # serializes here — the prime suspect for ROADMAP item 3's
+        # per-client collapse, now measured. The cluster's remote-
+        # interest index passes its own name so the two tries' numbers
+        # stay separable.
+        from .utils.locked import InstrumentedLock
+
+        self.retained = PacketStore(name="retained")
         self.root = _Particle("", None)
-        self._lock = threading.RLock()
+        self._lock = InstrumentedLock(lock_name, rlock=True)
         # bumped on every subscription mutation; device indexes (mqtt_tpu.ops)
         # compare against it to detect staleness
         self.version = 0

@@ -92,7 +92,11 @@ class Tracer:
         registry: Any = None,
     ) -> None:
         self.sample = max(0, int(sample))
-        self._lock = threading.Lock()
+        # lock-plane adoption (mqtt_tpu.utils.locked): span appends from
+        # data-plane threads race /traces exports under this lock
+        from .utils.locked import InstrumentedLock
+
+        self._lock = InstrumentedLock("trace_ring")
         self.ring: collections.deque = collections.deque(maxlen=max(16, int(ring)))
         self._rng = random.Random(seed)
         # worker id in a mesh (mqtt_tpu.cluster sets it); the export's

@@ -1,24 +1,249 @@
-"""A lock-guarded map shared by the broker's concurrent registries.
+"""Lock-guarded shared state plus the broker's lock-contention plane.
 
 The reference wraps every shared map in a small mutex-guarded struct
-(e.g. topics.go:249-301, packets/packets.go:66-117); this is the one Python
-equivalent they all reuse.
+(e.g. topics.go:249-301, packets/packets.go:66-117); ``LockedMap`` is
+the one Python equivalent they all reuse.
+
+ROADMAP item 3 says the broker path collapses 50x per-client going
+10->100 clients — but which locks actually contend was guesswork until
+now. ``InstrumentedLock`` is a drop-in ``threading.Lock``/``RLock``
+wrapper that measures, per named lock, how long acquirers WAIT and how
+long holders HOLD, aggregated by name in a process-wide ``LockPlane``
+(same-named locks share one stats record, so per-test/per-server lock
+churn stays bounded). The hot registries adopt it (the trie, the client
+map, the governor, the metrics registry, the trace/flight rings, the
+breaker, the cluster's remote-interest trie) and the telemetry plane
+exports the histograms at ``GET /metrics``
+(``Telemetry.attach_lock_plane``).
+
+Overhead discipline: the plane is DISARMED by default — a disarmed
+acquire is one extra attribute read and a bool test over the bare lock.
+Armed, the uncontended path pays one non-blocking try-acquire plus two
+``perf_counter`` reads (hold timing); the wait histogram is touched
+only when the try-acquire actually missed. Stats writes happen while
+the writing lock INSTANCE is held — but same-named instances on
+different objects (two brokers in one process, the local and remote
+tries' retained stores) share one record, so concurrent ``+=`` updates
+can occasionally lose an increment under GIL preemption. That is the
+same deliberately-unlocked posture as telemetry.Counter: telemetry-
+grade accuracy, never a lock on the measurement path itself.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Generic, Hashable, Optional, TypeVar
+from time import perf_counter
+from typing import Any, Generic, Hashable, Optional, TypeVar
+
+from ..telemetry import Histogram
 
 K = TypeVar("K", bound=Hashable)
 V = TypeVar("V")
 
+# the canonical lock-plane names (label values of the mqtt_tpu_lock_*
+# metric families): Telemetry.attach_lock_plane registers an exposition
+# child per name up front, so construction order between locks and the
+# telemetry plane never decides what /metrics shows
+LOCK_NAMES = (
+    "clients",
+    "topics_trie",
+    "cluster_remote_trie",
+    "retained",
+    "metrics_registry",
+    "flight_ring",
+    "trace_ring",
+    "overload_governor",
+    "overload_peer_pressure",
+    "matcher_breaker",
+)
 
-class LockedMap(Generic[K, V]):
-    """RLock-protected dict with copy-on-iterate semantics."""
+
+class LockStats:
+    """Aggregate wait/hold accounting for one lock NAME (all same-named
+    lock instances share one record)."""
+
+    __slots__ = (
+        "name",
+        "acquisitions",
+        "contended",
+        "wait_s",
+        "hold_s",
+        "wait_hist",
+        "hold_hist",
+    )
+
+    def __init__(self, name: str) -> None:
+        self.name = name
+        self.clear()
+
+    def clear(self) -> None:
+        """Zero IN PLACE: live locks and registered metric closures hold
+        references to this record, so reset must never replace it."""
+        self.acquisitions = 0
+        self.contended = 0  # acquires that actually blocked
+        self.wait_s = 0.0  # total seconds spent waiting (contended only)
+        self.hold_s = 0.0  # total seconds the lock was held
+        self.wait_hist = Histogram()
+        self.hold_hist = Histogram()
+
+    def as_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "acquisitions": self.acquisitions,
+            "contended": self.contended,
+            "wait_s": round(self.wait_s, 6),
+            "hold_s": round(self.hold_s, 6),
+            "wait_p99_ms": round(self.wait_hist.percentile(0.99) * 1e3, 4),
+            "hold_p99_ms": round(self.hold_hist.percentile(0.99) * 1e3, 4),
+        }
+
+
+class LockPlane:
+    """The process-wide registry of named lock stats. Armed/disarmed by
+    the server (``Options.profile_locks``); arming is refcounted so two
+    in-process brokers (tests, bench) cannot disarm each other."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._names_mutex = threading.Lock()
+        self._stats: dict[str, LockStats] = {}
+        self._armed = 0
+        self.enabled = False
+
+    def stats(self, name: str) -> LockStats:
+        with self._names_mutex:
+            st = self._stats.get(name)
+            if st is None:
+                st = self._stats[name] = LockStats(name)
+            return st
+
+    def arm(self) -> None:
+        with self._names_mutex:
+            self._armed += 1
+            self.enabled = True
+
+    def disarm(self) -> None:
+        with self._names_mutex:
+            self._armed = max(0, self._armed - 1)
+            self.enabled = self._armed > 0
+
+    def reset(self) -> None:
+        """Zero every stats record (tests and bench A/B rounds) — in
+        place, so locks and metric closures created BEFORE the reset
+        keep feeding the same records afterwards."""
+        with self._names_mutex:
+            for st in self._stats.values():
+                st.clear()
+
+    def snapshot(self) -> list[LockStats]:
+        with self._names_mutex:
+            return list(self._stats.values())
+
+    def total_wait_s(self) -> float:
+        return sum(st.wait_s for st in self.snapshot())
+
+    def top_contended(self, k: int = 3) -> list[dict]:
+        """The k most-contended lock names by total wait time — the
+        bench artifact's "which locks own the collapse" field."""
+        ranked = sorted(self.snapshot(), key=lambda s: s.wait_s, reverse=True)
+        return [st.as_dict() for st in ranked[:k] if st.acquisitions]
+
+    def wait_share(self, name: str) -> float:
+        """One lock's share of ALL measured lock wait (the top-K
+        contended-locks gauge set renders this per name)."""
+        total = self.total_wait_s()
+        if total <= 0.0:
+            return 0.0
+        return self.stats(name).wait_s / total
+
+
+# the process default: broker locks register here by name; the server
+# arms it (Options.profile_locks) and Telemetry exports it
+DEFAULT_PLANE = LockPlane()
+
+
+class InstrumentedLock:
+    """A named, plane-registered ``threading.Lock``/``RLock`` drop-in:
+    context manager, ``acquire``/``release``/``locked``. Re-entrant
+    acquires (``rlock=True``) time only the outermost hold."""
+
+    __slots__ = ("_inner", "_plane", "stats", "_local")
+
+    def __init__(
+        self,
+        name: str,
+        rlock: bool = False,
+        plane: Optional[LockPlane] = None,
+    ) -> None:
+        self._inner: Any = threading.RLock() if rlock else threading.Lock()
+        self._plane = plane if plane is not None else DEFAULT_PLANE
+        self.stats = self._plane.stats(name)
+        self._local = threading.local()  # re-entrancy depth + hold start
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if not self._plane.enabled:
+            return self._inner.acquire(blocking, timeout)
+        ok = self._inner.acquire(False)
+        wait = 0.0
+        if not ok:
+            if not blocking:
+                return False
+            t0 = perf_counter()
+            ok = self._inner.acquire(True, timeout)
+            if not ok:
+                return False
+            wait = perf_counter() - t0
+        local = self._local
+        depth = getattr(local, "depth", 0)
+        local.depth = depth + 1
+        if depth == 0:
+            # stats writes below happen while THIS lock is held, so the
+            # shared per-name record is single-writer in practice
+            local.t_held = perf_counter()
+            st = self.stats
+            st.acquisitions += 1
+            if wait > 0.0:
+                st.contended += 1
+                st.wait_s += wait
+                st.wait_hist.observe(wait)
+        return True
+
+    def release(self) -> None:
+        local = self._local
+        depth = getattr(local, "depth", 0)
+        if depth > 0:
+            # the depth bookkeeping must unwind even when the plane was
+            # disarmed MID-HOLD (Server.close() racing a writer thread):
+            # skipping the decrement would leave this thread's counter
+            # stuck and silently blind the stats after a later re-arm
+            local.depth = depth - 1
+            if depth == 1 and self._plane.enabled:
+                held = perf_counter() - getattr(local, "t_held", perf_counter())
+                st = self.stats
+                st.hold_s += held
+                st.hold_hist.observe(held)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return bool(self._inner.locked()) if hasattr(self._inner, "locked") else False
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc: object) -> None:
+        self.release()
+
+
+class LockedMap(Generic[K, V]):
+    """RLock-protected dict with copy-on-iterate semantics. Pass a
+    ``name`` to register the lock with the contention plane (the hot
+    singletons — the client registry, the retained store); unnamed maps
+    (per-particle subscription containers, per-client state) keep the
+    bare RLock so the trie's millions of nodes cost nothing extra."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self._lock: Any = (
+            threading.RLock() if name is None else InstrumentedLock(name, rlock=True)
+        )
         self.internal: dict[K, V] = {}
 
     def add(self, key: K, val: V) -> None:
