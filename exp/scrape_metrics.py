@@ -61,6 +61,16 @@ async def main(out_path: str) -> int:
         sw.write(_subscribe_bytes(1, "bench/#"))
         await sw.drain()
         await sr.readexactly(5)
+        # a PREDICATED subscriber (ISSUE 8): the suffix is stripped at
+        # SUBSCRIBE, the rule table evaluates inside the staged batch,
+        # and the mqtt_tpu_predicate_* series must validate below
+        pr2, pw2 = await asyncio.open_connection(host, int(port))
+        pw2.write(_connect_bytes("scrape-pred", version=4))
+        await pw2.drain()
+        await pr2.readexactly(4)
+        pw2.write(_subscribe_bytes(1, "bench/+$GT{v:4.5}"))
+        await pw2.drain()
+        await pr2.readexactly(5)
         if srv.matcher is not None:
             srv.matcher.flush()
 
@@ -70,21 +80,35 @@ async def main(out_path: str) -> int:
         await pr.readexactly(4)
         for i in range(200):
             topic = f"bench/{i % 10}".encode()
-            payload = b"x" * 16
+            payload = b'{"v": %d.0}' % (i % 10)
             body = len(topic).to_bytes(2, "big") + topic + payload
             pw.write(bytes([0x30, len(body)]) + body)
         await pw.drain()
-        deadline = asyncio.get_event_loop().time() + 10
+        deadline = asyncio.get_event_loop().time() + 20
         got = 0
         while got < 200 and asyncio.get_event_loop().time() < deadline:
             try:
-                data = await asyncio.wait_for(sr.read(65536), 1.0)
+                # generous first-read budget: the burst's first staged
+                # batch pays the match + predicate kernel jit compiles
+                data = await asyncio.wait_for(sr.read(65536), 5.0)
             except asyncio.TimeoutError:
                 break
             if not data:
                 break
             got += data.count(b"bench/")
         print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
+
+        # the first staged batches pay the jit compile: wait for the
+        # predicate plane to have decided the burst before asserting on it
+        eng = srv._predicates
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 30
+        while (
+            eng is not None
+            and (eng.filtered == 0 or eng.deliveries == 0)
+            and loop.time() < deadline
+        ):
+            await asyncio.sleep(0.2)
 
         srv.publish_sys_topics()
         from scrapelib import http_get
@@ -98,10 +122,23 @@ async def main(out_path: str) -> int:
             "mqtt_tpu_publish_stage_seconds",
             "mqtt_tpu_messages_received_total",
             "mqtt_tpu_uptime_seconds",
+            "mqtt_tpu_predicate_rules",
+            "mqtt_tpu_predicate_filtered_total",
+            "mqtt_tpu_predicate_oracle_mismatches_total",
         ]
         missing = [m for m in required if m not in text]
         if missing:
             print(f"FAIL: metrics missing {missing}", file=sys.stderr)
+            return 1
+        if eng is None or eng.rule_count != 1:
+            print("FAIL: predicated subscribe did not register a rule", file=sys.stderr)
+            return 1
+        if eng.filtered == 0 or eng.oracle_mismatches:
+            print(
+                f"FAIL: predicate plane inert or mismatched "
+                f"(filtered={eng.filtered} mismatches={eng.oracle_mismatches})",
+                file=sys.stderr,
+            )
             return 1
         with open(out_path, "w") as f:
             f.write(text)

@@ -64,6 +64,7 @@ import asyncio
 import collections
 import json
 import logging
+import math
 import os
 import random
 import struct
@@ -203,6 +204,18 @@ class Cluster:
         self.partition_pings = getattr(
             opts, "cluster_peer_health_partition_pings", 5
         )
+        # seconds-dialable SUSPECT window (ISSUE 8 satellite): when set,
+        # the wall-clock grace wins over the missed-pong count — rounded
+        # UP to whole ping intervals (the health clock only ticks there),
+        # floor one interval. The PARTITIONED threshold keeps its strict
+        # ordering so the park buffer always gets a heal window.
+        window_s = float(getattr(opts, "cluster_suspect_window_s", 0.0) or 0.0)
+        if window_s > 0:
+            self.suspect_pings = max(
+                1, math.ceil(window_s / self.PING_INTERVAL_S)
+            )
+            if self.partition_pings <= self.suspect_pings:
+                self.partition_pings = self.suspect_pings + 3
         self.park_max_bytes = getattr(
             opts, "cluster_peer_park_max_bytes", 1 << 20
         )

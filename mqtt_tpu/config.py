@@ -192,7 +192,14 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "overload_priority_users",
         "cluster_peer_health_suspect_pings",
         "cluster_peer_health_partition_pings",
+        "cluster_suspect_window_s",
         "cluster_peer_park_max_bytes",
+        # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates):
+        # suffix parsing, device rule-table cap, differential-oracle
+        # sampling cadence
+        "predicate_filters",
+        "predicate_max_rules",
+        "predicate_oracle_sample",
         # telemetry plane: stage-clock sampling, flight recorder, /metrics
         # (mqtt_tpu.telemetry)
         "telemetry",

@@ -142,7 +142,11 @@ class Message:
 
 @dataclass
 class Subscription:
-    """A serializable client subscription (storage.go:156-179)."""
+    """A serializable client subscription (storage.go:156-179).
+
+    ``filter`` is the BASE filter (any MQTT+ predicate suffix already
+    stripped); ``predicates`` carries the suffix source texts so a
+    restart re-registers the rules (mqtt_tpu.predicates)."""
 
     t: str = SUBSCRIPTION_KEY
     client: str = ""
@@ -152,6 +156,7 @@ class Subscription:
     qos: int = 0
     retain_as_published: bool = False
     no_local: bool = False
+    predicates: list = field(default_factory=list)
 
 
 @dataclass

@@ -184,6 +184,7 @@ def subscription_from_dict(d: dict) -> Subscription:
         qos=d.get("qos", 0),
         retain_as_published=d.get("retain_as_published", False),
         no_local=d.get("no_local", False),
+        predicates=list(d.get("predicates") or []),
     )
 
 
@@ -301,6 +302,7 @@ class StorageHook(Hook):
                 no_local=f.no_local,
                 retain_handling=f.retain_handling,
                 retain_as_published=f.retain_as_published,
+                predicates=list(getattr(f, "predicates", ()) or ()),
             )
             self._set(self._sub_key(cl, f.filter), dumps(record))
 

@@ -130,6 +130,10 @@ class Subscription:
     no_local: bool = False
     # True when this subscription forms part of a retained-publish response.
     fwd_retained_flag: bool = False
+    # MQTT+ payload predicates (mqtt_tpu.predicates): the SOURCE suffix
+    # texts (e.g. "$GT{temp:25.0}") stripped off the filter at SUBSCRIBE
+    # time. () = unpredicated (deliver everything — the pre-MQTT+ path).
+    predicates: tuple = ()
 
     def merge(self, n: "Subscription") -> "Subscription":
         """Fold ``n`` into this subscription: max QoS [MQTT-3.3.4-2], union of
@@ -137,6 +141,11 @@ class Subscription:
 
         Mirrors the reference's value-receiver semantics: the receiver is not
         mutated, but an existing identifiers map is shared and extended.
+
+        Predicates merge with OR semantics: a client matched through an
+        UNPREDICATED filter must receive every payload, so either side
+        being () clears the merge; otherwise the union is kept and
+        delivery requires any one predicate to pass (mqtt_tpu.predicates).
         """
         s = Subscription(
             filter=self.filter,
@@ -148,6 +157,13 @@ class Subscription:
             retain_as_published=self.retain_as_published,
             no_local=self.no_local,
             fwd_retained_flag=self.fwd_retained_flag,
+            predicates=(
+                ()
+                if not self.predicates or not n.predicates
+                else self.predicates
+                if n.predicates == self.predicates
+                else tuple(dict.fromkeys(self.predicates + n.predicates))
+            ),
         )
         if s.identifiers is None:
             s.identifiers = {s.filter: s.identifier}

@@ -95,6 +95,7 @@ from .topics import (
     TopicsIndex,
     is_shared_filter,
     is_valid_filter,
+    split_predicate_suffix,
 )
 
 VERSION = "0.1.0"  # our framework version (reference tracks 2.7.9)
@@ -273,8 +274,27 @@ class Options:
     # the partition drop counters, link aborted for a clean re-dial)
     cluster_peer_health_suspect_pings: int = 2
     cluster_peer_health_partition_pings: int = 5
+    # seconds-dialable SUSPECT window (ISSUE 8 satellite): when > 0 this
+    # replaces the missed-pong COUNT with a wall-clock grace — the peer
+    # goes SUSPECT after ~this many seconds without a pong (rounded up
+    # to whole ping intervals). 0 keeps the legacy pings knob.
+    cluster_suspect_window_s: float = 0.0
     # byte budget of each SUSPECT peer's park buffer (oldest spill first)
     cluster_peer_park_max_bytes: int = 1 << 20
+    # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates): parse
+    # `$GT{...}`-style suffixes off SUBSCRIBE filters, filter fan-out by
+    # payload, evaluate the compiled rule table on device inside the
+    # staged match batch (host interpreter = oracle + degradation path).
+    # Default on — an unpredicated broker pays one attribute read per
+    # publish and stays bit-identical.
+    predicate_filters: bool = True
+    # device rule-table cap: rules registered past it are evaluated by
+    # the host interpreter only (degraded, never refused)
+    predicate_max_rules: int = 1 << 20
+    # differential oracle cadence: 1-in-N predicated publishes re-derive
+    # every device verdict from the raw payload on the host and count
+    # mismatches (0 disables sampling)
+    predicate_oracle_sample: int = 64
     # unified telemetry plane (mqtt_tpu.telemetry): per-publish stage
     # clock sampled 1-in-N, histogram metrics, Prometheus exposition at
     # GET /metrics (sysinfo listener), the retained
@@ -442,6 +462,14 @@ class Options:
             )
         if self.cluster_peer_park_max_bytes <= 0:
             self.cluster_peer_park_max_bytes = 1 << 20
+        if self.cluster_suspect_window_s < 0:
+            self.cluster_suspect_window_s = 0.0  # 0 = legacy pings knob
+        # predicate knobs are config-reachable: a zero/negative rule cap
+        # would refuse every predicate, a negative sample means "default"
+        if self.predicate_max_rules <= 0:
+            self.predicate_max_rules = 1 << 20
+        if self.predicate_oracle_sample < 0:
+            self.predicate_oracle_sample = 64
         # telemetry knobs are config-reachable: a negative sample rate
         # means "default", a zero one disables stage sampling outright
         if self.telemetry_sample < 0:
@@ -700,6 +728,22 @@ class Server:
                 self.overload.add_source(
                     "memory", lambda: rss_bytes() / limit
                 )
+        # MQTT+ payload-predicate plane (mqtt_tpu.predicates): suffix
+        # registry + host interpreter + device rule table. Built before
+        # the matcher so the staging loop can carry its feature batches.
+        self._predicates = None
+        if opts.predicate_filters:
+            from .predicates import PredicateEngine
+
+            self._predicates = PredicateEngine(
+                max_rules=opts.predicate_max_rules,
+                oracle_sample=opts.predicate_oracle_sample,
+                registry=(
+                    self.telemetry.registry
+                    if self.telemetry is not None
+                    else None
+                ),
+            )
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
@@ -886,6 +930,7 @@ class Server:
                 max_pending=self.options.overload_stage_max_pending,
                 telemetry=self.telemetry,
                 profiler=self.profiler,
+                predicates=self._predicates,
             )
             self._stage.start()
             if self.overload is not None:
@@ -1595,9 +1640,26 @@ class Server:
             raise InlineClientNotEnabledError()
         if handler is None:
             raise ERR_INLINE_SUBSCRIPTION_HANDLER_INVALID()
+        predicates: tuple = ()
+        if self._predicates is not None:
+            base, pred_suffix = split_predicate_suffix(filter)
+            if pred_suffix:
+                filter = base
+                predicates = (pred_suffix,)
         if not is_valid_filter(filter, False):
             raise ERR_TOPIC_FILTER_INVALID()
-        subscription = Subscription(identifier=subscription_id, filter=filter)
+        if self._predicates is not None:
+            if predicates:
+                self._predicates.register(predicates[0])
+            # re-subscribing the same (identifier, filter) REPLACES the
+            # stored inline subscription: drop the replaced one's rule
+            # refs (after registering, like the client SUBSCRIBE path)
+            replaced = self.topics.inline_subscription(subscription_id, filter)
+            if replaced is not None and replaced.predicates:
+                self._predicates.release(replaced.predicates)
+        subscription = Subscription(
+            identifier=subscription_id, filter=filter, predicates=predicates
+        )
         pk = self.hooks.on_subscribe(
             self.inline_client,
             Packet(
@@ -1607,17 +1669,28 @@ class Server:
             ),
         )
         inline_sub = InlineSubscription(
-            filter=filter, identifier=subscription_id, handler=handler
+            filter=filter,
+            identifier=subscription_id,
+            handler=handler,
+            predicates=predicates,
         )
         self.topics.inline_subscribe(inline_sub)
         self.hooks.on_subscribed(self.inline_client, pk, bytes([CODE_SUCCESS.code]))
         for pkv in self.topics.messages(filter):  # [MQTT-3.8.4-4]
+            if self._predicates is not None and not self._predicates.passes_retained(
+                subscription, bytes(pkv.payload)
+            ):
+                continue
             handler(self.inline_client, subscription, pkv)
 
     def unsubscribe(self, filter: str, subscription_id: int) -> None:
         """Remove an inline subscription (server.go:813-836)."""
         if not self.options.inline_client:
             raise InlineClientNotEnabledError()
+        if self._predicates is not None:
+            base, pred_suffix = split_predicate_suffix(filter)
+            if pred_suffix:
+                filter = base
         if not is_valid_filter(filter, False):
             raise ERR_TOPIC_FILTER_INVALID()
         pk = self.hooks.on_unsubscribe(
@@ -1628,7 +1701,17 @@ class Server:
                 filters=[Subscription(identifier=subscription_id, filter=filter)],
             ),
         )
-        self.topics.inline_unsubscribe(subscription_id, filter)
+        if self._predicates is not None:
+            # release the STORED subscription's rule refs, and only when
+            # a subscription is actually removed — an unsubscribe for a
+            # (filter, id) that never existed must not underflow a rule
+            # other live subscriptions still reference
+            stored = self.topics.inline_subscription(subscription_id, filter)
+            removed = self.topics.inline_unsubscribe(subscription_id, filter)
+            if removed and stored is not None and stored.predicates:
+                self._predicates.release(stored.predicates)
+        else:
+            self.topics.inline_unsubscribe(subscription_id, filter)
         self.hooks.on_unsubscribed(self.inline_client, pk)
 
     def inject_packet(self, cl: Client, pk: Packet) -> None:
@@ -1828,10 +1911,20 @@ class Server:
         own result (SURVEY.md §7 stage 4; seam: server.go:984-1021)."""
         if not pk.ignore:
             self._stamp_publish_expiry(pk)
-            subscribers = await self._stage.submit(
-                pk.topic_name, getattr(pk, "_tclock", None)
+            # MQTT+ predicate plane: extract the payload features ONCE
+            # on the host; the stage batches them to the device beside
+            # the tokenized topics and stamps the resolved pass bits
+            # back onto this carrier (mqtt_tpu.predicates)
+            eng = self._predicates
+            feats = (
+                eng.features_for(bytes(pk.payload))
+                if eng is not None and eng.active
+                else None
             )
-            self._fan_out(pk, subscribers)
+            subscribers = await self._stage.submit(
+                pk.topic_name, getattr(pk, "_tclock", None), feats
+            )
+            self._fan_out(pk, subscribers, feats)
             if self._cluster is not None:
                 self._cluster.forward_packet(pk)
             self._finish_publish_clock(pk)
@@ -2059,9 +2152,19 @@ class Server:
         if cached is not None and cached[0] == version:
             return cached[1]
         subscribers = self.topics.subscribers(topic)
-        if subscribers.shared or subscribers.inline_subscriptions:
-            # negative-cache: shared/inline topics always take the
-            # decode path; don't re-walk here on every publish
+        if (
+            subscribers.shared
+            or subscribers.inline_subscriptions
+            or any(
+                sub.predicates
+                for sub in subscribers.subscriptions.values()
+            )
+        ):
+            # negative-cache: shared/inline topics — and topics with any
+            # PREDICATED subscriber, whose delivery depends on each
+            # payload — always take the decode path; don't re-walk here
+            # on every publish. Version-keyed, so a predicated subscribe
+            # (which bumps the trie version) invalidates stale plans.
             if len(self._fastpub_plans) >= 4096:
                 self._fastpub_plans.clear()
             self._fastpub_plans[topic] = (version, None)
@@ -2146,9 +2249,23 @@ class Server:
         self._stamp_publish_expiry(pk)
         return pk
 
-    def _fan_out(self, pk: Packet, subscribers) -> None:
+    def _fan_out(self, pk: Packet, subscribers, feats=None) -> None:
         """Deliver one matched publish: shared-group selection, inline
-        handlers, per-subscriber delivery (server.go:1000-1021)."""
+        handlers, per-subscriber delivery (server.go:1000-1021).
+
+        MQTT+ predicate filtering happens here — the one choke point
+        every delivery path funnels through (staged fan-out, the host
+        sync path, cluster-forwarded decode deliveries). ``feats`` is
+        the publish's PublishFeatures carrier when the staged pipeline
+        evaluated the rule table on device (mqtt_tpu.staging); without
+        it the host interpreter decides. With no live rules this is one
+        attribute read — the unpredicated path stays bit-identical."""
+        emissions = ()
+        eng = self._predicates
+        if eng is not None and eng.active:
+            subscribers, emissions = eng.apply(
+                subscribers, bytes(pk.payload), feats
+            )
         if subscribers.shared:
             subscribers = self.hooks.on_select_subscribers(subscribers, pk)
             if not subscribers.shared_selected:
@@ -2187,6 +2304,29 @@ class Server:
                 except Exception as e:
                     self.log.debug(
                         "failed publishing packet: error=%s client=%s", e, id_
+                    )
+
+        # MQTT+ aggregation windows that completed on this publish emit
+        # ONE synthesized publish each (payload = the aggregate), riding
+        # the same fan-out tick — no extra timers (mqtt_tpu.predicates)
+        for kind, target, sub, agg_payload in emissions:
+            out = pk.copy(False)
+            out.payload = agg_payload
+            if kind == "inline":
+                try:
+                    target.handler(self.inline_client, target, out)
+                except Exception as e:
+                    self.log.debug("inline aggregate handler failed: %s", e)
+                continue
+            cl = self.clients.get(target)
+            if cl is not None:
+                try:
+                    self.publish_to_client(cl, sub, out)
+                except Exception as e:
+                    self.log.debug(
+                        "failed publishing aggregate: error=%s client=%s",
+                        e,
+                        target,
                     )
 
     def publish_to_client(
@@ -2307,6 +2447,13 @@ class Server:
         # trie-stored subscription never carries fwd_retained_flag
         sub = replace(sub, fwd_retained_flag=True)
         for pkv in self.topics.messages(sub.filter):  # [MQTT-3.8.4-4]
+            # MQTT+ predicates apply to retained payloads too: the
+            # sub.filter here is already the BASE filter, so the walk is
+            # unchanged and only the delivery gate consults the rules
+            if self._predicates is not None and not self._predicates.passes_retained(
+                sub, bytes(pkv.payload)
+            ):
+                continue
             try:
                 self.publish_to_client(cl, sub, pkv)
             except Exception as e:
@@ -2412,6 +2559,16 @@ class Server:
             if code != CODE_SUCCESS:
                 reason_codes[i] = code.code  # NB 3.9.3 Non-normative 0x91
                 continue
+            # MQTT+ predicate suffix (mqtt_tpu.predicates): split BEFORE
+            # validation so the SUBACK reason, the ACL check, $SHARE
+            # parsing, and the trie all see the BASE filter — the suffix
+            # never leaks past this point. Registration waits for the
+            # success branch so a refused filter leaks no rule.
+            pred_suffix = ""
+            if self._predicates is not None:
+                base, pred_suffix = split_predicate_suffix(sub.filter)
+                if pred_suffix:
+                    sub.filter = base
             if not is_valid_filter(sub.filter, False):
                 reason_codes[i] = ERR_TOPIC_FILTER_INVALID.code
             elif sub.no_local and is_shared_filter(sub.filter):
@@ -2421,6 +2578,17 @@ class Server:
                 if caps.compatibilities.obscure_not_authorized:
                     reason_codes[i] = ERR_UNSPECIFIED_ERROR.code
             else:
+                if pred_suffix:
+                    self._predicates.register(pred_suffix)
+                    sub.predicates = (pred_suffix,)
+                if self._predicates is not None:
+                    # [MQTT-3.8.4-3] a re-subscribe REPLACES the stored
+                    # subscription: drop the replaced one's rule refs
+                    # (after registering, so a same-suffix replace never
+                    # drops the rule to zero in between)
+                    old = cl.state.subscriptions.get(sub.filter)
+                    if old is not None and old.predicates:
+                        self._predicates.release(old.predicates)
                 is_new = self.topics.subscribe(cl.id, sub)  # [MQTT-3.8.4-3]
                 if is_new:
                     self.info.subscriptions += 1
@@ -2462,6 +2630,15 @@ class Server:
             if code != CODE_SUCCESS:
                 reason_codes[i] = code.code
                 continue
+            if self._predicates is not None:
+                # an UNSUBSCRIBE naming the original predicated filter
+                # must remove the subscription stored under its base
+                base, pred_suffix = split_predicate_suffix(sub.filter)
+                if pred_suffix:
+                    sub.filter = base
+                old = cl.state.subscriptions.get(sub.filter)
+                if old is not None and old.predicates:
+                    self._predicates.release(old.predicates)
             if self.topics.unsubscribe(sub.filter, cl.id):
                 self.info.subscriptions -= 1
                 reason_codes[i] = CODE_SUCCESS.code
@@ -2487,8 +2664,10 @@ class Server:
         for k in filter_map:
             cl.state.subscriptions.delete(k)
         if cl.is_taken_over:
-            return
-        for k in filter_map:
+            return  # the inheriting session keeps the rules referenced
+        for k, sub in filter_map.items():
+            if self._predicates is not None and sub.predicates:
+                self._predicates.release(sub.predicates)
             if self.topics.unsubscribe(k, cl.id):
                 self.info.subscriptions -= 1
         self.hooks.on_unsubscribed(
@@ -2591,6 +2770,12 @@ class Server:
                     topics[
                         SYS_PREFIX + "/broker/matcher/breaker/" + key
                     ] = str(val)
+        if self._predicates is not None:
+            # MQTT+ predicate plane (mqtt_tpu.predicates): rule counts,
+            # device vs host eval split, filter selectivity, aggregation
+            # emissions, oracle verdicts, breaker posture
+            for key, val in self._predicates.gauges().items():
+                topics[SYS_PREFIX + "/broker/predicates/" + key] = str(val)
         if self.overload is not None:
             # overload-governor observability (mqtt_tpu.overload): state,
             # transition/shed/eviction/throttle counters, per-signal
@@ -2789,6 +2974,17 @@ class Server:
 
     def load_subscriptions(self, v: list) -> None:
         for sub in v:
+            predicates = tuple(getattr(sub, "predicates", ()) or ())
+            if predicates and self._predicates is not None:
+                # re-intern persisted MQTT+ rules (a restart must keep
+                # filtering; with the plane disabled the subscription
+                # restores as its base filter and fails open)
+                for suffix in predicates:
+                    try:
+                        self._predicates.register(suffix)
+                    except ValueError:
+                        predicates = ()
+                        break
             sb = Subscription(
                 filter=sub.filter,
                 retain_handling=sub.retain_handling,
@@ -2796,6 +2992,7 @@ class Server:
                 retain_as_published=sub.retain_as_published,
                 no_local=sub.no_local,
                 identifier=sub.identifier,
+                predicates=predicates,
             )
             if self.topics.subscribe(sub.client, sb):
                 cl = self.clients.get(sub.client)

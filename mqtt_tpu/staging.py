@@ -66,9 +66,18 @@ class MatchStage:
         max_pending: int = 8192,
         telemetry=None,
         profiler=None,
+        predicates=None,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
+        # MQTT+ predicate engine (mqtt_tpu.predicates.PredicateEngine) or
+        # None. When attached, each batch's payload-feature rows ride to
+        # the device BESIDE the tokenized topics — one extra dispatch,
+        # zero extra round trips: both results sync in the drain loop's
+        # single executor leg, and the resolved pass bits are stamped
+        # back onto the per-publish feature carriers before the futures
+        # complete, so fan-out receives the already-filtered set.
+        self.predicates = predicates
         # telemetry plane (mqtt_tpu.telemetry.Telemetry) or None: batch
         # service-time + fill-ratio histograms, fallback-class counters,
         # and the per-publish stage clock's staging_wait / device_batch
@@ -193,7 +202,9 @@ class MatchStage:
         queue = self._queue
         if queue is not None:
             while not queue.empty():
-                _resolver, futs, topics, _clocks, _rec = queue.get_nowait()
+                _resolver, futs, topics, _clocks, _rec, _pred, _feats = (
+                    queue.get_nowait()
+                )
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
         if self._executor is not None:
             # in-flight resolves may finish on their own time; queued
@@ -203,10 +214,17 @@ class MatchStage:
 
     # -- submission --------------------------------------------------------
 
-    def submit(self, topic: str, clock=None) -> "asyncio.Future[Subscribers]":
+    def submit(
+        self, topic: str, clock=None, feats=None
+    ) -> "asyncio.Future[Subscribers]":
         """Park one publish; the future resolves with its Subscribers.
         ``clock`` is an optional sampled stage clock (mqtt_tpu.telemetry)
         stamped at batch issue (staging_wait) and resolve (device_batch).
+        ``feats`` is the publish's optional payload-feature carrier
+        (mqtt_tpu.predicates.PublishFeatures): the batch ships it to the
+        device rule table and the resolved pass bits come back ON the
+        carrier — host-fallback resolutions simply leave it unstamped
+        and the fan-out path's host interpreter decides.
 
         Admission is bounded: once ``max_pending`` publishes are parked,
         or the pipeline's projected wait already exceeds the deadline
@@ -224,7 +242,7 @@ class MatchStage:
                 self.telemetry.note_fallback("admission")
             fut.set_result(self.host_fallback(topic))
             return fut
-        self._pending.append((topic, fut, clock))
+        self._pending.append((topic, fut, clock, feats))
         if len(self._pending) > self.peak_pending:
             self.peak_pending = len(self._pending)
         wake.set()
@@ -294,12 +312,15 @@ class MatchStage:
             # during accumulation) is dead weight: drop it here so the
             # device never matches for it and no resolver path trips on
             # an already-cancelled future
-            batch = [(t, f, c) for t, f, c in batch if not f.cancelled()]
+            batch = [
+                (t, f, c, p) for t, f, c, p in batch if not f.cancelled()
+            ]
             if not batch:
                 continue
-            topics = [t for t, _, _ in batch]
-            futs = [f for _, f, _ in batch]
-            clocks = [c for _, _, c in batch]
+            topics = [t for t, _, _, _ in batch]
+            futs = [f for _, f, _, _ in batch]
+            clocks = [c for _, _, c, _ in batch]
+            feats = [p for _, _, _, p in batch]
             for c in clocks:
                 if c is not None:  # end of the accumulation/park wait
                     c.stamp("staging_wait")
@@ -322,8 +343,24 @@ class MatchStage:
                 _log.exception("stage issue failed; host fallback for batch")
                 self._fallback_all(batch, klass="issue_error")
                 continue
+            # MQTT+ predicate evaluation rides the SAME staged batch:
+            # one extra async dispatch against the device rule table,
+            # resolved in the same drain-loop executor leg as the match
+            # result — no additional device round trip. A None resolver
+            # (no rules, breaker open, eval error) leaves the carriers
+            # unstamped and the fan-out host interpreter decides.
+            pred_resolver = None
+            if self.predicates is not None:
+                try:
+                    pred_resolver = self.predicates.eval_batch_async(feats)
+                except Exception:
+                    _log.exception(
+                        "predicate eval issue failed; host interpreter"
+                    )
             try:
-                await queue.put((resolver, futs, topics, clocks, rec))
+                await queue.put(
+                    (resolver, futs, topics, clocks, rec, pred_resolver, feats)
+                )
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch in hand (in neither
                 # _pending nor the queue): resolve it before going down
@@ -335,14 +372,27 @@ class MatchStage:
         queue = self._queue
         assert queue is not None  # start() created us
         while True:
-            resolver, futs, topics, clocks, rec = await queue.get()
+            resolver, futs, topics, clocks, rec, pred_resolver, feats = (
+                await queue.get()
+            )
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
                 # sampled at resolve time: batches still queued waited for
                 # this one, so the controller budgets depth x service.
+                # The predicate rows sync in the SAME executor leg (the
+                # pred resolver never raises — failures degrade to None).
                 depth = queue.qsize() + 1
                 t0 = loop.time()
-                results = await loop.run_in_executor(self._executor, resolver)
+                if pred_resolver is None:
+                    results = await loop.run_in_executor(
+                        self._executor, resolver
+                    )
+                else:
+                    pr, mr = pred_resolver, resolver
+                    results, pred_rows = await loop.run_in_executor(
+                        self._executor, lambda: (mr(), pr())
+                    )
+                    self.predicates.attach_rows(feats, pred_rows)
                 dt = loop.time() - t0
                 self._observe_service(dt, len(topics), depth)
                 if self.telemetry is not None:

@@ -24,6 +24,7 @@ does not match topic ``a``.
 
 from __future__ import annotations
 
+import re
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
@@ -33,6 +34,67 @@ from .utils import LockedMap
 
 SHARE_PREFIX = "$SHARE"  # prefix indicating a shared-subscription filter
 SYS_PREFIX = "$SYS"  # prefix indicating a system info topic
+
+# -- MQTT+ predicate suffixes (mqtt_tpu.predicates) -------------------------
+#
+# An MQTT+ subscription rides a standard SUBSCRIBE filter with a payload
+# predicate appended: ``sensors/+/temp$GT{25.0}``. The trie only ever sees
+# the BASE filter — the suffix is split off at SUBSCRIBE time so the walk,
+# retained matching, and $SHARE parsing are byte-identical to a plain
+# subscription. The split is defined here (string surgery is the topic
+# layer's business); compilation/evaluation live in mqtt_tpu.predicates.
+
+#: ops that compare a numeric payload feature against a threshold
+PREDICATE_NUMERIC_OPS = ("GT", "GTE", "LT", "LTE", "EQ", "NE")
+#: ops that aggregate a numeric payload feature over a message window
+PREDICATE_AGG_OPS = ("MEAN", "MAX", "MIN")
+#: every recognized predicate op (CONTAINS is the one payload-bytes op)
+PREDICATE_OPS = PREDICATE_NUMERIC_OPS + ("CONTAINS",) + PREDICATE_AGG_OPS
+
+_PREDICATE_RE = re.compile(
+    r"^(?P<base>.*?)\$(?P<op>" + "|".join(PREDICATE_OPS) + r")\{(?P<arg>[^{}]*)\}$",
+    re.DOTALL,
+)
+
+
+def _predicate_arg_ok(op: str, arg: str) -> bool:
+    """Validate a predicate argument for ``op`` — an invalid argument means
+    the whole token is NOT a predicate (the filter stays literal, so the
+    extension can never reject a filter plain MQTT would accept)."""
+    if op == "CONTAINS":
+        return len(arg) > 0
+    field_part, _, num = arg.rpartition(":")
+    if op in PREDICATE_AGG_OPS:
+        try:
+            return int(num) >= 1
+        except ValueError:
+            return False
+    try:
+        value = float(num)
+    except ValueError:
+        return False
+    return value == value  # reject an explicit nan threshold
+    # (field_part may be empty: "whole payload as the number")
+
+
+def split_predicate_suffix(filter: str) -> tuple[str, str]:
+    """Split a trailing MQTT+ predicate off a subscription filter.
+
+    Returns ``(base_filter, suffix)`` where ``suffix`` is the literal
+    ``$OP{arg}`` text ("" when the filter carries no well-formed
+    predicate). Only a syntactically valid suffix is split — anything
+    else is a literal filter, so pre-MQTT+ behavior is bit-identical. A
+    bare predicate (``$CONTAINS{alarm}``) means "every topic": the base
+    widens to ``#``."""
+    m = _PREDICATE_RE.match(filter)
+    if m is None:
+        return filter, ""
+    if not _predicate_arg_ok(m.group("op"), m.group("arg")):
+        return filter, ""
+    base = m.group("base")
+    if base == "":
+        base = "#"  # payload-only subscription: predicate over all topics
+    return base, filter[len(m.group("base")):]
 
 
 @dataclass(frozen=True)
@@ -393,6 +455,16 @@ class TopicsIndex:
                 )
             )
             return not existed
+
+    def inline_subscription(self, id_: int, filter: str) -> Optional[InlineSubscription]:
+        """The stored inline subscription at (identifier, filter), or
+        None. The predicate plane consults it on replace/unsubscribe so
+        rule refcounts track the subscription actually stored."""
+        with self._lock:
+            particle = self._seek(filter, 0)
+            if particle is None:
+                return None
+            return particle.inline_subscriptions.get(id_)
 
     def inline_unsubscribe(self, id_: int, filter: str) -> bool:
         with self._lock:

@@ -1,0 +1,829 @@
+"""MQTT+ payload-predicate subscriptions (ISSUE 8 / ROADMAP item 4).
+
+Covers: the suffix grammar (malformed suffixes stay literal filters),
+host-interpreter semantics (skip-to-pass for missing/non-numeric/
+non-JSON payloads, float32 coercion), the seeded device-vs-host
+differential oracle across every op code, registry interning/refcounts,
+Subscription merge OR semantics, the engine's fan-out filtering (client
+/ shared / inline legs), aggregation windows, the three round-trip
+seams (retained matching, $SHARE parsing, v5 SUBACK reasons), the
+breaker chaos leg (device eval degrades to the host interpreter
+mid-storm), persistence round-trip, and the seconds-dialable cluster
+SUSPECT window satellite.
+"""
+
+import asyncio
+import json
+import math
+import random
+
+import pytest
+
+from mqtt_tpu import Options, Server
+from mqtt_tpu.packets import PUBLISH, SUBACK, UNSUBACK, Subscription
+from mqtt_tpu.predicates import (
+    OP_CONTAINS,
+    OP_GT,
+    OP_MEAN,
+    PredicateEngine,
+    compile_suffix,
+    eval_rule_host,
+    payload_number,
+)
+from mqtt_tpu.topics import SYS_PREFIX, Subscribers, split_predicate_suffix
+
+from tests.test_server import (
+    Harness,
+    pub_packet,
+    read_wire_packet,
+    run,
+    sub_packet,
+)
+
+
+def staged_options(**kw):
+    return Options(
+        inline_client=True,
+        device_matcher=True,
+        matcher_stage_window_ms=kw.pop("window_ms", 5.0),
+        matcher_opts={"max_levels": 4, "background": False},
+        predicate_oracle_sample=kw.pop("oracle_sample", 1),
+        **kw,
+    )
+
+
+class TestGrammar:
+    def test_numeric_suffix_splits(self):
+        assert split_predicate_suffix("sensors/+/temp$GT{25.0}") == (
+            "sensors/+/temp",
+            "$GT{25.0}",
+        )
+        assert split_predicate_suffix("a/b$LTE{hum:-1.5}") == (
+            "a/b",
+            "$LTE{hum:-1.5}",
+        )
+
+    def test_bare_predicate_widens_to_hash(self):
+        assert split_predicate_suffix("$CONTAINS{alarm}") == (
+            "#",
+            "$CONTAINS{alarm}",
+        )
+
+    def test_malformed_suffixes_stay_literal(self):
+        for literal in (
+            "a/b$GT{notanum}",  # non-numeric threshold
+            "a/b$GT{}",  # empty arg
+            "a/b$CONTAINS{}",  # empty substring
+            "a/b$MEAN{temp:0}",  # window < 1
+            "a/b$FOO{1}",  # unknown op
+            "a/b$GT{1}/c",  # suffix not at the end
+            "a/b$GT{nan}",  # explicit nan threshold
+            "plain/topic",
+        ):
+            base, suffix = split_predicate_suffix(literal)
+            assert (base, suffix) == (literal, ""), literal
+
+    def test_share_filter_splits_on_base(self):
+        base, suffix = split_predicate_suffix("$SHARE/g/a/b$GT{t:1.5}")
+        assert base == "$SHARE/g/a/b" and suffix == "$GT{t:1.5}"
+
+    def test_wildcard_base_with_suffix_validates(self):
+        # the raw string would be an INVALID filter ('#' not last); the
+        # base after the split is valid — the SUBACK seam relies on this
+        base, suffix = split_predicate_suffix("alerts/#$CONTAINS{alarm}")
+        assert base == "alerts/#" and suffix == "$CONTAINS{alarm}"
+
+    def test_compile_suffix_round_trip(self):
+        spec = compile_suffix("$GT{temp:25.0}")
+        assert spec.op == OP_GT and spec.field == "temp" and spec.value == 25.0
+        spec = compile_suffix("$CONTAINS{alarm}")
+        assert spec.op == OP_CONTAINS and spec.text == b"alarm"
+        spec = compile_suffix("$MEAN{v:10}")
+        assert spec.op == OP_MEAN and spec.window == 10 and spec.is_agg
+
+
+class TestHostInterpreter:
+    def test_whole_payload_number(self):
+        assert payload_number(b"25.5", "") == 25.5
+        assert math.isnan(payload_number(b"abc", ""))
+        assert math.isnan(payload_number(b"", ""))
+
+    def test_json_field_extraction(self):
+        p = json.dumps({"temp": 21.5, "ok": True, "s": "x"}).encode()
+        assert payload_number(p, "temp") == 21.5
+        assert math.isnan(payload_number(p, "missing"))
+        assert math.isnan(payload_number(p, "s"))
+        assert math.isnan(payload_number(p, "ok"))  # bool is not a number
+        assert math.isnan(payload_number(b"not json", "temp"))
+
+    def test_skip_to_pass(self):
+        spec = compile_suffix("$GT{temp:25.0}")
+        assert eval_rule_host(spec, b"not json")  # non-JSON: pass
+        assert eval_rule_host(spec, b"{}")  # missing field: pass
+        assert eval_rule_host(spec, b'{"temp": "warm"}')  # non-numeric: pass
+        assert not eval_rule_host(spec, b'{"temp": 20}')  # applies: fail
+        assert eval_rule_host(spec, b'{"temp": 30}')  # applies: pass
+
+    def test_contains_never_skips(self):
+        spec = compile_suffix("$CONTAINS{alarm}")
+        assert eval_rule_host(spec, b"fire alarm!")
+        assert not eval_rule_host(spec, b"all quiet")
+        assert not eval_rule_host(spec, b"")
+
+
+class TestRegistry:
+    def test_intern_and_refcount(self):
+        eng = PredicateEngine()
+        r1 = eng.register("$GT{v:1.0}")
+        r2 = eng.register("$GT{v:1.0}")
+        assert r1 is r2 and r1.refs == 2 and eng.rule_count == 1
+        eng.release(("$GT{v:1.0}",))
+        assert eng.rule_count == 1
+        eng.release(("$GT{v:1.0}",))
+        assert eng.rule_count == 0 and not eng.active
+
+    def test_max_rules_degrades_to_host_only(self):
+        eng = PredicateEngine(max_rules=1)
+        a = eng.register("$GT{v:1.0}")
+        b = eng.register("$GT{v:2.0}")
+        assert a.device and not b.device  # past the cap: host interpreter
+
+    def test_agg_rules_never_on_device(self):
+        eng = PredicateEngine()
+        r = eng.register("$MEAN{v:5}")
+        assert not r.device
+
+    def test_parse_subscribe(self):
+        eng = PredicateEngine()
+        base, preds = eng.parse_subscribe("s/+/t$GT{25.0}")
+        assert base == "s/+/t" and preds == ("$GT{25.0}",)
+        base, preds = eng.parse_subscribe("plain/t")
+        assert base == "plain/t" and preds == ()
+
+
+class TestMergeSemantics:
+    def test_unpredicated_side_clears(self):
+        a = Subscription(filter="a/+", predicates=())
+        b = Subscription(filter="a/b", predicates=("$GT{1.0}",))
+        assert a.merge(b).predicates == ()
+        assert b.merge(a).predicates == ()
+
+    def test_predicated_union(self):
+        a = Subscription(filter="a/+", predicates=("$GT{1.0}",))
+        b = Subscription(filter="a/b", predicates=("$LT{0.5}",))
+        assert a.merge(b).predicates == ("$GT{1.0}", "$LT{0.5}")
+        c = Subscription(filter="a/#", predicates=("$GT{1.0}",))
+        assert a.merge(c).predicates == ("$GT{1.0}",)
+
+    def test_self_merged_copy_keeps_predicates(self):
+        a = Subscription(filter="a/+", identifier=3, predicates=("$GT{1.0}",))
+        assert a.self_merged_copy().predicates == ("$GT{1.0}",)
+
+
+class TestDifferentialOracle:
+    """The satellite property test: seeded rules x payload corpus, every
+    device verdict must equal the host interpreter's — across op codes,
+    NaN/missing-field payloads, and non-JSON payloads."""
+
+    def test_seeded_device_vs_host_property(self):
+        import numpy as np
+
+        rng = random.Random(1234)
+        eng = PredicateEngine(oracle_sample=0)
+        suffixes = []
+        ops = ["GT", "GTE", "LT", "LTE", "EQ", "NE"]
+        for _ in range(120):
+            op = rng.choice(ops)
+            field = rng.choice(["", "temp", "hum", "deep"])
+            thr = round(rng.uniform(-3, 3), 3)
+            s = "$%s{%s%s}" % (op, (field + ":") if field else "", thr)
+            if s not in suffixes:
+                eng.register(s)
+                suffixes.append(s)
+        for text in ("alarm", "zed", "}{"):
+            s = "$CONTAINS{%s}" % text
+            eng.register(s)
+            suffixes.append(s)
+        payloads = [
+            b"1.5",
+            b"-2",
+            b"0",
+            b"",
+            b"not json at all",
+            b"alarm",
+            b"alarm}{",
+            json.dumps({"temp": 1.25, "hum": -0.5}).encode(),
+            json.dumps({"temp": "hot"}).encode(),
+            json.dumps({"hum": 2.999}).encode(),
+            json.dumps([1, 2, 3]).encode(),
+            json.dumps({"deep": 0.0, "temp": None}).encode(),
+        ]
+        # exact-threshold payloads drill EQ/NE/GTE/LTE boundary cases
+        for s in suffixes[:40]:
+            spec = eng._rules[s].spec
+            if spec.field in ("", "temp") and spec.op <= 6:
+                payloads.append(
+                    json.dumps({"temp": spec.value}).encode()
+                    if spec.field
+                    else repr(spec.value).encode()
+                )
+        feats = [eng.features_for(p) for p in payloads]
+        resolved = eng.eval_batch_async(feats)
+        assert resolved is not None
+        eng.attach_rows(feats, resolved())
+        mismatches = []
+        for p, f in zip(payloads, feats):
+            assert f.device_row is not None
+            for s in suffixes:
+                rule = eng._rules[s]
+                bit = bool(
+                    (f.device_row[rule.idx >> 5] >> np.uint32(rule.idx & 31)) & 1
+                )
+                want = eval_rule_host(rule.spec, p)
+                if bit != want:
+                    mismatches.append((s, p, bit, want))
+        assert not mismatches, mismatches[:5]
+
+    def test_registry_churn_between_build_and_eval_stays_host(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:1.0}")
+        feats = [eng.features_for(b'{"v": 2.0}')]
+        eng.register("$LT{v:9.0}")  # layout changed after extraction
+        resolved = eng.eval_batch_async(feats)
+        # stale-version rows are excluded: either no eligible rows (None)
+        # or the carrier stays unstamped — the host interpreter decides
+        if resolved is not None:
+            eng.attach_rows(feats, resolved())
+        assert feats[0].device_row is None
+
+
+def _subs_with(*entries) -> Subscribers:
+    s = Subscribers()
+    for cid, sub in entries:
+        s.subscriptions[cid] = sub
+    return s
+
+
+class TestApplyFiltering:
+    def test_client_filtering_and_fail_open(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:5.0}")
+        eng.register("$GT{v:99.0}")
+        subs = _subs_with(
+            ("hot", Subscription(filter="t", predicates=("$GT{v:5.0}",))),
+            ("plain", Subscription(filter="t")),
+            ("gone", Subscription(filter="t", predicates=("$GT{v:99.0}",))),
+        )
+        out, emissions = eng.apply(subs, b'{"v": 6.0}')
+        assert set(out.subscriptions) == {"hot", "plain"}
+        assert emissions == []
+        assert eng.filtered == 1 and eng.deliveries == 1
+
+    def test_released_rule_fails_open(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:5.0}")  # keeps the engine active
+        subs = _subs_with(
+            ("c", Subscription(filter="t", predicates=("$LT{v:0.0}",))),
+        )
+        out, _ = eng.apply(subs, b'{"v": 3.0}')  # rule never registered
+        assert "c" in out.subscriptions  # unknown rule: deliver
+
+    def test_shared_groups_filter_before_selection(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:5.0}")
+        subs = Subscribers()
+        subs.shared["$SHARE/g/t"] = {
+            "fail": Subscription(filter="t", predicates=("$GT{v:5.0}",)),
+            "pass": Subscription(filter="t"),
+        }
+        out, _ = eng.apply(subs, b'{"v": 1.0}')
+        assert set(out.shared["$SHARE/g/t"]) == {"pass"}
+        out.select_shared()
+        assert set(out.shared_selected) == {"pass"}
+
+    def test_empty_shared_group_removed(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:5.0}")
+        subs = Subscribers()
+        subs.shared["$SHARE/g/t"] = {
+            "fail": Subscription(filter="t", predicates=("$GT{v:5.0}",)),
+        }
+        out, _ = eng.apply(subs, b'{"v": 1.0}')
+        assert out.shared == {}
+
+    def test_aggregation_window_mean_and_max(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$MEAN{v:3}")
+        eng.register("$MAX{v:2}")
+        sub_mean = Subscription(filter="t", predicates=("$MEAN{v:3}",))
+        sub_max = Subscription(filter="t", predicates=("$MAX{v:2}",))
+        emitted = []
+        for v in (1.0, 2.0, 6.0, 4.0):
+            subs = _subs_with(("m", sub_mean), ("x", sub_max))
+            out, emissions = eng.apply(subs, json.dumps({"v": v}).encode())
+            # aggregation subscriptions never get the raw message
+            assert "m" not in out.subscriptions
+            assert "x" not in out.subscriptions
+            emitted.extend(emissions)
+        kinds = [(k, t, p) for k, t, _s, p in emitted]
+        # MAX window 2 completes twice: max(1,2)=2, max(6,4)=6
+        # MEAN window 3 completes once: (1+2+6)/3 = 3
+        assert ("client", "x", b"2") in kinds
+        assert ("client", "x", b"6") in kinds
+        assert ("client", "m", b"3") in kinds
+        assert eng.agg_emits == 3
+
+    def test_aggregation_skips_nan_samples(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$MIN{v:2}")
+        sub = Subscription(filter="t", predicates=("$MIN{v:2}",))
+        emitted = []
+        for payload in (b'{"v": 5}', b"not json", b'{"v": 3}'):
+            _, emissions = eng.apply(_subs_with(("c", sub)), payload)
+            emitted.extend(emissions)
+        assert len(emitted) == 1 and emitted[0][3] == b"3"
+
+    def test_inline_subscriptions_filter(self):
+        from mqtt_tpu.topics import InlineSubscription
+
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$CONTAINS{alarm}")
+        subs = Subscribers()
+        subs.inline_subscriptions[1] = InlineSubscription(
+            filter="t", identifier=1, handler=lambda *a: None,
+            predicates=("$CONTAINS{alarm}",),
+        )
+        out, _ = eng.apply(subs, b"quiet")
+        assert out.inline_subscriptions == {}
+        subs = Subscribers()
+        subs.inline_subscriptions[1] = InlineSubscription(
+            filter="t", identifier=1, handler=lambda *a: None,
+            predicates=("$CONTAINS{alarm}",),
+        )
+        out, _ = eng.apply(subs, b"ALARM alarm")
+        assert 1 in out.inline_subscriptions
+
+
+class TestBreakerDegradation:
+    """The chaos leg: device predicate evaluation fails mid-storm, the
+    breaker trips, the host interpreter keeps filtering correctly, and
+    a healthy probe closes the breaker again."""
+
+    class _BoomEvaluator:
+        n_rules = 1
+        n_slots = 1
+        n_cwords = 1
+
+        def rebuild(self, *a, **k):
+            pass
+
+        def eval_async(self, feats, cmask):
+            raise RuntimeError("injected device fault")
+
+    def test_breaker_trips_to_host_and_probes_back(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$GT{v:5.0}")
+        # force the evaluator in and poison it
+        eng._rebuild_evaluator()
+        healthy = eng._evaluator
+        eng._evaluator = self._BoomEvaluator()
+        sub = Subscription(filter="t", predicates=("$GT{v:5.0}",))
+        for _ in range(eng.breaker.failure_threshold):
+            feats = [eng.features_for(b'{"v": 9.0}')]
+            resolved = eng.eval_batch_async(feats)
+            assert resolved is None  # issue leg failed -> breaker failure
+            # fan-out still filters correctly via the host interpreter
+            out, _ = eng.apply(_subs_with(("c", sub)), b'{"v": 1.0}', feats[0])
+            assert "c" not in out.subscriptions
+            out, _ = eng.apply(_subs_with(("c", sub)), b'{"v": 9.0}', feats[0])
+            assert "c" in out.subscriptions
+        assert eng.breaker.state == "open"
+        assert eng.device_errors >= eng.breaker.failure_threshold
+        # while OPEN (before the probe window) the device is not touched
+        assert eng.eval_batch_async([eng.features_for(b"1")]) is None
+        # heal the device; force the probe window open
+        eng._evaluator = healthy
+        eng._table_gen = -1  # rebuild against the healthy evaluator
+        eng.breaker._retry_at = 0.0
+        closed = 0
+        for _ in range(eng.breaker.probe_successes):
+            eng.breaker._retry_at = 0.0
+            feats = [eng.features_for(b'{"v": 9.0}')]
+            resolved = eng.eval_batch_async(feats)
+            assert resolved is not None  # the probe batch runs on device
+            assert resolved() is not None
+            closed += 1
+        assert eng.breaker.state == "closed"
+        # and device decisions flow again
+        feats = [eng.features_for(b'{"v": 9.0}')]
+        resolved = eng.eval_batch_async(feats)
+        eng.attach_rows(feats, resolved())
+        assert feats[0].device_row is not None
+
+
+class TestBrokerEndToEnd:
+    def test_staged_device_filtering_with_oracle(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            r1, w1, _ = await h.connect("pred-sub")
+            w1.write(
+                sub_packet(1, [Subscription(filter="s/+/t$GT{temp:25.0}", qos=0)])
+            )
+            await w1.drain()
+            ack = await read_wire_packet(r1)
+            assert ack.fixed_header.type == SUBACK
+            assert ack.reason_codes == b"\x00"
+            r2, w2, _ = await h.connect("plain-sub")
+            w2.write(sub_packet(1, [Subscription(filter="s/#", qos=0)]))
+            await w2.drain()
+            await read_wire_packet(r2)
+            h.server.matcher.flush()
+            # the trie stores the BASE filter with the predicate attached
+            subs = h.server.topics.subscribers("s/1/t")
+            assert any(
+                s.predicates == ("$GT{temp:25.0}",)
+                for s in subs.subscriptions.values()
+            )
+            rp, wp, _ = await h.connect("pub")
+            for v in (20.0, 30.0, 26.5):
+                wp.write(pub_packet("s/1/t", json.dumps({"temp": v}).encode()))
+            await wp.drain()
+            for _ in range(3):  # plain subscriber: everything
+                pk = await read_wire_packet(r2)
+                assert pk.fixed_header.type == PUBLISH
+            got = []  # predicated subscriber: only > 25
+            for _ in range(2):
+                pk = await read_wire_packet(r1)
+                got.append(json.loads(bytes(pk.payload))["temp"])
+            assert got == [30.0, 26.5], got
+            eng = h.server._predicates
+            g = eng.gauges()
+            assert g["oracle_mismatches"] == 0
+            assert g["filtered"] == 1 and g["deliveries"] == 2, g
+            assert g["device_decisions"] >= 1, g  # device path really ran
+            # $SYS tree renders the plane
+            h.server.publish_sys_topics()
+            pks = h.server.topics.messages(SYS_PREFIX + "/broker/predicates/+")
+            tree = {p.topic_name: bytes(p.payload) for p in pks}
+            assert tree[SYS_PREFIX + "/broker/predicates/rules"] == b"1"
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unsubscribe_with_original_suffixed_filter(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            r, w, _ = await h.connect("c1")
+            w.write(sub_packet(1, [Subscription(filter="a/b$GT{1.0}", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            assert h.server._predicates.rule_count == 1
+            assert h.server.info.subscriptions == 1
+            from mqtt_tpu.packets import (
+                UNSUBSCRIBE,
+                FixedHeader,
+                Packet,
+                encode_packet,
+            )
+
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=UNSUBSCRIBE, qos=1),
+                        packet_id=2,
+                        filters=[Subscription(filter="a/b$GT{1.0}")],
+                    )
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r)
+            assert ack.fixed_header.type == UNSUBACK
+            assert h.server.info.subscriptions == 0
+            assert h.server._predicates.rule_count == 0  # refs released
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_resubscribe_replaces_predicate(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            r, w, _ = await h.connect("c1")
+            w.write(sub_packet(1, [Subscription(filter="a/b$GT{1.0}", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            w.write(sub_packet(2, [Subscription(filter="a/b$LT{9.0}", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            eng = h.server._predicates
+            assert eng.rule_count == 1  # the $GT rule's ref was released
+            assert "$LT{9.0}" in eng._rules
+            # replacing with a PLAIN subscribe drops the last rule too
+            w.write(sub_packet(3, [Subscription(filter="a/b", qos=0)]))
+            await w.drain()
+            await read_wire_packet(r)
+            assert eng.rule_count == 0
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestRoundTripSeams:
+    """ISSUE 8 satellite: the stripped suffix must not leak into retained
+    matching, $SHARE parsing, or the v5 SUBACK reason path."""
+
+    def test_retained_matching_uses_base_and_filters_payload(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            rp, wp, _ = await h.connect("retainer")
+            wp.write(
+                pub_packet("s/1/t", json.dumps({"temp": 30.0}).encode(), retain=True)
+            )
+            wp.write(
+                pub_packet("s/2/t", json.dumps({"temp": 10.0}).encode(), retain=True)
+            )
+            await wp.drain()
+            retained = h.server.topics.retained
+            deadline = asyncio.get_event_loop().time() + 10
+            while (
+                retained.get("s/1/t") is None or retained.get("s/2/t") is None
+            ) and asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
+            assert retained.get("s/1/t") is not None
+            assert retained.get("s/2/t") is not None
+            # a predicated subscribe matches retained messages on the
+            # BASE filter and delivers only the passing payload
+            r, w, _ = await h.connect("late-sub")
+            w.write(
+                sub_packet(1, [Subscription(filter="s/+/t$GT{temp:25.0}", qos=0)])
+            )
+            await w.drain()
+            got = []
+            for _ in range(2):  # SUBACK + exactly one retained publish
+                pk = await read_wire_packet(r)
+                got.append(pk)
+            types = [p.fixed_header.type for p in got]
+            assert SUBACK in types and PUBLISH in types
+            pub = got[types.index(PUBLISH)]
+            assert pub.topic_name == "s/1/t"
+            assert json.loads(bytes(pub.payload))["temp"] == 30.0
+            with pytest.raises(asyncio.TimeoutError):
+                await read_wire_packet(r)  # the failing retained never comes
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_share_group_parses_on_base(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            r, w, _ = await h.connect("shared-1")
+            w.write(
+                sub_packet(
+                    1,
+                    [Subscription(filter="$SHARE/grp/s/t$GT{v:5.0}", qos=0)],
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r)
+            assert ack.reason_codes == b"\x00"
+            # the share index stores the BASE group filter
+            subs = h.server.topics.subscribers("s/t")
+            assert "$SHARE/grp/s/t" in subs.shared
+            h.server.matcher.flush()
+            rp, wp, _ = await h.connect("pub")
+            wp.write(pub_packet("s/t", b'{"v": 1.0}'))  # fails the predicate
+            wp.write(pub_packet("s/t", b'{"v": 7.0}'))  # passes
+            await wp.drain()
+            pk = await read_wire_packet(r)
+            assert json.loads(bytes(pk.payload))["v"] == 7.0
+            with pytest.raises(asyncio.TimeoutError):
+                await read_wire_packet(r)
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_v5_suback_reasons_and_identifier(self):
+        async def scenario():
+            from mqtt_tpu.packets import (
+                ERR_TOPIC_FILTER_INVALID,
+                SUBSCRIBE,
+                FixedHeader,
+                Packet,
+                Properties,
+                encode_packet,
+            )
+
+            h = Harness(staged_options())
+            await h.server.serve()
+            r, w, _ = await h.connect("v5-sub", version=5)
+            # reason-code seam: one valid predicated filter (the raw
+            # string would be INVALID: '#' not last), one invalid base,
+            # one valid plain — codes reflect the BASE filters
+            w.write(
+                sub_packet(
+                    1,
+                    [
+                        Subscription(filter="bad/#/mid$GT{1.0}", qos=0),
+                        Subscription(filter="plain/t", qos=0),
+                    ],
+                    version=5,
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r, version=5)
+            assert ack.fixed_header.type == SUBACK
+            assert ack.reason_codes == bytes(
+                [ERR_TOPIC_FILTER_INVALID.code, 0]
+            )
+            # identifier seam: the v5 subscription-identifier property
+            # must survive the suffix strip onto delivered publishes
+            w.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                        protocol_version=5,
+                        packet_id=2,
+                        properties=Properties(subscription_identifier=[7]),
+                        filters=[
+                            Subscription(
+                                filter="alerts/#$CONTAINS{alarm}",
+                                qos=1,
+                                identifier=7,
+                            )
+                        ],
+                    )
+                )
+            )
+            await w.drain()
+            ack = await read_wire_packet(r, version=5)
+            assert ack.reason_codes == bytes([1])
+            h.server.matcher.flush()
+            rp, wp, _ = await h.connect("pub")
+            wp.write(pub_packet("alerts/fire", b"big alarm"))
+            wp.write(pub_packet("alerts/fire", b"quiet"))
+            await wp.drain()
+            pk = await read_wire_packet(r, version=5)
+            assert bytes(pk.payload) == b"big alarm"
+            # the v5 subscription identifier survives the strip
+            assert pk.properties.subscription_identifier == [7]
+            with pytest.raises(asyncio.TimeoutError):
+                await read_wire_packet(r, version=5)
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestPersistence:
+    def test_storage_round_trip_re_registers_rules(self):
+        from mqtt_tpu.hooks.storage import Subscription as StoredSub
+        from mqtt_tpu.hooks.storage.base import subscription_from_dict
+
+        rec = StoredSub(
+            client="c1",
+            filter="s/+/t",
+            qos=1,
+            predicates=["$GT{temp:25.0}"],
+        )
+        back = subscription_from_dict(
+            json.loads(json.dumps(rec.__dict__))
+        )
+        assert back.predicates == ["$GT{temp:25.0}"]
+        s = Server(Options(inline_client=False))
+        s.load_subscriptions([back])
+        assert s._predicates.rule_count == 1
+        subs = s.topics.subscribers("s/1/t")
+        assert subs.subscriptions["c1"].predicates == ("$GT{temp:25.0}",)
+
+    def test_disabled_plane_restores_base_filter(self):
+        from mqtt_tpu.hooks.storage import Subscription as StoredSub
+
+        s = Server(Options(inline_client=False, predicate_filters=False))
+        s.load_subscriptions(
+            [StoredSub(client="c1", filter="s/t", predicates=["$GT{1.0}"])]
+        )
+        # fails open: base filter serves unfiltered, nothing crashes
+        assert "c1" in s.topics.subscribers("s/t").subscriptions
+
+
+class TestFastPathGate:
+    def test_plan_negative_caches_predicated_topics(self):
+        s = Server(Options(inline_client=False))
+        s.topics.subscribe("plain", Subscription(filter="t/a"))
+        assert s._plan_for_topic("t/a")  # fast-path plan exists
+        s.topics.subscribe(
+            "pred", Subscription(filter="t/b", predicates=("$GT{1.0}",))
+        )
+        assert s._plan_for_topic("t/b") is None  # decode path: per-payload
+        # and the plain topic keeps its plan
+        assert s._plan_for_topic("t/a")
+
+
+class TestInlinePredicates:
+    def test_inline_subscribe_filters_and_releases(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            got = []
+            h.server.subscribe(
+                "s/t$CONTAINS{alarm}", 42, lambda cl, sub, pk: got.append(bytes(pk.payload))
+            )
+            assert h.server._predicates.rule_count == 1
+            h.server.publish("s/t", b"no match", False, 0)
+            h.server.publish("s/t", b"alarm now", False, 0)
+            await asyncio.sleep(0.1)
+            assert got == [b"alarm now"]
+            h.server.unsubscribe("s/t$CONTAINS{alarm}", 42)
+            assert h.server._predicates.rule_count == 0
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_unmatched_inline_unsubscribe_never_underflows_refs(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            handler = lambda *a: None  # noqa: E731
+            h.server.subscribe("a/t$GT{5.0}", 1, handler)
+            h.server.subscribe("b/t$GT{5.0}", 2, handler)  # shared rule
+            eng = h.server._predicates
+            assert eng._rules["$GT{5.0}"].refs == 2
+            # unsubscribes that match NOTHING must not drop the refs
+            h.server.unsubscribe("a/t$GT{5.0}", 99)  # wrong id
+            h.server.unsubscribe("zz/t$GT{5.0}", 1)  # wrong filter
+            assert eng._rules["$GT{5.0}"].refs == 2
+            h.server.unsubscribe("a/t$GT{5.0}", 1)
+            h.server.unsubscribe("b/t$GT{5.0}", 2)
+            assert eng.rule_count == 0
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_inline_resubscribe_releases_replaced_rule(self):
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            handler = lambda *a: None  # noqa: E731
+            h.server.subscribe("a/t$GT{5.0}", 1, handler)
+            h.server.subscribe("a/t$GT{5.0}", 1, handler)  # replace, same rule
+            eng = h.server._predicates
+            assert eng._rules["$GT{5.0}"].refs == 1
+            h.server.subscribe("a/t$LT{2.0}", 1, handler)  # replace, new rule
+            assert "$GT{5.0}" not in eng._rules
+            assert eng._rules["$LT{2.0}"].refs == 1
+            h.server.unsubscribe("a/t$LT{2.0}", 1)
+            assert eng.rule_count == 0 and not eng.active
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+
+class TestSuspectWindowKnob:
+    """ISSUE 8 satellite: the PR 5 SUSPECT window becomes seconds-dialable
+    via cluster_suspect_window_s (wall-clock wins over the pings knob)."""
+
+    def _cluster_for(self, opts):
+        from mqtt_tpu.cluster import Cluster
+
+        s = Server(opts)
+        return Cluster(s, worker_id=0, n_workers=1, sock_dir="/tmp")
+
+    def test_window_converts_to_ping_intervals(self):
+        from mqtt_tpu.cluster import Cluster
+
+        opts = Options(cluster_suspect_window_s=27.0)
+        c = self._cluster_for(opts)
+        # 27s at a 5s ping cadence rounds UP to 6 missed pongs; the
+        # default PARTITIONED threshold (5) is re-floored strictly above
+        assert c.suspect_pings == math.ceil(27.0 / Cluster.PING_INTERVAL_S)
+        assert c.partition_pings == c.suspect_pings + 3
+
+    def test_sub_interval_window_floors_at_one(self):
+        opts = Options(cluster_suspect_window_s=0.5)
+        c = self._cluster_for(opts)
+        assert c.suspect_pings == 1
+        assert c.partition_pings == 5  # default already strictly above
+
+    def test_zero_keeps_legacy_pings_knob(self):
+        opts = Options(
+            cluster_suspect_window_s=0.0, cluster_peer_health_suspect_pings=3
+        )
+        c = self._cluster_for(opts)
+        assert c.suspect_pings == 3
+
+    def test_negative_normalizes_to_legacy(self):
+        opts = Options(cluster_suspect_window_s=-5.0)
+        opts.ensure_defaults()
+        assert opts.cluster_suspect_window_s == 0.0

@@ -518,11 +518,13 @@ class TestSysTopics:
             assert "$SYS/broker/clients/connected" in topics
             assert "$SYS/broker/overload/state" in topics
             assert "$SYS/broker/telemetry/flight/ring_depth" in topics
+            assert "$SYS/broker/predicates/rules" in topics
             base = {
                 t
                 for t in topics
                 if not t.startswith("$SYS/broker/overload/")
                 and not t.startswith("$SYS/broker/telemetry/")
+                and not t.startswith("$SYS/broker/predicates/")
             }
             assert len(base) == 20
             await h.shutdown()
