@@ -50,6 +50,8 @@ test: verify
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
 	  tests/test_overload.py tests/test_cluster.py tests/test_race.py \
+	  tests/test_federation.py tests/test_tree_mesh.py \
+	  tests/test_mesh_drill.py \
 	  -q -m slow \
 	  -p no:cacheprovider -p no:xdist -p no:randomly
 

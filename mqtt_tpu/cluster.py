@@ -56,12 +56,37 @@ drop counters, stale interest withdrawn, link aborted for a clean
 re-dial). Every (re)connect opens a fresh presence GENERATION
 (``_T_SYNC``), so presence frames from a raced stale link can never
 resurrect withdrawn filters.
+
+Spanning-tree mode (ISSUE 9, ``cluster_topology: tree``): the all-pairs
+fabric above grows O(N²) links and gossip, so tree mode routes over the
+epoch-stamped loop-free tree mqtt_tpu.mesh_topology elects instead —
+per-worker links stay O(degree) at 32+ workers (MQTT-ST, arxiv
+1911.07622). Publishes travel tree edges only, gated by per-edge
+counted-bloom INTEREST SUMMARIES (``_T_SUMMARY``, TD-MQTT-style
+transparent aggregation: the summary sent on edge E is local interest ∪
+every OTHER edge's received summary) with conservative pass-through
+while a summary is stale; receiving workers RE-FORWARD along their other
+matching edges, but only under the frame's own epoch — an epoch mismatch
+delivers locally and stops, so a mid-election frame can never loop.
+Every routed frame carries (epoch, origin, boot, seq) and receivers keep
+per-(origin, boot) windows: re-parenting replays are suppressed as
+duplicates, never double-delivered. The per-peer health machine becomes
+per-tree-EDGE: a severed edge parks QoS>0 exactly as before, and the
+PARTITIONED verdict triggers a SCOPED RE-ELECTION (``_T_EPOCH`` floods
+the strictly-greater epoch; mesh_topology's total order makes
+concurrent proposals converge) after which the park re-routes through
+the new tree under the new epoch — exactly once, by the suppression
+window. Pressure gossip rides tree edges folded PER SUBTREE: the advert
+sent on edge E is the elementwise max of this worker's signals and the
+adverts from every other edge, so the ``peers`` signal reads "how hot is
+everything behind that edge" in O(degree) gossip volume.
 """
 
 from __future__ import annotations
 
 import asyncio
 import collections
+import itertools
 import json
 import logging
 import math
@@ -69,11 +94,23 @@ import os
 import random
 import struct
 import time
-from typing import Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
+from .mesh_topology import (
+    ROUTE_DUP,
+    ROUTE_NEW,
+    ROUTE_REFORWARD,
+    BloomBits,
+    CountedBloom,
+    DuplicateSuppressor,
+    Topology,
+    TreeEpoch,
+    decode_members,
+    encode_members,
+)
 from .packets import PUBLISH, FixedHeader, Packet
 from .packets import Subscription
-from .topics import SHARE_PREFIX, InlineSubscription, TopicsIndex
+from .topics import SHARE_PREFIX, InlineSubscription, TopicsIndex, summary_base
 
 _log = logging.getLogger("mqtt_tpu.cluster")
 
@@ -102,6 +139,24 @@ _T_SYNC = 0x59  # 'Y' json {gen}
 # every frame. Traced _T_PACKET forwards need no new type — the json
 # head just grows a "trace" key older peers ignore.
 _T_TFRAME = 0x54  # 'T' u16 origin_len | origin | u16 tlen | trace json | frame
+# spanning-tree mode (ISSUE 9): E floods an epoch announcement (the
+# member view; edges are NOT carried — every worker recomputes the same
+# deterministic tree from the view), U carries one edge's aggregated
+# interest summary, and X is the tree-routed QoS0 passthrough frame —
+# _T_FRAME plus the (epoch, origin, boot, seq) route header receivers
+# need for duplicate suppression and re-forwarding (trace context rides
+# the same header). Tree-routed packet forwards stay _T_PACKET: their
+# json head just grows an "rt" key.
+_T_EPOCH = 0x45  # 'E' json {e: [num, boot, proposer], m: {worker: boot}}
+_T_SUMMARY = 0x55  # 'U' json {e, g, all} | 0x00 | bloom bitset
+_T_RFRAME = 0x58  # 'X' u16 origin_len | origin | u16 rlen | route json | frame
+
+# control-plane frame types: byte volume is accounted (``control_bytes``,
+# the drill's O(degree) gossip-volume assertion) and presence/sync keep
+# their 8x never-shed headroom in _send_nowait
+_CONTROL_TYPES = frozenset(
+    {_T_HELLO, _T_PRESENCE, _T_PING, _T_PONG, _T_GOSSIP, _T_SYNC, _T_EPOCH, _T_SUMMARY}
+)
 
 # per-peer health states (the link-failure posture between "up" and the
 # old binary link_down): SUSPECT holds QoS>0 forwards in a bounded park
@@ -220,6 +275,41 @@ class Cluster:
             opts, "cluster_peer_park_max_bytes", 1 << 20
         )
         self.advert_ttl_s = getattr(opts, "overload_federation_ttl_ms", 15000.0) / 1e3
+        # spanning-tree mode (ISSUE 9): the deterministic epoch-stamped
+        # tree replaces the all-pairs fabric — O(degree) links, interest-
+        # scoped routing, per-edge health. "mesh" keeps the PR 5 all-pairs
+        # behavior bit-for-bit (and stays the default for small meshes).
+        self.topology_mode = str(
+            getattr(opts, "cluster_topology", "mesh") or "mesh"
+        ).lower()
+        self.tree_degree = int(getattr(opts, "cluster_tree_degree", 4) or 4)
+        summary_bits = int(getattr(opts, "cluster_summary_bits", 4096) or 4096)
+        self.topo: Optional[Topology] = None
+        self._local_interest = CountedBloom(summary_bits)
+        self._summary_filters: set[str] = set()  # summary keys currently counted
+        # peer -> (received bits, sender gen, sender (num, boot, proposer))
+        self._edge_summaries: dict[
+            int, tuple[BloomBits, int, tuple[int, int, int]]
+        ] = {}
+        # peer -> (gen, full epoch key) last successfully sent
+        self._summary_sent: dict[
+            int, tuple[int, tuple[int, int, int]]
+        ] = {}
+        self._dup = DuplicateSuppressor(
+            window=int(getattr(opts, "cluster_dup_window", 8192) or 8192)
+        )
+        self._seq = itertools.count(1)  # origin seq stamp (GIL-atomic next())
+        self._dial_tasks: dict[int, asyncio.Task] = {}
+        self._peer_advert_sigs: dict[int, dict[str, float]] = {}
+        self.duplicates_suppressed = 0  # (origin, boot, seq) window hits
+        self.stale_epoch_frames = 0  # re-forwarded under the live tree, counted
+        self.summary_filtered_forwards = 0  # edges skipped by a fresh summary
+        self.summary_passthrough_forwards = 0  # conservative sends on stale/absent summaries
+        self.control_bytes = 0  # wire bytes spent on control-plane frames
+        if self.topology_mode == "tree":
+            self.topo = Topology(
+                worker_id, range(n_workers), self.tree_degree, boot_id=self.boot_id
+            )
         server._cluster = self
         server.topics.add_observer(self._on_mutation)
         governor = getattr(server, "overload", None)
@@ -277,6 +367,59 @@ class Cluster:
                 "Bytes currently held in SUSPECT peers' park buffers",
                 fn=lambda: sum(h.park_bytes for h in self._health.values()),
             )
+            r.counter(
+                "mqtt_tpu_cluster_control_bytes_total",
+                "Wire bytes spent on mesh control traffic (hello/presence/"
+                "ping/pong/gossip/sync/epoch/summary) — the drill's "
+                "O(degree) gossip-volume number",
+                fn=lambda: self.control_bytes,
+            )
+            if self.topo is not None:
+                topo = self.topo
+                r.gauge(
+                    "mqtt_tpu_cluster_tree_epoch",
+                    "Current spanning-tree epoch number (bumps on every "
+                    "re-election/adoption)",
+                    fn=topo.epoch_num,
+                )
+                r.gauge(
+                    "mqtt_tpu_cluster_tree_links",
+                    "Live links to current tree neighbors (the O(degree) "
+                    "link-count bound)",
+                    fn=lambda: sum(
+                        1 for p in topo.neighbors() if p in self._writers
+                    ),
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_tree_re_elections_total",
+                    "Local re-election proposals (edge death, member "
+                    "join/rejoin, self re-join)",
+                    fn=lambda: topo.re_elections,
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_duplicates_suppressed_total",
+                    "Routed frames dropped by the (origin, boot, seq) "
+                    "window — re-parenting replays, never double-delivered",
+                    fn=lambda: self.duplicates_suppressed,
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_stale_epoch_frames_total",
+                    "Routed frames stamped with a non-current epoch: "
+                    "delivered locally, never re-forwarded (loop guard)",
+                    fn=lambda: self.stale_epoch_frames,
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_summary_filtered_total",
+                    "Tree edges skipped because a FRESH interest summary "
+                    "proved no subscriber behind them matches",
+                    fn=lambda: self.summary_filtered_forwards,
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_summary_passthrough_total",
+                    "Conservative forwards on edges whose summary was "
+                    "stale or not yet received",
+                    fn=lambda: self.summary_passthrough_forwards,
+                )
 
     @property
     def peer_count(self) -> int:
@@ -306,11 +449,11 @@ class Cluster:
             self._on_peer_connect, path
         )
         # connect to lower-numbered peers (they accept from us); retries
-        # cover start-order races
-        for peer in range(self.worker_id):
-            self._tasks.append(
-                loop.create_task(self._dial(peer), name=f"cluster-dial-{peer}")
-            )
+        # cover start-order races. Tree mode dials only the current tree
+        # NEIGHBORS (plus slow re-join probes toward excluded members) —
+        # the O(degree) link bound — and _reconcile_links keeps the dial
+        # set in step with epoch changes.
+        self._sync_dial_tasks()
         self._tasks.append(
             loop.create_task(self._presence_loop(), name="cluster-presence")
         )
@@ -324,7 +467,12 @@ class Cluster:
         self._stopping = True
         for t in self._tasks:
             t.cancel()
-        await asyncio.gather(*self._tasks, return_exceptions=True)
+        for t in self._dial_tasks.values():
+            t.cancel()
+        await asyncio.gather(
+            *self._tasks, *self._dial_tasks.values(), return_exceptions=True
+        )
+        self._dial_tasks.clear()
         for w in self._writers.values():
             w.close()
         if self._unix_server is not None:
@@ -339,6 +487,41 @@ class Cluster:
     # workers don't hammer a restarting peer in lockstep
     DIAL_BACKOFF_S = 0.05
     DIAL_BACKOFF_MAX_S = 2.0
+    # excluded-member re-join probe floor (tree mode): a member voted out
+    # of the view is probed gently — contact, not traffic, is the goal
+    PROBE_BACKOFF_S = 1.0
+
+    def _dial_wanted(self, peer: int) -> bool:
+        """Should this worker hold a dial task toward ``peer``? Mesh
+        mode: every lower-numbered peer, forever. Tree mode: current
+        tree neighbors (the link budget), plus members EXCLUDED from the
+        view — the slow re-join probe that heals a true partition (the
+        tree carries no path to them, so only a direct dial can ever
+        learn they are back)."""
+        if peer >= self.worker_id or self._stopping:
+            return False
+        if self.topo is None:
+            return True
+        return self.topo.is_neighbor(peer) or not self.topo.in_view(peer)
+
+    def _sync_dial_tasks(self) -> None:
+        """Reconcile the dial-task set with _dial_wanted (cluster loop
+        only). Finished/cancelled tasks are pruned so a re-wanted peer
+        gets a fresh dialer."""
+        loop = self._loop
+        if loop is None:
+            return
+        for peer, task in list(self._dial_tasks.items()):
+            if task.done():
+                del self._dial_tasks[peer]
+            elif not self._dial_wanted(peer):
+                task.cancel()
+                del self._dial_tasks[peer]
+        for peer in range(self.worker_id):
+            if self._dial_wanted(peer) and peer not in self._dial_tasks:
+                self._dial_tasks[peer] = loop.create_task(
+                    self._dial(peer), name=f"cluster-dial-{peer}"
+                )
 
     async def _dial(self, peer: int) -> None:
         """Connect (and RE-connect) to a lower-numbered peer: a dropped
@@ -357,26 +540,62 @@ class Cluster:
             seed=self.worker_id * 131 + peer,  # deterministic, desynced
         )
         connected_before = False
-        while not self._stopping:
+        while self._dial_wanted(peer):
+            probe = self.topo is not None and not self.topo.in_view(peer)
             try:
                 reader, writer = await asyncio.open_unix_connection(path)
             except OSError:
-                await asyncio.sleep(backoff.next())
-                continue
-            try:
-                await self._send(
-                    writer, _T_HELLO, json.dumps({"worker": self.worker_id}).encode()
+                # an excluded member gets the gentle probe cadence: the
+                # fast first-retry ladder is for start-order races, not
+                # for hammering a socket that has been dead for minutes
+                await asyncio.sleep(
+                    max(backoff.next(), self.PROBE_BACKOFF_S if probe else 0.0)
                 )
+                continue
+            hello = json.dumps(
+                {"worker": self.worker_id, "boot": self.boot_id}
+            ).encode()
+            try:
+                await self._send(writer, _T_HELLO, hello)
             except (ConnectionError, OSError):
                 writer.close()
                 await asyncio.sleep(backoff.next())
                 continue
+            except asyncio.CancelledError:
+                # _sync_dial_tasks cancelled us mid-HELLO (re-election
+                # demoted the peer): the socket is open but unregistered
+                # — nothing else will ever close it
+                writer.close()
+                raise
+            self.control_bytes += len(hello) + 5
             if connected_before:  # start-order races aren't reconnects
                 self.reconnects[peer] = self.reconnects.get(peer, 0) + 1
             connected_before = True
             backoff.reset()  # link is up: next outage starts fast again
+            if probe:
+                # the probe landed: the excluded member is alive again —
+                # vote it back in and flood the new epoch
+                self._member_contact(peer, 0)
+                if not self._dial_wanted(peer):
+                    # the re-add made this peer a non-neighbor under the
+                    # new tree — and _sync_dial_tasks may have cancelled
+                    # THIS task. _reconcile_links already ran (before the
+                    # writer was registered), so registering now would
+                    # leak an open, unread socket in _writers that nothing
+                    # closes until the next epoch change
+                    writer.close()
+                    return
             self._register(peer, writer)
-            await self._read_loop(peer, reader, writer)
+            try:
+                await self._read_loop(peer, reader, writer)
+            except asyncio.CancelledError:
+                # cancelled mid-read (re-election demoted the peer, or
+                # shutdown): the registration must not outlive the task —
+                # deregister only if this link still owns the slot
+                if self._writers.get(peer) is writer:
+                    self._writers.pop(peer, None)
+                writer.close()
+                raise
             await asyncio.sleep(backoff.next())  # link dropped: re-dial
 
     async def _on_peer_connect(self, reader, writer) -> None:
@@ -388,7 +607,14 @@ class Cluster:
         if mtype != _T_HELLO:
             writer.close()
             return
-        peer = json.loads(payload)["worker"]
+        hello = json.loads(payload)
+        peer = hello["worker"]
+        # tree mode: a HELLO is membership evidence — a brand-new or
+        # voted-out member re-joins the view (epoch bump + flood), a
+        # restarted incarnation's moved boot nonce forces the same (its
+        # stale tree must never be resurrected), and a first-time boot
+        # nonce is simply learned
+        self._member_contact(peer, int(hello.get("boot", 0) or 0))
         self._register(peer, writer)
         await self._read_loop(peer, reader, writer)
 
@@ -411,12 +637,25 @@ class Cluster:
             )
         except (ConnectionError, RuntimeError):
             pass  # the link died mid-register: the dial loop heals it
-        # announce every currently-populated filter to the new peer: walk
-        # the live trie terminals (late-joining workers must converge)
-        for f in self._populated_filters():
-            self._pending_presence.add(f)
-        if self._presence_wake is not None:
-            self._presence_wake.set()
+        if self.topo is not None:
+            # tree mode: per-filter presence is replaced by the edge
+            # summary — announce the current epoch (a stale joiner
+            # catches up immediately), re-probe the live trie into the
+            # local bloom (covers interest created before any link was
+            # up), and push this edge's aggregate
+            self._announce_epoch([peer])
+            for f in self._populated_filters():
+                self._pending_presence.add(f)
+            if self._presence_wake is not None:
+                self._presence_wake.set()
+            self._send_summary(peer, writer, force=True)
+        else:
+            # announce every currently-populated filter to the new peer:
+            # walk the live trie terminals (late joiners must converge)
+            for f in self._populated_filters():
+                self._pending_presence.add(f)
+            if self._presence_wake is not None:
+                self._presence_wake.set()
         self._heal_peer(peer, writer)
 
     # -- peer health (UP -> SUSPECT -> PARTITIONED -> resync) --------------
@@ -441,31 +680,58 @@ class Cluster:
         """Hold one QoS>0 forward for a SUSPECT peer in its bounded park
         buffer; the oldest frames spill into the partition drop counters
         once the byte budget is exceeded (bounded memory, never silent)."""
+        self._park_entry(peer, ("M", mtype, payload), len(payload))
+
+    def _park_packet(self, peer: int, topic: str, head: dict, body: bytes) -> None:
+        """Tree-mode park entry: the decoded pieces, not the serialized
+        payload — a replay under a NEW epoch must restamp the route
+        header, and a re-election may re-route it through different
+        edges entirely."""
+        self._park_entry(peer, ("P", topic, dict(head), body), len(body))
+
+    def _park_entry(self, peer: int, entry: tuple, nbytes: int) -> None:
         ph = self._health_for(peer)
-        ph.park.append((mtype, payload))
-        ph.park_bytes += len(payload)
+        ph.park.append((entry, nbytes))
+        ph.park_bytes += nbytes
         self.parked_forwards += 1
         while ph.park_bytes > self.park_max_bytes and len(ph.park) > 1:
-            _m, old = ph.park.popleft()
-            ph.park_bytes -= len(old)
+            _e, old_n = ph.park.popleft()
+            ph.park_bytes -= old_n
             self.parked_forwards -= 1
             self._count_drop(peer, partition=True)
             self.dropped_qos_forwards += 1
+
+    def _drain_park(self, peer: int) -> list[tuple]:
+        """Detach and return every parked entry for ``peer`` (counters
+        adjusted); the caller decides replay vs re-route vs drop."""
+        ph = self._health.get(peer)
+        if ph is None:
+            return []
+        out = []
+        while ph.park:
+            entry, n = ph.park.popleft()
+            ph.park_bytes -= n
+            self.parked_forwards -= 1
+            out.append(entry)
+        return out
 
     def _heal_peer(self, peer: int, writer) -> None:
         """A (re)connected link: reset the health record to UP and replay
         everything parked while the peer was SUSPECT — exactly once; a
         replay that fails on the fresh link is a counted drop, never a
-        duplicate."""
+        duplicate. Tree-mode entries are restamped with the CURRENT
+        epoch before the replay, so the receiving edge re-forwards them
+        down its (possibly re-elected) subtree; the (origin, boot, seq)
+        suppression window makes the whole heal exactly-once even when
+        the original send had partially propagated."""
         ph = self._health.get(peer)
         if ph is None:
             return
         ph.state = PEER_UP
         ph.outstanding = 0
-        while ph.park:
-            mtype, payload = ph.park.popleft()
-            ph.park_bytes -= len(payload)
-            self.parked_forwards -= 1
+        for entry in self._drain_park(peer):
+            payload = self._park_payload(entry)
+            mtype = entry[1] if entry[0] == "M" else _T_PACKET
             try:
                 sent = self._send_nowait(peer, writer, mtype, payload, qos=1)
             except (ConnectionError, RuntimeError):
@@ -476,22 +742,39 @@ class Cluster:
                 self._count_drop(peer, partition=False)
                 self.dropped_qos_forwards += 1
 
+    def _park_payload(self, entry: tuple) -> bytes:
+        """Serialize one park entry for the wire, restamping tree route
+        headers with the FULL current epoch identity (num, boot,
+        proposer — receivers re-forward only on an exact triple match,
+        so a partial restamp would make every replay read as stale and
+        stop at the first hop instead of fanning down the healed
+        subtree). The (origin, boot, seq) triple is never touched: it
+        is what keeps the replay exactly-once."""
+        if entry[0] == "M":
+            return entry[2]
+        _kind, _topic, head, body = entry
+        rt = head.get("rt")
+        if isinstance(rt, dict) and self.topo is not None:
+            ep = self.topo.epoch
+            rt["e"], rt["eb"], rt["ep"] = ep.num, ep.boot, ep.proposer
+        return json.dumps(head).encode() + b"\x00" + body
+
     def _mark_partitioned(self, peer: int) -> None:
-        """Give up on a peer: flush its park buffer into the partition
-        drop counters, forget its pressure advert, and abort any live
-        writer so the link-down cleanup + re-dial machinery runs."""
+        """Give up on a peer: flush its park buffer, forget its pressure
+        advert, and abort any live writer so the link-down cleanup +
+        re-dial machinery runs. Mesh mode flushes the park into the
+        partition drop counters; tree mode instead triggers the SCOPED
+        RE-ELECTION (the member leaves the view, the strictly-greater
+        epoch floods) and RE-ROUTES the park through the new tree under
+        the new epoch — the orphaned subtree's traffic heals instead of
+        dropping, and the suppression window keeps it exactly-once."""
         ph = self._health_for(peer)
         if ph.state == PEER_PARTITIONED:
             return
         ph.state = PEER_PARTITIONED
-        n = len(ph.park)
-        while ph.park:
-            _m, payload = ph.park.popleft()
-            ph.park_bytes -= len(payload)
-            self.parked_forwards -= 1
-            self._count_drop(peer, partition=True)
-            self.dropped_qos_forwards += 1
+        parked = self._drain_park(peer)
         self._peer_adverts.pop(peer, None)
+        self._peer_advert_sigs.pop(peer, None)
         governor = getattr(self.server, "overload", None)
         sig = getattr(governor, "peer_signal", None)
         if sig is not None:
@@ -500,7 +783,9 @@ class Cluster:
         # stale beyond repair — withdraw it (a heal re-advertises)
         self._withdraw_peer(peer)
         _log.warning(
-            "peer %d marked PARTITIONED (%d parked forwards flushed)", peer, n
+            "peer %d marked PARTITIONED (%d parked forwards held)",
+            peer,
+            len(parked),
         )
         w = self._writers.get(peer)
         if w is not None:
@@ -508,6 +793,600 @@ class Cluster:
                 w.transport.abort()
             except Exception:  # brokerlint: ok=R4 transport already torn down; the dial loop re-runs either way
                 pass
+        if self.topo is not None:
+            ep = self.topo.propose_remove(peer)
+            self._edge_summaries.pop(peer, None)
+            if ep is not None:
+                self._reconcile_links()
+                self._announce_epoch()
+            self._reroute_parked(parked)
+        else:
+            for _entry in parked:
+                self._count_drop(peer, partition=True)
+                self.dropped_qos_forwards += 1
+
+    def _reroute_parked(self, parked: list[tuple]) -> None:
+        """Send park entries through the CURRENT tree (post re-election
+        or re-parent): each re-routed copy counts as a replay; an entry
+        no edge claims interest in simply stops here (the summary says
+        nobody behind any live edge wants it — not a loss)."""
+        for entry in parked:
+            if entry[0] != "P":
+                continue  # mesh entries never reach here
+            _kind, topic, head, body = entry
+            payload = self._park_payload(entry)
+            for p in self._route_edges(topic, None, bool(head.get("retain"))):
+                w = self._writers.get(p)
+                ph = self._health.get(p)
+                if (ph is not None and ph.state == PEER_SUSPECT) or w is None:
+                    self._park_packet(p, topic, head, body)
+                    continue
+                try:
+                    sent = self._send_nowait(p, w, _T_PACKET, payload, qos=1)
+                except (ConnectionError, RuntimeError):
+                    sent = False
+                if sent:
+                    self.replayed_forwards += 1
+                else:
+                    self._count_drop(p, partition=False)
+                    self.dropped_qos_forwards += 1
+
+    # -- spanning tree (ISSUE 9): epochs, summaries, link reconcile --------
+
+    def _member_contact(self, peer: int, boot: int) -> None:
+        """Membership evidence from a live connection (HELLO/SYNC): in
+        tree mode a new/excluded member is voted back in and a moved
+        boot nonce (restarted incarnation) forces a re-election; both
+        flood the strictly-greater epoch."""
+        if self.topo is None or peer == self.worker_id:
+            return
+        ep = self.topo.propose_add(peer, boot)
+        if ep is not None:
+            self._reconcile_links()
+            self._announce_epoch()
+
+    def _announce_epoch(
+        self, only: Optional[Iterable[int]] = None, digest: bool = False
+    ) -> None:
+        """Flood the current epoch + member view to tree neighbors (or
+        the given peers): receivers holding a smaller epoch adopt and
+        re-flood; receivers holding a greater one answer with theirs.
+        Edges are never carried — the tree is recomputed identically
+        from the view at every hop (mesh_topology.compute_parents).
+
+        ``digest`` sends the 3-int epoch identity WITHOUT the member
+        map: the anti-entropy heartbeat. A neighbor whose epoch agrees
+        ignores it; one that disagrees answers with its full
+        announcement, so the O(N) member map only moves on actual
+        divergence and the steady-state per-edge cost stays O(1)."""
+        if self.topo is None:
+            return
+        ep = self.topo.epoch
+        body: dict = {"e": [ep.num, ep.boot, ep.proposer]}
+        if not digest:
+            body["m"] = encode_members(self.topo.members())
+        payload = json.dumps(body).encode()
+        targets = list(only) if only is not None else list(self.topo.neighbors())
+        for p in targets:
+            w = self._writers.get(p)
+            if w is None:
+                continue
+            try:
+                self._send_nowait(p, w, _T_EPOCH, payload)
+            except (ConnectionError, RuntimeError):
+                continue  # the dial machinery heals it; re-announce rides it
+
+    def _on_epoch(self, peer: int, payload: bytes) -> None:
+        try:
+            d = json.loads(payload)
+            e = d["e"]
+            cand = TreeEpoch(int(e[0]), int(e[1]), int(e[2]))
+            m = d.get("m")
+            members = None if m is None else decode_members(m)
+        except (ValueError, TypeError, KeyError, IndexError):
+            return  # a malformed announcement must not kill the read loop
+        if self.topo is None:
+            return
+        if members is None:
+            # an anti-entropy digest: agreement costs nothing; any
+            # divergence (ahead OR behind — adoption needs the member
+            # map we don't have) is answered with our full announcement,
+            # and the exchange converges in at most one more round trip
+            # (the ahead side's answer-back below carries its map)
+            if cand != self.topo.epoch:
+                self._announce_epoch([peer])
+            return
+        if self.topo.adopt(cand, members):
+            excluded_me = self.worker_id not in members
+            if excluded_me:
+                # the mesh thought we were dead: the only way back in is
+                # an epoch strictly above the one that voted us out
+                self.topo.propose_self()
+            self._reconcile_links()
+            self._announce_epoch(
+                p for p in self.topo.neighbors() if excluded_me or p != peer
+            )
+        elif cand < self.topo.epoch:
+            # the sender is behind: answer with the greater epoch so it
+            # converges without waiting for the next membership event
+            self._announce_epoch([peer])
+
+    def _reconcile_links(self) -> None:
+        """Bring links/dials/health in line with the current tree (runs
+        on the cluster loop): non-neighbor links close (the O(degree)
+        budget is the point of tree mode), ex-neighbors' parked frames
+        re-route through the new tree, and the dial set re-syncs."""
+        if self.topo is None:
+            return
+        neighbors = set(self.topo.neighbors())
+        for peer, w in list(self._writers.items()):
+            if peer in neighbors:
+                continue
+            self._writers.pop(peer, None)
+            try:
+                w.transport.abort()
+            except Exception:  # brokerlint: ok=R4 racing teardown of a link being closed on purpose
+                pass
+        for peer in list(self._health):
+            if peer in neighbors:
+                continue
+            parked = self._drain_park(peer)
+            self._health.pop(peer, None)
+            self._edge_summaries.pop(peer, None)
+            self._summary_sent.pop(peer, None)
+            self._peer_adverts.pop(peer, None)
+            self._peer_advert_sigs.pop(peer, None)
+            governor = getattr(self.server, "overload", None)
+            sig = getattr(governor, "peer_signal", None)
+            if sig is not None:
+                sig.forget(peer)
+            self._reroute_parked(parked)
+        self._sync_dial_tasks()
+
+    def _tree_update_interest(self, filter: str, populated: bool) -> None:
+        """Fold one filter's populated state into the local counted
+        bloom, idempotently: the ``_summary_filters`` set guarantees one
+        add per live filter and one counted-bloom DELETE per withdrawal
+        (the UNSUBSCRIBE path), whatever order probe results land in.
+        $SHARE groups and predicate bases summarize as the BASE filter
+        publishes actually match (topics.summary_base). The set keys on
+        the ORIGINAL filter — `$SHARE/g/a/b` and `a/b` share a base, and
+        the counted bloom (not the set) owns that refcount."""
+        base = summary_base(filter)
+        if populated:
+            if filter not in self._summary_filters:
+                self._summary_filters.add(filter)
+                self._local_interest.add(base)
+        elif filter in self._summary_filters:
+            self._summary_filters.discard(filter)
+            self._local_interest.discard(base)
+
+    def _edge_summary_for(
+        self, peer: int, local: Optional[BloomBits] = None
+    ) -> BloomBits:
+        """The aggregate summary advertised ON one edge: local interest
+        ∪ every OTHER edge's received summary (TD-MQTT transparent
+        aggregation) — the edge answers 'is anything on MY side of the
+        tree interested'. ``local`` lets a sweep over every edge pay the
+        O(n_bits) counted-bloom export once, not once per edge."""
+        bits = self._local_interest.bits() if local is None else local
+        for other, (obits, _gen, _ep) in self._edge_summaries.items():
+            if other != peer:
+                bits = bits.union(obits)
+        return bits
+
+    def _send_summary(
+        self,
+        peer: int,
+        writer,
+        force: bool = False,
+        local: Optional[BloomBits] = None,
+    ) -> None:
+        """Push this edge's aggregate when anything feeding it moved
+        since the last send (local generation, epoch) — or always, on
+        ``force`` (fresh link)."""
+        if self.topo is None:
+            return
+        # the FULL epoch identity, not just the number: two concurrent
+        # proposals can share a num (different boot/proposer tie-breaks),
+        # and a summary computed under the losing tree must read stale
+        # on the winner's — comparing numbers alone would let it filter
+        # forwards toward a subtree whose membership changed
+        ep = self.topo.epoch
+        ep_key = (ep.num, ep.boot, ep.proposer)
+        # remote summary changes bump no local counter, so fold the
+        # received generations into the freshness key — EXCLUDING this
+        # edge's own (its summary is not part of what we send it; folding
+        # it in would make every receipt trigger a send back, and two
+        # neighbors would ping-pong summaries forever)
+        gen = self._local_interest.generation + sum(
+            g
+            for other, (_b, g, _e) in self._edge_summaries.items()
+            if other != peer
+        )
+        if not force and self._summary_sent.get(peer) == (gen, ep_key):
+            return
+        bits = self._edge_summary_for(peer, local)
+        head = json.dumps(
+            {
+                "e": ep.num,
+                "eb": ep.boot,
+                "ep": ep.proposer,
+                "g": gen,
+                "all": bits.match_all,
+            }
+        ).encode()
+        try:
+            if self._send_nowait(
+                peer, writer, _T_SUMMARY, head + b"\x00" + bits.data
+            ):
+                self._summary_sent[peer] = (gen, ep_key)
+        except (ConnectionError, RuntimeError):
+            pass  # the link is dying; the heal re-sends with force=True
+
+    def _send_summaries(self) -> None:
+        """Refresh every live edge's summary (gossip cadence + after a
+        batch of interest mutations)."""
+        if self.topo is None:
+            return
+        local = self._local_interest.bits()  # one export for the sweep
+        for peer in self.topo.neighbors():
+            w = self._writers.get(peer)
+            if w is not None:
+                self._send_summary(peer, w, local=local)
+
+    def _on_summary(self, peer: int, payload: bytes) -> None:
+        try:
+            sep = payload.index(b"\x00")
+            head = json.loads(payload[:sep])
+            bits = BloomBits(
+                bytes(payload[sep + 1 :]), bool(head.get("all", False))
+            )
+            gen = int(head.get("g", 0))
+            # a head missing the boot/proposer fields stores a key no
+            # live epoch can equal: conservative pass-through, not trust
+            ep_key = (
+                int(head.get("e", -1)),
+                int(head.get("eb", -1)),
+                int(head.get("ep", -1)),
+            )
+        except (ValueError, TypeError):
+            return  # malformed summary: keep the stale one (conservative)
+        first = peer not in self._edge_summaries
+        self._edge_summaries[peer] = (bits, gen, ep_key)
+        tele = getattr(self.server, "telemetry", None)
+        if first and tele is not None:
+            tele.registry.gauge(
+                "mqtt_tpu_cluster_edge_summary_fill_ratio",
+                "Fill ratio of the interest summary last received on a "
+                "tree edge (1.0 ≈ saturated, everything forwards)",
+                fn=lambda p=peer: (
+                    self._edge_summaries[p][0].fill_ratio()
+                    if p in self._edge_summaries
+                    else 0.0
+                ),
+                peer=str(peer),
+            )
+        # the subtree behind this edge changed: aggregates sent on OTHER
+        # edges fold this summary in, so let the refresh re-derive them
+        self._send_summaries()
+
+    def _route_edges(
+        self, topic: str, exclude: Optional[int], always: bool = False
+    ) -> list[int]:
+        """The tree edges a publish on ``topic`` travels: every current
+        neighbor except the arrival edge, gated by that edge's received
+        interest summary. A missing summary, or one stamped under a
+        different epoch (the subtree behind the edge may have changed
+        shape), passes conservatively — correctness never hangs on
+        summary freshness, only efficiency does. ``always`` bypasses the
+        gate (retained replication reaches every worker)."""
+        if self.topo is None:
+            return []
+        out = []
+        ep = self.topo.epoch
+        ep_key = (ep.num, ep.boot, ep.proposer)
+        for p in self.topo.neighbors():
+            if p == exclude:
+                continue
+            if always:
+                out.append(p)
+                continue
+            stored = self._edge_summaries.get(p)
+            if stored is None or stored[2] != ep_key:
+                self.summary_passthrough_forwards += 1
+                out.append(p)
+            elif stored[0].might_match(topic):
+                out.append(p)
+            else:
+                self.summary_filtered_forwards += 1
+        return out
+
+    @staticmethod
+    def _frame_topic(frame: bytes) -> str:
+        """The topic of a raw PUBLISH frame (intermediate tree hops gate
+        re-forwarding on it); "" on any parse trouble — the caller must
+        treat that as match-everything, never as match-nothing."""
+        from .server import publish_frame_body_offset
+
+        try:
+            off = publish_frame_body_offset(frame)
+            tl = (frame[off] << 8) | frame[off + 1]
+            return frame[off + 2 : off + 2 + tl].decode("utf-8", "replace")
+        except (IndexError, ValueError):
+            return ""
+
+    def _route_stamp(self) -> dict:
+        """A fresh route header for an ORIGINATING publish: the full
+        epoch identity (two concurrent proposals can share a number, so
+        telling live from raced-by-a-re-election frames needs the exact
+        triple) plus the (origin, boot, seq) key of the suppression
+        window that makes any forwarding — matched epoch or not —
+        loop-free and deliver-at-most-once per worker."""
+        assert self.topo is not None
+        ep = self.topo.epoch
+        return {
+            "e": ep.num,
+            "eb": ep.boot,
+            "ep": ep.proposer,
+            "o": self.worker_id,
+            "b": self.boot_id,
+            "s": next(self._seq),
+        }
+
+    def _note_route(self, rt: Any) -> int:
+        """Record a routed frame's (origin, boot, seq) in the window and
+        return the routing verdict: ROUTE_NEW (deliver + re-forward),
+        ROUTE_REFORWARD (a parked copy re-routed under a strictly NEWER
+        epoch crossed a worker the original already visited — re-forward
+        down the live tree so the subtree it now heads for still heals,
+        but never re-deliver), or ROUTE_DUP (skip everything — counted,
+        never silent).
+
+        A frame whose origin is THIS incarnation is always a duplicate:
+        the origin delivered locally at publish time and never records
+        its own sends, so a replay echoing back through re-elected
+        edges (mixed-epoch trees can route a frame back to its source)
+        must stop here, not re-deliver to the origin's subscribers."""
+        try:
+            o = int(rt["o"])
+            b = int(rt.get("b", 0))
+            s = int(rt["s"])
+        except (KeyError, ValueError, TypeError):
+            return ROUTE_NEW  # unparseable header: deliver, don't suppress
+        if o == self.worker_id and b == self.boot_id:
+            self.duplicates_suppressed += 1
+            return ROUTE_DUP
+        try:
+            ep_key: Optional[tuple[int, int, int]] = (
+                int(rt["e"]), int(rt["eb"]), int(rt["ep"])
+            )
+        except (KeyError, ValueError, TypeError):
+            ep_key = None
+        verdict = self._dup.route(o, b, s, ep_key)
+        if verdict != ROUTE_NEW:
+            # delivery was suppressed either way; the REFORWARD copy
+            # still travels (that is the exactly-once-HEAL half)
+            self.duplicates_suppressed += 1
+        return verdict
+
+    def _epoch_current(self, rt: dict) -> bool:
+        """Does the frame's route header name EXACTLY the tree this
+        worker runs? Missing fields (older peers) default to matching —
+        the suppression window still backstops them."""
+        assert self.topo is not None
+        ep = self.topo.epoch
+        try:
+            return (
+                int(rt.get("e", -1)) == ep.num
+                and int(rt.get("eb", ep.boot)) == ep.boot
+                and int(rt.get("ep", ep.proposer)) == ep.proposer
+            )
+        except (ValueError, TypeError):
+            return False
+
+    def _route_frame_tree(
+        self, topic: str, frame: bytes, origin: str, clock: Any = None
+    ) -> None:
+        """Origin-side tree routing of a QoS0 v4 passthrough frame: one
+        _T_RFRAME per summary-matching edge, all carrying the same
+        (origin, boot, seq) stamp — each receiver is a distinct worker
+        and sees it once; re-forwarding fans it down the tree."""
+        edges = self._route_edges(topic, None)
+        if not edges:
+            return
+        ob = origin.encode()
+        prefix = struct.pack(">H", len(ob)) + ob
+        tracer = self._tracer()
+        traced = tracer is not None and getattr(clock, "trace_id", None) is not None
+        route = self._route_stamp()
+        payload = b""
+        if not traced:
+            rj = json.dumps(route).encode()
+            payload = prefix + struct.pack(">H", len(rj)) + rj + frame
+        for p in edges:
+            fsid = ""
+            t0 = 0.0
+            if traced:
+                # a fresh forward-span id per edge rides the route json:
+                # the receiving hop's remote_fanout span parents on it
+                fsid = tracer.new_span_id()
+                route["tid"] = clock.trace_id
+                route["sid"] = fsid
+                rj = json.dumps(route).encode()
+                payload = prefix + struct.pack(">H", len(rj)) + rj + frame
+                t0 = time.perf_counter()
+            sent = False
+            w = self._writers.get(p)
+            if w is None:  # edge briefly dark: QoS0 never parks
+                self._count_drop(p, partition=True)
+            else:
+                try:
+                    sent = self._send_nowait(p, w, _T_RFRAME, payload, qos=0)
+                except (ConnectionError, RuntimeError):
+                    self._count_drop(p)
+            if traced:
+                tracer.add_span(
+                    "forward", "cluster", clock.trace_id, fsid,
+                    clock.span_id, t0, time.perf_counter() - t0,
+                    {"peer": p, "topic": topic, "sent": bool(sent)},
+                )
+
+    def _route_packet_tree(self, pk: Packet) -> None:
+        """Origin-side tree routing of a decoded publish (QoS>0 / v5 /
+        retained): the mesh _T_PACKET encoding plus the ``rt`` route
+        header. Retained replication rides every edge unconditionally
+        (all workers must converge on the retained store); QoS>0 to a
+        SUSPECT edge parks exactly as in mesh mode — but the park holds
+        the decoded pieces, so a heal or re-election can restamp and
+        re-route it."""
+        topic = pk.topic_name
+        retain = bool(pk.fixed_header.retain)
+        edges = self._route_edges(topic, None, retain)
+        if not edges:
+            return
+        c = pk.copy(False)
+        c.protocol_version = 5
+        c.fixed_header.qos = pk.fixed_header.qos
+        c.packet_id = pk.packet_id or pk.fixed_header.qos  # encoder guard
+        body = bytearray()
+        c.publish_encode(body)
+        body_b = bytes(body)
+        qos = pk.fixed_header.qos
+        head = {
+            "origin": pk.origin,
+            "created": pk.created,
+            "expiry": pk.expiry,
+            "retain": retain,
+            "qos": qos,
+            "rt": self._route_stamp(),
+        }
+        tracer = self._tracer()
+        clock = getattr(pk, "_tclock", None)
+        traced = tracer is not None and getattr(clock, "trace_id", None) is not None
+        payload = b"" if traced else json.dumps(head).encode() + b"\x00" + body_b
+        tier_qos = 1 if retain else qos
+        for p in edges:
+            fsid = ""
+            t_f0 = 0.0
+            if traced:
+                fsid = tracer.new_span_id()
+                head["trace"] = {"tid": clock.trace_id, "sid": fsid}
+                payload = json.dumps(head).encode() + b"\x00" + body_b
+                t_f0 = time.perf_counter()
+            w = self._writers.get(p)
+            ph = self._health.get(p)
+            if tier_qos > 0 and (
+                (ph is not None and ph.state == PEER_SUSPECT)
+                or (w is None and (ph is None or ph.state != PEER_PARTITIONED))
+            ):
+                self._park_packet(p, topic, head, body_b)
+                if traced:
+                    tracer.add_span(
+                        "forward", "cluster", clock.trace_id, fsid,
+                        clock.span_id, t_f0, time.perf_counter() - t_f0,
+                        {"peer": p, "topic": topic, "parked": True},
+                    )
+                continue
+            if w is None:
+                self._count_drop(p, partition=True)
+                sent = False
+            else:
+                try:
+                    sent = self._send_nowait(p, w, _T_PACKET, payload, qos=tier_qos)
+                except (ConnectionError, RuntimeError):
+                    self._count_drop(p)
+                    sent = False
+            if traced:
+                tracer.add_span(
+                    "forward", "cluster", clock.trace_id, fsid,
+                    clock.span_id, t_f0, time.perf_counter() - t_f0,
+                    {"peer": p, "topic": topic, "sent": bool(sent)},
+                )
+            if not sent and qos > 0:
+                self.dropped_qos_forwards += 1
+
+    def _reforward_packet(
+        self, peer: int, head: dict, rt: dict, payload: bytes, frame: bytes
+    ) -> None:
+        """Intermediate-hop re-forward of a routed _T_PACKET down every
+        OTHER matching edge of the LIVE tree, with the same park
+        semantics per SUSPECT edge. A frame stamped under a different
+        tree identity (a re-election raced it mid-flight) still
+        re-forwards — dropping it would starve the whole downstream
+        subtree — it is just counted: loop safety comes from the
+        (origin, boot, seq) window, which lets each worker process a
+        frame at most once, not from epoch agreement."""
+        if not self._epoch_current(rt):
+            self.stale_epoch_frames += 1
+        topic = self._frame_topic(frame)
+        retain = bool(head.get("retain"))
+        qos = int(head.get("qos", 0) or 0)
+        tier_qos = 1 if retain else qos
+        for p in self._route_edges(topic, peer, retain or not topic):
+            w = self._writers.get(p)
+            ph = self._health.get(p)
+            if tier_qos > 0 and (
+                (ph is not None and ph.state == PEER_SUSPECT)
+                or (w is None and (ph is None or ph.state != PEER_PARTITIONED))
+            ):
+                self._park_packet(p, topic, head, frame)
+                continue
+            if w is None:
+                self._count_drop(p, partition=True)
+                if qos > 0:
+                    self.dropped_qos_forwards += 1
+                continue
+            try:
+                sent = self._send_nowait(p, w, _T_PACKET, payload, qos=tier_qos)
+            except (ConnectionError, RuntimeError):
+                self._count_drop(p)
+                sent = False
+            if not sent and qos > 0:
+                self.dropped_qos_forwards += 1
+
+    def _on_rframe(self, peer: int, payload: bytes) -> None:
+        """A tree-routed QoS0 passthrough frame: suppress duplicates,
+        re-forward VERBATIM down the live tree's other matching edges,
+        then deliver locally (trace context, when present, rides the
+        route json)."""
+        (olen,) = struct.unpack(">H", payload[:2])
+        origin = payload[2 : 2 + olen].decode()
+        off = 2 + olen
+        (rlen,) = struct.unpack(">H", payload[off : off + 2])
+        rt = json.loads(payload[off + 2 : off + 2 + rlen])
+        frame = payload[off + 2 + rlen :]
+        if not isinstance(rt, dict) or self.topo is None:
+            return
+        verdict = self._note_route(rt)
+        if verdict == ROUTE_DUP:
+            return  # already traveled through this worker
+        if not self._epoch_current(rt):
+            # raced by a re-election: counted, then re-forwarded anyway
+            # under the live tree — the suppression window (not epoch
+            # agreement) is what makes forwarding loop-safe
+            self.stale_epoch_frames += 1
+        topic = self._frame_topic(frame)
+        for p in self._route_edges(topic, peer, not topic):
+            w = self._writers.get(p)
+            if w is None:
+                self._count_drop(p, partition=True)
+                continue
+            try:
+                self._send_nowait(p, w, _T_RFRAME, payload, qos=0)
+            except (ConnectionError, RuntimeError):
+                self._count_drop(p)
+        if verdict == ROUTE_REFORWARD:
+            return  # already delivered here under an older tree
+        t0 = time.perf_counter()
+        self._deliver_frame(frame, origin)
+        if rt.get("tid"):
+            self._remote_span(
+                "remote_fanout",
+                {"tid": rt.get("tid"), "sid": rt.get("sid")},
+                t0,
+                {"from_peer": peer},
+            )
 
     # -- wire helpers ------------------------------------------------------
 
@@ -563,7 +1442,7 @@ class Cluster:
         the full buffer, and control traffic (presence/sync) never
         sheds: it gets 8x headroom and a wedged-link close instead."""
         buffered = writer.transport.get_write_buffer_size()
-        if mtype in (_T_PRESENCE, _T_SYNC):
+        if mtype in (_T_PRESENCE, _T_SYNC, _T_EPOCH, _T_SUMMARY):
             if buffered > 8 * self.MAX_PEER_BUFFER:
                 _log.warning("peer link wedged past the control cap; closing")
                 writer.transport.abort()
@@ -600,6 +1479,8 @@ class Cluster:
                         governor.note_shed()
                 return False
         writer.write(struct.pack(">IB", len(payload) + 1, mtype) + payload)
+        if mtype in _CONTROL_TYPES:
+            self.control_bytes += len(payload) + 5
         return True
 
     def _buffer_pressure(self) -> float:
@@ -651,7 +1532,23 @@ class Cluster:
         while not self._stopping:
             await asyncio.sleep(self.PING_INTERVAL_S)
             self._gossip_now()
-            for peer in set(self._writers) | set(self._health):
+            self._send_summaries()  # tree mode: the summary refresh cadence
+            if self.topo is not None:
+                # anti-entropy: a proposal flood can be LOST mid-storm
+                # (the link it rode was being severed), leaving two live
+                # fragments on different epochs forever. A 3-int DIGEST
+                # per edge per tick guarantees neighbors reconcile — the
+                # O(N) member map only moves when a digest disagrees, so
+                # the steady-state control rate stays O(degree), not
+                # O(degree * N)
+                self._announce_epoch(digest=True)
+            peers = set(self._writers) | set(self._health)
+            if self.topo is not None:
+                # tree mode: only tree EDGES carry a health clock (the
+                # reconcile pass retires ex-neighbor records; a stray
+                # non-neighbor link is closing, not aging)
+                peers &= set(self.topo.neighbors())
+            for peer in peers:
                 w = self._writers.get(peer)
                 ph = self._health_for(peer)
                 if w is not None:
@@ -660,6 +1557,7 @@ class Cluster:
                             struct.pack(">IB", 9, _T_PING)
                             + struct.pack(">d", time.perf_counter())
                         )
+                        self.control_bytes += 13
                     except (ConnectionError, RuntimeError):
                         pass  # link teardown races: aged below anyway
                 elif ph.state == PEER_UP and not ph.park:
@@ -699,28 +1597,77 @@ class Cluster:
 
     # -- pressure gossip ---------------------------------------------------
 
-    def _gossip_payload(self) -> Optional[bytes]:
+    def _local_advert(self) -> Optional[tuple[int, float, dict[str, float]]]:
+        """This worker's own advert triple: governor state code, scalar
+        pressure, and the PER-SIGNAL breakdown (ISSUE 9 satellite —
+        operators need to see WHY a subtree is hot, not just how hot).
+        The ``peers`` signal is excluded from the breakdown: it is
+        derived FROM adverts, and re-advertising it would compound."""
         governor = getattr(self.server, "overload", None)
         if governor is None:
             return None
         from .overload import _STATE_CODES
 
-        return json.dumps(
-            {
-                "s": _STATE_CODES.get(governor.state, 0),
-                "p": round(governor.pressure, 4),
-            }
-        ).encode()
+        sigs = {
+            k: round(v, 4)
+            for k, v in governor.signal_pressures.items()
+            if k != "peers"
+        }
+        return (
+            _STATE_CODES.get(governor.state, 0),
+            round(governor.pressure, 4),
+            sigs,
+        )
+
+    def _advert_payload(self, exclude: Optional[int] = None) -> Optional[bytes]:
+        """One gossip payload. Mesh mode: the local advert, broadcast
+        identically to every peer. Tree mode: the PER-SUBTREE fold — the
+        advert sent on edge E is the elementwise max of this worker's
+        posture and the live adverts received on every OTHER edge, so
+        one frame per edge per tick (O(degree) gossip volume) still
+        tells each neighbor how hot everything behind this worker is."""
+        local = self._local_advert()
+        if local is None:
+            return None
+        s, p, sigs = local
+        if self.topo is not None:
+            now = time.monotonic()
+            for peer, (ps, pp, t) in list(self._peer_adverts.items()):
+                if peer == exclude or now - t >= self.advert_ttl_s:
+                    continue
+                s = max(s, ps)
+                p = max(p, pp)
+                for k, v in self._peer_advert_sigs.get(peer, {}).items():
+                    if v > sigs.get(k, 0.0):
+                        sigs[k] = v
+        return json.dumps({"s": s, "p": p, "sig": sigs}).encode()
 
     def _gossip_now(self) -> None:
         """Advertise this worker's governor posture to every live peer
         (must run on the cluster's loop — writers are loop-affine)."""
-        payload = self._gossip_payload()
-        if payload is None:
+        if self.topo is None:
+            payload = self._advert_payload()
+            if payload is None:
+                return
+            for _peer, w in list(self._writers.items()):
+                try:
+                    w.write(
+                        struct.pack(">IB", len(payload) + 1, _T_GOSSIP) + payload
+                    )
+                    self.control_bytes += len(payload) + 5
+                except (ConnectionError, RuntimeError):
+                    continue  # link teardown races: the dial loop heals it
             return
-        for _peer, w in list(self._writers.items()):
+        for peer in self.topo.neighbors():
+            w = self._writers.get(peer)
+            if w is None:
+                continue
+            payload = self._advert_payload(exclude=peer)
+            if payload is None:
+                return
             try:
                 w.write(struct.pack(">IB", len(payload) + 1, _T_GOSSIP) + payload)
+                self.control_bytes += len(payload) + 5
             except (ConnectionError, RuntimeError):
                 continue  # link teardown races: the dial loop heals it
 
@@ -757,13 +1704,36 @@ class Cluster:
             d = json.loads(payload)
             state_code = int(d.get("s", 0))
             pressure = float(d.get("p", 0.0))
+            raw_sigs = d.get("sig")
+            sigs = (
+                {str(k): float(v) for k, v in raw_sigs.items()}
+                if isinstance(raw_sigs, dict)
+                else {}
+            )
         except (ValueError, TypeError):
             return  # a malformed advert must not kill the read loop
         self._peer_adverts[peer] = (state_code, pressure, time.monotonic())
+        if sigs:
+            self._peer_advert_sigs[peer] = sigs
         governor = getattr(self.server, "overload", None)
         sig = getattr(governor, "peer_signal", None)
         if sig is not None:
-            sig.observe(peer, state_code, pressure)
+            known = sig.signal_names()
+            sig.observe(peer, state_code, pressure, signals=sigs or None)
+            tele = getattr(self.server, "telemetry", None)
+            if tele is not None:
+                # lazily register one gauge per NEW per-signal breakdown
+                # name (the _rtt_hist idiom): operators read why a
+                # subtree is hot straight off /metrics
+                for name in sig.signal_names() - known:
+                    tele.registry.gauge(
+                        "mqtt_tpu_cluster_peer_signal_pressure",
+                        "Decayed max of one overload signal across peer "
+                        "gossip adverts (the per-signal WHY behind the "
+                        "folded peers pressure)",
+                        fn=lambda n=name, s=sig: s.signal_value(n),
+                        signal=name,
+                    )
 
     # -- presence sync -----------------------------------------------------
 
@@ -825,6 +1795,19 @@ class Cluster:
             await self._presence_wake.wait()
             self._presence_wake.clear()
             pending, self._pending_presence = self._pending_presence, set()
+            if self.topo is not None:
+                # tree mode: the same mutation stream feeds the LOCAL
+                # interest bloom instead of per-filter presence frames —
+                # a populated filter counts in once, an emptied one is a
+                # counted-bloom DELETE — and changed edge aggregates push
+                # right away (tests and subscribers shouldn't wait a
+                # whole gossip tick for routability)
+                for f in pending:
+                    populated, _inline_only = self._probe_populated(f)
+                    self._tree_update_interest(f, populated)
+                self._send_summaries()
+                await asyncio.sleep(0)
+                continue
             for f in pending:
                 populated, inline_only = self._probe_populated(f)
                 msg = json.dumps(
@@ -972,7 +1955,11 @@ class Cluster:
         verbatim (the fast path's cluster leg). A traced publish's clock
         (mqtt_tpu.tracing.PublishTrace) switches the wire type to
         _T_TFRAME so the trace context rides along, and records one
-        ``forward`` span per peer."""
+        ``forward`` span per peer. Tree mode routes along summary-gated
+        tree edges instead (_T_RFRAME, re-forwarded at every hop)."""
+        if self.topo is not None:
+            self._route_frame_tree(topic, frame, origin, clock)
+            return
         peers = self._interested_peers(topic)
         if not peers:
             return
@@ -1025,6 +2012,9 @@ class Cluster:
         topic = pk.topic_name
         if not topic or topic.startswith("$"):
             return  # $SYS is per-worker; never forwarded
+        if self.topo is not None:
+            self._route_packet_tree(pk)
+            return
         if pk.fixed_header.retain:
             peers = tuple(p for p in self._writers)
         else:
@@ -1131,6 +2121,15 @@ class Cluster:
         that silently dropped everything the moment the socket died."""
         if self._writers.get(peer) is writer:
             self._writers.pop(peer, None)
+        if self.topo is not None and not self.topo.is_neighbor(peer):
+            # tree mode: a closing NON-edge link (reconcile closed it, or
+            # a stale joiner moved on) is not an edge failure — retire
+            # the record instead of starting a health clock that would
+            # end in a bogus re-election against a live member
+            parked = self._drain_park(peer)
+            self._health.pop(peer, None)
+            self._reroute_parked(parked)
+            return
         ph = self._health_for(peer)
         if ph.state == PEER_UP:
             ph.state = PEER_SUSPECT
@@ -1181,18 +2180,41 @@ class Cluster:
                 elif mtype == _T_PACKET:
                     sep = payload.index(b"\x00")
                     head = json.loads(payload[:sep])
+                    frame = payload[sep + 1 :]
+                    rt = head.get("rt")
+                    if self.topo is not None and isinstance(rt, dict):
+                        # tree-routed: route the suppression verdict —
+                        # a DUP skips everything, a re-routed park copy
+                        # under a newer epoch re-forwards but must not
+                        # deliver twice, a new frame does both
+                        verdict = self._note_route(rt)
+                        if verdict == ROUTE_DUP:
+                            continue
+                        self._reforward_packet(peer, head, rt, payload, frame)
+                        if verdict == ROUTE_REFORWARD:
+                            continue
                     t0 = time.perf_counter()
-                    self._deliver_packet(head, payload[sep + 1 :])
+                    self._deliver_packet(head, frame)
                     tr = head.get("trace")
                     if tr:
                         self._remote_span(
                             "remote_fanout", tr, t0, {"from_peer": peer}
                         )
+                elif mtype == _T_RFRAME:
+                    self._on_rframe(peer, payload)
+                elif mtype == _T_EPOCH:
+                    self._on_epoch(peer, payload)
+                elif mtype == _T_SUMMARY:
+                    self._on_summary(peer, payload)
                 elif mtype == _T_PING:
-                    # echo verbatim; the sender computes the RTT
+                    # echo verbatim; the sender computes the RTT. The raw
+                    # write bypasses _send_nowait, so count the pong's
+                    # control bytes here (the catalog row and the drill's
+                    # O(degree) rate are defined over ping AND pong)
                     writer.write(
                         struct.pack(">IB", len(payload) + 1, _T_PONG) + payload
                     )
+                    self.control_bytes += len(payload) + 5
                 elif mtype == _T_PONG:
                     self._on_pong(peer, payload)
                 elif mtype == _T_GOSSIP:
@@ -1200,6 +2222,11 @@ class Cluster:
                 elif mtype == _T_SYNC:
                     d = json.loads(payload)
                     self._apply_sync(peer, int(d["gen"]), d.get("boot"))
+                    # tree mode: the sync's boot nonce is membership
+                    # evidence too — a moved nonce is a restarted
+                    # incarnation and forces a re-election (its stale
+                    # tree must never be resurrected)
+                    self._member_contact(peer, int(d.get("boot") or 0))
             except Exception:
                 _log.exception("cluster delivery failed (peer %d)", peer)
 
@@ -1261,13 +2288,26 @@ class Cluster:
         s._fan_out(pk, s.topics.subscribers(pk.topic_name))
 
 
-def worker_env(worker_id: int, n_workers: int, sock_dir: str) -> dict:
-    """Environment for a spawned worker process (read by __main__/stress)."""
-    return {
+def worker_env(
+    worker_id: int,
+    n_workers: int,
+    sock_dir: str,
+    topology: str = "",
+    degree: int = 0,
+) -> dict:
+    """Environment for a spawned worker process (read by __main__/stress).
+    ``topology``/``degree`` select the spanning-tree fabric mesh-wide —
+    every worker must agree, so the launcher owns the choice."""
+    env = {
         "MQTT_TPU_WORKER": str(worker_id),
         "MQTT_TPU_WORKERS": str(n_workers),
         "MQTT_TPU_CLUSTER_DIR": sock_dir,
     }
+    if topology:
+        env["MQTT_TPU_CLUSTER_TOPOLOGY"] = topology
+    if degree:
+        env["MQTT_TPU_CLUSTER_DEGREE"] = str(degree)
+    return env
 
 
 def maybe_attach_from_env(server) -> Optional[Cluster]:
@@ -1282,6 +2322,14 @@ def maybe_attach_from_env(server) -> Optional[Cluster]:
     wid = os.environ.get("MQTT_TPU_WORKER")
     if wid is None:
         return None
+    topo = os.environ.get("MQTT_TPU_CLUSTER_TOPOLOGY")
+    if topo:
+        opts = getattr(server, "options", None)
+        if opts is not None:
+            opts.cluster_topology = topo
+            degree = os.environ.get("MQTT_TPU_CLUSTER_DEGREE")
+            if degree:
+                opts.cluster_tree_degree = int(degree)
     sock_dir = os.environ.get("MQTT_TPU_CLUSTER_DIR")
     if not sock_dir:
         raise RuntimeError(
@@ -1289,9 +2337,30 @@ def maybe_attach_from_env(server) -> Optional[Cluster]:
             "cluster socket dir must be a private directory (the mesh "
             "trusts every connection on it)"
         )
-    return Cluster(
+    c = Cluster(
         server,
         int(wid),
         int(os.environ.get("MQTT_TPU_WORKERS", "1")),
         sock_dir,
     )
+    ping_s = os.environ.get("MQTT_TPU_CLUSTER_PING_S")
+    if ping_s:
+        # drill workers run the ping/gossip/health clock fast so a
+        # partition storm resolves in seconds, not minutes (instance
+        # attribute: shadows the class constant for this worker only)
+        c.PING_INTERVAL_S = float(ping_s)
+    suspect = os.environ.get("MQTT_TPU_CLUSTER_SUSPECT_PINGS")
+    if suspect:
+        # a fast ping clock needs a deeper missed-pong window on a
+        # CPU-oversubscribed drill box: N workers sharing a couple of
+        # cores stall past one ping interval routinely, and a SUSPECT
+        # threshold tuned for real links turns scheduler jitter into a
+        # perpetual re-election storm. Real cuts still sever the socket
+        # (link drop -> SUSPECT immediately), so only stall
+        # misclassification is being widened here. The flap driver
+        # derives its held-cut duration from partition_pings, so held
+        # cuts keep crossing the PARTITIONED threshold.
+        c.suspect_pings = max(1, int(suspect))
+        if c.partition_pings <= c.suspect_pings:
+            c.partition_pings = c.suspect_pings + 3
+    return c

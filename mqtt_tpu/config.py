@@ -194,6 +194,11 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "cluster_peer_health_partition_pings",
         "cluster_suspect_window_s",
         "cluster_peer_park_max_bytes",
+        # spanning-tree mesh (mqtt_tpu.mesh_topology + mqtt_tpu.cluster)
+        "cluster_topology",
+        "cluster_tree_degree",
+        "cluster_summary_bits",
+        "cluster_dup_window",
         # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates):
         # suffix parsing, device rule-table cap, differential-oracle
         # sampling cadence

@@ -28,7 +28,11 @@ behave like that hardware — reproducibly, from one seed:
   :func:`asymmetric_partition` loses one peer's return path only (the
   peer-health SUSPECT/PARTITIONED drill); :func:`lose_gossip` drops a
   seeded fraction of inbound pressure-gossip frames (the federation
-  signal's decay/TTL drill).
+  signal's decay/TTL drill); :class:`FlapPlan`/:func:`drive_link_flaps`
+  run a seeded, bounded link-flap storm over whatever link set the
+  fabric holds (all-pairs or spanning tree); :func:`partition_peers`
+  cuts a whole peer SET at once (the subtree-partition shape the tree's
+  scoped re-election exists for).
 
 - :class:`StormPlan` — a seeded publish-storm schedule (publisher ->
   topic/payload/qos sequence, deterministic from the seed) plus
@@ -312,20 +316,7 @@ def asymmetric_partition(cluster, peer: int) -> Callable[[], None]:
     unanswered pings and must walk the peer through SUSPECT (QoS>0
     forwards parked) toward PARTITIONED; a plain severed link would
     instead error the socket immediately. Returns release()."""
-    prev = cluster._rx_filter
-
-    def drop_from_peer(p: int, mtype: int, payload: bytes) -> bool:
-        if p == peer:
-            return False
-        return prev is None or prev(p, mtype, payload)
-
-    cluster._rx_filter = drop_from_peer
-
-    def release() -> None:
-        if cluster._rx_filter is drop_from_peer:
-            cluster._rx_filter = prev
-
-    return release
+    return partition_peers(cluster, {peer})
 
 
 def lose_gossip(cluster, rate: float, seed: int = 0) -> Callable[[], None]:
@@ -348,6 +339,118 @@ def lose_gossip(cluster, rate: float, seed: int = 0) -> Callable[[], None]:
 
     def release() -> None:
         if cluster._rx_filter is drop_gossip:
+            cluster._rx_filter = prev
+
+    return release
+
+
+@dataclass
+class FlapPlan:
+    """A seeded link-flap schedule (ISSUE 9): sever one random LIVE
+    link every ``interval_s`` (jittered) for ``duration_s``, then stop —
+    so a drill has a storm phase and a guaranteed heal phase. The plan
+    is topology-agnostic by construction: it draws from whatever link
+    set the fabric currently holds, so the same plan drives the
+    all-pairs mesh and the spanning tree (where a severed link is a
+    severed tree EDGE and the heal path includes re-election).
+
+    A plain sever heals on the next re-dial (tens of ms) — enough to
+    exercise park/replay but never the partition machinery. With
+    ``partition_rate`` > 0, that fraction of draws instead CUTS the peer
+    for ``partition_hold_s``: inbound frames from it are dropped (pongs
+    included) while the hold lasts, so the health clock walks the edge
+    through SUSPECT to PARTITIONED and, in tree mode, fires the scoped
+    re-election — a real partition storm, not just flaps. Every hold is
+    released by the end of the schedule: heal is guaranteed."""
+
+    seed: int = 0
+    interval_s: float = 0.5
+    duration_s: float = 5.0
+    jitter: float = 0.5  # +/- fraction of interval per draw
+    partition_rate: float = 0.0
+    partition_hold_s: float = 2.0
+
+
+async def drive_link_flaps(cluster, plan: FlapPlan) -> int:
+    """Run one worker's flap schedule to completion; returns the number
+    of links actually disturbed. Draws are deterministic from the seed;
+    which PEER each draw lands on depends on the live link set at that
+    instant (the healing mesh decides), so the schedule is reproducible
+    while the storm stays adversarial. The hold set is managed by ONE
+    rx filter installed for the schedule's lifetime and removed in a
+    finally — out-of-order releases can never leak a permanent cut."""
+    rng = random.Random(plan.seed)
+    disturbed = 0
+    cut: dict = {}  # peer -> hold release deadline (monotonic)
+    prev = cluster._rx_filter
+
+    def flap_filter(p: int, mtype: int, payload: bytes) -> bool:
+        if p in cut:
+            return False
+        return prev is None or prev(p, mtype, payload)
+
+    cluster._rx_filter = flap_filter
+    try:
+        deadline = time.monotonic() + plan.duration_s
+        while time.monotonic() < deadline:
+            pause = plan.interval_s * (
+                1 + plan.jitter * (2 * rng.random() - 1)
+            )
+            await _asyncio_sleep(
+                min(pause, max(0.0, deadline - time.monotonic()))
+            )
+            now = time.monotonic()
+            for p in [p for p, t in cut.items() if t <= now]:
+                del cut[p]  # hold expired: the edge may heal
+            peers = sorted(cluster._writers)
+            if not peers:
+                continue
+            peer = rng.choice(peers)
+            if rng.random() < plan.partition_rate:
+                cut[peer] = now + plan.partition_hold_s
+                sever_peer_link(cluster, peer)
+                disturbed += 1
+            elif sever_peer_link(cluster, peer):
+                disturbed += 1
+        # drain the remaining holds so the schedule ENDS healed
+        while cut:
+            now = time.monotonic()
+            horizon = max(cut.values())
+            await _asyncio_sleep(max(0.05, horizon - now))
+            now = time.monotonic()
+            for p in [p for p, t in cut.items() if t <= now]:
+                del cut[p]
+    finally:
+        if cluster._rx_filter is flap_filter:
+            cluster._rx_filter = prev
+    return disturbed
+
+
+async def _asyncio_sleep(s: float) -> None:
+    import asyncio
+
+    await asyncio.sleep(s)
+
+
+def partition_peers(cluster, peers) -> Callable[[], None]:
+    """Partition ``cluster`` from a SET of peers at once — the
+    subtree-cut shape: every inbound frame from any of them is lost
+    (pongs included) while writes keep succeeding, so the per-edge
+    health clocks walk all the cut edges through SUSPECT toward
+    PARTITIONED together and, in tree mode, the scoped re-election
+    excises the whole unreachable side. Returns release()."""
+    cut = frozenset(peers)
+    prev = cluster._rx_filter
+
+    def drop_from_cut(p: int, mtype: int, payload: bytes) -> bool:
+        if p in cut:
+            return False
+        return prev is None or prev(p, mtype, payload)
+
+    cluster._rx_filter = drop_from_cut
+
+    def release() -> None:
+        if cluster._rx_filter is drop_from_cut:
             cluster._rx_filter = prev
 
     return release

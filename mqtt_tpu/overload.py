@@ -104,21 +104,88 @@ class PeerPressureSignal:
         self._lock = InstrumentedLock("overload_peer_pressure")
         # peer -> (contribution, observed-at monotonic)
         self._peers: dict[int, tuple[float, float]] = {}
+        # peer -> advertised per-signal breakdown (ISSUE 9 satellite:
+        # gossip carries staging/outbound/memory/... individually, so an
+        # operator can see WHY a peer — or a whole subtree, in tree
+        # mode — is hot, not just how hot)
+        self._peer_signals: dict[int, dict[str, float]] = {}
         self.observations = 0
 
-    def observe(self, peer: int, state_code: int, pressure: float) -> None:
-        """Fold one gossip advert from ``peer`` into the signal."""
+    def observe(
+        self,
+        peer: int,
+        state_code: int,
+        pressure: float,
+        signals: "Optional[dict[str, float]]" = None,
+    ) -> None:
+        """Fold one gossip advert from ``peer`` into the signal; the
+        optional per-signal breakdown feeds the diagnostic gauges only —
+        the folded contribution stays the scalar max, unchanged."""
         contribution = max(
             max(0.0, float(pressure)), self.STATE_FLOORS.get(int(state_code), 0.0)
         )
         with self._lock:
             self._peers[peer] = (contribution, self.clock())
+            if signals:
+                self._peer_signals[peer] = dict(signals)
+            else:
+                # an advert WITHOUT a breakdown refreshes the decay clock
+                # (keyed on the scalar advert's stamp), so a stale stored
+                # breakdown would otherwise read at full strength forever
+                self._peer_signals.pop(peer, None)
             self.observations += 1
 
     def forget(self, peer: int) -> None:
         """Drop a peer's advert immediately (link torn down)."""
         with self._lock:
             self._peers.pop(peer, None)
+            self._peer_signals.pop(peer, None)
+
+    def _decay(self, peer: int, now: float) -> float:
+        """The linear TTL decay factor for one peer's advert (0 when
+        stale); call under the lock."""
+        rec = self._peers.get(peer)
+        if rec is None:
+            return 0.0
+        age = now - rec[1]
+        if age >= self.ttl_s:
+            return 0.0
+        return 1.0 - age / self.ttl_s
+
+    def signal_names(self) -> "set[str]":
+        """Every per-signal breakdown name seen so far (gauge
+        registration keys off it)."""
+        with self._lock:
+            out: set = set()
+            for sigs in self._peer_signals.values():
+                out.update(sigs)
+            return out
+
+    def signal_value(self, name: str) -> float:
+        """Decayed max of ONE advertised signal across peers — the
+        per-signal analog of :meth:`value` (unweighted: these gauges
+        answer 'why', the weighted fold answers 'how much')."""
+        now = self.clock()
+        worst = 0.0
+        with self._lock:
+            for peer, sigs in self._peer_signals.items():
+                v = sigs.get(name)
+                if v is not None:
+                    worst = max(worst, max(0.0, float(v)) * self._decay(peer, now))
+        return worst
+
+    def signal_values(self) -> "dict[str, float]":
+        """Every per-signal decayed max (the $SYS breakdown map)."""
+        now = self.clock()
+        out: dict[str, float] = {}
+        with self._lock:
+            for peer, sigs in self._peer_signals.items():
+                d = self._decay(peer, now)
+                for name, v in sigs.items():
+                    contrib = max(0.0, float(v)) * d
+                    if contrib > out.get(name, 0.0):
+                        out[name] = contrib
+        return out
 
     def value(self) -> float:
         """The decayed max over live adverts, scaled by ``weight`` —
@@ -522,4 +589,10 @@ class OverloadGovernor:
                 d[f"signal/{name}"] = round(v, 4)
             for name, v in self.peak_pressures.items():
                 d[f"peak/{name}"] = round(v, 4)
-            return d
+            sig = self.peer_signal
+        if sig is not None:
+            # the per-signal WHY behind the folded peers pressure
+            # (computed off the governor lock: it takes the signal's own)
+            for name, v in sig.signal_values().items():
+                d[f"peers_signal/{name}"] = round(v, 4)
+        return d

@@ -281,6 +281,19 @@ class Options:
     cluster_suspect_window_s: float = 0.0
     # byte budget of each SUSPECT peer's park buffer (oldest spill first)
     cluster_peer_park_max_bytes: int = 1 << 20
+    # mesh topology (ISSUE 9): "mesh" keeps the PR 5 all-pairs fabric
+    # (every worker dials every peer — fine to ~8 workers); "tree" routes
+    # over the epoch-stamped spanning tree mqtt_tpu.mesh_topology elects,
+    # keeping per-worker links and gossip O(degree) at 32+ workers.
+    # Mesh-wide: every worker must run the same mode.
+    cluster_topology: str = "mesh"
+    # spanning-tree branching factor (per-worker links <= degree + 1)
+    cluster_tree_degree: int = 4
+    # interest-summary bloom size in bits (per edge; must be a multiple
+    # of 8 — bigger = fewer false-positive forwards at more gossip bytes)
+    cluster_summary_bits: int = 4096
+    # (origin, boot) duplicate-suppression window in sequence numbers
+    cluster_dup_window: int = 8192
     # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates): parse
     # `$GT{...}`-style suffixes off SUBSCRIBE filters, filter fan-out by
     # payload, evaluate the compiled rule table on device inside the
@@ -464,6 +477,20 @@ class Options:
             self.cluster_peer_park_max_bytes = 1 << 20
         if self.cluster_suspect_window_s < 0:
             self.cluster_suspect_window_s = 0.0  # 0 = legacy pings knob
+        # topology knobs are config-reachable: an unknown mode falls back
+        # to the all-pairs mesh (never a refused boot), the tree degree
+        # needs >= 1 child slot, and the summary bloom must be whole
+        # bytes with enough slots to be worth probing
+        if str(self.cluster_topology).lower() not in ("mesh", "tree"):
+            self.cluster_topology = "mesh"
+        else:
+            self.cluster_topology = str(self.cluster_topology).lower()
+        if self.cluster_tree_degree < 1:
+            self.cluster_tree_degree = 4
+        if self.cluster_summary_bits < 64 or self.cluster_summary_bits % 8:
+            self.cluster_summary_bits = 4096
+        if self.cluster_dup_window < 1:
+            self.cluster_dup_window = 8192
         # predicate knobs are config-reachable: a zero/negative rule cap
         # would refuse every predicate, a negative sample means "default"
         if self.predicate_max_rules <= 0:
@@ -2838,6 +2865,11 @@ class Server:
             topics[SYS_PREFIX + "/broker/cluster/replayed_forwards"] = str(
                 c.replayed_forwards
             )
+            # control-plane byte volume (the drill's O(degree) gossip
+            # assertion reads it per worker)
+            topics[SYS_PREFIX + "/broker/cluster/control_bytes"] = str(
+                c.control_bytes
+            )
             for peer, n in sorted(c.dropped_by_peer.items()):
                 topics[
                     SYS_PREFIX + f"/broker/cluster/peer/{peer}/dropped_forwards"
@@ -2846,6 +2878,36 @@ class Server:
                 topics[
                     SYS_PREFIX + f"/broker/cluster/peer/{peer}/health"
                 ] = ph.state
+            if c.topo is not None:
+                # spanning-tree gauges (ISSUE 9): epoch, live edge
+                # count, the loop/duplicate guards, and the summary
+                # routing split — everything the partition-storm drill
+                # asserts from the outside
+                t = c.topo
+                topics[SYS_PREFIX + "/broker/cluster/tree/epoch"] = str(
+                    t.epoch_num()
+                )
+                topics[SYS_PREFIX + "/broker/cluster/tree/neighbors"] = str(
+                    len(t.neighbors())
+                )
+                topics[SYS_PREFIX + "/broker/cluster/tree/links"] = str(
+                    sum(1 for p in t.neighbors() if p in c._writers)
+                )
+                topics[SYS_PREFIX + "/broker/cluster/tree/re_elections"] = str(
+                    t.re_elections
+                )
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/duplicates_suppressed"
+                ] = str(c.duplicates_suppressed)
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/stale_epoch_frames"
+                ] = str(c.stale_epoch_frames)
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/summary_filtered"
+                ] = str(c.summary_filtered_forwards)
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/summary_passthrough"
+                ] = str(c.summary_passthrough_forwards)
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,

@@ -507,11 +507,421 @@ async def run_partition(
     return out
 
 
+# -- N-worker mesh drill (spanning-tree acceptance, ISSUE 9) -----------------
+
+
+def _puback_bytes(pid: int) -> bytes:
+    return bytes((0x40, 0x02, (pid >> 8) & 0xFF, pid & 0xFF))
+
+
+class _DrillSubscriber:
+    """One per-worker drill subscriber: pinned to the worker's private
+    port, subscribed ``drill/#`` QoS1, counting every delivered payload
+    (the duplicate/loss ledger) and PUBACKing QoS1 deliveries so
+    inflight windows never wedge the read."""
+
+    def __init__(self, worker: int) -> None:
+        self.worker = worker
+        self.counts: dict = {}
+        self.reader = None
+        self.writer = None
+        self._task = None
+
+    async def start(self, host: str, port: int) -> None:
+        self.reader, self.writer = await asyncio.open_connection(host, port)
+        self.writer.write(_connect_bytes(f"drill-sub-{self.worker}", version=4))
+        await self.writer.drain()
+        assert await _read_packet_type(self.reader) == CONNACK
+        self.writer.write(
+            encode_packet(
+                Packet(
+                    fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+                    protocol_version=4,
+                    packet_id=1,
+                    filters=[Subscription(filter="drill/#", qos=1)],
+                )
+            )
+        )
+        await self.writer.drain()
+        assert await _read_packet_type(self.reader) == SUBACK
+        self._task = asyncio.get_running_loop().create_task(
+            self._collect(), name=f"drill-sub-{self.worker}"
+        )
+
+    async def _collect(self) -> None:
+        buf = bytearray()
+        while True:
+            data = await self.reader.read(65536)
+            if not data:
+                return
+            buf += data
+            frames, consumed = _scan_frames(buf)
+            for first, bs, be in frames:
+                if (first >> 4) != PUBLISH:
+                    continue
+                qos = (first >> 1) & 3
+                body = bytes(buf[bs:be])
+                if len(body) < 2:
+                    continue
+                tl = (body[0] << 8) | body[1]
+                topic = body[2 : 2 + tl]
+                rest = body[2 + tl :]
+                if qos and len(rest) >= 2:
+                    pid = (rest[0] << 8) | rest[1]
+                    payload = rest[2:]
+                    self.writer.write(_puback_bytes(pid))
+                else:
+                    payload = rest
+                if topic.startswith(b"drill/"):
+                    key = bytes(payload)
+                    self.counts[key] = self.counts.get(key, 0) + 1
+            del buf[:consumed]
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except (asyncio.CancelledError, ConnectionError, OSError):
+                pass
+        if self.writer is not None:
+            self.writer.close()
+
+
+async def _drill_publish(
+    host: str, port: int, pub_id: int, tag: str, msgs: int, qos: int = 1
+) -> list:
+    """Publish ``msgs`` uniquely-tagged QoS1 payloads from one drill
+    publisher (pinned to whatever worker owns ``port``); returns the
+    payloads sent. Payloads are namespaced by PUBLISHER id, not worker,
+    so the same script against brokers of different worker counts — the
+    single-worker oracle — produces byte-identical expected sets.
+    PUBACKs are drained concurrently so the broker's inflight ledger
+    never stalls the writes — and COUNTED: the publisher holds its
+    connection open until every QoS1 publish is acked (PUBACK n proves
+    the broker fully processed publish n), so closing can never strand
+    the batch tail in a starved worker's receive buffer."""
+    reader, writer = await asyncio.open_connection(host, port)
+    sent = []
+    acked = 0
+    try:
+        writer.write(_connect_bytes(f"drill-pub-{tag}-{pub_id}", version=4))
+        await writer.drain()
+        assert await _read_packet_type(reader) == CONNACK
+
+        async def drain_acks() -> None:
+            nonlocal acked
+            try:
+                while True:
+                    if await _read_packet_type(reader) == 4:  # PUBACK
+                        acked += 1
+            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+                pass
+
+        ack_task = asyncio.get_running_loop().create_task(drain_acks())
+        for i in range(msgs):
+            payload = f"{tag}:{pub_id}:{i}".encode()
+            writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, qos=qos),
+                        protocol_version=4,
+                        topic_name=f"drill/{tag}/{pub_id}",
+                        packet_id=(i % 65535) + 1 if qos else 0,
+                        payload=payload,
+                    )
+                )
+            )
+            sent.append(payload)
+            if i % 16 == 15:
+                await writer.drain()
+        await writer.drain()
+        # block on full acknowledgement, not a fixed grace sleep: on a
+        # CPU-oversubscribed box the broker can take seconds to read the
+        # tail of the blast, and an early close races its read loop
+        deadline = time.perf_counter() + (60.0 if qos else 1.0)
+        while qos and acked < msgs and time.perf_counter() < deadline:
+            await asyncio.sleep(0.05)
+        ack_task.cancel()
+    finally:
+        writer.close()
+    return sent
+
+
+def _drill_port(port: int, workers: int, worker: int) -> int:
+    """The per-worker private port (MQTT_TPU_WORKER_PORTS=1 layout); a
+    single-worker oracle broker has no private ports."""
+    return port + 1 + worker if workers > 1 else port
+
+
+async def run_mesh_drill(
+    host: str,
+    port: int,
+    workers: int,
+    storm_msgs: int = 40,
+    storm_publishers: int = 4,
+    verify_msgs: int = 20,
+    verify_publishers: int = 4,
+    settle_s: float = 3.0,
+    verify_timeout_s: float = 30.0,
+    scrape: bool = True,
+) -> dict:
+    """The N-worker mesh acceptance drill (``--mesh-drill``), run
+    against a broker started with ``--workers N`` (+ ``--topology tree
+    --flap-peer-s S --flap-for-s T`` for the partition-storm leg and
+    env ``MQTT_TPU_WORKER_PORTS=1`` for the per-worker pinning):
+
+    1. one subscriber per worker on its private port (``drill/#`` QoS1);
+    2. STORM: publishers pinned across workers blast unique QoS1
+       payloads while the launcher's link flaps cut tree edges;
+    3. HEAL: the flap schedule ends (``--flap-for-s``) and the drill
+       BLOCKS on observed convergence — every worker's links match its
+       wanted set, parks drained, one epoch mesh-wide (scraped, not
+       assumed; ``healed`` reports the gate's verdict);
+    4. PROBE: uniquely-tagged probes from every verify worker until
+       every subscriber has seen one from each — a healed LINK is not
+       yet a healed ROUTE (``_probe_routes``);
+    5. VERIFY: a fresh tagged batch — every subscriber must converge to
+       every verify payload, exactly once (the post-heal oracle);
+    6. a per-worker ``$SYS/broker/cluster`` scrape (links, control
+       bytes, duplicate-suppression counters — the O(degree) numbers).
+
+    Duplicates are counted across BOTH phases: the storm may lose QoS0
+    and even QoS1 forwards (counted drops — the documented best-effort
+    posture), but a payload arriving TWICE at one subscriber is a
+    routing loop or a replayed park escaping the suppression window,
+    and fails the drill."""
+    subs = [_DrillSubscriber(w) for w in range(workers)]
+    for s in subs:
+        await s.start(host, _drill_port(port, workers, s.worker))
+
+    storm_sent: list = []
+    step = max(1, workers // max(1, storm_publishers))
+    storm_tasks = [
+        _drill_publish(
+            host, _drill_port(port, workers, (p * step) % workers),
+            p, "a", storm_msgs,
+        )
+        for p in range(storm_publishers)
+    ]
+    for sent in await asyncio.gather(*storm_tasks):
+        storm_sent.extend(sent)
+
+    await asyncio.sleep(settle_s)
+    healed, heal_wait = await _wait_healed(host, port, workers)
+    route_converged, probe_attempts = await _probe_routes(
+        host, port, workers, subs,
+        [(p * step + 1) % workers for p in range(verify_publishers)],
+    )
+
+    verify_sent: list = []
+    verify_tasks = [
+        _drill_publish(
+            host, _drill_port(port, workers, (p * step + 1) % workers),
+            p, "b", verify_msgs,
+        )
+        for p in range(verify_publishers)
+    ]
+    for sent in await asyncio.gather(*verify_tasks):
+        verify_sent.extend(sent)
+
+    want = set(verify_sent)
+    deadline = time.perf_counter() + verify_timeout_s
+    while time.perf_counter() < deadline:
+        if all(want <= set(s.counts) for s in subs):
+            break
+        await asyncio.sleep(0.1)
+
+    report: dict = {
+        "workers": workers,
+        "storm_sent": len(storm_sent),
+        # the heal-convergence gate the verify phase ran behind: False
+        # means the mesh never quiesced and the verify numbers below
+        # are storm numbers, not post-heal numbers
+        "healed": healed,
+        "heal_wait_s": round(heal_wait, 1),
+        # the route-convergence gate behind the heal gate: False means
+        # some (verify worker -> subscriber) route never carried a probe
+        "route_converged": route_converged,
+        "route_probe_attempts": probe_attempts,
+        "verify_sent": len(verify_sent),
+        "verify_complete": all(want <= set(s.counts) for s in subs),
+        "verify_missing": {
+            s.worker: len(want - set(s.counts)) for s in subs
+            if want - set(s.counts)
+        },
+        # a count > 1 for any payload at any subscriber = a duplicate
+        # delivery (loop / double-replay): the drill's zero assertion
+        "dup_deliveries": sum(
+            n - 1 for s in subs for n in s.counts.values() if n > 1
+        ),
+        "received_total": sum(sum(s.counts.values()) for s in subs),
+        # the oracle comparison key: per-subscriber verify-phase
+        # anomalies. complete + no dups + equal expected sets means the
+        # delivered multisets are IDENTICAL to any other green run of
+        # the same script — in particular the single-worker oracle's
+        "verify_anomalies": {
+            s.worker: {
+                "missing": len(want - set(s.counts)),
+                "dups": sum(
+                    n - 1
+                    for k, n in s.counts.items()
+                    if k in want and n > 1
+                ),
+            }
+            for s in subs
+            if (want - set(s.counts))
+            or any(n > 1 for k, n in s.counts.items() if k in want)
+        },
+    }
+    for s in subs:
+        await s.stop()
+    if scrape:
+        # the O(degree) gossip claim is about the steady-state per-worker
+        # control-plane RATE, not cumulative bytes (a storm's election
+        # floods are history, and both legs run different wall clocks):
+        # sample control_bytes twice across a quiesced window and report
+        # bytes/s per worker. The window swamps the 1s $SYS resend jitter.
+        c0 = await _scrape_workers(host, port, workers)
+        t0 = time.perf_counter()
+        await asyncio.sleep(8.0)
+        c1 = await _scrape_workers(host, port, workers)
+        elapsed = time.perf_counter() - t0
+        report["control_rate"] = {
+            w: (
+                int(c1[w]["control_bytes"]) - int(c0[w]["control_bytes"])
+            ) / elapsed
+            for w in range(workers)
+            if "control_bytes" in c0.get(w, {})
+            and "control_bytes" in c1.get(w, {})
+        }
+        report["cluster_sys"] = c1
+    return report
+
+
+async def _wait_healed(
+    host: str, port: int, workers: int, timeout_s: float = 90.0
+) -> "tuple[bool, float]":
+    """Block until the mesh reads HEALED from the outside — the drill's
+    'partition storm + heal converges' gate, polled via the per-worker
+    $SYS scrape: every worker's live link count matches its wanted set
+    (tree neighbors, or N-1 all-pairs), no park buffer still holds
+    frames, and (tree mode) every worker reports the same epoch.
+    Returns (healed, seconds waited); on timeout the caller proceeds and
+    the report carries healed=False (an assertable failure, not a
+    hang)."""
+    t0 = time.perf_counter()
+    if workers <= 1:
+        return True, 0.0
+    while time.perf_counter() - t0 < timeout_s:
+        sys_g = await _scrape_workers(host, port, workers)
+        epochs = set()
+        ok = True
+        for w in range(workers):
+            g = sys_g.get(w, {})
+            if "peers" not in g:
+                ok = False
+                break
+            if g.get("parked_forwards", "0") != "0":
+                ok = False
+                break
+            if "tree/epoch" in g:
+                epochs.add(g["tree/epoch"])
+                if g.get("tree/links") != g.get("tree/neighbors"):
+                    ok = False
+                    break
+            elif int(g["peers"]) < workers - 1:
+                ok = False
+                break
+        if ok and len(epochs) <= 1:
+            return True, time.perf_counter() - t0
+        await asyncio.sleep(1.0)
+    return False, time.perf_counter() - t0
+
+
+async def _probe_routes(
+    host: str,
+    port: int,
+    workers: int,
+    subs: "list[_DrillSubscriber]",
+    pub_workers: "list[int]",
+    timeout_s: float = 60.0,
+) -> "tuple[bool, int]":
+    """Block until every (verify worker -> subscriber) ROUTE has carried
+    a probe. A healed LINK is not yet a healed route: in all-pairs mode
+    the presence resync that re-teaches a re-dialed peer this worker's
+    filters can still be in flight when the link count converges, so a
+    verify batch sent the moment ``_wait_healed`` returns can be dropped
+    at a worker that does not yet know the remote interest (tree mode
+    forwards conservatively on stale summaries, so it converges here
+    almost immediately). Publishes one uniquely-tagged QoS1 probe per
+    verify worker per attempt — unique payloads, so a probe delivered
+    twice still counts as a real duplicate — until every subscriber has
+    seen a probe from every publisher id, then the verify batch rides
+    known-good routes. Returns (converged, attempts)."""
+    deadline = time.perf_counter() + timeout_s
+    attempt = 0
+    while time.perf_counter() < deadline:
+        await asyncio.gather(*[
+            _drill_publish(
+                host, _drill_port(port, workers, w), p, f"p{attempt}", 1
+            )
+            for p, w in enumerate(pub_workers)
+        ])
+        attempt += 1
+        # give this attempt's probes a short spread window before the
+        # next (re-)publication round
+        spread = min(time.perf_counter() + 3.0, deadline)
+        while time.perf_counter() < spread:
+            missing = False
+            for s in subs:
+                seen = {
+                    int(k.split(b":")[1].decode())
+                    for k in s.counts
+                    if k.startswith(b"p") and k.count(b":") == 2
+                }
+                if not set(range(len(pub_workers))) <= seen:
+                    missing = True
+                    break
+            if not missing:
+                return True, attempt
+            await asyncio.sleep(0.2)
+    return False, attempt
+
+
+async def _scrape_workers(host: str, port: int, workers: int) -> dict:
+    """Per-worker $SYS mesh-gauge scrape, chunked (32 concurrent
+    retained-tree reads in one burst starve each other) with one retry
+    pass for workers whose scrape came back incomplete."""
+    out: dict = {w: {} for w in range(workers)}
+
+    async def one(w: int, wait_s: float) -> None:
+        try:
+            out[w] = await _read_cluster_sys(
+                host, _drill_port(port, workers, w), wait_s=wait_s
+            )
+        except (OSError, AssertionError, asyncio.IncompleteReadError) as e:
+            out[w] = {"error": str(e)}
+
+    pending = list(range(workers))
+    for wait_s in (2.0, 4.0):  # first pass, then the retry sweep
+        for i in range(0, len(pending), 8):
+            await asyncio.gather(*(one(w, wait_s) for w in pending[i : i + 8]))
+        pending = [w for w in pending if "peers" not in out[w]]
+        if not pending:
+            break
+    return out
+
+
 def broker_main(
     address: str,
     device_matcher: bool = False,
     workers: int = 1,
     flap_peer_s: float = 0.0,
+    flap_for_s: float = 0.0,
+    flap_workers: int = 1,
+    topology: str = "",
+    degree: int = 0,
 ) -> None:
     """Run a bench broker on ``address`` until stdin closes (the bench
     driver's subprocess entry; prints READY once serving).
@@ -520,7 +930,11 @@ def broker_main(
     this process becomes the launcher, spawning one worker process per
     core slot, each binding ``address`` with SO_REUSEPORT plus a private
     per-worker port (base+1+i) for deterministic testing, all joined by
-    the unix-socket forwarding mesh."""
+    the unix-socket forwarding mesh. ``topology``/``degree`` select the
+    spanning-tree fabric mesh-wide (ISSUE 9); ``flap_for_s`` bounds the
+    link-flap storm so a drill gets a guaranteed heal phase, and
+    ``flap_workers`` spreads the flapping across the first K workers (a
+    partition STORM, not one noisy neighbor)."""
     import os
     import sys
 
@@ -528,7 +942,11 @@ def broker_main(
 
     wid_env = os.environ.get("MQTT_TPU_WORKER")
     if workers > 1 and wid_env is None:
-        _cluster_launcher(address, device_matcher, workers, flap_peer_s)
+        _cluster_launcher(
+            address, device_matcher, workers, flap_peer_s,
+            flap_for_s=flap_for_s, flap_workers=flap_workers,
+            topology=topology, degree=degree,
+        )
         return
 
     from .hooks.auth.allow_all import AllowHook
@@ -537,7 +955,20 @@ def broker_main(
     from .server import Options, Server
 
     async def main() -> None:
-        srv = Server(Options(device_matcher=device_matcher))
+        opt_kw = {}
+        sys_s = os.environ.get("MQTT_TPU_SYS_RESEND_S")
+        if sys_s:
+            # drill workers re-publish $SYS fast so the final scrape
+            # reads fresh counters, not 30s-old ones
+            opt_kw["sys_topic_resend_interval"] = int(sys_s)
+        if os.environ.get("MQTT_TPU_OVERLOAD_CONTROL") == "0":
+            # the mesh drill isolates ROUTING correctness: on a
+            # CPU-oversubscribed runner the governor legitimately SHEDs
+            # QoS1 publishes at the origin (invisible to the drill's v4
+            # publishers — v4 PUBACK has no reason code), which reads as
+            # a routing loss when it is the overload plane doing its job
+            opt_kw["overload_control"] = False
+        srv = Server(Options(device_matcher=device_matcher, **opt_kw))
         srv.add_hook(AllowHook())
         clustered = wid_env is not None
         srv.add_listener(
@@ -558,12 +989,35 @@ def broker_main(
             await cluster.start()
         flap_task = None
         if cluster is not None and flap_peer_s > 0:
-            # chaos self-injection (the --partition drill's server side):
-            # this worker severs one seeded-random live peer link every
-            # interval, so the mesh spends the whole run healing
-            from .faults import sever_peer_link
+            # chaos self-injection (the --partition / --mesh-drill server
+            # side): this worker severs one seeded-random live link every
+            # interval — bounded by --flap-for-s (storm then heal) or
+            # unbounded for the liveness-only partition drill
+            from .faults import FlapPlan, drive_link_flaps, sever_peer_link
 
             async def _flap_loop() -> None:
+                if flap_for_s > 0:
+                    import os as _os
+
+                    await drive_link_flaps(
+                        cluster,
+                        FlapPlan(
+                            seed=1234 + cluster.worker_id,
+                            interval_s=flap_peer_s,
+                            duration_s=flap_for_s,
+                            # a third of the draws are HELD cuts long
+                            # enough to cross the partition threshold:
+                            # re-elections actually fire mid-storm
+                            partition_rate=float(
+                                _os.environ.get(
+                                    "MQTT_TPU_FLAP_PARTITION_RATE", "0.34"
+                                )
+                            ),
+                            partition_hold_s=cluster.PING_INTERVAL_S
+                            * (cluster.partition_pings + 2),
+                        ),
+                    )
+                    return
                 import random as _random
 
                 rng = _random.Random(1234 + cluster.worker_id)
@@ -590,10 +1044,19 @@ def broker_main(
 
 
 def _cluster_launcher(
-    address: str, device_matcher: bool, workers: int, flap_peer_s: float = 0.0
+    address: str,
+    device_matcher: bool,
+    workers: int,
+    flap_peer_s: float = 0.0,
+    flap_for_s: float = 0.0,
+    flap_workers: int = 1,
+    topology: str = "",
+    degree: int = 0,
 ) -> None:
     """Spawn one worker subprocess per slot, relay READY when all workers
-    serve, and shut them down when stdin closes."""
+    serve, and shut them down when stdin closes. With
+    ``MQTT_TPU_WORKER_LOG_DIR`` set, each worker's stderr streams to
+    ``worker-N.log`` in that directory — the drill's failure artifacts."""
     import os
     import subprocess
     import sys
@@ -602,22 +1065,33 @@ def _cluster_launcher(
     from .cluster import worker_env
 
     sock_dir = tempfile.mkdtemp(prefix="mqtt-tpu-cluster-")
+    log_dir = os.environ.get("MQTT_TPU_WORKER_LOG_DIR", "")
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
     procs = []
+    logs = []
     try:
         for i in range(workers):
             env = dict(os.environ)
-            env.update(worker_env(i, workers, sock_dir))
+            env.update(worker_env(i, workers, sock_dir, topology, degree))
             cmd = [sys.executable, "-m", "mqtt_tpu.stress", "--serve",
                    "--broker", address]
             if device_matcher:
                 cmd.append("--device-matcher")
-            if flap_peer_s > 0 and i == 0:
-                # one flapping worker is a partition drill; every worker
-                # flapping is a mesh that never converges
+            if flap_peer_s > 0 and i < max(1, flap_workers):
+                # a bounded set of flapping workers is a partition drill;
+                # every worker flapping is a mesh that never converges
                 cmd += ["--flap-peer-s", str(flap_peer_s)]
+                if flap_for_s > 0:
+                    cmd += ["--flap-for-s", str(flap_for_s)]
+            stderr = None
+            if log_dir:
+                stderr = open(os.path.join(log_dir, f"worker-{i}.log"), "wb")
+                logs.append(stderr)
             procs.append(
                 subprocess.Popen(
-                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
+                    cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    stderr=stderr, env=env,
                 )
             )
         for p in procs:
@@ -631,6 +1105,11 @@ def _cluster_launcher(
                 p.wait(timeout=10)
             except Exception:
                 p.kill()
+        for f in logs:
+            try:
+                f.close()
+            except OSError:
+                pass
         import shutil
 
         shutil.rmtree(sock_dir, ignore_errors=True)
@@ -664,7 +1143,41 @@ def main() -> None:
     p.add_argument(
         "--flap-peer-s", type=float, default=0.0,
         help="serve mode: sever one random live peer link every S seconds "
-        "(the --partition drill's chaos source; worker 0 only)",
+        "(the --partition drill's chaos source; see --flap-workers)",
+    )
+    p.add_argument(
+        "--flap-for-s", type=float, default=0.0,
+        help="serve mode: stop flapping after S seconds (a bounded "
+        "partition STORM with a guaranteed heal phase — the --mesh-drill "
+        "shape); 0 = flap until shutdown",
+    )
+    p.add_argument(
+        "--flap-workers", type=int, default=1,
+        help="serve mode: how many workers run the flap schedule "
+        "(seeded independently per worker)",
+    )
+    p.add_argument(
+        "--topology", default="",
+        help="serve mode: cluster fabric — 'tree' routes over the "
+        "epoch-stamped spanning tree (mqtt_tpu.mesh_topology), empty/"
+        "'mesh' keeps the all-pairs fabric",
+    )
+    p.add_argument(
+        "--degree", type=int, default=0,
+        help="serve mode: spanning-tree branching factor (0 = default)",
+    )
+    p.add_argument(
+        "--mesh-drill", action="store_true",
+        help="N-worker mesh acceptance drill: per-worker subscribers, a "
+        "publish storm over the flapping mesh, then a post-heal verify "
+        "batch that must arrive everywhere exactly once, plus per-worker "
+        "$SYS scrapes (run the broker with --workers N --topology tree "
+        "--flap-peer-s S --flap-for-s T and MQTT_TPU_WORKER_PORTS=1)",
+    )
+    p.add_argument(
+        "--drill-workers", type=int, default=0,
+        help="--mesh-drill: worker count of the broker under test "
+        "(defaults to --workers)",
     )
     p.add_argument(
         "--sys-port", type=int, default=0,
@@ -685,7 +1198,19 @@ def main() -> None:
             device_matcher=args.device_matcher,
             workers=args.workers,
             flap_peer_s=args.flap_peer_s,
+            flap_for_s=args.flap_for_s,
+            flap_workers=args.flap_workers,
+            topology=args.topology,
+            degree=args.degree,
         )
+        return
+    if args.mesh_drill:
+        out = asyncio.run(
+            run_mesh_drill(
+                host, int(port), args.drill_workers or args.workers
+            )
+        )
+        print(json.dumps(out))
         return
     if args.partition:
         out = asyncio.run(

@@ -97,6 +97,22 @@ def split_predicate_suffix(filter: str) -> tuple[str, str]:
     return base, filter[len(m.group("base")):]
 
 
+def summary_base(filter: str) -> str:
+    """The filter as PUBLISHES match it — the key the mesh interest
+    summaries index (mqtt_tpu.mesh_topology): a ``$SHARE/<group>/...``
+    subscription strips to the inner filter (publishes arrive on the
+    inner topic space, the group is a delivery policy), and a trailing
+    MQTT+ predicate strips to its base filter (the predicate gates
+    delivery at the subscriber's worker, not routability — a remote
+    ``sensors/+/temp$GT{25}`` subscriber still needs the publish
+    forwarded before it can evaluate anything)."""
+    if is_shared_filter(filter):
+        parts = filter.split("/", 2)
+        filter = parts[2] if len(parts) > 2 else ""
+    base, _suffix = split_predicate_suffix(filter)
+    return base
+
+
 @dataclass(frozen=True)
 class Mutation:
     """One subscription mutation, delivered to trie observers.

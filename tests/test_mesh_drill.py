@@ -1,0 +1,226 @@
+"""The 32-worker spanning-tree acceptance drill (ISSUE 9, slow/nightly).
+
+Three subprocess broker fleets run the SAME seeded client script
+(mqtt_tpu.stress.run_mesh_drill — per-worker pinned subscribers, a QoS1
+publish storm over a flapping mesh, a post-heal verify batch, per-worker
+$SYS scrapes):
+
+1. ``tree``  — 32 workers on the epoch-stamped spanning tree, with a
+   partition storm (seeded link flaps + held asymmetric cuts crossing
+   the PARTITIONED threshold, so scoped re-elections fire mid-traffic);
+2. ``mesh``  — the same 32 workers and the same storm on the PR 5
+   all-pairs fabric: the measured baseline the O(degree) claims are
+   asserted AGAINST, not assumed;
+3. ``oracle`` — a single-worker broker running the identical script:
+   the delivery oracle the post-heal verify phase must match.
+
+Asserted: per-worker live link count stays <= degree+1 on the tree vs
+~N-1 all-pairs, per-worker control-plane gossip bytes stay a small
+fraction of the all-pairs baseline, the partition storm heals into ONE
+converged epoch with exactly-once park replay, zero duplicate deliveries
+and zero routing loops (the (origin, boot, seq) suppression counters are
+scraped and reported), and the verify-phase delivery multiset matches
+the single-worker oracle exactly.
+
+Worker stderr logs and the drill reports land in
+``MQTT_TPU_DRILL_ARTIFACTS`` (CI uploads that directory when the nightly
+run fails) or the test's tmp_path.
+"""
+
+import asyncio
+import json
+import os
+import socket
+import statistics
+import subprocess
+import sys
+import time
+
+import pytest
+
+from mqtt_tpu.stress import run_mesh_drill
+
+pytestmark = pytest.mark.slow
+
+WORKERS = 32
+DEGREE = 4
+PING_S = "0.5"
+
+
+def _free_base_port(span: int = WORKERS + 2) -> int:
+    """A base port with the whole private-port window free."""
+    for base in range(29010, 60000, span + 7):
+        try:
+            for off in (0, 1, span - 1):
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", base + off))
+            return base
+        except OSError:
+            continue
+    raise RuntimeError("no free port window for the drill")
+
+
+def _artifact_dir(tmp_path, leg: str) -> str:
+    root = os.environ.get("MQTT_TPU_DRILL_ARTIFACTS") or str(tmp_path)
+    d = os.path.join(root, leg)
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _launch(base: int, workers: int, topology: str, log_dir: str, flap: bool):
+    env = dict(os.environ)
+    env.update(
+        {
+            "MQTT_TPU_WORKER_PORTS": "1",
+            "MQTT_TPU_CLUSTER_PING_S": PING_S,
+            # 32 brokers on a couple of cores stall past one 0.5s ping
+            # interval all the time: widen the missed-pong window so
+            # scheduler jitter is not a partition (real cuts sever the
+            # socket and mark SUSPECT immediately regardless) — SUSPECT
+            # at 3s of silence, PARTITIONED at 4.5s, held flap cuts
+            # auto-stretch to keep crossing it
+            "MQTT_TPU_CLUSTER_SUSPECT_PINGS": "6",
+            "MQTT_TPU_SYS_RESEND_S": "1",
+            "MQTT_TPU_WORKER_LOG_DIR": log_dir,
+            # routing drill, not an overload drill: with the governor
+            # live, a CPU-starved runner SHEDs QoS1 at the origin (a
+            # silent loss to the v4 publishers) and fails verify for a
+            # reason that has nothing to do with the tree
+            "MQTT_TPU_OVERLOAD_CONTROL": "0",
+            "JAX_PLATFORMS": "cpu",
+        }
+    )
+    cmd = [
+        sys.executable, "-m", "mqtt_tpu.stress", "--serve",
+        "--broker", f"127.0.0.1:{base}", "--workers", str(workers),
+    ]
+    if topology:
+        cmd += ["--topology", topology, "--degree", str(DEGREE)]
+    if flap:
+        # 4 flapping workers x one disturbance per ~0.6s for 6s, about a
+        # third of them held cuts long enough to cross the PARTITIONED
+        # threshold: a partition storm with a guaranteed heal phase
+        cmd += ["--flap-peer-s", "0.6", "--flap-for-s", "6",
+                "--flap-workers", "4"]
+    proc = subprocess.Popen(
+        cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
+    )
+    line = proc.stdout.readline().strip()
+    if line != b"READY":
+        proc.kill()
+        raise AssertionError(f"drill broker failed to boot: {line!r}")
+    return proc
+
+
+def _stop(proc) -> None:
+    try:
+        proc.stdin.close()
+        proc.wait(timeout=120)
+    except Exception:
+        proc.kill()
+
+
+def _run_leg(tmp_path, leg: str, workers: int, topology: str, flap: bool) -> dict:
+    log_dir = _artifact_dir(tmp_path, leg)
+    base = _free_base_port()
+    proc = _launch(base, workers, topology, log_dir, flap)
+    try:
+        time.sleep(2.0)  # let the fabric link up before the storm
+        report = asyncio.run(
+            run_mesh_drill(
+                "127.0.0.1", base, workers,
+                settle_s=8.0 if flap else 2.0,
+                # generous: on a CPU-oversubscribed runner (32 broker
+                # processes on 2 cores in CI) post-heal epoch churn can
+                # park-and-replay QoS1 forwards several times over
+                verify_timeout_s=150.0,
+            )
+        )
+    finally:
+        _stop(proc)
+    with open(os.path.join(log_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+    return report
+
+
+def _gauge(report: dict, worker: int, key: str) -> int:
+    return int(report["cluster_sys"][worker].get(key, "0"))
+
+
+def test_32_worker_partition_storm_drill(tmp_path):
+    # -- leg 1: the spanning tree under a partition storm ------------------
+    tree = _run_leg(tmp_path, "tree", WORKERS, "tree", flap=True)
+    # the storm HEALED: links reconciled, parks drained, one epoch —
+    # observed from the outside before the verify batch was sent
+    assert tree["healed"], tree
+    assert tree["route_converged"], tree
+    assert tree["verify_complete"], tree["verify_missing"]
+    assert tree["dup_deliveries"] == 0, tree
+    assert tree["verify_anomalies"] == {}, tree["verify_anomalies"]
+
+    scraped = [
+        w for w in range(WORKERS) if "tree/epoch" in tree["cluster_sys"][w]
+    ]
+    assert len(scraped) >= WORKERS - 2, "too many workers unscrapable"
+    # post-heal the mesh converged on ONE epoch...
+    epochs = {_gauge(tree, w, "tree/epoch") for w in scraped}
+    assert len(epochs) == 1, f"epoch split survived the heal: {epochs}"
+    # ...the storm actually exercised the election machinery...
+    assert sum(_gauge(tree, w, "tree/re_elections") for w in scraped) > 0
+    # ...and every worker's live link count is O(degree), not O(N)
+    tree_links = [_gauge(tree, w, "tree/links") for w in scraped]
+    assert max(tree_links) <= DEGREE + 1, tree_links
+    for w in scraped:
+        assert _gauge(tree, w, "tree/neighbors") <= DEGREE + 1
+    # the loop/duplicate guards are live and scrapable (their VERDICT —
+    # zero duplicate deliveries — is asserted at the subscribers above;
+    # suppressed counts > 0 simply mean the window did real work)
+    suppressed = sum(
+        _gauge(tree, w, "tree/duplicates_suppressed") for w in scraped
+    )
+    replayed = sum(_gauge(tree, w, "replayed_forwards") for w in scraped)
+    assert suppressed >= 0 and replayed >= 0
+    tree_rates = list(tree["control_rate"].values())
+    assert len(tree_rates) >= WORKERS - 2
+
+    # -- leg 2: the all-pairs baseline under the same storm ----------------
+    mesh = _run_leg(tmp_path, "mesh", WORKERS, "", flap=True)
+    assert mesh["healed"], mesh
+    # the probe gate matters most HERE: all-pairs links converge before
+    # the presence resync re-teaches re-dialed peers the drill interest
+    assert mesh["route_converged"], mesh
+    assert mesh["verify_complete"], mesh["verify_missing"]
+    assert mesh["dup_deliveries"] == 0, mesh
+    mesh_scraped = [
+        w
+        for w in range(WORKERS)
+        if "control_bytes" in mesh["cluster_sys"][w]
+    ]
+    assert len(mesh_scraped) >= WORKERS - 2
+    # all-pairs: every worker holds ~N-1 links (a couple may be
+    # mid-re-dial at scrape time)
+    mesh_links = [
+        int(mesh["cluster_sys"][w].get("peers", "0")) for w in mesh_scraped
+    ]
+    assert statistics.median(mesh_links) >= WORKERS - 4, mesh_links
+    # the O(degree) gossip-volume claim, asserted against the MEASURED
+    # baseline: both legs sample their post-heal steady-state per-worker
+    # control-plane byte RATE over the same fixed window (cumulative
+    # bytes would compare storm histories, not the fabric — the tree
+    # pays election floods the all-pairs mesh never does). The tree
+    # rate must be a small fraction of all-pairs (< 1/3 asserted; the
+    # structural ratio is ~degree/N ≈ 1/6 at 32 workers, degree 4)
+    mesh_rates = list(mesh["control_rate"].values())
+    assert len(mesh_rates) >= WORKERS - 2
+    assert (
+        statistics.median(tree_rates) * 3
+        < statistics.median(mesh_rates)
+    ), (statistics.median(tree_rates), statistics.median(mesh_rates))
+
+    # -- leg 3: the single-worker delivery oracle --------------------------
+    oracle = _run_leg(tmp_path, "oracle", 1, "", flap=False)
+    assert oracle["verify_complete"] and oracle["dup_deliveries"] == 0
+    assert oracle["verify_anomalies"] == {}
+    # identical script, identical expected set, both anomaly-free:
+    # every tree subscriber's verify multiset IS the oracle's
+    assert tree["verify_sent"] == oracle["verify_sent"]

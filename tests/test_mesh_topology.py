@@ -1,0 +1,419 @@
+"""Property suite for mqtt_tpu.mesh_topology (ISSUE 9): the pure state
+the spanning-tree cluster routes over.
+
+The invariants that keep the mesh loop-free and duplicate-free live
+here, each hammered with seeded randomized inputs rather than a few
+hand-picked cases:
+
+- the elected tree is ACYCLIC and SPANNING for every membership view
+  (and every worker computing it from the same view agrees edge-for-edge)
+- per-worker degree stays O(degree): children <= degree, +1 for the
+  parent — the 32-worker drill's link-count bound, proved structurally
+- TreeEpoch is a strict total order, so concurrent re-elections converge
+- interest summaries can FALSE-POSITIVE but never FALSE-NEGATIVE: any
+  filter matching a topic is found by the topic's prefix probes
+- counted-bloom deletes really delete (UNSUBSCRIBE symmetry) without
+  disturbing other keys' membership
+- the (origin, boot, seq) duplicate window is exact inside its span:
+  first arrival False, every re-arrival True, fresh boots start clean
+"""
+
+import random
+
+import pytest
+
+from mqtt_tpu.mesh_topology import (
+    BloomBits,
+    CountedBloom,
+    DuplicateSuppressor,
+    ROUTE_DUP,
+    ROUTE_NEW,
+    ROUTE_REFORWARD,
+    Topology,
+    TreeEpoch,
+    compute_parents,
+    decode_members,
+    encode_members,
+    is_spanning_tree,
+    summary_key,
+    topic_keys,
+    tree_children,
+    tree_neighbors,
+)
+
+
+# -- deterministic tree election ---------------------------------------------
+
+
+class TestComputeParents:
+    @pytest.mark.parametrize("seed", range(20))
+    def test_random_views_are_acyclic_and_spanning(self, seed):
+        r = random.Random(seed)
+        n = r.randint(1, 64)
+        members = r.sample(range(200), n)
+        degree = r.randint(1, 6)
+        parents = compute_parents(members, degree)
+        assert is_spanning_tree(parents, members)
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_degree_bound_holds(self, seed):
+        """children <= degree and neighbors <= degree + 1 for every
+        worker — the O(degree) link budget the drill asserts."""
+        r = random.Random(100 + seed)
+        members = r.sample(range(500), r.randint(1, 64))
+        degree = r.randint(1, 5)
+        parents = compute_parents(members, degree)
+        for w in members:
+            assert len(tree_children(parents, w)) <= degree
+            assert len(tree_neighbors(parents, w)) <= degree + 1
+
+    def test_root_is_lowest_id(self):
+        parents = compute_parents([7, 3, 12, 5])
+        assert parents[3] is None
+        assert all(p is not None for w, p in parents.items() if w != 3)
+
+    def test_identical_across_views(self):
+        """Two workers holding the same member set compute the SAME
+        tree regardless of input order — announcements never need to
+        carry edges."""
+        r = random.Random(7)
+        members = r.sample(range(100), 32)
+        shuffled = list(members)
+        r.shuffle(shuffled)
+        assert compute_parents(members, 3) == compute_parents(shuffled, 3)
+
+    def test_single_member_is_its_own_root(self):
+        assert compute_parents([9]) == {9: None}
+
+    def test_degree_must_be_positive(self):
+        with pytest.raises(ValueError):
+            compute_parents([1, 2], 0)
+
+    def test_validator_rejects_cycles_and_forests(self):
+        assert not is_spanning_tree({1: 2, 2: 1}, [1, 2])  # cycle
+        assert not is_spanning_tree({1: None, 2: None}, [1, 2])  # 2 roots
+        assert not is_spanning_tree({1: None}, [1, 2])  # not spanning
+        assert not is_spanning_tree({}, [])  # empty is not a tree
+
+
+# -- epoch total order --------------------------------------------------------
+
+
+class TestTreeEpoch:
+    def test_strict_total_order(self):
+        """Any two distinct epochs compare strictly one way — two
+        concurrent proposals can never tie, so adoption converges."""
+        r = random.Random(3)
+        epochs = [
+            TreeEpoch(r.randint(0, 3), r.randint(0, 3), r.randint(0, 3))
+            for _ in range(100)
+        ]
+        for a in epochs:
+            for b in epochs:
+                assert (a < b) + (b < a) + (a == b) == 1
+
+    def test_counter_dominates_tiebreaks(self):
+        assert TreeEpoch(2, 0, 0) > TreeEpoch(1, 99, 99)
+        # same counter: the boot nonce then worker id break the tie
+        assert TreeEpoch(1, 5, 0) > TreeEpoch(1, 4, 9)
+        assert TreeEpoch(1, 4, 3) > TreeEpoch(1, 4, 2)
+
+
+class TestTopology:
+    def test_adopt_requires_strictly_greater(self):
+        t = Topology(0, range(4), boot_id=11)
+        assert not t.adopt(TreeEpoch(0, 0, 0), {0: 0, 1: 0})  # equal
+        assert t.adopt(TreeEpoch(1, 7, 2), {0: 11, 1: 0, 2: 7})
+        assert t.epoch == TreeEpoch(1, 7, 2)
+        assert not t.adopt(TreeEpoch(1, 7, 2), {0: 11})  # replay
+        assert t.adoptions == 1
+
+    def test_propose_always_exceeds_seen(self):
+        t = Topology(2, range(4), boot_id=5)
+        t.adopt(TreeEpoch(9, 1, 0), {0: 0, 1: 0, 2: 5, 3: 0})
+        ep = t.propose_remove(3)
+        assert ep is not None and ep > TreeEpoch(9, 1, 0)
+        assert ep.proposer == 2 and ep.boot == 5
+
+    def test_remove_is_idempotent(self):
+        t = Topology(0, range(4))
+        assert t.propose_remove(3) is not None
+        assert t.propose_remove(3) is None  # raced double-detection
+        assert t.propose_remove(0) is None  # never remove self
+        assert 3 not in t.parents()
+
+    def test_add_new_member_re_elects(self):
+        t = Topology(0, range(3))
+        ep = t.propose_add(7, boot=42)
+        assert ep is not None
+        assert 7 in t.parents()
+        assert t.members()[7] == 42
+
+    def test_moved_boot_nonce_re_elects(self):
+        """A restarted incarnation (same id, new nonce) must advance the
+        epoch — its old tree can never be resurrected."""
+        t = Topology(0, range(3))
+        assert t.propose_add(1, boot=100) is None  # first learn: no churn
+        assert t.members()[1] == 100
+        ep = t.propose_add(1, boot=200)  # restart: nonce moved
+        assert ep is not None
+        assert t.members()[1] == 200
+        assert t.propose_add(1, boot=200) is None  # steady state
+
+    def test_adoption_excluding_self_stays_routable(self):
+        t = Topology(2, range(4), boot_id=9)
+        assert t.adopt(TreeEpoch(5, 1, 0), {0: 0, 1: 0, 3: 0})
+        # the view excluded us, but the local tree keeps us present so
+        # forwarding never indexes a missing worker
+        assert 2 in t.parents()
+        ep = t.propose_self()
+        assert ep > TreeEpoch(5, 1, 0)
+        assert 2 in t.members()
+
+    def test_adopt_never_unlearns_boot_nonces(self):
+        t = Topology(0, range(3), boot_id=1)
+        t.learn_boot(1, 77)
+        assert t.adopt(TreeEpoch(1, 2, 2), {0: 1, 1: 0, 2: 0})
+        assert t.members()[1] == 77  # 0 in the announcement = unknown
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_random_protocol_runs_stay_spanning(self, seed):
+        """Whatever interleaving of adopt/add/remove/self-rejoin runs,
+        the local tree is a spanning tree of the local view."""
+        r = random.Random(seed)
+        t = Topology(0, range(8), degree=r.randint(1, 4), boot_id=3)
+        for _ in range(200):
+            op = r.randrange(4)
+            if op == 0:
+                t.propose_remove(r.randrange(8))
+            elif op == 1:
+                t.propose_add(r.randrange(12), boot=r.randrange(5))
+            elif op == 2:
+                members = {
+                    w: r.randrange(5)
+                    for w in r.sample(range(12), r.randint(1, 8))
+                }
+                t.adopt(
+                    TreeEpoch(r.randint(0, 300), r.randrange(5), r.randrange(8)),
+                    members,
+                )
+            else:
+                t.propose_self()
+            assert is_spanning_tree(t.parents(), t.members())
+            assert set(t.neighbors()) == set(
+                tree_neighbors(t.parents(), 0)
+            )
+
+
+# -- interest summaries -------------------------------------------------------
+
+
+def _rand_filter(r: random.Random) -> str:
+    levels = []
+    for _ in range(r.randint(1, 5)):
+        levels.append(r.choice(["a", "b", "c", "d", "+", "sensors", "deep"]))
+    if r.random() < 0.3:
+        levels.append("#")
+    return "/".join(levels)
+
+
+def _matching_topic(r: random.Random, filter: str) -> str:
+    """A topic the filter matches: wildcards instantiated randomly."""
+    out = []
+    for level in filter.split("/"):
+        if level == "#":
+            for _ in range(r.randint(0, 3)):
+                out.append(r.choice(["x", "y", "z"]))
+            break
+        out.append(r.choice(["x", "y", "z"]) if level == "+" else level)
+    return "/".join(out) if out else "x"
+
+
+class TestSummaryKeys:
+    def test_prefix_truncates_at_first_wildcard(self):
+        assert summary_key("a/b/c") == "a/b/c"
+        assert summary_key("a/+/c") == "a"
+        assert summary_key("a/b/#") == "a/b"
+        assert summary_key("#") is None
+        assert summary_key("+/a") is None
+
+    def test_topic_keys_are_all_prefixes(self):
+        assert topic_keys("a/b/c") == ["a", "a/b", "a/b/c"]
+
+    @pytest.mark.parametrize("seed", range(30))
+    def test_no_false_negatives(self, seed):
+        """SOUNDNESS: for any filter F and any topic T that F matches,
+        a summary containing F answers might_match(T) True. False
+        negatives would be lost cross-worker deliveries; false
+        positives only cost a conservative forward."""
+        r = random.Random(seed)
+        bloom = CountedBloom(512, k=3)
+        filters = [_rand_filter(r) for _ in range(r.randint(1, 20))]
+        for f in filters:
+            bloom.add(f)
+        bits = bloom.bits()
+        for f in filters:
+            topic = _matching_topic(r, f)
+            assert bits.might_match(topic), (f, topic)
+
+
+class TestCountedBloom:
+    def test_discard_really_deletes(self):
+        b = CountedBloom(256)
+        b.add("a/b")
+        assert b.bits().might_match("a/b/c")
+        b.discard("a/b")
+        assert not b.bits().might_match("a/b/c")
+
+    def test_refcounted_keys_survive_partial_discard(self):
+        b = CountedBloom(256)
+        b.add("a/b")
+        b.add("a/b")  # two subscribers, same prefix
+        b.discard("a/b")
+        assert b.bits().might_match("a/b")
+        b.discard("a/b")
+        assert not b.bits().might_match("a/b")
+
+    def test_discard_leaves_other_keys_alone(self):
+        r = random.Random(5)
+        b = CountedBloom(1024)
+        keep = [f"keep/{i}" for i in range(20)]
+        drop = [f"drop/{i}" for i in range(20)]
+        for f in keep + drop:
+            b.add(f)
+        for f in drop:
+            b.discard(f)
+        bits = b.bits()
+        for f in keep:
+            assert bits.might_match(f)
+
+    def test_wildcard_rooted_filters_set_match_all(self):
+        b = CountedBloom(256)
+        b.add("#")
+        assert b.bits().match_all
+        assert b.bits().might_match("anything/at/all")
+        b.discard("#")
+        assert not b.bits().match_all
+
+    def test_generation_bumps_on_every_mutation(self):
+        b = CountedBloom(256)
+        g0 = b.generation
+        b.add("x")
+        b.discard("x")
+        assert b.generation == g0 + 2
+
+    def test_saturated_slot_stays_conservative(self):
+        b = CountedBloom(64, k=1)
+        for _ in range(0x10001):
+            b._bump(3, 1)
+        b._bump(3, -1)  # saturated: the decrement is refused
+        off = 2 * 3
+        assert b._counts[off] | (b._counts[off + 1] << 8) == 0xFFFF
+
+    def test_size_must_be_whole_bytes(self):
+        with pytest.raises(ValueError):
+            CountedBloom(100)
+
+
+class TestBloomBits:
+    def test_union_is_bitwise_or(self):
+        a = CountedBloom(256)
+        a.add("a/b")
+        b = CountedBloom(256)
+        b.add("c/d")
+        u = a.bits().union(b.bits())
+        assert u.might_match("a/b") and u.might_match("c/d")
+
+    def test_union_mixed_sizes_degrades_to_match_all(self):
+        a = BloomBits.empty(256)
+        b = BloomBits.empty(512)
+        assert a.union(b).match_all  # conservative, never a lost route
+
+    def test_fill_ratio(self):
+        assert BloomBits.empty(256).fill_ratio() == 0.0
+        full = BloomBits(b"\xff" * 32, False)
+        assert full.fill_ratio() == 1.0
+
+
+# -- duplicate suppression ----------------------------------------------------
+
+
+class TestDuplicateSuppressor:
+    def test_first_seen_then_suppressed(self):
+        d = DuplicateSuppressor()
+        assert not d.seen(1, 7, 100)
+        assert d.seen(1, 7, 100)
+        assert d.seen(1, 7, 100)
+
+    def test_out_of_order_inside_window_is_exact(self):
+        r = random.Random(2)
+        d = DuplicateSuppressor(window=128)
+        seqs = list(range(1, 100))
+        r.shuffle(seqs)
+        for s in seqs:
+            assert not d.seen(3, 9, s)
+        r.shuffle(seqs)
+        for s in seqs:
+            assert d.seen(3, 9, s)
+
+    def test_behind_the_window_counts_as_seen(self):
+        d = DuplicateSuppressor(window=16)
+        assert not d.seen(1, 1, 1000)
+        assert d.seen(1, 1, 1000 - 16)  # out the back: call it seen
+
+    def test_new_boot_opens_fresh_window(self):
+        """A restarted origin's seq counter starts over; its frames must
+        not be mistaken for replays of the dead incarnation."""
+        d = DuplicateSuppressor()
+        assert not d.seen(1, 111, 5)
+        assert not d.seen(1, 222, 5)  # same origin+seq, new incarnation
+        assert d.seen(1, 222, 5)
+
+    def test_origins_are_independent(self):
+        d = DuplicateSuppressor()
+        assert not d.seen(1, 0, 9)
+        assert not d.seen(2, 0, 9)
+
+    def test_window_memory_stays_bounded(self):
+        d = DuplicateSuppressor(window=64, max_origins=8)
+        for origin in range(50):
+            d.seen(origin, 0, 1)
+        assert d.origins() <= 9  # clear-then-insert on overflow
+        d2 = DuplicateSuppressor(window=8)
+        for s in range(1, 10000):
+            d2.seen(1, 1, s)
+        # the per-origin recent set is trimmed to the window
+        assert len(d2._origins[(1, 1)][1]) <= 4 * 8
+
+    def test_newer_epoch_repeat_reforwards_never_redelivers(self):
+        """A parked copy re-routed by a re-election can cross a worker
+        the original already visited: the repeat under a strictly newer
+        epoch must travel on (ROUTE_REFORWARD) — dropping it would
+        starve the orphaned subtree the re-route exists to heal — but a
+        repeat under the SAME epoch is a loop and stops."""
+        d = DuplicateSuppressor()
+        e1, e2 = (3, 10, 0), (4, 10, 2)
+        assert d.route(1, 7, 100, e1) == ROUTE_NEW
+        assert d.route(1, 7, 100, e1) == ROUTE_DUP  # same tree: a loop
+        assert d.route(1, 7, 100, e2) == ROUTE_REFORWARD  # re-routed park
+        # the re-forward was recorded under e2: the new tree can also
+        # only carry it through here once
+        assert d.route(1, 7, 100, e2) == ROUTE_DUP
+        assert d.route(1, 7, 100, e1) == ROUTE_DUP  # older epoch: never
+
+    def test_epochless_frames_stay_plain_duplicates(self):
+        d = DuplicateSuppressor()
+        assert d.route(2, 1, 5, None) == ROUTE_NEW
+        assert d.route(2, 1, 5, None) == ROUTE_DUP
+        # an epoch-stamped re-route of a frame first seen without one
+        # still re-forwards (None compares older than any real epoch)
+        assert d.route(2, 1, 5, (1, 0, 0)) == ROUTE_REFORWARD
+
+
+# -- wire codec ---------------------------------------------------------------
+
+
+class TestMemberCodec:
+    def test_round_trip(self):
+        view = {0: 12345, 7: 0, 31: 2**40}
+        assert decode_members(encode_members(view)) == view

