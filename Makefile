@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate
+.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate lockgraph
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -22,6 +22,12 @@ lint:
 # hard-required mypy run (fails when mypy is absent)
 typecheck:
 	$(PY) -m mypy --config-file mypy.ini
+
+# extract the whole-program lock-acquisition-order graph (brokerlint
+# R9) and write exp/artifacts/lockgraph.{dot,json}; render the DOT with
+# `dot -Tsvg exp/artifacts/lockgraph.dot` when graphviz is installed
+lockgraph:
+	$(PY) -m tools.brokerlint mqtt_tpu --lock-graph exp/artifacts
 
 # gcc -fanalyzer (+ cppcheck when installed) over the native C sources
 c-gate:
@@ -46,7 +52,8 @@ test: verify
 # sustained publish-storm overload drill (tests/test_overload.py), the
 # partition-storm mesh drill against a flapping 2-worker broker
 # (tests/test_cluster.py + stress.py --partition), and the seeded
-# thread-schedule sweep (tests/test_race.py switch-interval fuzzing)
+# thread-schedule sweeps (tests/test_race.py: the switch-interval
+# fuzz plus the 200-schedule graph-guided preemption fuzzer)
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
 	  tests/test_overload.py tests/test_cluster.py tests/test_race.py \

@@ -94,7 +94,11 @@ class ClientState:
         self.inflight = Inflight()
         self.subscriptions = Subscriptions()  # filter -> Subscription (client mirror)
         self.disconnected = 0  # unix ts of disconnect, for expiry
-        self.outbound: asyncio.Queue[Packet] = asyncio.Queue(maxsize=max_writes_pending)
+        # Packet on the per-subscriber path, raw bytes on the shared
+        # QoS0 frame fast path (clients._write_loop dispatches on type)
+        self.outbound: "asyncio.Queue[Packet | bytes]" = asyncio.Queue(
+            maxsize=max_writes_pending
+        )
         self.outbound_qty = 0
         self.keepalive = DEFAULT_KEEPALIVE
         self.server_keepalive = False
@@ -389,7 +393,9 @@ class Client:
                 pk = self._decode_body(fh, body)
                 if clock is not None:
                     clock.stamp("decode")
-                    pk._tclock = clock
+                    # dynamic rider, not a Packet field: the clock never
+                    # touches the wire or dataclass equality
+                    setattr(pk, "_tclock", clock)
                 result = packet_handler(self, pk)
                 if asyncio.iscoroutine(result):
                     # deferred (staged-publish) completions: schedule now,

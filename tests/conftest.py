@@ -20,3 +20,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Lock-order witness (ISSUE 10): armed for the WHOLE session so every
+# named-lock acquisition any test provokes feeds the process-wide edge
+# set. tests/test_zz_lockwitness.py (named to sort last under
+# -p no:randomly) asserts the accumulated edges all appear in the
+# statically extracted lock graph — an unexplained runtime edge is an
+# extraction gap and fails tier-1. Cost: a disarmed-stats acquire grows
+# by one held-stack append/pop and one dict probe per held lock.
+from mqtt_tpu.utils.locked import DEFAULT_PLANE  # noqa: E402
+
+DEFAULT_PLANE.arm_witness()

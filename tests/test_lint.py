@@ -95,6 +95,30 @@ def test_r1_fires_on_await_under_sync_lock(tmp_path):
     assert fired == ["R1"]
 
 
+def test_r1_quiet_on_callback_defined_directly_in_lock_scope(tmp_path):
+    # a def sitting DIRECTLY in the with body only DEFINES the callback;
+    # its blocking call runs later, outside this lock scope (regression:
+    # _iter_scope used to prune nested defs only one level down)
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading, time
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def register(self):
+                with self._lock:
+                    def cb():
+                        time.sleep(0.1)
+                    self.on_event = cb
+        """,
+        ["R1"],
+    )
+    assert fired == []
+
+
 def test_r1_quiet_on_io_outside_lock_and_str_join(tmp_path):
     fired, _ = lint_snippet(
         tmp_path,
@@ -522,6 +546,470 @@ def test_r8_quiet_on_none_default_and_constant_tables(tmp_path):
     assert fired == []
 
 
+# -- R9: whole-program lock-order graph (project rule) -----------------------
+
+
+def run_r9(tmp_path, module_src):
+    (tmp_path / "m.py").write_text(textwrap.dedent(module_src))
+    new, _ = run(
+        [str(tmp_path / "m.py")], str(tmp_path), {},
+        {"R9": PROJECT_RULES["R9"]},
+    )
+    return new
+
+
+CYCLE_SRC = """
+    import threading
+
+    class A:
+        def __init__(self):
+            self._alpha_lock = threading.Lock()
+            self._beta_lock = threading.Lock()
+
+        def one(self):
+            with self._alpha_lock:
+                with self._beta_lock:
+                    pass
+
+        def two(self):
+            with self._beta_lock:
+                with self._alpha_lock:{pragma}
+                    pass
+"""
+
+
+def test_r9_fires_on_anonymous_lock_cycle(tmp_path):
+    findings = run_r9(tmp_path, CYCLE_SRC.format(pragma=""))
+    assert [f.rule for f in findings] and all(f.rule == "R9" for f in findings)
+    assert any("cycle" in f.msg for f in findings)
+
+
+def test_r9_quiet_on_consistent_order(tmp_path):
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._alpha_lock = threading.Lock()
+                self._beta_lock = threading.Lock()
+
+            def one(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+
+            def two(self):
+                with self._alpha_lock:
+                    with self._beta_lock:
+                        pass
+        """,
+    )
+    assert findings == []
+
+
+def test_r9_fires_on_reversed_blessed_edge(tmp_path):
+    # metrics_registry (last in LOCK_ORDER) must never wrap a governor
+    # acquisition — the scrape-path-calls-into-the-control-plane shape
+    findings = run_r9(
+        tmp_path,
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class Registry:
+            def __init__(self, gov: "Gov"):
+                self._lock = InstrumentedLock("metrics_registry")
+                self.gov = gov
+
+            def bad(self):
+                with self._lock:
+                    with self.gov._lock:
+                        pass
+
+        class Gov:
+            def __init__(self):
+                self._lock = InstrumentedLock("overload_governor")
+        """,
+    )
+    assert any("reversed" in f.msg for f in findings)
+
+
+def test_r9_propagates_one_call_level(tmp_path):
+    # the cycle only exists through the call: locked_path() holds the
+    # gate lock while _touch() takes the inner one
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class B:
+            def __init__(self):
+                self._gate_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def locked_path(self):
+                with self._gate_lock:
+                    self._touch()
+
+            def _touch(self):
+                with self._inner_lock:
+                    pass
+
+            def reverse(self):
+                with self._inner_lock:
+                    with self._gate_lock:
+                        pass
+        """,
+    )
+    assert any(f.rule == "R9" and "cycle" in f.msg for f in findings)
+
+
+def test_r9_param_named_rlock_reentry_is_not_a_cycle(tmp_path):
+    # a parameter-named RLock resolves to EVERY name it can carry; one
+    # scope still holds exactly one of them, so legal same-instance
+    # re-entry through a helper must not fabricate cross-name edges
+    # between the alternatives (review regression — the TopicsIndex
+    # lock_name shape)
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class Trie:
+            def __init__(self, lock_name: str = "topics_trie") -> None:
+                self._lock = InstrumentedLock(lock_name, rlock=True)
+
+            def outer(self):
+                with self._lock:
+                    self.inner()
+
+            def inner(self):
+                with self._lock:
+                    pass
+
+        def make_remote():
+            return Trie(lock_name="cluster_remote_trie")
+        """,
+    )
+    assert findings == []
+
+
+def test_r9_lock_graph_export_survives_syntax_error(tmp_path):
+    # a committed syntax error must surface as the PARSE finding, not
+    # crash the --lock-graph export mid-JSON (review regression)
+    (tmp_path / "ok.py").write_text("import threading\n")
+    (tmp_path / "broken.py").write_text("def oops(:\n")
+    out = tmp_path / "artifacts"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint", str(tmp_path),
+         "--root", str(tmp_path), "--baseline", str(tmp_path / "bl.json"),
+         "--json", "--lock-graph", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 1  # the PARSE finding fails the run...
+    payload = json.loads(r.stdout)  # ...but the JSON is still complete
+    assert any(f["rule"] == "PARSE" for f in payload["findings"])
+    assert (out / "lockgraph.json").exists()
+
+
+def test_r9_callback_defined_inside_with_block_is_not_held(tmp_path):
+    # a def nested INSIDE the with block runs later, not under the
+    # lock: no phantom edge, no false cycle (review regression — the
+    # first fix only covered the call-propagation path)
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class G:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def register(self):
+                with self._outer_lock:
+                    def cb():
+                        with self._inner_lock:
+                            pass
+                    self.on_event = cb
+
+            def legit(self):
+                with self._inner_lock:
+                    with self._outer_lock:
+                        pass
+        """,
+    )
+    assert findings == []
+
+
+def test_r9_module_level_with_statements_are_scanned(tmp_path):
+    # module-scope lock nesting executes at import time and is part of
+    # the whole-program order; a reversed nesting elsewhere is a real
+    # AB-BA cycle (review regression — module body used to be skipped)
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        _g_lock = threading.Lock()
+        _h_lock = threading.Lock()
+
+        with _g_lock:
+            with _h_lock:
+                pass
+
+        def reverse():
+            with _h_lock:
+                with _g_lock:
+                    pass
+        """,
+    )
+    assert any(f.rule == "R9" and "cycle" in f.msg for f in findings)
+
+
+def test_r9_scans_duplicate_class_names_in_every_file(tmp_path):
+    # two modules defining the same class name: BOTH bodies must be
+    # scanned — a cycle in the second must not hide behind the first
+    # (review regression: first-definition-wins used to skip it)
+    (tmp_path / "first.py").write_text(textwrap.dedent(
+        """
+        class Dup:
+            def harmless(self):
+                return 1
+        """
+    ))
+    (tmp_path / "second.py").write_text(textwrap.dedent(
+        """
+        import threading
+
+        class Dup:
+            def __init__(self):
+                self._p_lock = threading.Lock()
+                self._q_lock = threading.Lock()
+
+            def one(self):
+                with self._p_lock:
+                    with self._q_lock:
+                        pass
+
+            def two(self):
+                with self._q_lock:
+                    with self._p_lock:
+                        pass
+        """
+    ))
+    new, _ = run(
+        [str(tmp_path / "first.py"), str(tmp_path / "second.py")],
+        str(tmp_path), {}, {"R9": PROJECT_RULES["R9"]},
+    )
+    assert any(f.rule == "R9" and "cycle" in f.msg for f in new)
+
+
+def test_r9_unblessed_lock_baseline_keys_are_per_lock(tmp_path):
+    # baselining ONE unblessed lock must not suppress a DIFFERENT one in
+    # the same file (review regression: empty context collapsed them)
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent(
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class C:
+            def __init__(self):
+                self._lock = InstrumentedLock("first_unblessed")
+        """
+    ))
+    rules = {"R9": PROJECT_RULES["R9"]}
+    new, _ = run([str(mod)], str(tmp_path), {}, rules)
+    bl = tmp_path / "bl.json"
+    save_baseline(str(bl), new)
+    mod.write_text(textwrap.dedent(
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class C:
+            def __init__(self):
+                self._lock = InstrumentedLock("first_unblessed")
+                self._other_lock = InstrumentedLock("second_unblessed")
+        """
+    ))
+    new2, old2 = run(
+        [str(mod)], str(tmp_path), {}, rules, baseline=load_baseline(str(bl))
+    )
+    assert any("second_unblessed" in f.msg for f in new2), new2
+    assert all("first_unblessed" not in f.msg for f in new2)
+
+
+def test_r9_fires_on_unblessed_named_lock(tmp_path):
+    findings = run_r9(
+        tmp_path,
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class C:
+            def __init__(self):
+                self._lock = InstrumentedLock("nobody_blessed_me")
+        """,
+    )
+    assert any("LOCK_ORDER" in f.msg for f in findings)
+
+
+def test_r9_multi_item_with_orders_left_to_right(tmp_path):
+    # `with a, b:` acquires left-to-right; reversed nesting elsewhere is
+    # a genuine AB-BA cycle and must fire (review regression)
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class E:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock, self._b_lock:
+                    pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """,
+    )
+    assert any(f.rule == "R9" and "cycle" in f.msg for f in findings)
+
+
+def test_r9_callback_definition_is_not_an_acquisition(tmp_path):
+    # a method that only DEFINES a callback taking a lock (the server's
+    # _trip_dump registration shape) must not be credited with that
+    # acquisition — the phantom edge would fabricate a cycle here
+    # (review regression)
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class F:
+            def __init__(self):
+                self._outer_lock = threading.Lock()
+                self._inner_lock = threading.Lock()
+
+            def register(self):
+                def cb():
+                    with self._inner_lock:
+                        pass
+                self.on_event = cb
+
+            def outer_path(self):
+                with self._outer_lock:
+                    self.register()
+
+            def legit(self):
+                with self._inner_lock:
+                    with self._outer_lock:
+                        pass
+        """,
+    )
+    assert findings == []
+
+
+def test_r9_locked_suffix_scope_counts_as_held(tmp_path):
+    findings = run_r9(
+        tmp_path,
+        """
+        import threading
+
+        class D:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._aux_lock = threading.Lock()
+
+            def _step_locked(self):
+                with self._aux_lock:
+                    pass
+
+            def other(self):
+                with self._aux_lock:
+                    with self._lock:
+                        pass
+        """,
+    )
+    assert any(f.rule == "R9" and "cycle" in f.msg for f in findings)
+
+
+def test_r9_reasoned_pragma_suppresses_and_reasonless_does_not(tmp_path):
+    ok = run_r9(
+        tmp_path,
+        CYCLE_SRC.format(
+            pragma="  # brokerlint: ok=R9 proven single-threaded in tests"
+        ),
+    )
+    # the pragma'd site is suppressed; the cycle seen from the OTHER
+    # direction still reports (the cycle genuinely still exists)
+    assert all(f.line != 16 for f in ok)
+    (tmp_path / "m.py").write_text(
+        textwrap.dedent(CYCLE_SRC.format(pragma="  # brokerlint: ok=R9"))
+    )
+    new, _ = run(
+        [str(tmp_path / "m.py")], str(tmp_path), {},
+        {"R9": PROJECT_RULES["R9"]},
+    )
+    assert any(f.rule == "PRAGMA" for f in new)
+
+
+def test_r9_baseline_and_json_round_trip(tmp_path):
+    """R9 rides the identical --json/--write-baseline machinery as
+    R1-R8: findings appear in JSON, grandfather into a baseline, and
+    vanish from the next run."""
+    mod = tmp_path / "m.py"
+    mod.write_text(textwrap.dedent(CYCLE_SRC.format(pragma="")))
+    bl = tmp_path / "bl.json"
+    base = [
+        sys.executable, "-m", "tools.brokerlint", str(mod),
+        "--root", str(tmp_path), "--baseline", str(bl),
+    ]
+    r = subprocess.run(base + ["--json"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert any(f["rule"] == "R9" for f in payload["findings"])
+    r = subprocess.run(base + ["--write-baseline"], capture_output=True,
+                       text=True, timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = subprocess.run(base + ["--json"], capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    payload = json.loads(r.stdout)
+    assert payload["findings"] == [] and payload["baselined"] > 0
+
+
+def test_lock_graph_export_artifacts(tmp_path):
+    """--lock-graph writes Graphviz + JSON artifacts for the CI upload;
+    the JSON carries the blessed order, every named lock, and the edge
+    sites."""
+    out = tmp_path / "artifacts"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint", "mqtt_tpu",
+         "--lock-graph", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    dot = (out / "lockgraph.dot").read_text()
+    assert dot.startswith("digraph lockorder")
+    data = json.loads((out / "lockgraph.json").read_text())
+    from tools.brokerlint.lockgraph import LOCK_ORDER
+
+    assert data["order"] == list(LOCK_ORDER)
+    names = {n["name"] for n in data["nodes"]}
+    assert set(LOCK_ORDER) <= names
+    assert data["cycles"] == []
+    edges = {(e["src"], e["dst"]) for e in data["edges"]}
+    assert ("topics_trie", "retained") in edges
+    assert all(e["sites"] for e in data["edges"])
+
+
 # -- pragmas and baseline ---------------------------------------------------
 
 
@@ -589,8 +1077,9 @@ def test_rule_catalog_is_complete():
     reason="mypy not installed (CI installs it; the gate is advisory locally)",
 )
 def test_mypy_gate_on_typed_core_modules():
-    """`mypy` (config: mypy.ini) must pass over the four typed core
-    modules — telemetry, overload, staging, ops/matcher."""
+    """`mypy` (config: mypy.ini) must pass over the typed core modules
+    — the full scope now includes server.py and clients.py (ISSUE 10
+    closed the last PR 4 residual)."""
     r = subprocess.run(
         [sys.executable, "-m", "mypy", "--config-file", "mypy.ini"],
         capture_output=True, text=True, timeout=300,

@@ -1,0 +1,332 @@
+"""Unit tests for the lock plane's order-verification layer (ISSUE 10):
+the runtime witness (held-set tracking, edge merge, cycle detection),
+the plane's single-flag fast path, and the seeded preemption injector.
+
+The whole-suite integration of the same machinery lives in
+tests/test_zz_lockwitness.py (witness⊆static cross-validation) and
+tests/test_race.py (graph-guided schedule fuzzing)."""
+
+import threading
+
+import pytest
+
+from mqtt_tpu.utils.locked import (
+    InstrumentedLock,
+    LockOrderViolation,
+    LockPlane,
+    LockWitness,
+    PreemptionInjector,
+)
+
+
+# -- witness held-set tracking ----------------------------------------------
+
+
+class TestWitnessHeldSet:
+    def test_nested_acquire_records_edge_and_stack(self):
+        plane = LockPlane()
+        w = plane.arm_witness()
+        a = InstrumentedLock("a", plane=plane)
+        b = InstrumentedLock("b", plane=plane)
+        with a:
+            assert w.held() == ("a",)
+            with b:
+                assert w.held() == ("a", "b")
+            assert w.held() == ("a",)
+        assert w.held() == ()
+        assert ("a", "b") in w.edges
+        assert ("b", "a") not in w.edges
+        thread, stack = w.edges[("a", "b")]
+        assert stack == ("a", "b")
+        assert thread == threading.current_thread().name
+
+    def test_non_lifo_release_drops_right_name(self):
+        w = LockWitness()
+        w.note_acquire("a")
+        w.note_acquire("b")
+        w.note_release("a")  # out of order: A released while B held
+        assert w.held() == ("b",)
+        w.note_release("b")
+        assert w.held() == ()
+
+    def test_reentrant_same_name_is_not_a_self_edge(self):
+        plane = LockPlane()
+        w = plane.arm_witness()
+        r = InstrumentedLock("re", rlock=True, plane=plane)
+        with r:
+            with r:
+                pass
+        assert w.edges == {}
+        assert w.held() == ()
+
+    def test_same_name_two_instances_is_not_a_self_edge(self):
+        # two tries sharing one stats name: name-level order has nothing
+        # to say about one name, so no (x, x) edge and no violation
+        plane = LockPlane()
+        w = plane.arm_witness()
+        t1 = InstrumentedLock("trie", plane=plane)
+        t2 = InstrumentedLock("trie", plane=plane)
+        with t1:
+            with t2:
+                pass
+        assert w.edges == {}
+        assert w.violations == []
+
+    def test_per_thread_stacks_are_independent(self):
+        w = LockWitness()
+        w.note_acquire("main-held")
+        seen = {}
+
+        def other():
+            seen["held"] = w.held()
+            w.note_acquire("other-held")
+            seen["after"] = w.held()
+            w.note_release("other-held")
+
+        t = threading.Thread(target=other, daemon=True)
+        t.start()
+        t.join(timeout=10)
+        assert seen["held"] == ()
+        assert seen["after"] == ("other-held",)
+        assert w.held() == ("main-held",)
+        assert w.edges == {}  # no thread ever held two names at once
+
+
+# -- witness cycle detection -------------------------------------------------
+
+
+class TestWitnessCycles:
+    def test_reversed_order_is_a_violation(self):
+        w = LockWitness()
+        w.note_acquire("a")
+        w.note_acquire("b")
+        w.note_release("b")
+        w.note_release("a")
+        assert w.violations == []
+        w.note_acquire("b")
+        w.note_acquire("a")  # closes a -> b -> a
+        assert len(w.violations) == 1
+        assert "a" in w.violations[0] and "b" in w.violations[0]
+
+    def test_three_party_cycle_detected(self):
+        w = LockWitness()
+        for src, dst in (("a", "b"), ("b", "c")):
+            w.note_acquire(src)
+            w.note_acquire(dst)
+            w.note_release(dst)
+            w.note_release(src)
+        assert w.violations == []
+        w.note_acquire("c")
+        w.note_acquire("a")  # a -> b -> c -> a
+        assert len(w.violations) == 1
+        assert "->" in w.violations[0]
+
+    def test_raise_on_cycle(self):
+        w = LockWitness(raise_on_cycle=True)
+        w.note_acquire("x")
+        w.note_acquire("y")
+        w.note_release("y")
+        w.note_release("x")
+        w.note_acquire("y")
+        with pytest.raises(LockOrderViolation):
+            w.note_acquire("x")
+
+    def test_raise_on_cycle_only_for_the_closing_acquire(self):
+        # an innocent never-seen edge AFTER a recorded violation must
+        # not re-raise someone else's old cycle (review regression)
+        w = LockWitness(raise_on_cycle=True)
+        w.note_acquire("x")
+        w.note_acquire("y")
+        w.note_release("y")
+        w.note_release("x")
+        w.note_acquire("y")
+        with pytest.raises(LockOrderViolation):
+            w.note_acquire("x")
+        w.note_release("y")  # x was never pushed (the acquire raised)
+        w.note_release("x")
+        w.note_acquire("c")
+        w.note_acquire("d")  # fresh edge, no cycle: must NOT raise
+        w.note_release("d")
+        w.note_release("c")
+        assert len(w.violations) == 1
+
+    def test_raise_on_cycle_through_lock_releases_inner(self):
+        # the tripwire fails the offending acquire() CLEANLY: the inner
+        # lock it just took is released, so no thread deadlocks on a
+        # lock nobody will ever release (review regression)
+        plane = LockPlane()
+        plane.arm_witness(raise_on_cycle=True)
+        a = InstrumentedLock("ra", plane=plane)
+        b = InstrumentedLock("rb", plane=plane)
+        with a:
+            with b:
+                pass
+        with b:
+            with pytest.raises(LockOrderViolation):
+                a.acquire()
+        assert not a.locked()  # the failed acquire left nothing held
+        with a:  # and the lock is still usable
+            pass
+
+    def test_diamond_is_not_a_cycle(self):
+        w = LockWitness()
+        for src, dst in (("a", "b"), ("a", "c"), ("b", "d"), ("c", "d")):
+            w.note_acquire(src)
+            w.note_acquire(dst)
+            w.note_release(dst)
+            w.note_release(src)
+        assert w.violations == []
+
+
+# -- plane fast path / arming ------------------------------------------------
+
+
+class TestPlaneArming:
+    def test_disarmed_plane_records_nothing(self):
+        plane = LockPlane()
+        lk = InstrumentedLock("quiet", plane=plane)
+        with lk:
+            pass
+        assert not plane.active
+        assert plane.stats("quiet").acquisitions == 0
+
+    def test_witness_without_stats_keeps_stats_silent(self):
+        plane = LockPlane()
+        w = plane.arm_witness()
+        assert plane.active and not plane.enabled
+        a = InstrumentedLock("w1", plane=plane)
+        b = InstrumentedLock("w2", plane=plane)
+        with a:
+            with b:
+                pass
+        assert ("w1", "w2") in w.edges
+        # stats arming is a separate refcount: witness alone must not
+        # pay the perf_counter/histogram writes
+        assert plane.stats("w1").acquisitions == 0
+
+    def test_active_flag_tracks_all_three_modes(self):
+        plane = LockPlane()
+        assert not plane.active
+        plane.arm()
+        assert plane.active and plane.enabled
+        plane.disarm()
+        assert not plane.active
+        plane.arm_witness()
+        assert plane.active
+        plane.disarm_witness()
+        assert not plane.active
+        plane.arm_fuzz(lambda name, phase: None)
+        assert plane.active
+        plane.disarm_fuzz()
+        assert not plane.active
+
+    def test_disarm_cost_is_one_flag_test(self):
+        """The disarmed acquire path must not touch witness/fuzz/stats
+        state at all — the overhead contract that lets the witness knob
+        default off in production."""
+        plane = LockPlane()
+        lk = InstrumentedLock("cheap", plane=plane)
+        calls = []
+        plane.fuzz = calls.append  # NOT via arm_fuzz: active stays False
+        with lk:
+            pass
+        assert calls == []  # fast path never consulted the hook
+        plane.fuzz = None
+
+    def test_arm_witness_escalates_raise_on_cycle(self):
+        # a caller asking for the raising tripwire must get it even when
+        # a recording witness was armed first (review regression)
+        plane = LockPlane()
+        w1 = plane.arm_witness()
+        assert not w1.raise_on_cycle
+        w2 = plane.arm_witness(raise_on_cycle=True)
+        assert w2 is w1 and w1.raise_on_cycle
+        # never de-escalates through arm_witness
+        plane.arm_witness(raise_on_cycle=False)
+        assert w1.raise_on_cycle
+
+    def test_witness_armed_mid_hold_unwinds_cleanly(self):
+        plane = LockPlane()
+        lk = InstrumentedLock("mid", plane=plane)
+        lk.acquire()  # fast path: no depth bookkeeping
+        w = plane.arm_witness()
+        lk.release()  # must not underflow or ghost-release a held name
+        assert w.held() == ()
+        with lk:
+            assert w.held() == ("mid",)
+        assert w.held() == ()
+
+
+# -- preemption injector ------------------------------------------------------
+
+
+class TestPreemptionInjector:
+    def _drive(self, seed, ops=24, name="det-thread"):
+        inj = PreemptionInjector(seed, rate=0.5, pause_s=0.0)
+        out = {}
+
+        def work():
+            for i in range(ops):
+                inj("lockA" if i % 2 else "lockB", "acquire")
+                inj("lockA" if i % 2 else "lockB", "release")
+            out["trace"] = inj.trace()[name]
+
+        t = threading.Thread(target=work, daemon=True, name=name)
+        t.start()
+        t.join(timeout=10)
+        return out["trace"]
+
+    def test_same_seed_same_thread_name_same_decisions(self):
+        assert self._drive(7) == self._drive(7)
+
+    def test_different_seed_differs(self):
+        assert self._drive(7) != self._drive(8)
+
+    def test_different_thread_name_draws_its_own_stream(self):
+        a = self._drive(7, name="det-a")
+        b = self._drive(7, name="det-b")
+        # decision logs cover identical op sequences but independent
+        # RNG streams; equality would mean the streams are shared
+        assert [(i, n, p) for i, n, p, _ in a] == [(i, n, p) for i, n, p, _ in b]
+        assert a != b
+
+    def test_reused_thread_name_continues_its_log(self):
+        # two sequential threads sharing a name: trace() must hold the
+        # COMBINED decision log, not just the second thread's (review
+        # regression: the old code replaced the list)
+        inj = PreemptionInjector(5, rate=0.5)
+
+        def work():
+            inj("lk", "acquire")
+            inj("lk", "release")
+
+        for _ in range(2):
+            t = threading.Thread(target=work, daemon=True, name="reused")
+            t.start()
+            t.join(timeout=10)
+        log = inj.trace()["reused"]
+        assert len(log) == 4
+        assert [op[0] for op in log] == [0, 1, 2, 3]  # indices continue
+
+    def test_names_filter_skips_other_locks(self):
+        inj = PreemptionInjector(3, rate=1.0, names=frozenset({"hot"}))
+        inj("cold", "acquire")
+        assert inj.trace() == {} or all(
+            not ops for ops in inj.trace().values()
+        )
+        inj("hot", "acquire")
+        ops = [o for log in inj.trace().values() for o in log]
+        assert [(o[1], o[2]) for o in ops] == [("hot", "acquire")]
+
+    def test_plane_integration_fires_both_phases(self):
+        plane = LockPlane()
+        log = []
+        plane.arm_fuzz(lambda name, phase: log.append((name, phase)))
+        lk = InstrumentedLock("fz", plane=plane)
+        with lk:
+            pass
+        plane.disarm_fuzz()
+        assert log == [("fz", "acquire"), ("fz", "release")]
+        with lk:
+            pass
+        assert len(log) == 2  # disarmed: no further calls

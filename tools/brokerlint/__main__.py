@@ -34,6 +34,10 @@ def main(argv=None) -> int:
                     help="emit findings as JSON (CI artifact format)")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--lock-graph", metavar="DIR", default=None,
+                    help="also write the extracted whole-program "
+                         "lock-order graph (R9) to DIR/lockgraph.dot and "
+                         "DIR/lockgraph.json")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -47,6 +51,27 @@ def main(argv=None) -> int:
     paths = args.paths or ["mqtt_tpu"]
     baseline_path = None if args.no_baseline else args.baseline
     new, baselined = lint_paths(paths, root=root, baseline_path=baseline_path)
+
+    if args.lock_graph is not None:
+        from .core import collect_files, load_ctx
+        from .lockgraph import extract_lock_graph
+
+        ctxs = []
+        for p in collect_files(paths, root):
+            try:
+                ctxs.append(load_ctx(p, root))
+            except SyntaxError:
+                continue  # already reported as a PARSE finding above
+        graph = extract_lock_graph(ctxs)
+        os.makedirs(args.lock_graph, exist_ok=True)
+        dot = os.path.join(args.lock_graph, "lockgraph.dot")
+        with open(dot, "w", encoding="utf-8") as f:
+            f.write(graph.to_dot())
+        gj = os.path.join(args.lock_graph, "lockgraph.json")
+        with open(gj, "w", encoding="utf-8") as f:
+            json.dump(graph.as_dict(), f, indent=1)
+            f.write("\n")
+        print(f"lock graph written: {dot} {gj}", file=sys.stderr)
 
     if args.write_baseline:
         save_baseline(args.baseline, new + baselined)

@@ -1,0 +1,992 @@
+"""Whole-program lock-acquisition-order graph extraction (ISSUE 10).
+
+The broker is a dozen named locks (``mqtt_tpu/utils/locked.py``
+``LOCK_NAMES``) plus a constellation of anonymous ``threading.Lock``s,
+and PRs 1-9 each shipped at least one review-caught lock bug. This
+module turns "we hope the acquisition order is consistent" into a
+checked property:
+
+- every lock DEFINITION is resolved to a canonical name: the
+  ``InstrumentedLock("name")`` literal, the ``LockedMap(name=...)`` /
+  ``PacketStore(name="retained")`` family (name kwarg, including
+  ``super().__init__(name=...)`` in subclasses), parameter-named locks
+  (``TopicsIndex(lock_name=...)`` resolves to the default PLUS every
+  call-site override), and raw ``threading.Lock()`` attributes, which
+  get stable anonymous names like ``ops/delta.py:DeltaMatcher._lock``;
+- every lock-held SCOPE is walked for nested acquisitions: lexical
+  ``with a: with b:`` nesting, the ``*_locked``-suffix convention
+  (the whole body runs under the class's ``_lock``), and ONE level of
+  call propagation — ``self.m()``, same-module ``f()``, and
+  ``self.attr.m()`` where ``attr``'s class is known from a constructor
+  assignment or an annotated ``__init__`` parameter (the existing R5
+  machinery, grown cross-module through attribute types);
+- the resulting directed graph (edge = "held src, acquired dst") is
+  checked against the blessed total order ``LOCK_ORDER`` below and for
+  cycles; violations surface as rule R9 findings through the normal
+  brokerlint pragma/baseline workflow, anchored at the acquisition (or
+  call) site so a reasoned ``# brokerlint: ok=R9 why`` documents every
+  deliberate exception where it lives.
+
+The runtime half lives in ``mqtt_tpu/utils/locked.py``
+(``LockWitness``): the tier-1 gate asserts every edge the witness
+observes across the suite appears in this statically extracted graph,
+so an extraction gap here fails loudly instead of rotting silently.
+
+Known honest limits (the witness gate is the backstop for all of
+them): callbacks registered under one lock and fired under another are
+not followed; locals (``task = self._tasks[k]; task._lock``) resolve
+to a per-site anonymous node unless the attribute name is unique
+project-wide; propagation is one call level deep; and cross-module
+NAME-based class resolution (base classes, annotated attribute types)
+prefers a same-file definition, else the first-indexed one — every
+class BODY is always scanned under its own file's definition, but an
+ambiguous cross-module reference may resolve to the wrong namesake.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .core import FileCtx, Finding
+from .rules import (
+    _LOCK_NAME_RE,
+    _LOCKED_SUFFIX,
+    _dotted,
+    _iter_scope,
+    _terminal_name,
+)
+
+# The blessed whole-program acquisition order, OUTERMOST FIRST: an edge
+# (a -> b) is legal iff position(a) < position(b). Every named lock
+# (utils/locked.py LOCK_NAMES) must appear here — a new named lock
+# without a blessed position is itself an R9 finding, so ordering
+# decisions are made deliberately, in review, in this file. Anonymous
+# locks participate in cycle detection but not in order checking.
+LOCK_ORDER = (
+    # control plane / registries first: these are taken at the top of
+    # call chains and may reach into the data-plane stores below
+    "overload_governor",
+    "overload_peer_pressure",
+    "matcher_breaker",
+    "clients",
+    # the tries and their retained stores: the trie lock wraps
+    # subscribe/unsubscribe/set_retained, which touch the retained
+    # PacketStore (both the local and the cluster's remote trie share
+    # the "retained" stats name)
+    "topics_trie",
+    "cluster_remote_trie",
+    "retained",
+    # observability rings/registries last: leaf locks that must never
+    # call back out into the planes above
+    "flight_ring",
+    "trace_ring",
+    "metrics_registry",
+)
+
+_LOCK_CTORS = {"Lock", "RLock", "threading.Lock", "threading.RLock"}
+
+
+@dataclass
+class LockDef:
+    """One lock attribute definition site."""
+
+    names: frozenset  # canonical name(s) this attribute can carry
+    kind: str  # "named" | "param" | "anon"
+    site: str  # "module.py:Class.attr"
+
+
+@dataclass
+class ClassInfo:
+    rel: str
+    name: str
+    bases: tuple
+    methods: dict = field(default_factory=dict)  # name -> ast node
+    lock_attrs: dict = field(default_factory=dict)  # attr -> LockDef
+    # attr -> (class name, {ctor kwarg -> literal}) for self.x = C(...)
+    # and annotated __init__ params assigned to self
+    obj_attrs: dict = field(default_factory=dict)
+    # attr -> ctor param name, for InstrumentedLock(<param>) /
+    # LockedMap-family name= params resolved per call site
+    param_locks: dict = field(default_factory=dict)
+    param_defaults: dict = field(default_factory=dict)  # param -> literal
+
+
+@dataclass(frozen=True)
+class EdgeSite:
+    path: str
+    line: int
+    context: str
+
+
+class LockGraph:
+    """The extracted graph: nodes (canonical names), edges with their
+    acquisition sites, and the definition index for the catalog."""
+
+    def __init__(self) -> None:
+        self.defs: dict[str, list[str]] = {}  # name -> definition sites
+        self.edges: dict[tuple, list[EdgeSite]] = {}
+
+    def add_def(self, name: str, site: str) -> None:
+        sites = self.defs.setdefault(name, [])
+        if site not in sites:
+            sites.append(site)
+
+    def add_edge(self, src: str, dst: str, site: EdgeSite) -> None:
+        if src == dst:
+            return  # same-name nesting is re-entrancy by convention
+        self.edges.setdefault((src, dst), []).append(site)
+
+    def nodes(self) -> list[str]:
+        out = set(self.defs)
+        for a, b in self.edges:
+            out.add(a)
+            out.add(b)
+        return sorted(out)
+
+    def named_edges(self) -> set:
+        """Edges between two NAMED locks — the witness-comparable set."""
+        order = set(LOCK_ORDER)
+        return {(a, b) for a, b in self.edges if a in order and b in order}
+
+    def cycles(self) -> list[list[str]]:
+        """Strongly connected components with >= 2 nodes (each is at
+        least one acquisition-order cycle), via iterative Tarjan."""
+        adj: dict[str, list[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on_stack: set = set()
+        stack: list[str] = []
+        sccs: list[list[str]] = []
+        counter = [0]
+
+        for root in sorted(adj):
+            if root in index:
+                continue
+            work = [(root, iter(adj[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(adj[nxt])))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        scc.append(w)
+                        if w == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(sorted(scc))
+        return sccs
+
+    def as_dict(self) -> dict:
+        order = {n: i for i, n in enumerate(LOCK_ORDER)}
+        return {
+            "order": list(LOCK_ORDER),
+            "nodes": [
+                {
+                    "name": n,
+                    "kind": "named" if n in order else "anon",
+                    "position": order.get(n),
+                    "defined": self.defs.get(n, []),
+                }
+                for n in self.nodes()
+            ],
+            "edges": [
+                {
+                    "src": a,
+                    "dst": b,
+                    "sites": [
+                        {"path": s.path, "line": s.line, "context": s.context}
+                        for s in sites
+                    ],
+                }
+                for (a, b), sites in sorted(self.edges.items())
+            ],
+            "cycles": self.cycles(),
+        }
+
+    def to_dot(self) -> str:
+        """GraphViz rendering: blessed locks ranked by order position,
+        anonymous locks dashed, cycle edges red."""
+        order = {n: i for i, n in enumerate(LOCK_ORDER)}
+        in_cycle = {n for scc in self.cycles() for n in scc}
+        lines = [
+            "digraph lockorder {",
+            '  rankdir="TB";',
+            '  node [shape=box, fontname="monospace", fontsize=10];',
+        ]
+        for n in self.nodes():
+            attrs = []
+            if n in order:
+                attrs.append(f'xlabel="#{order[n]}"')
+            else:
+                attrs.append("style=dashed")
+            if n in in_cycle:
+                attrs.append('color="red"')
+            lines.append(f'  "{n}" [{", ".join(attrs)}];')
+        for (a, b), sites in sorted(self.edges.items()):
+            attrs = [f'label="{len(sites)} site{"s" if len(sites) > 1 else ""}"']
+            if a in in_cycle and b in in_cycle:
+                attrs.append('color="red", penwidth=2')
+            elif a in order and b in order and order[a] > order[b]:
+                attrs.append('color="orange", style=bold')
+            lines.append(f'  "{a}" -> "{b}" [{", ".join(attrs)}];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+# -- extraction -------------------------------------------------------------
+
+
+def _literal_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _ctor_kwargs(call: ast.Call) -> dict:
+    out = {}
+    for kw in call.keywords:
+        if kw.arg is not None:
+            lit = _literal_str(kw.value)
+            if lit is not None:
+                out[kw.arg] = lit
+    return out
+
+
+def _init_params(cls_node: ast.ClassDef) -> tuple[dict, dict, list]:
+    """(param -> default literal, param -> annotation name, ordered
+    param names) from the class's ``__init__``."""
+    defaults: dict = {}
+    annots: dict = {}
+    names: list = []
+    for node in cls_node.body:
+        if isinstance(node, ast.FunctionDef) and node.name == "__init__":
+            args = node.args.args[1:]  # drop self
+            names = [a.arg for a in args]
+            for a in args:
+                if a.annotation is not None:
+                    ann = a.annotation
+                    # unwrap Optional[X] / "X" strings
+                    if isinstance(ann, ast.Subscript):
+                        ann = ann.slice
+                    t = _terminal_name(ann)
+                    if t is None:
+                        lit = _literal_str(ann)
+                        t = lit
+                    if t:
+                        annots[a.arg] = t
+            ds = node.args.defaults
+            for a, d in zip(args[len(args) - len(ds):], ds):
+                lit = _literal_str(d)
+                if lit is not None or (
+                    isinstance(d, ast.Constant) and d.value is None
+                ):
+                    defaults[a.arg] = lit  # None stays None (= anonymous)
+            break
+    return defaults, annots, names
+
+
+class _Project:
+    """Project-wide symbol tables feeding edge extraction."""
+
+    def __init__(self, ctxs: list[FileCtx]) -> None:
+        self.ctxs = ctxs
+        # class name -> every definition, in index order: duplicate class
+        # names across modules are all kept (and all scanned); NAME-based
+        # resolution prefers a same-file definition, else the first
+        self.classes: dict[str, list[ClassInfo]] = {}
+        self.module_funcs: dict[str, dict[str, ast.AST]] = {}
+        self.module_locks: dict[str, dict[str, str]] = {}  # rel -> var -> name
+        # (class, ctor param) -> set of literal overrides seen at call sites
+        self.ctor_overrides: dict[tuple, set] = {}
+        for ctx in ctxs:
+            self._index_file(ctx)
+        self._collect_overrides()
+
+    def cls_info(
+        self, name: Optional[str], rel: Optional[str] = None
+    ) -> Optional[ClassInfo]:
+        infos = self.classes.get(name) if name is not None else None
+        if not infos:
+            return None
+        if rel is not None:
+            for info in infos:
+                if info.rel == rel:
+                    return info
+        return infos[0]
+
+    # -- pass 1: definitions ------------------------------------------------
+
+    def _index_file(self, ctx: FileCtx) -> None:
+        funcs: dict[str, ast.AST] = {}
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault(node.name, node)
+            elif isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                d = _dotted(node.value.func)
+                if d in _LOCK_CTORS:
+                    for tgt in node.targets:
+                        if isinstance(tgt, ast.Name):
+                            self.module_locks.setdefault(ctx.rel, {})[
+                                tgt.id
+                            ] = f"{ctx.rel}:{tgt.id}"
+        self.module_funcs[ctx.rel] = funcs
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.ClassDef):
+                self._index_class(ctx, node)
+
+    def _index_class(self, ctx: FileCtx, cls_node: ast.ClassDef) -> None:
+        base_exprs = [
+            # unwrap generic bases: LockedMap[str, Packet] -> LockedMap
+            b.value if isinstance(b, ast.Subscript) else b
+            for b in cls_node.bases
+        ]
+        bases = tuple(
+            t for t in (_terminal_name(b) for b in base_exprs) if t
+        )
+        info = ClassInfo(ctx.rel, cls_node.name, bases)
+        defaults, annots, ordered = _init_params(cls_node)
+        info.param_defaults = defaults
+        for node in cls_node.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info.methods.setdefault(node.name, node)
+        anchor = f"{ctx.rel}:{cls_node.name}"
+        for meth in info.methods.values():
+            for node in ast.walk(meth):
+                if isinstance(node, ast.Assign):
+                    tgt = node.targets[0] if len(node.targets) == 1 else None
+                elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                    tgt = node.target  # self._lock: Any = ... (LockedMap)
+                else:
+                    continue
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"
+                ):
+                    continue
+                attr = tgt.attr
+                val = node.value
+                if isinstance(val, ast.Call):
+                    d = _dotted(val.func)
+                    if d in _LOCK_CTORS:
+                        info.lock_attrs[attr] = LockDef(
+                            frozenset([f"{anchor}.{attr}"]),
+                            "anon",
+                            f"{anchor}.{attr}",
+                        )
+                        continue
+                    if d is not None and d.split(".")[-1] == "InstrumentedLock":
+                        if val.args:
+                            lit = _literal_str(val.args[0])
+                            if lit is not None:
+                                info.lock_attrs[attr] = LockDef(
+                                    frozenset([lit]), "named",
+                                    f"{anchor}.{attr}",
+                                )
+                                continue
+                            pname = (
+                                val.args[0].id
+                                if isinstance(val.args[0], ast.Name)
+                                else None
+                            )
+                            if pname is not None:
+                                info.param_locks[attr] = pname
+                                continue
+                    ctor = d.split(".")[-1] if d else None
+                    if ctor and ctor[:1].isupper():
+                        info.obj_attrs[attr] = (ctor, _ctor_kwargs(val))
+                        continue
+                elif isinstance(val, ast.Name) and val.id in annots:
+                    info.obj_attrs[attr] = (annots[val.id], {})
+                elif isinstance(val, ast.IfExp):
+                    # the LockedMap shape: RLock() if name is None else
+                    # InstrumentedLock(name) — a parameter-named lock
+                    for arm in (val.body, val.orelse):
+                        if isinstance(arm, ast.Call):
+                            d = _dotted(arm.func)
+                            if (
+                                d is not None
+                                and d.split(".")[-1] == "InstrumentedLock"
+                                and arm.args
+                                and isinstance(arm.args[0], ast.Name)
+                            ):
+                                info.param_locks[attr] = arm.args[0].id
+        # LockedMap-family subclasses: super().__init__(name="clients")
+        init = info.methods.get("__init__")
+        if init is not None and "_lock" not in info.lock_attrs:
+            for node in ast.walk(init):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "__init__"
+                ):
+                    kw = _ctor_kwargs(node)
+                    if "name" in kw:
+                        info.lock_attrs["_lock"] = LockDef(
+                            frozenset([kw["name"]]), "named",
+                            f"{anchor}._lock",
+                        )
+        self.classes.setdefault(cls_node.name, []).append(info)
+
+    def _collect_overrides(self) -> None:
+        """Literal arguments at every call site of a class whose lock is
+        parameter-named: ``TopicsIndex(lock_name="cluster_remote_trie")``
+        adds that name to TopicsIndex._lock's set. Positional arguments
+        are matched through the __init__ signature."""
+        interesting: dict[str, dict[str, list]] = {}
+        for cname, infos in self.classes.items():
+            info = next((i for i in infos if i.param_locks), None)
+            if info is not None:
+                _, _, ordered = _init_params(
+                    self._class_node(info) or ast.ClassDef(
+                        name=cname, bases=[], keywords=[], body=[],
+                        decorator_list=[],
+                    )
+                )
+                interesting[cname] = {"params": ordered}
+        if not interesting:
+            return
+        for ctx in self.ctxs:
+            for node in ast.walk(ctx.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _terminal_name(node.func)
+                if name not in interesting:
+                    continue
+                ordered = interesting[name]["params"]
+                got: dict[str, str] = {}
+                for i, arg in enumerate(node.args):
+                    lit = _literal_str(arg)
+                    if lit is not None and i < len(ordered):
+                        got[ordered[i]] = lit
+                for kw in node.keywords:
+                    lit = _literal_str(kw.value)
+                    if kw.arg and lit is not None:
+                        got[kw.arg] = lit
+                for pname, lit in got.items():
+                    self.ctor_overrides.setdefault((name, pname), set()).add(
+                        lit
+                    )
+
+    def _class_node(self, info: ClassInfo) -> Optional[ast.ClassDef]:
+        for ctx in self.ctxs:
+            if ctx.rel != info.rel:
+                continue
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef) and node.name == info.name:
+                    return node
+        return None
+
+    # -- resolution ---------------------------------------------------------
+
+    def resolve_lock_attr(
+        self,
+        cls: Optional[str],
+        attr: str,
+        override: Optional[dict] = None,
+        rel: Optional[str] = None,
+    ) -> Optional[frozenset]:
+        """Canonical names for ``self.<attr>`` in class ``cls`` (walking
+        name-resolved bases; ``rel`` anchors a duplicated class name to
+        its defining file). ``override`` carries instance-level ctor
+        literals (``PacketStore(name="retained")``)."""
+        seen: set = set()
+        walked: set = {cls} if cls else set()
+        info = self.cls_info(cls, rel)
+        while info is not None and id(info) not in seen:
+            seen.add(id(info))
+            walked.add(info.name)
+            if attr in info.lock_attrs:
+                return info.lock_attrs[attr].names
+            if attr in info.param_locks:
+                pname = info.param_locks[attr]
+                names: set = set()
+                if override and pname in override:
+                    names.add(override[pname])
+                else:
+                    default = info.param_defaults.get(pname)
+                    if default is not None:
+                        names.add(default)
+                    # call-site overrides keyed on any class name along
+                    # the walk (subclass ctor calls collect under the
+                    # subclass name, LockedMap's own under the base)
+                    for c in walked:
+                        names |= self.ctor_overrides.get((c, pname), set())
+                if not names:
+                    return frozenset([f"{info.rel}:{info.name}.{attr}"])
+                return frozenset(names)
+            info = self.cls_info(info.bases[0]) if info.bases else None
+        return None
+
+    def attr_type(
+        self, cls: Optional[str], attr: str, rel: Optional[str] = None
+    ) -> Optional[tuple[str, dict]]:
+        seen: set = set()
+        info = self.cls_info(cls, rel)
+        while info is not None and id(info) not in seen:
+            seen.add(id(info))
+            if attr in info.obj_attrs:
+                return info.obj_attrs[attr]
+            info = self.cls_info(info.bases[0]) if info.bases else None
+        return None
+
+    def find_method(
+        self, cls: Optional[str], name: str, rel: Optional[str] = None
+    ) -> Optional[tuple[ClassInfo, ast.AST]]:
+        """(defining class info, node) for ``cls.name`` walking bases."""
+        seen: set = set()
+        info = self.cls_info(cls, rel)
+        while info is not None and id(info) not in seen:
+            seen.add(id(info))
+            if name in info.methods:
+                return info, info.methods[name]
+            info = self.cls_info(info.bases[0]) if info.bases else None
+        return None
+
+
+class _Extractor:
+    """Walks every function with a held-lock stack, recording edges."""
+
+    def __init__(self, project: _Project) -> None:
+        self.project = project
+        self.graph = LockGraph()
+
+    def run(self) -> LockGraph:
+        p = self.project
+        for ctx in p.ctxs:
+            for var, anon in p.module_locks.get(ctx.rel, {}).items():
+                self.graph.add_def(anon, anon)
+            for node in ast.walk(ctx.tree):
+                if isinstance(node, ast.ClassDef):
+                    info = p.cls_info(node.name, ctx.rel)
+                    if info is None or info.rel != ctx.rel:
+                        continue
+                    for attr, ld in info.lock_attrs.items():
+                        for n in ld.names:
+                            self.graph.add_def(n, ld.site)
+                    for attr, pname in info.param_locks.items():
+                        names = p.resolve_lock_attr(
+                            node.name, attr, rel=ctx.rel
+                        ) or ()
+                        for n in names:
+                            self.graph.add_def(
+                                n, f"{ctx.rel}:{node.name}.{attr}"
+                            )
+                    for attr, (tcls, override) in info.obj_attrs.items():
+                        # instance-named stores: self.retained =
+                        # PacketStore(name="retained")
+                        if not override:
+                            continue
+                        names = p.resolve_lock_attr(tcls, "_lock", override)
+                        for n in names or ():
+                            if ":" not in n:
+                                self.graph.add_def(
+                                    n, f"{ctx.rel}:{node.name}.{attr}"
+                                )
+                    for meth in info.methods.values():
+                        self._scan_function(ctx, meth, node.name)
+            for fn in p.module_funcs.get(ctx.rel, {}).values():
+                self._scan_function(ctx, fn, None)
+            # module-level statements execute at import time: a
+            # top-level `with _g_lock:` ordering is as real as any
+            # other (defs/classes excluded — their members are already
+            # scanned above, and rescanning would duplicate edge sites)
+            module_stmts = [
+                s
+                for s in ctx.tree.body
+                if not isinstance(
+                    s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                )
+            ]
+            self._scan_body(ctx, module_stmts, None, [])
+        return self.graph
+
+    # -- per-function walk --------------------------------------------------
+
+    def _scan_function(
+        self, ctx: FileCtx, fn: ast.AST, cls: Optional[str]
+    ) -> None:
+        # `held` is a list of GROUPS (frozensets): a parameter-named
+        # lock resolves to every name it can carry (topics_trie AND
+        # cluster_remote_trie for TopicsIndex._lock), but one scope only
+        # ever holds ONE of them — acquiring the SAME group again is
+        # same-instance re-entry (legal on an RLock), never a
+        # cross-name edge pair, so edges are emitted per group and a
+        # group never edges into itself
+        held: list[frozenset] = []
+        if getattr(fn, "name", "").endswith(_LOCKED_SUFFIX) and cls:
+            names = self.project.resolve_lock_attr(cls, "_lock", rel=ctx.rel)
+            if names:
+                held = [names]
+        self._scan_body(ctx, list(getattr(fn, "body", [])), cls, held)
+
+    def _scan_body(
+        self, ctx: FileCtx, body: list, cls: Optional[str], held: list
+    ) -> None:
+        for stmt in body:
+            self._scan_stmt(ctx, stmt, cls, held)
+
+    def _scan_stmt(
+        self, ctx: FileCtx, node: ast.AST, cls: Optional[str], held: list
+    ) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # a def statement under a held lock only DEFINES the inner
+            # function — its body runs later, under whatever its caller
+            # holds, so it is scanned with a FRESH held stack (the same
+            # reason _direct_acquisitions prunes nested defs)
+            self._scan_body(ctx, list(node.body), cls, [])
+            return
+        if isinstance(node, ast.Lambda):
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: list[frozenset] = []
+            for item in node.items:
+                names = self._resolve_lock_expr(ctx, item.context_expr, cls)
+                if names:
+                    site = EdgeSite(
+                        ctx.rel, node.lineno, ctx.context_line(node.lineno)
+                    )
+                    # `with a, b:` acquires left-to-right, so earlier
+                    # items in THIS statement are already held when a
+                    # later item acquires — they join the edge sources
+                    self._add_edges(held + acquired, names, site)
+                    acquired.append(names)
+                else:
+                    self._scan_expr(ctx, item.context_expr, cls, held)
+            self._scan_body(ctx, node.body, cls, held + acquired)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+            ):
+                continue
+            if isinstance(child, ast.stmt):
+                self._scan_stmt(ctx, child, cls, held)
+            else:
+                self._scan_expr(ctx, child, cls, held)
+
+    def _scan_expr(
+        self, ctx: FileCtx, expr: ast.AST, cls: Optional[str], held: list
+    ) -> None:
+        if not held:
+            return
+        stack = [expr]
+        while stack:
+            node = stack.pop()
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            if isinstance(node, ast.Call):
+                for names, _desc in self._call_acquisitions(ctx, node, cls):
+                    site = EdgeSite(
+                        ctx.rel, node.lineno, ctx.context_line(node.lineno)
+                    )
+                    self._add_edges(held, names, site)
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _add_edges(
+        self, held: list, names: frozenset, site: EdgeSite
+    ) -> None:
+        """Edges from every held GROUP to the acquired name set — except
+        a group acquiring itself: one scope holds exactly one of a
+        parameter-named lock's alternative names, so re-acquiring the
+        same attribute (RLock re-entry through a helper) must not
+        fabricate cross-name edge pairs between the alternatives."""
+        for group in held:
+            if group == names:
+                continue
+            for h in group:
+                for n in names:
+                    self.graph.add_edge(h, n, site)
+
+    # -- lock expression resolution -----------------------------------------
+
+    def _resolve_lock_expr(
+        self, ctx: FileCtx, expr: ast.AST, cls: Optional[str]
+    ) -> Optional[frozenset]:
+        term = _terminal_name(expr)
+        if term is None or not _LOCK_NAME_RE.search(term):
+            return None
+        p = self.project
+        if isinstance(expr, ast.Name):
+            mod = p.module_locks.get(ctx.rel, {})
+            if expr.id in mod:
+                return frozenset([mod[expr.id]])
+            return frozenset([f"{ctx.rel}:<local>.{expr.id}"])
+        if isinstance(expr, ast.Attribute):
+            base = expr.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                names = p.resolve_lock_attr(cls, expr.attr, rel=ctx.rel)
+                if names:
+                    return names
+                return frozenset([f"{ctx.rel}:{cls}.{expr.attr}"])
+            if (
+                isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == "self"
+            ):
+                # self.attr._lock: resolve through the attribute's type
+                at = p.attr_type(cls, base.attr, rel=ctx.rel)
+                if at is not None:
+                    tcls, override = at
+                    names = p.resolve_lock_attr(tcls, expr.attr, override)
+                    if names:
+                        return names
+                return frozenset(
+                    [f"{ctx.rel}:{cls}.{base.attr}.{expr.attr}"]
+                )
+            # x._lock on a local: resolvable only when the attr name is
+            # unique among all project lock attributes
+            owners = [
+                (info.name, info.lock_attrs[expr.attr])
+                for infos in p.classes.values()
+                for info in infos
+                if expr.attr in info.lock_attrs
+            ]
+            if len(owners) == 1:
+                return owners[0][1].names
+            d = _dotted(expr) or term
+            return frozenset([f"{ctx.rel}:<local>.{d}"])
+        return None
+
+    # -- one-level call propagation -----------------------------------------
+
+    def _call_acquisitions(
+        self, ctx: FileCtx, call: ast.Call, cls: Optional[str]
+    ) -> list[tuple[frozenset, str]]:
+        f = call.func
+        p = self.project
+        if isinstance(f, ast.Name):
+            fn = p.module_funcs.get(ctx.rel, {}).get(f.id)
+            if fn is not None:
+                return self._direct_acquisitions(ctx, fn, None, None)
+            return []
+        if not isinstance(f, ast.Attribute):
+            return []
+        base = f.value
+        if isinstance(base, ast.Name) and base.id == "self":
+            found = p.find_method(cls, f.attr, rel=ctx.rel)
+            if found is not None:
+                owner, node = found
+                octx = self._ctx_for(owner.rel) or ctx
+                return self._direct_acquisitions(
+                    octx, node, owner.name, None, rel=owner.rel
+                )
+            return []
+        if (
+            isinstance(base, ast.Attribute)
+            and isinstance(base.value, ast.Name)
+            and base.value.id == "self"
+        ):
+            at = p.attr_type(cls, base.attr, rel=ctx.rel)
+            if at is None:
+                return []
+            tcls, override = at
+            found = p.find_method(tcls, f.attr)
+            if found is None:
+                return []
+            owner, node = found
+            octx = self._ctx_for(owner.rel) or ctx
+            tinfo = p.cls_info(tcls)
+            return self._direct_acquisitions(
+                octx, node, tcls, override,
+                rel=tinfo.rel if tinfo is not None else None,
+            )
+        return []
+
+    def _ctx_for(self, rel: str) -> Optional[FileCtx]:
+        for c in self.project.ctxs:
+            if c.rel == rel:
+                return c
+        return None
+
+    def _direct_acquisitions(
+        self,
+        ctx: FileCtx,
+        fn: ast.AST,
+        cls: Optional[str],
+        override: Optional[dict],
+        rel: Optional[str] = None,
+    ) -> list[tuple[frozenset, str]]:
+        """The with-acquisitions lexically inside ``fn`` (one level: no
+        recursion into ITS calls), resolved in the receiver's context."""
+        out = []
+        name = getattr(fn, "name", "?")
+        # rules._iter_scope PRUNES nested function/lambda/class bodies:
+        # a with-acquisition inside a merely-DEFINED callback (the
+        # _trip_dump registration shape) runs later, under whatever
+        # locks its eventual caller holds — attributing it to this
+        # callee would fabricate edges and false R9 cycles
+        for node in _iter_scope(list(getattr(fn, "body", []))):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    term = _terminal_name(item.context_expr)
+                    if term is None or not _LOCK_NAME_RE.search(term):
+                        continue
+                    names = None
+                    expr = item.context_expr
+                    if (
+                        isinstance(expr, ast.Attribute)
+                        and isinstance(expr.value, ast.Name)
+                        and expr.value.id == "self"
+                    ):
+                        names = self.project.resolve_lock_attr(
+                            cls, expr.attr, override, rel=rel
+                        )
+                    if names is None:
+                        names = self._resolve_lock_expr(ctx, expr, cls)
+                    if names:
+                        out.append((names, f"{cls or ctx.rel}.{name}"))
+        return out
+
+
+# -- public API -------------------------------------------------------------
+
+
+def extract_lock_graph(ctxs: list[FileCtx]) -> LockGraph:
+    """Extract (or reuse) the graph for this exact source set. The
+    single-slot memo (a function attribute, keyed on every file's rel
+    path + source hash) exists because one CLI invocation runs
+    extraction twice over identical sources — once inside the R9 rule,
+    once for the --lock-graph DOT/JSON export."""
+    key = tuple(sorted((c.rel, hash(c.source)) for c in ctxs))
+    memo = getattr(extract_lock_graph, "_memo", None)
+    if memo is not None and memo[0] == key:
+        return memo[1]
+    graph = _Extractor(_Project(ctxs)).run()
+    extract_lock_graph._memo = (key, graph)  # type: ignore[attr-defined]
+    return graph
+
+
+def _lock_names_from_source(root: str) -> Optional[list[str]]:
+    """The LOCK_NAMES catalog parsed (AST, no import) out of
+    mqtt_tpu/utils/locked.py; None when the file is absent (fixture
+    trees)."""
+    path = os.path.join(root, "mqtt_tpu", "utils", "locked.py")
+    if not os.path.exists(path):
+        return None
+    with open(path, encoding="utf-8") as f:
+        try:
+            tree = ast.parse(f.read())
+        except SyntaxError:
+            return None
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            tgt = node.targets[0] if len(node.targets) == 1 else None
+            if isinstance(tgt, ast.Name) and tgt.id == "LOCK_NAMES":
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    out = []
+                    for e in node.value.elts:
+                        lit = _literal_str(e)
+                        if lit is not None:
+                            out.append(lit)
+                    return out
+    return None
+
+
+def check_r9(ctxs: list[FileCtx], root: str) -> list[Finding]:
+    """R9: the whole-program lock graph must be acyclic and consistent
+    with the blessed LOCK_ORDER; every named lock must hold a blessed
+    position. Findings anchor at acquisition/call sites so the reasoned
+    pragma workflow applies."""
+    graph = extract_lock_graph(ctxs)
+    out: list[Finding] = []
+    pos = {n: i for i, n in enumerate(LOCK_ORDER)}
+
+    # catalog sync: utils/locked.py LOCK_NAMES <-> LOCK_ORDER
+    names = _lock_names_from_source(root)
+    if names is not None:
+        for n in names:
+            if n not in pos:
+                # context carries the lock name: the baseline key is
+                # (rule, path, context), so two DIFFERENT unblessed
+                # locks in one file must never share a baseline entry
+                out.append(
+                    Finding(
+                        "R9", "mqtt_tpu/utils/locked.py", 1, 0,
+                        f"named lock {n!r} (LOCK_NAMES) has no blessed "
+                        "position in tools/brokerlint/lockgraph.py "
+                        "LOCK_ORDER; add it where it belongs in the "
+                        "acquisition order", f"lock:{n}",
+                    )
+                )
+    # a named lock extracted from the tree but absent from the order is
+    # the same drift in the other direction (e.g. a new
+    # InstrumentedLock("x") nobody blessed)
+    for n in sorted(graph.defs):
+        if ":" not in n and n not in pos:
+            site = graph.defs[n][0]
+            out.append(
+                Finding(
+                    "R9", site.split(":")[0], 1, 0,
+                    f"named lock {n!r} ({site}) is missing from the "
+                    "blessed LOCK_ORDER in tools/brokerlint/lockgraph.py",
+                    f"lock:{n}",
+                )
+            )
+
+    # reversed edges against the blessed order
+    for (a, b), sites in sorted(graph.edges.items()):
+        if a in pos and b in pos and pos[a] > pos[b]:
+            for s in sites:
+                out.append(
+                    Finding(
+                        "R9", s.path, s.line, 0,
+                        f"lock order reversed: {b!r} (position {pos[b]}) "
+                        f"must never be acquired while holding {a!r} "
+                        f"(position {pos[a]}); see LOCK_ORDER in "
+                        "tools/brokerlint/lockgraph.py", s.context,
+                    )
+                )
+
+    # cycles (potential deadlocks) anywhere in the graph, anonymous
+    # locks included
+    for scc in graph.cycles():
+        member = set(scc)
+        cyc = " -> ".join(scc + [scc[0]])
+        for (a, b), sites in sorted(graph.edges.items()):
+            if a in member and b in member:
+                for s in sites:
+                    out.append(
+                        Finding(
+                            "R9", s.path, s.line, 0,
+                            f"lock-order cycle {cyc}: this acquisition of "
+                            f"{b!r} under {a!r} participates; break the "
+                            "cycle or document why it cannot deadlock",
+                            s.context,
+                        )
+                    )
+    return out

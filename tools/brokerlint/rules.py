@@ -76,18 +76,19 @@ def _is_lock_expr(node: ast.AST) -> bool:
 def _iter_scope(body: list) -> Iterator[ast.AST]:
     """Walk statements without descending into nested function/class
     definitions or lambdas — their bodies execute later, outside the
-    enclosing lock scope."""
+    enclosing lock scope. The prune happens at pop so a ``def`` sitting
+    DIRECTLY in ``body`` (a callback defined inside a ``with`` block)
+    is skipped exactly like one nested deeper."""
     stack = list(body)
     while stack:
         node = stack.pop()
+        if isinstance(
+            node,
+            (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
+        ):
+            continue
         yield node
-        for child in ast.iter_child_nodes(node):
-            if isinstance(
-                child,
-                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef),
-            ):
-                continue
-            stack.append(child)
+        stack.extend(ast.iter_child_nodes(node))
 
 
 def _lock_scopes(ctx: FileCtx) -> Iterator[tuple[list, str]]:
@@ -749,8 +750,16 @@ FILE_RULES = {
     "R8": check_r8,
 }
 
+def _check_r9(ctxs: list[FileCtx], root: str) -> list[Finding]:
+    # late import: lockgraph imports helpers from this module
+    from .lockgraph import check_r9
+
+    return check_r9(ctxs, root)
+
+
 PROJECT_RULES = {
     "R6": check_r6,
+    "R9": _check_r9,
 }
 
 RULE_DOC = {
@@ -762,4 +771,5 @@ RULE_DOC = {
     "R6": "metric names: README catalog sync + naming scheme",
     "R7": "threads: explicit daemon= and a tracking binding",
     "R8": "no mutable default args / module-level mutable singletons",
+    "R9": "lock-order graph: acyclic and consistent with LOCK_ORDER",
 }
