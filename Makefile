@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate lockgraph
+.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate lockgraph pipeline-smoke
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -66,3 +66,10 @@ chaos-smoke:
 # (exp/stage_gate.py): fails on a >25% p99 regression in any stage
 stage-gate:
 	$(PY) exp/stage_gate.py
+
+# staged-pipeline smoke (exp/pipeline_smoke.py): boot the broker with
+# compaction + the 3-deep pipeline on, 1k-publish burst vs wildcard
+# subs, zero host-trie-oracle mismatches and a nonzero device duty
+# cycle; writes pipeline-smoke.json (uploaded as a CI artifact)
+pipeline-smoke:
+	env JAX_PLATFORMS=cpu $(PY) exp/pipeline_smoke.py

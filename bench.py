@@ -131,6 +131,13 @@ def probe_link():
     }
 
 
+def bench_compact() -> bool:
+    """BENCH_COMPACT=0 disables device-resident hit compaction for an
+    A/B against the padded-ranges transfer (default: on, the production
+    posture)."""
+    return os.environ.get("BENCH_COMPACT", "1") != "0"
+
+
 # -- index builders ---------------------------------------------------------
 
 
@@ -444,22 +451,34 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         kernel_rate = sorted(rates)[len(rates) // 2]
         kernel_best = max(rates)
 
+    tel_block = telemetry_block(
+        lat,
+        "device_batch",
+        fallbacks={
+            "host_fallbacks": fallbacks,
+            "overflows": overflows,
+            "host_fast": matcher.stats.host_fast,
+        },
+        fill={"p50": 1.0, "note": "fixed-size bench batches"},
+    )
+    if profiler.compact_d2h_hist.count:
+        # the compaction d2h leg as its own stage row so stage_gate
+        # diffs it round over round (a new name passes through its
+        # new_stage_names notice on the first post-compaction round)
+        h = profiler.compact_d2h_hist
+        tel_block["stages"]["compact_d2h"] = {
+            "count": h.count,
+            "p50_ms": round(h.percentile(0.5) * 1e3, 3),
+            "p99_ms": round(h.percentile(0.99) * 1e3, 3),
+        }
     return {
         "e2e_matches_per_sec": round((iters * batch) / e2e_dt),
         # kernel duty cycle / transfer-compute overlap / idle gaps over
         # the pipelined e2e loop (mqtt_tpu.tracing.DeviceProfiler) — the
-        # ROADMAP item 1 gap, measured per round
+        # ROADMAP item 1 gap, measured per round; carries the compaction
+        # transfer ledger (d2h bytes actual vs padded, reduction ratios)
         "device_pipeline": device_pipeline,
-        "telemetry": telemetry_block(
-            lat,
-            "device_batch",
-            fallbacks={
-                "host_fallbacks": fallbacks,
-                "overflows": overflows,
-                "host_fast": matcher.stats.host_fast,
-            },
-            fill={"p50": 1.0, "note": "fixed-size bench batches"},
-        ),
+        "telemetry": tel_block,
         "device_kernel_matches_per_sec": round(kernel_rate) if kernel_rate else None,
         # best of the timed windows: the tunnel's per-dispatch overhead is
         # volatile (PROFILE.md §2); median is the headline, best shows the
@@ -486,7 +505,7 @@ def run_cfg1(rng, fast, batch):
 
     index, topic_gen = build_cfg1(rng)
     host_rate = time_host(index, topic_gen, 2000 if fast else 20000)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=32, transfer_slots=8)
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=32, transfer_slots=8, compact=bench_compact())
     matcher.rebuild()
     parity_check(matcher, index, topic_gen)
     # same batch as the other configs: the tunnel's per-dispatch overhead
@@ -501,7 +520,7 @@ def run_cfg2(n_subs, batch, iters, rng):
     from mqtt_tpu.ops import TpuMatcher
 
     index, topic_gen = build_cfg2(n_subs, rng)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16)
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16, compact=bench_compact())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg2 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -515,7 +534,7 @@ def run_cfg3(n_subs, batch, iters, rng):
     index, topic_gen = build_cfg3(n_subs, rng)
     # deep fan-in: a topic can gather hundreds of '#' subs — bigger output
     # window keeps the device path useful instead of 100% host fallback
-    matcher = TpuMatcher(index, max_levels=8, frontier=8, out_slots=256, transfer_slots=32)
+    matcher = TpuMatcher(index, max_levels=8, frontier=8, out_slots=256, transfer_slots=32, compact=bench_compact())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg3 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -527,7 +546,7 @@ def run_cfg4(n_groups, members, batch, iters, rng):
     from mqtt_tpu.ops import TpuMatcher
 
     index, topic_gen = build_cfg4(n_groups, members, rng)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=128, transfer_slots=48)
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=128, transfer_slots=48, compact=bench_compact())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg4 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -566,7 +585,8 @@ def run_cfg5(n_subs, batch, iters, rng):
         return f"{rng.choice(v0)}/{rng.choice(v1)}/{rng.choice(v2)}"
 
     m = DeltaMatcher(index, max_levels=4, out_slots=64, transfer_slots=16,
-                     rebuild_after=256, rebuild_interval=0.2, background=True)
+                     rebuild_after=256, rebuild_interval=0.2, background=True,
+                     compact=bench_compact())
 
     # same GC posture as the other configs (time_matcher does this): the
     # built index must not be young-gen-scanned every 700 allocations
@@ -1284,26 +1304,51 @@ def main() -> None:
     # comparable with prior BENCH_rNN.json); the transfer-free kernel rate
     # — the on-chip capability this harness's tunneled link (RTT/bandwidth
     # in "link") cannot express e2e — is surfaced alongside.
-    value = (headline or {}).get("e2e_matches_per_sec") or 0
+    value = (headline or {}).get("e2e_matches_per_sec")
     kernel = (headline or {}).get("device_kernel_matches_per_sec") or 0
-    out = {
-        "metric": f"publish_topic_matches_per_sec@{n_subs}_wildcard_subs_e2e",
-        "value": value,
-        "unit": "matches/s",
-        "vs_baseline": round(value / TARGET_MATCHES_PER_SEC, 4),
-        "device_kernel_matches_per_sec": kernel,
-        "kernel_vs_baseline": round(kernel / TARGET_MATCHES_PER_SEC, 4),
-        "link": link,
-        "configs": configs,
-    }
+    if value is not None:
+        out = {
+            "metric": f"publish_topic_matches_per_sec@{n_subs}_wildcard_subs_e2e",
+            "value": value,
+            "unit": "matches/s",
+            "vs_baseline": round(value / TARGET_MATCHES_PER_SEC, 4),
+            "device_kernel_matches_per_sec": kernel,
+            "kernel_vs_baseline": round(kernel / TARGET_MATCHES_PER_SEC, 4),
+            "link": link,
+            "configs": configs,
+        }
+    else:
+        # NO e2e-producing config ran (dead device tunnel, or a
+        # broker-only BENCH_CONFIGS selection): the run is SKIPPED for
+        # headline purposes — value/vs_baseline are null, never a silent
+        # 0 that poisons trend lines (the r05 artifact recorded
+        # vs_baseline=0.0 for a run that never touched the device)
+        if device_wanted and not device_ok:
+            reason = (
+                "device unreachable after probe retries: " + probe_err
+            )
+        else:
+            reason = "no e2e-producing config selected by BENCH_CONFIGS"
+        out = {
+            "metric": f"publish_topic_matches_per_sec@{n_subs}_wildcard_subs_e2e",
+            "value": None,
+            "unit": "matches/s",
+            "vs_baseline": None,
+            "device_kernel_matches_per_sec": None,
+            "kernel_vs_baseline": None,
+            "skipped": True,
+            "skip_reason": reason,
+            "link": link,
+            "configs": configs,
+        }
     if device_wanted:
         # breaker-style probe stats in every device-wanting artifact:
         # attempts, failure kinds (hang vs error), trips — so a degraded
         # run documents HOW the link failed, not just that it did
         out["probe_breaker"] = probe_breaker.as_dict()
     if device_wanted and not device_ok:
-        # an explicit flag instead of a silent 0 headline: the device was
-        # unreachable for this run, the recorded value covers only what
+        # an explicit flag beside the skipped headline: the device was
+        # unreachable for this run, the recorded configs cover only what
         # actually ran (VERDICT r4 item 2)
         out["device_unreachable"] = True
         out["device_probe_error"] = probe_err

@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""CI pipeline-smoke gate (ISSUE 11): boot a staged broker with
+device-resident hit compaction AND the 3-deep overlapped staging
+pipeline on, push a 1k-publish burst over real TCP against wildcard
+subscribers, and assert
+
+- ZERO oracle mismatches: every subscriber's received topic multiset
+  equals the host-trie-derived expectation (the compacted device path
+  must be delivery-identical to the reference walk), and
+- a nonzero ``device_duty_cycle`` with at least one compacted batch —
+  the pipeline actually ran through the device, it did not silently
+  degrade to the host walk.
+
+The device duty-cycle/overlap block (plus the compaction transfer
+ledger and per-leg staging waits) is written to ``--out`` and uploaded
+as a CI artifact, so every run carries the pipeline-health numbers
+ROADMAP item 1 gates on.
+
+Usage: python exp/pipeline_smoke.py [--out pipeline-smoke.json]
+"""
+
+import argparse
+import asyncio
+import collections
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_PUBLISHES = 1000
+SUB_FILTERS = {
+    "wild-hash": "burst/#",
+    "wild-plus": "burst/+/x",
+    "exact": "burst/d7/x",
+}
+
+
+async def _drain_topics(reader, counts, stop):
+    """Collect delivered PUBLISH topic names (QoS0 frames) into counts."""
+    buf = b""
+    while not stop.is_set():
+        try:
+            data = await asyncio.wait_for(reader.read(65536), 0.5)
+        except asyncio.TimeoutError:
+            continue
+        if not data:
+            return
+        buf += data
+        while len(buf) >= 2:
+            if buf[0] >> 4 != 3:  # not PUBLISH: skip one byte defensively
+                buf = buf[1:]
+                continue
+            # single-byte remaining length is enough for this burst's
+            # tiny frames; bail to the next read otherwise
+            rl = buf[1]
+            if rl & 0x80 or len(buf) < 2 + rl:
+                break
+            frame = buf[2 : 2 + rl]
+            tlen = int.from_bytes(frame[:2], "big")
+            counts[frame[2 : 2 + tlen].decode()] += 1
+            buf = buf[2 + rl :]
+
+
+async def main(out_path: str) -> int:
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+
+    try:
+        import jax  # noqa: F401
+    except ImportError:
+        print("SKIP: no jax backend; the pipeline smoke needs the device path")
+        return 0
+
+    srv = Server(
+        Options(
+            device_matcher=True,
+            matcher_opts={"max_levels": 4, "background": False},
+            matcher_compact=True,
+            matcher_stage_pipeline_depth=3,
+            matcher_stage_window_ms=2.0,
+            telemetry_sample=1,
+        )
+    )
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    await srv.serve()
+    stop = asyncio.Event()
+    drains = []
+    try:
+        host, port = srv.listeners.get("t").address().rsplit(":", 1)
+        counts: dict = {}
+        for name, flt in SUB_FILTERS.items():
+            r, w = await asyncio.open_connection(host, int(port))
+            w.write(_connect_bytes(f"smoke-{name}", version=4))
+            await w.drain()
+            await r.readexactly(4)
+            w.write(_subscribe_bytes(1, flt))
+            await w.drain()
+            await r.readexactly(5)
+            counts[name] = collections.Counter()
+            drains.append(
+                asyncio.get_event_loop().create_task(
+                    _drain_topics(r, counts[name], stop)
+                )
+            )
+        # fold the subscriptions into a fresh compiled snapshot so the
+        # burst takes the compacted device path, not the delta overlay
+        srv.matcher.flush()
+
+        # the host-trie oracle: expected per-subscriber delivery counts
+        topics = [f"burst/d{i % 20}/{'x' if i % 3 else 'y'}" for i in range(N_PUBLISHES)]
+        expected = {name: collections.Counter() for name in SUB_FILTERS}
+        for t in topics:
+            subs = srv.topics.subscribers(t)
+            for cid in subs.subscriptions:
+                name = cid.removeprefix("smoke-")
+                if name in expected:
+                    expected[name][t] += 1
+
+        pr, pw = await asyncio.open_connection(host, int(port))
+        pw.write(_connect_bytes("smoke-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        for t in topics:
+            tb = t.encode()
+            body = len(tb).to_bytes(2, "big") + tb + b"p"
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+
+        want_total = sum(sum(c.values()) for c in expected.values())
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + 60
+        while loop.time() < deadline:
+            got_total = sum(sum(c.values()) for c in counts.values())
+            if got_total >= want_total:
+                break
+            await asyncio.sleep(0.2)
+        stop.set()
+        await asyncio.gather(*drains, return_exceptions=True)
+
+        mismatches = 0
+        for name in SUB_FILTERS:
+            if counts[name] != expected[name]:
+                mismatches += 1
+                missing = expected[name] - counts[name]
+                surplus = counts[name] - expected[name]
+                print(
+                    f"FAIL: {name} diverged from the host-trie oracle "
+                    f"(missing={dict(list(missing.items())[:5])} "
+                    f"surplus={dict(list(surplus.items())[:5])})",
+                    file=sys.stderr,
+                )
+        stats = srv.matcher.stats
+        block = {
+            "publishes": N_PUBLISHES,
+            "oracle_mismatched_subscribers": mismatches,
+            "device_pipeline": (
+                srv.profiler.bench_block() if srv.profiler is not None else {}
+            ),
+            "matcher": stats.as_dict(),
+            "staging": {
+                "pipeline_depth": (
+                    srv._stage.pipeline_depth if srv._stage is not None else 0
+                ),
+                "leg_wait_counts": {
+                    leg: h.count
+                    for leg, h in srv.telemetry.leg_wait.items()
+                },
+            },
+        }
+        with open(out_path, "w") as f:
+            json.dump(block, f, indent=2)
+        print(f"# pipeline block -> {out_path}: {json.dumps(block)}",
+              file=sys.stderr)
+        if mismatches:
+            return 1
+        duty = block["device_pipeline"].get("duty_cycle", 0.0)
+        if duty <= 0.0:
+            print("FAIL: device duty cycle is zero — the pipeline never "
+                  "touched the device", file=sys.stderr)
+            return 1
+        if stats.compact_batches < 1:
+            print("FAIL: no batch took the compacted path", file=sys.stderr)
+            return 1
+        print(
+            f"OK: {want_total} oracle-checked deliveries, duty_cycle={duty}, "
+            f"compact_batches={stats.compact_batches}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        stop.set()
+        await srv.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="pipeline-smoke.json")
+    sys.exit(asyncio.run(main(ap.parse_args().out)))

@@ -18,9 +18,15 @@ Robustness rules (a gate that cries wolf gets deleted):
   device_batch never diffs against config 8's;
 - stage names present only in the CURRENT run — e.g. the trace plane's
   h2d/device_dispatch/d2h sub-stages against a round recorded before
-  the device_batch split — pass through with a notice, never a
-  failure: a new sub-stage has no baseline to regress against
-  (``device_batch`` stays populated as their sum for continuity);
+  the device_batch split, or the staging pipeline's per-leg waits
+  (``leg_wait_h2d`` / ``leg_wait_d2h``) and the compaction d2h leg
+  (``compact_d2h``) against a round recorded before the 3-deep
+  overlapped pipeline — pass through with a notice, never a failure: a
+  new sub-stage has no baseline to regress against (``device_batch``
+  stays populated as their sum for continuity);
+- stage names present only in the PREVIOUS run are reported as a
+  retirement notice (renames are visible, never silently un-diffed)
+  and never fail the gate;
 - a run with no telemetry blocks (device-less driver hosts) passes with
   a notice — absence of evidence is not a regression.
 
@@ -132,6 +138,27 @@ def new_stage_names(current: dict, previous: dict) -> list[str]:
     return sorted(out)
 
 
+def removed_stage_names(current: dict, previous: dict) -> list[str]:
+    """Stage names the previous run observed (at the same json path)
+    that the current run never did — a renamed or retired stage. By
+    construction compare() never diffs them (it iterates the CURRENT
+    run's stages), so a retirement can't fail the gate; main() surfaces
+    the list so a rename is visible instead of silently un-diffed —
+    e.g. when the pipeline sub-stage split retires a coarse stage."""
+    cur_blocks = find_telemetry_blocks(current)
+    prev_blocks = find_telemetry_blocks(previous)
+    out: set[str] = set()
+    for path, prev in prev_blocks.items():
+        cur = cur_blocks.get(path)
+        if cur is None:
+            continue
+        cur_rows = stage_rows(cur)
+        for name in stage_rows(prev):
+            if name not in cur_rows:
+                out.add(name)
+    return sorted(out)
+
+
 def _bench_rank(path: str) -> tuple[int, str]:
     """Order BENCH files by their round number (BENCH_r05 > BENCH_r04)."""
     m = re.search(r"_r(\d+)", os.path.basename(path))
@@ -213,6 +240,12 @@ def main() -> int:
         print(
             "stage-gate: new stage(s) without a baseline (not diffed): "
             + ", ".join(fresh)
+        )
+    retired = removed_stage_names(current, previous)
+    if retired:
+        print(
+            "stage-gate: stage(s) retired since the previous round "
+            "(not diffed): " + ", ".join(retired)
         )
     if not compared:
         print(

@@ -152,6 +152,11 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "matcher_stage_max_batch",
         "matcher_stage_max_inflight",
         "matcher_stage_latency_budget_ms",
+        # overlapped staging + device-resident hit compaction
+        # (mqtt_tpu.staging + ops/flat.flat_match_compact)
+        "matcher_stage_pipeline_depth",
+        "matcher_compact",
+        "matcher_compact_capacity",
         # degradation manager: breaker/backoff knobs (mqtt_tpu.resilience)
         "matcher_resilience",
         "breaker_failure_threshold",

@@ -526,6 +526,162 @@ fail:
     return NULL;
 }
 
+/* resolve_compact(sids, shards, totals, route, n_hits, n_topics, snaps,
+ *                 window, subscribers_cls)
+ *   sids:    C-contiguous int32 buffer — the device-compacted pair
+ *            stream (topic-major; the per-topic totals drive the cursor,
+ *            so each pair's topic_idx is implicit)
+ *   shards:  None (single-device: sid space is snaps) or a parallel
+ *            int32 buffer of per-pair shard ids — snaps is then a list
+ *            of per-shard snapshot lists (mesh-sharded form)
+ *   totals:  int32 buffer [B] — hits per (padded) batch row
+ *   route:   int32 buffer [B] — nonzero = host re-walk (device overflow,
+ *            over-deep topic, delta-routed): results[i] stays None and i
+ *            lands in overflow_indices; the row's pairs are skipped
+ *   returns: (results, overflow_indices) like resolve_batch.
+ * The cursor must land exactly on n_hits after the walk — a mismatch
+ * means the caller mixed buffers from different batches and is an error,
+ * never a silent mis-expansion. */
+static PyObject *
+resolve_compact(PyObject *Py_UNUSED(self), PyObject *args)
+{
+    PyObject *sids_obj, *shards_obj, *totals_obj, *route_obj, *snaps,
+        *subscribers_cls;
+    Py_ssize_t n_hits, n_topics;
+    long long window;
+    if (!PyArg_ParseTuple(args, "OOOOnnOLO", &sids_obj, &shards_obj,
+                          &totals_obj, &route_obj, &n_hits, &n_topics,
+                          &snaps, &window, &subscribers_cls))
+        return NULL;
+    int sharded = shards_obj != Py_None;
+    if (!PyList_Check(snaps)) {
+        PyErr_SetString(PyExc_TypeError, "snaps must be a list");
+        return NULL;
+    }
+    if (window <= 0 || n_hits < 0 || n_topics < 0 ||
+        !PyType_Check(subscribers_cls)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "window must be > 0, counts >= 0, cls a type");
+        return NULL;
+    }
+
+    Py_buffer sids_v, totals_v, route_v, shards_v;
+    sids_v.buf = totals_v.buf = route_v.buf = shards_v.buf = NULL;
+    PyObject *results = NULL, *overflow_idx = NULL, *out = NULL;
+    if (PyObject_GetBuffer(sids_obj, &sids_v, PyBUF_C_CONTIGUOUS) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(totals_obj, &totals_v, PyBUF_C_CONTIGUOUS) < 0)
+        goto done;
+    if (PyObject_GetBuffer(route_obj, &route_v, PyBUF_C_CONTIGUOUS) < 0)
+        goto done;
+    if (sharded &&
+        PyObject_GetBuffer(shards_obj, &shards_v, PyBUF_C_CONTIGUOUS) < 0)
+        goto done;
+    if (sids_v.itemsize != 4 || totals_v.itemsize != 4 ||
+        route_v.itemsize != 4 || (sharded && shards_v.itemsize != 4)) {
+        PyErr_SetString(PyExc_ValueError, "buffers must be int32");
+        goto done;
+    }
+    Py_ssize_t B = totals_v.len / 4;
+    Py_ssize_t n_sids = sids_v.len / 4;
+    if (route_v.len / 4 < B || n_topics > B || n_hits > n_sids ||
+        (sharded && shards_v.len / 4 < n_sids)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "compact buffers disagree on batch geometry");
+        goto done;
+    }
+    const int32_t *sids = (const int32_t *)sids_v.buf;
+    const int32_t *totals = (const int32_t *)totals_v.buf;
+    const int32_t *route = (const int32_t *)route_v.buf;
+    const int32_t *shards = sharded ? (const int32_t *)shards_v.buf : NULL;
+    Py_ssize_t n_shards = sharded ? PyList_GET_SIZE(snaps) : 0;
+
+    results = PyList_New(n_topics);
+    overflow_idx = PyList_New(0);
+    if (results == NULL || overflow_idx == NULL)
+        goto done;
+
+    /* loop-invariant: one layout lookup per call (resolve_batch parity) */
+    ResLayout *RL = res_layout_for((PyTypeObject *)subscribers_cls);
+    Py_ssize_t cursor = 0;
+    for (Py_ssize_t i = 0; i < B; i++) {
+        int32_t t = totals[i];
+        if (t < 0 || cursor + t > n_hits) {
+            PyErr_SetString(PyExc_ValueError,
+                            "compact totals overrun the pair stream");
+            goto done;
+        }
+        if (i >= n_topics || route[i]) {
+            if (i < n_topics) {
+                PyObject *idx = PyLong_FromSsize_t(i);
+                if (idx == NULL || PyList_Append(overflow_idx, idx) < 0) {
+                    Py_XDECREF(idx);
+                    goto done;
+                }
+                Py_DECREF(idx);
+                Py_INCREF(Py_None);
+                PyList_SET_ITEM(results, i, Py_None);
+            }
+            cursor += t; /* skip the routed/padded row's pairs */
+            continue;
+        }
+        PyObject *subscriptions, *shared, *inline_subs;
+        PyObject *subs_obj = new_result(subscribers_cls, RL, &subscriptions,
+                                        &shared, &inline_subs);
+        if (subs_obj == NULL)
+            goto done;
+        PyList_SET_ITEM(results, i, subs_obj); /* steals */
+        int merr = 0;
+        for (int32_t k = 0; k < t && !merr; k++) {
+            Py_ssize_t j = cursor + k;
+            PyObject *shard_snaps = snaps;
+            if (sharded) {
+                int32_t s = shards[j];
+                if (s < 0 || s >= n_shards) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "pair shard id out of range");
+                    merr = 1;
+                    break;
+                }
+                shard_snaps = PyList_GET_ITEM(snaps, s); /* borrowed */
+                if (!PyList_Check(shard_snaps)) {
+                    PyErr_SetString(PyExc_TypeError,
+                                    "sharded snaps must be a list of lists");
+                    merr = 1;
+                    break;
+                }
+            }
+            if (merge_sid(sids[j], shard_snaps, PyList_GET_SIZE(shard_snaps),
+                          window, subscriptions, shared, inline_subs) < 0)
+                merr = 1;
+        }
+        Py_DECREF(subscriptions);
+        Py_DECREF(shared);
+        Py_DECREF(inline_subs);
+        if (merr)
+            goto done;
+        cursor += t;
+    }
+    if (cursor != n_hits) {
+        PyErr_SetString(PyExc_ValueError,
+                        "compact pair stream and totals disagree");
+        goto done;
+    }
+    out = PyTuple_Pack(2, results, overflow_idx);
+
+done:
+    PyBuffer_Release(&sids_v);
+    if (totals_v.buf != NULL)
+        PyBuffer_Release(&totals_v);
+    if (route_v.buf != NULL)
+        PyBuffer_Release(&route_v);
+    if (sharded && shards_v.buf != NULL)
+        PyBuffer_Release(&shards_v);
+    Py_XDECREF(results);
+    Py_XDECREF(overflow_idx);
+    return out;
+}
+
 /* expand_sids_list(sids, snaps, window, subscribers_obj) — the same merge
  * over an explicit sid list into an EXISTING Subscribers instance; used by
  * the differential tests and any caller holding slot arrays rather than
@@ -653,6 +809,9 @@ fail:
 static PyMethodDef methods[] = {
     {"resolve_batch", resolve_batch, METH_VARARGS,
      "Expand packed device range rows into Subscribers results."},
+    {"resolve_compact", resolve_compact, METH_VARARGS,
+     "Expand a device-compacted (topic-major) pair stream into "
+     "Subscribers results."},
     {"expand_sids_list", expand_sids_list, METH_VARARGS,
      "Merge an explicit sid list into an existing Subscribers instance."},
     {"expand_snap", expand_snap, METH_VARARGS,

@@ -159,6 +159,9 @@ class DeltaMatcher:
         mesh=None,
         transfer_slots: Optional[int] = None,
         window: int = 16,
+        compact: bool = True,
+        compact_capacity: int = 0,
+        hits_estimate: float = 2.0,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
@@ -183,6 +186,9 @@ class DeltaMatcher:
                 max_levels=max_levels,
                 out_slots=out_slots,
                 window=window,
+                compact=compact,
+                compact_capacity=compact_capacity,
+                hits_estimate=hits_estimate,
             )
         else:
             snap = _Snapshot(
@@ -195,6 +201,9 @@ class DeltaMatcher:
                 # background rebuilds must not starve the serving thread's
                 # match latency for the build duration (churn p99)
                 cooperative=background,
+                compact=compact,
+                compact_capacity=compact_capacity,
+                hits_estimate=hits_estimate,
             )
         snap.rebuild()
         self._snap = snap

@@ -1039,6 +1039,145 @@ def _packed_core(
     )
 
 
+def _compact_core(
+    table,
+    pat_kind,
+    pat_depth,
+    pat_mask,
+    packed_tokens,
+    *,
+    max_levels,
+    capacity,
+):
+    """Device-resident hit compaction (ROADMAP item 1): match ``B`` topics
+    and compact every real hit into packed ``(topic_idx, subscriber_id)``
+    pairs ON DEVICE, so the D2H transfer scales with the hits that exist
+    (~``hits x 8`` bytes) instead of the padded result geometry.
+
+    The probe head emits per-probe contiguous sid ranges; a segmented
+    prefix-sum over the ``[B, P]`` count matrix assigns each output slot
+    its source segment — each non-empty segment scatters its id at its
+    first output slot and a running max fills the gaps (O(B*P + K),
+    where a searchsorted formulation costs O(K log(B*P)) and measurably
+    dominates the whole match kernel on wide capacities) — and the
+    slot's sid is recomputed from the segment's range start: no host
+    expansion, no per-topic padding.
+
+    Output: ONE int32 vector ``[2 + 2B + capacity]`` =
+    ``(n_hits, batch_overflow | totals[B] | overflow[B] |
+    pair_sid[capacity])`` (-1-padded). The pair stream is TOPIC-MAJOR,
+    so each pair's topic_idx is reconstructed for free on the host by
+    walking the per-topic totals — the logical ``(topic_idx, sid)``
+    pair moves 4 bytes, not 8. ``n_hits`` is the TRUE hit count even
+    when it exceeds ``capacity``: the host uses it to size the next
+    batch's capacity, and ``batch_overflow`` routes THIS batch onto the
+    padded-ranges path (compaction never guesses — an overflowing batch
+    pays one extra round trip, a fitting batch transfers only its
+    hits)."""
+    import jax
+    import jax.numpy as jnp
+
+    L = (packed_tokens.shape[1] - 2) // 2
+    tok1 = jax.lax.bitcast_convert_type(packed_tokens[:, :L], jnp.uint32)
+    tok2 = jax.lax.bitcast_convert_type(packed_tokens[:, L : 2 * L], jnp.uint32)
+    lengths = packed_tokens[:, 2 * L]
+    is_dollar = packed_tokens[:, 2 * L + 1].astype(bool)
+    B = lengths.shape[0]
+    P = pat_depth.shape[0]
+    if P == 0:  # empty index: no hits, nothing overflows
+        z = jnp.zeros((B,), jnp.int32)
+        return jnp.concatenate(
+            [
+                jnp.zeros((2,), jnp.int32),
+                z,
+                z,
+                jnp.full((capacity,), -1, jnp.int32),
+            ]
+        )
+    start, cnt, totals, overflow = flat_match_ranges_core(
+        table,
+        pat_kind,
+        pat_depth,
+        pat_mask,
+        tok1,
+        tok2,
+        lengths,
+        is_dollar,
+        max_levels=max_levels,
+    )
+    c_flat = cnt.reshape(B * P)
+    cum = jnp.cumsum(c_flat)  # inclusive prefix sum over segments
+    offs = cum - c_flat  # exclusive
+    n_hits = cum[-1]
+    seg_c = _segment_of_slot(c_flat, offs, capacity)
+    k = jnp.arange(capacity, dtype=jnp.int32)
+    sid = start.reshape(-1)[seg_c] + (k - offs[seg_c].astype(jnp.int32))
+    valid = k < n_hits
+    header = jnp.stack(
+        [n_hits, (n_hits > capacity).astype(jnp.int32)]
+    )
+    return jnp.concatenate(
+        [
+            header,
+            totals,
+            overflow.astype(jnp.int32),
+            jnp.where(valid, sid, -1),
+        ]
+    )
+
+
+def _segment_of_slot(c_flat, offs, capacity: int):
+    """Which segment supplies each compacted output slot: every
+    non-empty segment scatters ``id + 1`` at its first output offset,
+    a running max fills the runs, minus one recovers the id. O(S + K)
+    device work. Slots past the real hit count read the last marked
+    segment — callers mask them with their own validity test; a
+    segment whose offset lands past ``capacity`` clips onto the last
+    slot, which only happens on a batch that overflows (and therefore
+    falls back) anyway."""
+    import jax
+    import jax.numpy as jnp
+
+    n_segs = c_flat.shape[0]
+    seg_ids = jnp.arange(n_segs, dtype=jnp.int32)
+    nonzero = c_flat > 0
+    targets = jnp.where(
+        nonzero, jnp.minimum(offs, capacity - 1), capacity - 1
+    ).astype(jnp.int32)
+    marks = jnp.zeros((capacity,), jnp.int32).at[targets].max(
+        jnp.where(nonzero, seg_ids + 1, 0)
+    )
+    seg = jax.lax.cummax(marks) - 1
+    return jnp.clip(seg, 0, n_segs - 1)
+
+
+def donation_supported() -> bool:
+    """True when the default backend honors buffer donation (TPU/GPU).
+    The CPU backend ignores donations with a per-call warning, so the
+    compact path only donates its staging buffer where it actually
+    buys the memory reuse (SNIPPETS.md [1]/[3] ``donate_argnums``)."""
+    import jax
+
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:  # pragma: no cover - uninitialized backend  # brokerlint: ok=R4 conservative default: no donation when the backend cannot be queried
+        return False
+
+
+def _jit_compact():
+    import jax
+
+    donate = (4,) if donation_supported() else ()
+    return partial(
+        jax.jit,
+        static_argnames=("max_levels", "capacity"),
+        donate_argnums=donate,
+    )(_compact_core)
+
+
+flat_match_compact = _LazyJit(_jit_compact)
+
+
 def _scatter_core(table, idx, rows):
     """Functional bucket-row scatter: the fold's device-side update. The
     caller pads ``idx``/``rows`` to a power-of-two length by repeating the

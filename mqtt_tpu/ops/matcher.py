@@ -14,6 +14,7 @@ PROFILE.md for the trace-backed analysis and the flat design's budget.
 
 from __future__ import annotations
 
+import logging
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
@@ -29,6 +30,7 @@ from .flat import (
     FlatIndex,
     _bucket,
     build_flat_index,
+    flat_match_compact,
     flat_match_packed,
     flat_match_ranges,
     pack_tokens,
@@ -40,6 +42,8 @@ from .hashing import tokenize_topics
 # is benign: native.accel() is itself memoized and returns one module
 _ACCEL_MEMO: Optional[object] = None
 _ACCEL_RESOLVED = False
+
+_log = logging.getLogger("mqtt_tpu.ops.matcher")
 
 
 def _accel():
@@ -120,6 +124,180 @@ def subscribers_equal(a: Subscribers, b: Subscribers) -> bool:
     )
 
 
+def pick_compact_capacity(
+    pinned: int,
+    hits_ewma: float,
+    b_padded: int,
+    max_hits: int,
+    held_caps: dict,
+) -> int:
+    """The shared pair-buffer capacity policy (single-device and
+    mesh-sharded matchers — one implementation so the hysteresis can
+    never desynchronize). A pinned capacity is honored at its bucket
+    (no floor: the operator chose the overflow/transfer trade-off);
+    the adaptive pick sizes EWMA x 1.5 headroom, pow2-bucketed, capped
+    at the theoretical hit bound, and STICKY per batch bucket: grow
+    the moment the need does (overflows are the expensive path) but
+    shrink only once the need sits 4x below the held capacity —
+    chasing the EWMA down through every pow2 bucket would pay a fresh
+    XLA compile per step, which measurably dwarfs anything the smaller
+    transfer saves. ``held_caps`` (batch bucket -> capacity) is the
+    caller-owned sticky state."""
+    if pinned > 0:
+        return _bucket(max(1, min(pinned, max_hits)), minimum=8)
+    need = _bucket(
+        max(1, min(int(b_padded * hits_ewma * 1.5) + 64, max_hits)),
+        minimum=256,
+    )
+    held = held_caps.get(b_padded, 0)
+    if need > held or need * 4 <= held:
+        held_caps[b_padded] = held = need
+    return held
+
+
+def fold_hits_ewma(ewma: float, n_hits: int, b: int) -> float:
+    """One batch's true hit count folded into the capacity EWMA."""
+    if b <= 0:
+        return ewma
+    return 0.7 * ewma + 0.3 * (n_hits / b)
+
+
+def resolve_compact_py(
+    pair_sid: np.ndarray,
+    pair_shard: Optional[np.ndarray],
+    totals: np.ndarray,
+    host_route: np.ndarray,
+    topics: list[str],
+    subs_table: Any,
+    tables: Optional[list] = None,
+    n_hits: Optional[int] = None,
+) -> tuple[list, list[int]]:
+    """The pure-Python compacted-pair expansion — the semantic source of
+    truth the C fast path (accelmod.resolve_compact) is pinned against.
+    The pair stream is topic-major; ``totals`` drives the cursor, so each
+    pair's topic index is implicit. Host-routed rows skip their pairs and
+    land in the overflow index list (the caller re-walks them).
+
+    ``n_hits`` (when given) enforces the same geometry invariant the C
+    path checks: the totals must account for exactly the pair stream —
+    a mismatch means the caller mixed buffers from different batches
+    and raises, never a silent mis-expansion (list slicing would
+    quietly truncate otherwise)."""
+    if n_hits is not None:
+        claimed = int(totals.sum())
+        if claimed != n_hits or n_hits > len(pair_sid):
+            raise ValueError(
+                "compact pair stream and totals disagree "
+                f"(totals claim {claimed}, n_hits {n_hits}, "
+                f"stream {len(pair_sid)})"
+            )
+    sids = pair_sid.tolist()
+    shards = pair_shard.tolist() if pair_shard is not None else None
+    tot = totals.tolist()
+    route = host_route.tolist()
+    results: list = []
+    ovf_idx: list[int] = []
+    cursor = 0
+    n = len(topics)
+    for i, t in enumerate(tot):
+        if i >= n:
+            break  # bucket-padding rows: nothing to materialize
+        if route[i]:
+            ovf_idx.append(i)
+            results.append(None)
+            cursor += t
+            continue
+        subs = Subscribers()
+        if shards is None:
+            expand_sids(subs_table, sids[cursor : cursor + t], subs)
+        else:
+            assert tables is not None
+            # group this topic's pairs by shard run (pairs are emitted
+            # shard-major within a topic; sid spaces are shard-local)
+            j = cursor
+            end = cursor + t
+            while j < end:
+                s = shards[j]
+                k = j
+                while k < end and shards[k] == s:
+                    k += 1
+                expand_sids(tables[s], sids[j:k], subs, seen=set())
+                j = k
+        results.append(subs)
+        cursor += t
+    return results, ovf_idx
+
+
+def materialize_compact_pairs(
+    stats: "MatcherStats",
+    host_walk: Callable[[str], Subscribers],
+    pair_sid: np.ndarray,
+    pair_shard: Optional[np.ndarray],
+    totals: np.ndarray,
+    host_route: np.ndarray,
+    n_hits: int,
+    topics: list[str],
+    subs_table: Any,
+    window: int,
+    true_overflow: np.ndarray,
+    tables: Optional[list] = None,
+) -> list[Subscribers]:
+    """Expand one device-compacted batch into Subscribers results —
+    shared by the single-device and mesh-sharded matchers. ``totals``
+    drives a cursor over the topic-major pair stream (padded rows
+    included); host-routed topics skip their pairs and re-walk the live
+    trie. ``pair_shard``/``tables`` serve the sharded form."""
+    acc = _accel()
+    results: Optional[list] = None
+    ovf_idx: list[int] = []
+    if acc is not None and hasattr(acc, "resolve_compact"):
+        try:
+            results, ovf_idx = acc.resolve_compact(
+                np.ascontiguousarray(pair_sid),
+                None if pair_shard is None
+                else np.ascontiguousarray(pair_shard),
+                np.ascontiguousarray(totals),
+                np.ascontiguousarray(host_route.astype(np.int32)),
+                int(n_hits),
+                len(topics),
+                subs_table.snaps if tables is None
+                else [t.snaps for t in tables],
+                window,
+                Subscribers,
+            )
+        except ValueError:
+            # the C path's geometry tripwire (mixed-batch buffers):
+            # deliberate and NOT recoverable — the Python expansion
+            # would silently truncate on the same inputs, which is
+            # exactly the mis-expansion the check exists to prevent
+            raise
+        except Exception:  # pragma: no cover - C/py parity is pinned
+            # a genuine C-side fault (layout/runtime): the Python
+            # expansion is the bit-identical fallback, and it re-checks
+            # the geometry invariant itself so nothing degrades silently
+            _log.exception("C resolve_compact failed; python expansion")
+            results = None
+    if results is None:
+        results, ovf_idx = resolve_compact_py(
+            pair_sid, pair_shard, totals, host_route, topics, subs_table,
+            tables, n_hits=int(n_hits),
+        )
+    for i in ovf_idx:
+        topic = topics[i]
+        if topic:
+            stats.host_fallbacks += 1
+            # routed-only rows are fallbacks but not device overflows
+            stats.overflows += int(bool(true_overflow[i]))
+            results[i] = host_walk(topic)
+        else:
+            results[i] = Subscribers()
+    if "" in topics:  # empty topic never matches (host-walk parity)
+        for i, topic in enumerate(topics):
+            if not topic:
+                results[i] = Subscribers()
+    return results
+
+
 @dataclass
 class MatcherStats:
     """Observability counters for a device matcher (SURVEY §5 tracing
@@ -142,6 +320,13 @@ class MatcherStats:
     # topics served by the exact-map host fast path (wildcard-free filter
     # sets answer from one dict probe; no device round trip)
     host_fast: int = 0
+    # device-resident hit compaction (ROADMAP item 1): batches whose
+    # results transferred as packed (topic_idx, sid) pairs, batches whose
+    # hit count overflowed the compaction capacity (served by the padded
+    # path for that batch only), and the actual D2H result bytes moved
+    compact_batches: int = 0
+    compact_overflows: int = 0
+    d2h_bytes: int = 0
     # optional per-rebuild duration observer (the telemetry plane's
     # compile/rebuild histogram — mqtt_tpu.telemetry); set by the server
     rebuild_observer: Optional[Callable[[float], None]] = None
@@ -166,6 +351,9 @@ class MatcherStats:
             "rebuild_seconds": round(self.rebuild_seconds, 3),
             "folds": self.folds,
             "host_fast": self.host_fast,
+            "compact_batches": self.compact_batches,
+            "compact_overflows": self.compact_overflows,
+            "d2h_bytes": self.d2h_bytes,
         }
         out["fallback_ratio"] = (
             round(self.host_fallbacks / self.topics, 6) if self.topics else 0.0
@@ -195,6 +383,9 @@ class TpuMatcher:
         transfer_slots: Optional[int] = None,
         window: int = 16,
         cooperative: bool = False,
+        compact: bool = True,
+        compact_capacity: int = 0,
+        hits_estimate: float = 2.0,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
@@ -207,6 +398,18 @@ class TpuMatcher:
         # retired knob (kept for API continuity): the packed transfer is
         # per-probe ranges — complete results at 2P+2 ints/topic
         self.transfer_slots = min(transfer_slots or out_slots, out_slots)
+        # device-resident hit compaction (ROADMAP item 1): results come
+        # back as packed (topic_idx, sid) pairs sized for the hits that
+        # exist. compact_capacity pins the pair buffer (0 = adaptive from
+        # the observed hits-per-topic EWMA, seeded by hits_estimate —
+        # the server wires TopicSketch's avg_hits_per_topic here).
+        self.compact = compact
+        self.compact_capacity = max(0, compact_capacity)
+        self._hits_ewma = max(1.0, float(hits_estimate))
+        # sticky per-batch-bucket capacities (see _compact_capacity_for):
+        # every distinct capacity is one XLA executable, so the pick must
+        # not chase the EWMA through pow2 buckets compile after compile
+        self._caps: dict[int, int] = {}
         self.stats = MatcherStats()
         # device pipeline profiler (mqtt_tpu.tracing.DeviceProfiler) or
         # None; set by the server (or bench.py). match_topics_async
@@ -404,23 +607,38 @@ class TpuMatcher:
         tok1, tok2, lengths, is_dollar, len_overflow = tokenize_topics(
             padded, flat.max_levels, flat.salt
         )
-        packed_dev = flat_match_packed(
-            *arrays,
-            jnp.asarray(pack_tokens(tok1, tok2, lengths, is_dollar)),
-            max_levels=flat.max_levels,
-        )
+        # the host copy stays alive for the overflow fallback's re-upload:
+        # the compact dispatch may DONATE the device-side staging buffer
+        # (flat.donation_supported), after which it must not be reused
+        host_tokens = pack_tokens(tok1, tok2, lengths, is_dollar)
+        P = flat.pat_depth.shape[0]
+        use_compact = self.compact and P > 0 and self._compact_pays(P)
+        capacity = 0
+        if use_compact:
+            capacity = self._compact_capacity_for(len(padded), flat)
+            out_dev = flat_match_compact(
+                *arrays,
+                jnp.asarray(host_tokens),
+                max_levels=flat.max_levels,
+                capacity=capacity,
+            )
+        else:
+            out_dev = flat_match_packed(
+                *arrays,
+                jnp.asarray(host_tokens),
+                max_levels=flat.max_levels,
+            )
         try:
             # start the D2H as soon as the kernel finishes instead of when
             # the resolver blocks: on a high-RTT tunneled link this overlaps
             # the transfer with the pipeline's other in-flight batches
-            packed_dev.copy_to_host_async()
+            out_dev.copy_to_host_async()
         except AttributeError:  # pragma: no cover - older jax arrays
             pass
         if prof is not None:
             # device pipeline profiler: the issue leg (tokenize + H2D +
             # async dispatch) ends here; the device window opens now
             prof.note_dispatch(rec, t_issue0, time.perf_counter())
-        P = flat.pat_depth.shape[0]
         if route_to_host is None:
             pred = batch_pred = None
         elif hasattr(route_to_host, "affected_batch"):
@@ -429,55 +647,214 @@ class TpuMatcher:
         else:
             pred = route_to_host
             batch_pred = None
+        # the pre-compaction transfer geometries, stamped per batch so the
+        # bench's device_pipeline block reports the measured reduction:
+        # ranges = the previous production path ([B, 2P+2] ints), dense =
+        # the classic padded slot buffer ([B, out_slots] ints)
+        bytes_ranges = len(padded) * (2 * P + 2) * 4
+        bytes_dense = len(padded) * self.out_slots * 4
 
-        def resolve() -> list[Subscribers]:
+        if not use_compact:
+
+            def resolve() -> list[Subscribers]:
+                t_sync0 = time.perf_counter() if prof is not None else 0.0
+                packed = np.asarray(out_dev)  # ONE D2H: [B, 2P+2]
+                if prof is not None:
+                    # the blocking D2H sync just completed: close the
+                    # device window (kernel + transfer) on this record
+                    self._stamp_bytes(rec, packed.nbytes, bytes_ranges, bytes_dense, False)
+                    prof.note_resolve(rec, t_sync0, time.perf_counter())
+                stats = self.stats
+                stats.batches += 1
+                stats.topics += len(topics)
+                stats.d2h_bytes += int(packed.nbytes)
+                # the ranges row carries per-topic totals: feed the same
+                # hits EWMA the compact path uses, so the encoding pick
+                # (_compact_pays) keeps adapting from EITHER path
+                self._observe_hits(
+                    int(packed[: len(topics), 2 * P].sum()), len(topics)
+                )
+                packed = packed[: len(topics)]  # drop bucket-padding rows
+                return self._resolve_ranges(
+                    packed, topics, flat, P,
+                    len_overflow[: len(topics)], pred, batch_pred,
+                )
+
+            return resolve
+
+        def resolve_compact() -> list[Subscribers]:
             t_sync0 = time.perf_counter() if prof is not None else 0.0
-            packed = np.asarray(packed_dev)  # ONE D2H: [B, 2P+2]
-            if prof is not None:
-                # the blocking D2H sync just completed: close the device
-                # window (kernel + transfer) on this batch's record
-                prof.note_resolve(rec, t_sync0, time.perf_counter())
-            packed = packed[: len(topics)]  # drop bucket-padding rows
+            out = np.asarray(out_dev)  # ONE D2H: [2 + 2B + 2K] ints
+            bp = len(padded)
+            n_hits = int(out[0])
+            batch_ovf = bool(out[1])
             stats = self.stats
             stats.batches += 1
             stats.topics += len(topics)
-            acc = _accel()
-            if acc is not None:
-                return self._resolve_native(
-                    acc, packed, topics, flat, P,
+            self._observe_hits(n_hits, b)
+            if batch_ovf:
+                # hits outgrew the pair buffer: THIS batch re-runs on the
+                # padded-ranges path (one extra dispatch+sync, still
+                # bit-identical); the EWMA above already absorbed the
+                # true hit count, so the next capacity pick fits
+                stats.compact_overflows += 1
+                self._hits_ewma = max(self._hits_ewma, n_hits / max(1, b))
+                packed = np.asarray(
+                    flat_match_packed(
+                        *arrays,
+                        jnp.asarray(host_tokens),
+                        max_levels=flat.max_levels,
+                    )
+                )
+                d2h_bytes = int(out.nbytes + packed.nbytes)
+                stats.d2h_bytes += d2h_bytes
+                if prof is not None:
+                    self._stamp_bytes(rec, d2h_bytes, bytes_ranges, bytes_dense, True, overflow=True)
+                    prof.note_resolve(rec, t_sync0, time.perf_counter())
+                return self._resolve_ranges(
+                    packed[: len(topics)], topics, flat, P,
                     len_overflow[: len(topics)], pred, batch_pred,
                 )
-            # the ONLY host-route class left: device overflow (sat/spill)
-            # or >max_levels topics — ranges carry the COMPLETE result,
-            # so every fallback is also an overflow
-            overflow = (
-                packed[:, 2 * P + 1].astype(bool) | len_overflow[: len(topics)]
-            ).tolist()
-            # one bulk C conversion: per-row numpy slicing costs ~10us of
-            # fixed overhead per topic, plain list walks are ~10x cheaper
-            out_rows = packed[:, : 2 * P].tolist()
-            results = []
-            results_append = results.append
-            table = flat.subs
-            for i, topic in enumerate(topics):
-                if not topic:
-                    results_append(Subscribers())  # empty topic never matches
-                elif overflow[i] or (pred is not None and pred(topic)):
-                    stats.host_fallbacks += 1
-                    stats.overflows += int(overflow[i])
-                    results_append(self.topics.subscribers(topic))  # host fallback
-                else:
-                    row = out_rows[i]
-                    sids = []
-                    for p in range(P):
-                        c = row[P + p]
-                        if c:
-                            s0 = row[p]
-                            sids.extend(range(s0, s0 + c))
-                    results_append(expand_sids(table, sids, Subscribers()))
-            return results
+            if prof is not None:
+                self._stamp_bytes(rec, int(out.nbytes), bytes_ranges, bytes_dense, True)
+                prof.note_resolve(rec, t_sync0, time.perf_counter())
+            stats.compact_batches += 1
+            stats.d2h_bytes += int(out.nbytes)
+            totals = out[2 : 2 + bp]
+            true_overflow = out[2 + bp : 2 + 2 * bp].astype(bool) | len_overflow
+            pair_sid = out[2 + 2 * bp : 2 + 2 * bp + capacity]
+            if batch_pred is not None:
+                routed = batch_pred(topics)
+            elif pred is not None:
+                routed = [i for i, t in enumerate(topics) if t and pred(t)]
+            else:
+                routed = ()
+            host_route = true_overflow.copy()
+            if len(routed):
+                host_route[np.asarray(routed, dtype=np.int64)] = True
+            return self._materialize_pairs(
+                pair_sid, None, totals, host_route, n_hits, topics, flat,
+                true_overflow,
+            )
 
-        return resolve
+        return resolve_compact
+
+    def _compact_pays(self, P: int) -> bool:
+        """The transfer-optimal encoding pick. The padded-ranges row
+        costs ``2P+2`` ints/topic regardless of hits; the compacted
+        stream costs ~``hits x 1.5`` (headroom) + 2 ints/topic. Dense
+        workloads (hits/topic high vs the probe count — cfg 2's 1M
+        `+`-subs measures ~11 hits at P=4) are ALREADY optimally encoded
+        by the contiguous synthetic-sid ranges, and expanding them to
+        pairs would transfer MORE; sparse workloads (deep/`#` mixes,
+        exact-heavy sets, most real MQTT subscription shapes) win with
+        pairs. Both paths stay bit-identical and both feed the same
+        hits EWMA, so the pick adapts with the workload. A pinned
+        ``compact_capacity`` forces the compact path (the operator
+        chose)."""
+        if self.compact_capacity > 0:
+            return True
+        return self._hits_ewma * 1.5 + 2.0 < 2.0 * P + 2.0
+
+    def _compact_capacity_for(self, b_padded: int, flat) -> int:
+        """The pair-buffer capacity for one batch (pick_compact_capacity:
+        pinned-or-adaptive with sticky pow2 buckets), capped at the
+        theoretical hit bound (P probes x window ids per topic)."""
+        max_hits = b_padded * int(flat.pat_depth.shape[0]) * flat.window
+        return pick_compact_capacity(
+            self.compact_capacity, self._hits_ewma, b_padded, max_hits,
+            self._caps,
+        )
+
+    def _observe_hits(self, n_hits: int, b: int) -> None:
+        """Feed one batch's true hit count into the capacity EWMA."""
+        self._hits_ewma = fold_hits_ewma(self._hits_ewma, n_hits, b)
+
+    @staticmethod
+    def _stamp_bytes(
+        rec, d2h_bytes: int, bytes_ranges: int, bytes_dense: int,
+        compact: bool, overflow: bool = False,
+    ) -> None:
+        """Stamp one batch's transfer accounting onto its BatchProfile
+        (mqtt_tpu.tracing) — the device profiler folds these into the
+        bench device_pipeline block's reduction ratios."""
+        if rec is None:
+            return
+        rec.d2h_bytes = d2h_bytes
+        rec.d2h_bytes_ranges = bytes_ranges
+        rec.d2h_bytes_dense = bytes_dense
+        rec.compact = compact
+        rec.compact_overflow = overflow
+
+    def _materialize_pairs(
+        self,
+        pair_sid: np.ndarray,
+        pair_shard: Optional[np.ndarray],
+        totals: np.ndarray,
+        host_route: np.ndarray,
+        n_hits: int,
+        topics: list[str],
+        flat,
+        true_overflow: np.ndarray,
+        tables: Optional[list] = None,
+    ) -> list[Subscribers]:
+        return materialize_compact_pairs(
+            self.stats,
+            self.topics.subscribers,
+            pair_sid,
+            pair_shard,
+            totals,
+            host_route,
+            n_hits,
+            topics,
+            flat.subs,
+            flat.window,
+            true_overflow,
+            tables=tables,
+        )
+
+    def _resolve_ranges(
+        self, packed, topics, flat, P, len_overflow, pred, batch_pred
+    ) -> list[Subscribers]:
+        """Materialize one already-synced padded-ranges batch (the
+        pre-compaction production form, and the compact path's per-batch
+        overflow fallback): C materializer when available, the Python
+        loop otherwise."""
+        acc = _accel()
+        if acc is not None:
+            return self._resolve_native(
+                acc, packed, topics, flat, P, len_overflow, pred, batch_pred
+            )
+        stats = self.stats
+        # the ONLY host-route class left: device overflow (sat/spill)
+        # or >max_levels topics — ranges carry the COMPLETE result,
+        # so every fallback is also an overflow
+        overflow = (
+            packed[:, 2 * P + 1].astype(bool) | len_overflow
+        ).tolist()
+        # one bulk C conversion: per-row numpy slicing costs ~10us of
+        # fixed overhead per topic, plain list walks are ~10x cheaper
+        out_rows = packed[:, : 2 * P].tolist()
+        results = []
+        results_append = results.append
+        table = flat.subs
+        for i, topic in enumerate(topics):
+            if not topic:
+                results_append(Subscribers())  # empty topic never matches
+            elif overflow[i] or (pred is not None and pred(topic)):
+                stats.host_fallbacks += 1
+                stats.overflows += int(overflow[i])
+                results_append(self.topics.subscribers(topic))  # host fallback
+            else:
+                row = out_rows[i]
+                sids = []
+                for p in range(P):
+                    c = row[P + p]
+                    if c:
+                        s0 = row[p]
+                        sids.extend(range(s0, s0 + c))
+                results_append(expand_sids(table, sids, Subscribers()))
+        return results
 
     def _match_exact_fast(self, topics: list[str], flat, route_to_host):
         """Serve a batch from the exact-map (wildcard-free filter sets):

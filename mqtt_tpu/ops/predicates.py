@@ -41,6 +41,11 @@ OP_LTE = 4
 OP_EQ = 5
 OP_NE = 6
 OP_CONTAINS = 7
+# aggregation ops (host-stateful windows; the REDUCTION runs on device
+# for large windows — agg_reduce below)
+OP_MEAN = 8
+OP_MAX = 9
+OP_MIN = 10
 
 
 def rules_eval_core(op, slot, thresh, cbit, feats, cmask):
@@ -81,6 +86,64 @@ def _jit_rules_eval():
 
 
 rules_eval = _LazyJit(_jit_rules_eval)
+
+
+def agg_reduce_core(vals, ops, counts):
+    """Reduce ``W`` completed aggregation windows in ONE fused dispatch:
+    ``vals`` is float32 ``[W, N]`` NaN-padded (window buffers packed by
+    the host), ``ops`` int32 ``[W]`` (OP_MEAN/OP_MAX/OP_MIN), ``counts``
+    int32 ``[W]`` live samples per window. Returns float32 ``[W]``.
+
+    This is the PR 8 carried-over residual: large predicate windows ride
+    a compact device reduction — only the ``W`` aggregates come back,
+    the per-row value columns never materialize host-side. MEAN reduces
+    in float32 (device-native); MAX/MIN are order-insensitive and
+    bit-identical to the host interpreter."""
+    import jax.numpy as jnp
+
+    live = ~jnp.isnan(vals)
+    s = jnp.where(live, vals, 0.0).sum(axis=1)
+    mean = s / jnp.maximum(counts.astype(jnp.float32), 1.0)
+    mx = jnp.where(live, vals, -jnp.inf).max(axis=1)
+    mn = jnp.where(live, vals, jnp.inf).min(axis=1)
+    return jnp.select([ops == OP_MEAN, ops == OP_MAX], [mean, mx], default=mn)
+
+
+def _jit_agg_reduce():
+    import jax
+
+    return jax.jit(agg_reduce_core)
+
+
+agg_reduce = _LazyJit(_jit_agg_reduce)
+
+
+def agg_reduce_batch(pending: list) -> Optional[np.ndarray]:
+    """Host driver for one fused window-reduction dispatch. ``pending``
+    is a list of ``(op_code, values)`` with ``values`` a non-empty
+    sequence of floats; returns float32 ``[len(pending)]`` aggregates,
+    or None when no jax backend is importable (the caller host-reduces).
+    Shapes are power-of-two bucketed so churn in window count or width
+    reuses a handful of jitted executables."""
+    try:
+        import jax.numpy as jnp
+    except ImportError:
+        return None
+    w = len(pending)
+    n = max(len(values) for _op, values in pending)
+    wp = _bucket(max(1, w), minimum=2)
+    np_ = _bucket(max(1, n), minimum=8)
+    vals = np.full((wp, np_), np.nan, dtype=np.float32)
+    ops = np.zeros(wp, dtype=np.int32)
+    counts = np.ones(wp, dtype=np.int32)
+    for i, (op, values) in enumerate(pending):
+        vals[i, : len(values)] = np.asarray(values, dtype=np.float32)
+        ops[i] = op
+        counts[i] = len(values)
+    out = agg_reduce(
+        jnp.asarray(vals), jnp.asarray(ops), jnp.asarray(counts)
+    )
+    return np.asarray(out)[:w]
 
 
 class DeviceRuleEvaluator:

@@ -50,6 +50,8 @@ def shard_map(*args, disable_rep_check=False, **kwargs):
         kwargs[_REP_KWARG] = False
     return _shard_map(*args, **kwargs)
 
+from functools import partial
+
 from ..telemetry import Histogram
 from ..topics import Mutation, Subscribers, TopicsIndex
 from ..ops.flat import (
@@ -64,7 +66,14 @@ from ..ops.flat import (
     flat_match_core,
 )
 from ..ops.hashing import tokenize_topics
-from ..ops.matcher import MatcherStats, _accel, expand_sids
+from ..ops.matcher import (
+    MatcherStats,
+    _accel,
+    expand_sids,
+    fold_hits_ewma,
+    materialize_compact_pairs,
+    pick_compact_capacity,
+)
 
 _log = logging.getLogger("mqtt_tpu.parallel")
 
@@ -78,6 +87,55 @@ def make_mesh(devices=None, batch_axis: Optional[int] = None) -> Mesh:
     subs_axis = n // batch_axis
     grid = np.array(devices[: batch_axis * subs_axis]).reshape(batch_axis, subs_axis)
     return Mesh(grid, ("batch", "subs"))
+
+
+def _tile_compact_core(out, totals, overflow, *, cap_local):
+    """Compact one batch-tile's gathered result ON DEVICE (ROADMAP item
+    1 feeding item 2's cheap all-gather): the device's local
+    ``[S, b_local, K]`` -1-padded slot view becomes a topic-major
+    ``(shard, sid)`` pair stream sized for the hits that exist, so the
+    D2H moves ~``hits x 8`` bytes instead of ``S x B x K x 4``.
+
+    Runs INSIDE a shard_map over the ``batch`` mesh axis (the gathered
+    arrays come from a ``check_rep``-disabled shard_map, whose claimed
+    replication plain jitted jnp code must not trust — the same reason
+    the match step itself is explicit SPMD). Per-tile output row:
+    ``[2 + 2*b_local + 2*cap_local]`` = ``(tile_hits, tile_overflow |
+    totals[b_local] | overflow[b_local] | pair_shard[cap_local] |
+    pair_sid[cap_local])``. Per-segment counts are clamped to ``K`` —
+    rows past the slot window are overflow-flagged by the kernel and
+    host-routed, so their surplus never reaches the pair stream."""
+    import jax.numpy as jnp
+
+    from ..ops.flat import _segment_of_slot
+
+    S, bl, K = out.shape
+    out_t = jnp.transpose(out, (1, 0, 2)).reshape(bl * S, K)
+    t_flat = jnp.minimum(jnp.transpose(totals, (1, 0)).reshape(bl * S), K)
+    cum = jnp.cumsum(t_flat)
+    offs = cum - t_flat
+    n_hits = cum[-1]
+    k = jnp.arange(cap_local, dtype=jnp.int32)
+    seg_c = _segment_of_slot(t_flat, offs, cap_local)
+    slot = jnp.minimum(k - offs[seg_c].astype(jnp.int32), K - 1)
+    sid = out_t[seg_c, slot]
+    shard = seg_c % S
+    valid = k < n_hits
+    per_topic = jnp.minimum(totals, K).sum(axis=0).astype(jnp.int32)
+    ovf_topic = overflow.any(axis=0).astype(jnp.int32)
+    header = jnp.stack(
+        [n_hits.astype(jnp.int32), (n_hits > cap_local).astype(jnp.int32)]
+    )
+    vec = jnp.concatenate(
+        [
+            header,
+            per_topic,
+            ovf_topic,
+            jnp.where(valid, shard, -1),
+            jnp.where(valid, sid, -1),
+        ]
+    )
+    return vec[None, :]
 
 
 def shard_of(kind, client: str, filter: str, identifier: int, n_shards: int) -> int:
@@ -117,6 +175,9 @@ class ShardedTpuMatcher:
         out_slots: int = 64,
         window: int = 16,
         incremental: bool = True,
+        compact: bool = True,
+        compact_capacity: int = 0,
+        hits_estimate: float = 2.0,
     ) -> None:
         self.topics = topics
         self.mesh = mesh or make_mesh()
@@ -127,6 +188,15 @@ class ShardedTpuMatcher:
         self.n_shards = self.mesh.shape["subs"]
         self.n_batch = self.mesh.shape["batch"]
         self.incremental = incremental
+        # device-resident hit compaction of the gathered result (see
+        # _gather_compact_core); same knob contract as TpuMatcher
+        self.compact = compact
+        self.compact_capacity = max(0, compact_capacity)
+        self._hits_ewma = max(1.0, float(hits_estimate))
+        # sticky per-batch-bucket capacities (TpuMatcher contract: grow
+        # immediately, shrink only at 4x oversize — every distinct
+        # capacity is one XLA executable)
+        self._caps: dict[int, int] = {}
         self.stats = MatcherStats()
         # device pipeline profiler (mqtt_tpu.tracing.DeviceProfiler) or
         # None; same seam as TpuMatcher.profiler (ops/matcher.py) — the
@@ -151,6 +221,9 @@ class ShardedTpuMatcher:
         self._dirty = [False] * self.n_shards
         self._salt = 0
         self._step: Optional[Callable] = None
+        # jitted per-tile compaction steps, keyed on cap_local (each
+        # capacity is one executable; jax re-traces per input shape)
+        self._compact_steps: dict[int, Callable] = {}
         # per-shard compile-time histogram SHARDS (mqtt_tpu.telemetry):
         # the thread compiling shard s records into shard s's local
         # histogram — no cross-thread write sharing — and the scrape
@@ -530,6 +603,28 @@ class ShardedTpuMatcher:
         self._step = step
         return step
 
+    def _get_compact_step(self, cap_local: int) -> Callable:
+        """The jitted shard_map'd per-tile compaction for one local
+        capacity (cached; jax re-traces per input shape)."""
+        step = self._compact_steps.get(cap_local)
+        if step is None:
+            fn = partial(_tile_compact_core, cap_local=cap_local)
+            step = jax.jit(
+                shard_map(
+                    fn,
+                    mesh=self.mesh,
+                    in_specs=(
+                        P(None, "batch", None),
+                        P(None, "batch"),
+                        P(None, "batch"),
+                    ),
+                    out_specs=P("batch", None),
+                    disable_rep_check=True,
+                )
+            )
+            self._compact_steps[cap_local] = step
+        return step
+
     @property
     def stale(self) -> bool:
         return self._compiled is None or self._built_version != self.topics.version
@@ -572,6 +667,24 @@ class ShardedTpuMatcher:
                 for a in (tok1, tok2, lengths, is_dollar)
             ),
         )
+        bp = len(padded)
+        bl = bp // self.n_batch
+        cap_local = 0
+        compact_dev = None
+        if self.compact:
+            # compact the gathered result ON DEVICE before any transfer:
+            # the [S, B, K] slot buffer collapses to per-tile topic-major
+            # (shard, sid) pair streams sized for the hits that exist
+            cap_local = max(
+                16, self._compact_capacity_for(bp) // self.n_batch
+            )
+            compact_dev = self._get_compact_step(cap_local)(
+                out_dev, totals_dev, overflow_dev
+            )
+            try:
+                compact_dev.copy_to_host_async()
+            except AttributeError:  # pragma: no cover - older jax arrays
+                pass
         if prof is not None:
             # device pipeline profiler: the SPMD issue leg ends here
             prof.note_dispatch(rec, t_issue0, time.perf_counter())
@@ -579,17 +692,21 @@ class ShardedTpuMatcher:
         # the delta overlay object exposing .affected
         if route_to_host is not None and hasattr(route_to_host, "affected"):
             route_to_host = route_to_host.affected
+        # the pre-compaction transfer geometry: the full gathered slot
+        # buffer — what the resolver synced before this PR
+        bytes_padded = self.n_shards * bp * self.out_slots * 4
 
-        def resolve() -> list[Subscribers]:
-            t_sync0 = time.perf_counter() if prof is not None else 0.0
+        def resolve_full(t_sync0: float) -> list[Subscribers]:
             out = np.asarray(out_dev)  # [S, B, K]
             overflow = np.asarray(overflow_dev).any(axis=0) | len_overflow  # [B]
+            self.stats.d2h_bytes += int(out.nbytes)
             if prof is not None:
+                rec.d2h_bytes += int(out.nbytes)
+                rec.d2h_bytes_ranges += int(out.nbytes)
+                rec.d2h_bytes_dense += bytes_padded
                 prof.note_resolve(rec, t_sync0, time.perf_counter())
             results = []
             stats = self.stats
-            stats.batches += 1
-            stats.topics += b
             acc = _accel()  # once per batch, not per topic
             for i, topic in enumerate(topics):
                 if not topic:
@@ -604,7 +721,107 @@ class ShardedTpuMatcher:
                     results.append(self._expand(tables, out[:, i, :], acc))
             return results
 
-        return resolve
+        if compact_dev is None:
+
+            def resolve() -> list[Subscribers]:
+                t_sync0 = time.perf_counter() if prof is not None else 0.0
+                self.stats.batches += 1
+                self.stats.topics += b
+                return resolve_full(t_sync0)
+
+            return resolve
+
+        def resolve_compact() -> list[Subscribers]:
+            t_sync0 = time.perf_counter() if prof is not None else 0.0
+            # [n_batch, 2 + 2*bl + 2*cap_local]: one compacted row per
+            # batch tile (shard_map over the batch axis)
+            rows = np.asarray(compact_dev)
+            stats = self.stats
+            stats.batches += 1
+            stats.topics += b
+            n_hits = int(rows[:, 0].sum())
+            batch_ovf = bool(rows[:, 1].any())
+            self._observe_hits(n_hits, b)
+            if batch_ovf:
+                # a tile outgrew its pair buffer: fall back to the full
+                # gathered transfer for THIS batch only (the device
+                # arrays are still resident — one extra sync, no
+                # recompute)
+                stats.compact_overflows += 1
+                self._hits_ewma = max(self._hits_ewma, n_hits / max(1, b))
+                # the compacted stream was synced too: both transfers
+                # count (resolve_full adds the full gather's bytes)
+                stats.d2h_bytes += int(rows.nbytes)
+                if rec is not None:
+                    rec.compact = True
+                    rec.compact_overflow = True
+                    rec.d2h_bytes = int(rows.nbytes)
+                return resolve_full(t_sync0)
+            stats.compact_batches += 1
+            stats.d2h_bytes += int(rows.nbytes)
+            if prof is not None:
+                rec.d2h_bytes = int(rows.nbytes)
+                rec.d2h_bytes_ranges = bytes_padded
+                rec.d2h_bytes_dense = bytes_padded
+                rec.compact = True
+                prof.note_resolve(rec, t_sync0, time.perf_counter())
+            # stitch the per-tile streams back into one topic-major batch
+            per_topic = rows[:, 2 : 2 + bl].reshape(bp)
+            true_overflow = (
+                rows[:, 2 + bl : 2 + 2 * bl].reshape(bp).astype(bool)
+                | len_overflow
+            )
+            tile_hits = rows[:, 0]
+            pair_shard = np.concatenate(
+                [
+                    rows[t, 2 + 2 * bl : 2 + 2 * bl + tile_hits[t]]
+                    for t in range(rows.shape[0])
+                ]
+            ) if n_hits else np.zeros(0, dtype=rows.dtype)
+            pair_sid = np.concatenate(
+                [
+                    rows[
+                        t,
+                        2 + 2 * bl + cap_local : 2 + 2 * bl + cap_local
+                        + tile_hits[t],
+                    ]
+                    for t in range(rows.shape[0])
+                ]
+            ) if n_hits else np.zeros(0, dtype=rows.dtype)
+            host_route = true_overflow.copy()
+            if route_to_host is not None:
+                for i, topic in enumerate(topics):
+                    if topic and route_to_host(topic):
+                        host_route[i] = True
+            return materialize_compact_pairs(
+                stats,
+                self.topics.subscribers,
+                pair_sid,
+                pair_shard,
+                per_topic,
+                host_route,
+                n_hits,
+                topics,
+                None,
+                self.window,
+                true_overflow,
+                tables=tables,
+            )
+
+        return resolve_compact
+
+    def _compact_capacity_for(self, b_padded: int) -> int:
+        """Pair-buffer capacity for one gathered batch (the shared
+        pick_compact_capacity policy), capped at the slot-buffer bound
+        the gather could actually fill."""
+        max_hits = b_padded * self.n_shards * self.out_slots
+        return pick_compact_capacity(
+            self.compact_capacity, self._hits_ewma, b_padded, max_hits,
+            self._caps,
+        )
+
+    def _observe_hits(self, n_hits: int, b: int) -> None:
+        self._hits_ewma = fold_hits_ewma(self._hits_ewma, n_hits, b)
 
     def match_topics(self, topics: list[str], route_to_host=None) -> list[Subscribers]:
         """Match a batch of topics; every result is bit-identical to the

@@ -245,17 +245,28 @@ class CompiledRule:
 
 
 class _AggWindow:
-    """One (rule, subscriber) aggregation accumulator."""
+    """One (rule, subscriber) aggregation accumulator.
 
-    __slots__ = ("count", "total", "best")
+    Small windows accumulate in O(1) state (running total / best —
+    reducing them on device would cost more dispatch than it saves).
+    LARGE windows (``PredicateEngine.device_agg_min_window``) BUFFER the
+    raw samples instead: completed buffers from one fan-out tick reduce
+    in ONE fused device dispatch (ops/predicates.agg_reduce), and only
+    the aggregates come back — the PR 8 carried-over residual."""
 
-    def __init__(self) -> None:
+    __slots__ = ("count", "total", "best", "values")
+
+    def __init__(self, buffered: bool = False) -> None:
         self.count = 0
         self.total = 0.0
         self.best = math.nan
+        self.values: Optional[list[float]] = [] if buffered else None
 
     def add(self, op: int, v: float) -> None:
         self.count += 1
+        if self.values is not None:
+            self.values.append(v)
+            return
         self.total += v
         if math.isnan(self.best):
             self.best = v
@@ -265,11 +276,37 @@ class _AggWindow:
             self.best = min(self.best, v)
 
     def emit(self, op: int) -> float:
+        # unbuffered windows only: buffered completions drain through
+        # take_values() into the fused device/host reduction instead
+        assert self.values is None
         value = self.total / self.count if op == OP_MEAN else self.best
         self.count = 0
         self.total = 0.0
         self.best = math.nan
         return value
+
+    def take_values(self) -> list[float]:
+        """Drain the buffered samples (buffered windows only)."""
+        assert self.values is not None
+        vals = self.values
+        self.values = []
+        self.count = 0
+        return vals
+
+
+def host_reduce_window(op: int, values: list[float]) -> float:
+    """The host window reduction — the differential oracle for the
+    device ``agg_reduce`` kernel and the degradation path when it is
+    unavailable. MAX/MIN reduce over float32-coerced samples (the
+    device's dtype; float32 rounding is monotone, so the coerced
+    reduction picks the same element the device does — host fallback
+    and device path stay bit-identical). MEAN accumulates in float64
+    (the device reduces in float32 — the sampled oracle compares with
+    a relative tolerance)."""
+    if op == OP_MEAN:
+        return sum(values) / len(values)
+    vals32 = [float(np.float32(v)) for v in values]
+    return max(vals32) if op == OP_MAX else min(vals32)
 
 
 class PredicateEngine:
@@ -288,9 +325,21 @@ class PredicateEngine:
         oracle_sample: int = 64,
         breaker=None,
         registry=None,
+        device_agg_min_window: int = 32,
     ) -> None:
         self.max_rules = max(1, max_rules)
         self.oracle_sample = max(0, oracle_sample)
+        # aggregation windows at least this wide buffer raw samples and
+        # reduce on device in one fused dispatch per fan-out tick
+        # (ops/predicates.agg_reduce); smaller windows keep the O(1)
+        # host accumulator. <= 0 disables device reductions entirely.
+        self.device_agg_min_window = device_agg_min_window
+        # the device dispatch engages only when one fan-out tick
+        # completed at least this many windows (the mass-fan-out shape
+        # the reduction is for): the samples are host-resident, so a
+        # single window's round trip would only add link latency —
+        # the host reduction serves it in microseconds
+        self.device_agg_min_batch = 4
         self._lock = threading.Lock()
         self._rules: dict[str, CompiledRule] = {}
         self._fields: dict[str, int] = {}  # field name -> feature slot
@@ -319,6 +368,7 @@ class PredicateEngine:
         self.filtered = 0  # deliveries suppressed by a failing predicate
         self.deliveries = 0  # predicated deliveries that passed
         self.agg_emits = 0  # synthesized aggregate publishes emitted
+        self.agg_device_reductions = 0  # windows reduced on device
         self.oracle_checks = 0
         self.oracle_mismatches = 0
         self.device_batches = 0
@@ -638,14 +688,18 @@ class PredicateEngine:
         agg_key: str,
         oracle: bool,
         memo: list,
-    ) -> tuple[bool, list]:
-        """One subscriber's verdict: ``(deliver_raw, emissions)`` where
-        emissions are (suffix, value) aggregate completions. OR
-        semantics across the subscriber's predicates; aggregation rules
-        withhold raw delivery and accumulate instead."""
+    ) -> tuple[bool, list, list]:
+        """One subscriber's verdict: ``(deliver_raw, emissions,
+        pending)`` where emissions are (suffix, value) aggregate
+        completions and pending are ``(op, values)`` BUFFERED window
+        completions the caller reduces on device (one fused dispatch for
+        every window the fan-out tick completed). OR semantics across
+        the subscriber's predicates; aggregation rules withhold raw
+        delivery and accumulate instead."""
         deliver = False
         saw_filter = False
         emissions: list = []
+        pending: list = []
         for suffix in predicates:
             rule = self._rules.get(suffix)
             if rule is None:
@@ -664,10 +718,20 @@ class PredicateEngine:
                 if not math.isnan(v):
                     win = self._agg.get((suffix, agg_key))
                     if win is None:
-                        win = self._agg[(suffix, agg_key)] = _AggWindow()
+                        buffered = (
+                            self.device_agg_min_window > 0
+                            and spec.window >= self.device_agg_min_window
+                            and self._device_enabled
+                        )
+                        win = self._agg[(suffix, agg_key)] = _AggWindow(
+                            buffered
+                        )
                     win.add(spec.op, v)
                     if win.count >= spec.window:
-                        emissions.append((suffix, win.emit(spec.op)))
+                        if win.values is not None:
+                            pending.append((spec.op, win.take_values()))
+                        else:
+                            emissions.append((suffix, win.emit(spec.op)))
                 continue
             saw_filter = True
             if not deliver and self._rule_passes(
@@ -676,7 +740,7 @@ class PredicateEngine:
                 deliver = True
         # an aggregation-only subscription receives ONLY synthesized
         # aggregates; mixed subscriptions deliver raw when a filter passes
-        return deliver if saw_filter else False, emissions
+        return deliver if saw_filter else False, emissions, pending
 
     def apply(
         self, subs: Subscribers, payload: bytes, feats=None
@@ -697,16 +761,22 @@ class PredicateEngine:
         )
         memo: list = [None]  # one JSON parse per publish on the host path
         emissions: list = []
+        # buffered large-window completions collected across EVERY
+        # subscriber this publish matched, reduced in ONE fused device
+        # dispatch after the walk (ops/predicates.agg_reduce)
+        agg_pending: list = []
         drop: list = []
         for cid, sub in subs.subscriptions.items():
             preds = sub.predicates
             if not preds:
                 continue
-            deliver, emits = self._decide(
+            deliver, emits, pend = self._decide(
                 preds, payload, feats, cid, oracle, memo
             )
             for _suffix, value in emits:
                 emissions.append(("client", cid, sub, _format_agg(value)))
+            for op, values in pend:
+                agg_pending.append(("client", cid, sub, op, values))
             if deliver:
                 self.deliveries += 1
             else:
@@ -724,7 +794,7 @@ class PredicateEngine:
                 for cid, sub in members.items():
                     if not sub.predicates:
                         continue
-                    deliver, emits = self._decide(
+                    deliver, emits, pend = self._decide(
                         sub.predicates,
                         payload,
                         feats,
@@ -736,6 +806,8 @@ class PredicateEngine:
                         emissions.append(
                             ("client", cid, sub, _format_agg(value))
                         )
+                    for op, values in pend:
+                        agg_pending.append(("client", cid, sub, op, values))
                     if deliver:
                         self.deliveries += 1
                     else:
@@ -753,11 +825,13 @@ class PredicateEngine:
             for iid, isub in subs.inline_subscriptions.items():
                 if not isub.predicates:
                     continue
-                deliver, emits = self._decide(
+                deliver, emits, pend = self._decide(
                     isub.predicates, payload, feats, f"$inline:{iid}", oracle, memo
                 )
                 for _suffix, value in emits:
                     emissions.append(("inline", isub, isub, _format_agg(value)))
+                for op, values in pend:
+                    agg_pending.append(("inline", isub, isub, op, values))
                 if deliver:
                     self.deliveries += 1
                 else:
@@ -766,9 +840,66 @@ class PredicateEngine:
                 self.filtered += len(idrop)
                 for iid in idrop:
                     del subs.inline_subscriptions[iid]
+        if agg_pending:
+            self._flush_agg(agg_pending, emissions, oracle)
         if emissions:
             self.agg_emits += len(emissions)
         return subs, emissions
+
+    def _flush_agg(
+        self, agg_pending: list, emissions: list, oracle: bool
+    ) -> None:
+        """Reduce the buffered windows this fan-out tick completed in
+        ONE fused device dispatch and append the synthesized emissions.
+        Only the aggregates transfer back; the dispatch engages when the
+        tick batched at least ``device_agg_min_batch`` windows AND the
+        breaker admits the device (an open breaker serves every window
+        from the host reduction silently — same never-drop posture as
+        rule evaluation, never a per-tick failing dispatch)."""
+        values_out = None
+        if (
+            len(agg_pending) >= max(1, self.device_agg_min_batch)
+            and self._device_enabled
+            and self.breaker.allow()
+        ):
+            try:
+                from .ops.predicates import agg_reduce_batch
+
+                values_out = agg_reduce_batch(
+                    [(op, values) for _k, _t, _s, op, values in agg_pending]
+                )
+                if values_out is not None:
+                    self.breaker.record_success()
+            except Exception:
+                _log.exception("device window reduction failed; host path")
+                self.device_errors += 1
+                self.breaker.record_failure("agg")
+                values_out = None
+        if values_out is not None:
+            self.agg_device_reductions += len(agg_pending)
+            if oracle:
+                # sampled differential: MAX/MIN must be bit-identical
+                # (both sides reduce float32-coerced samples), MEAN
+                # within float32 accumulation tolerance
+                for got, (_k, _t, _s, op, values) in zip(
+                    values_out, agg_pending
+                ):
+                    self.oracle_checks += 1
+                    want = host_reduce_window(op, values)
+                    tol = 1e-5 * max(1.0, abs(want)) if op == OP_MEAN else 0.0
+                    if abs(float(got) - want) > tol:
+                        self.oracle_mismatches += 1
+                        _log.warning(
+                            "window-reduction oracle mismatch: device=%r "
+                            "host=%r op=%d n=%d",
+                            float(got), want, op, len(values),
+                        )
+        for i, (kind, target, sub, op, values) in enumerate(agg_pending):
+            if values_out is not None:
+                value = float(values_out[i])
+            else:
+                value = host_reduce_window(op, values)
+            emissions.append((kind, target, sub, _format_agg(value)))
 
     def passes_retained(self, sub, payload: bytes) -> bool:
         """Gate one retained message against a fresh subscription's
@@ -822,6 +953,7 @@ class PredicateEngine:
             "filtered_ratio": round(self.filtered_ratio(), 6),
             "agg_emits": self.agg_emits,
             "agg_windows": len(self._agg),
+            "agg_device_reductions": self.agg_device_reductions,
             "oracle_checks": self.oracle_checks,
             "oracle_mismatches": self.oracle_mismatches,
             "device_errors": self.device_errors,
@@ -841,6 +973,10 @@ class PredicateEngine:
             ("mqtt_tpu_predicate_filtered_total", "filtered"),
             ("mqtt_tpu_predicate_deliveries_total", "deliveries"),
             ("mqtt_tpu_predicate_agg_emits_total", "agg_emits"),
+            (
+                "mqtt_tpu_predicate_agg_device_reductions_total",
+                "agg_device_reductions",
+            ),
             ("mqtt_tpu_predicate_oracle_checks_total", "oracle_checks"),
             ("mqtt_tpu_predicate_oracle_mismatches_total", "oracle_mismatches"),
             ("mqtt_tpu_predicate_device_errors_total", "device_errors"),

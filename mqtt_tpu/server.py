@@ -175,6 +175,19 @@ class Options:
     # p99 latency budget for one staged publish (staging.MatchStage adapts
     # window + batch cap to hold it); <= 0 disables adaptation
     matcher_stage_latency_budget_ms: float = 250.0
+    # overlapped-staging depth (mqtt_tpu.staging): batches in flight
+    # across the h2d-tokenize / device-dispatch / d2h-drain legs
+    # (ROADMAP item 1); <= 0 falls back to matcher_stage_max_inflight
+    matcher_stage_pipeline_depth: int = 3
+    # device-resident hit compaction (ops/flat.flat_match_compact):
+    # match results transfer as packed (topic_idx, sid) pairs sized for
+    # the hits that exist; a batch whose hits outgrow the pair buffer
+    # falls back to the padded path for that batch only
+    matcher_compact: bool = True
+    # pinned pair-buffer capacity; 0 = adaptive from the observed
+    # hits-per-topic EWMA (seeded by the TopicSketch's avg_hits_per_topic
+    # when the host observatory is on)
+    matcher_compact_capacity: int = 0
     # degradation manager (mqtt_tpu.resilience): wrap every device dispatch
     # in a circuit breaker + hang watchdog; timeouts/errors/corrupt results
     # route matching to the bit-identical host trie and background probes
@@ -780,7 +793,20 @@ class Server:
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
-            self.matcher = DeltaMatcher(self.topics, **(opts.matcher_opts or {}))
+            # compaction knobs ride beside matcher_opts (which wins on
+            # conflict); the hits-per-topic capacity seed comes from the
+            # TopicSketch when the host observatory is on (its EWMA then
+            # keeps learning from every compacted batch)
+            mopts: dict = {
+                "compact": opts.matcher_compact,
+                "compact_capacity": opts.matcher_compact_capacity,
+            }
+            if self.topic_sketch is not None:
+                mopts["hits_estimate"] = max(
+                    2.0, self.topic_sketch.avg_hits_per_topic()
+                )
+            mopts.update(opts.matcher_opts or {})
+            self.matcher = DeltaMatcher(self.topics, **mopts)
             if opts.matcher_resilience:
                 # degradation manager (mqtt_tpu.resilience): breaker +
                 # hang watchdog + half-open probes around every dispatch
@@ -965,6 +991,7 @@ class Server:
                 telemetry=self.telemetry,
                 profiler=self.profiler,
                 predicates=self._predicates,
+                pipeline_depth=self.options.matcher_stage_pipeline_depth,
             )
             self._stage.start()
             if self.overload is not None:
@@ -1085,6 +1112,23 @@ class Server:
             fn=lambda: 0 if self._stage is None else self._stage.pending_depth,
         )
         r.gauge(
+            "mqtt_tpu_staging_pipeline_depth",
+            "Device batches in flight across the staging pipeline legs",
+            fn=lambda: (
+                0 if self._stage is None else self._stage.inflight_batches
+            ),
+        )
+        r.counter(
+            "mqtt_tpu_staging_compact_overflow_total",
+            "Batches whose compacted hits outgrew the pair buffer and "
+            "fell back to the padded path (MatcherStats.compact_overflows)",
+            fn=lambda: (
+                0
+                if self.matcher is None
+                else getattr(self.matcher.stats, "compact_overflows", 0)
+            ),
+        )
+        r.gauge(
             "mqtt_tpu_outbound_backlog",
             "Aggregate publishes parked in client outbound queues "
             "(last overload-sweep sample)",
@@ -1107,6 +1151,8 @@ class Server:
             ("mqtt_tpu_matcher_rebuilds_total", "rebuilds"),
             ("mqtt_tpu_matcher_folds_total", "folds"),
             ("mqtt_tpu_matcher_host_fast_total", "host_fast"),
+            ("mqtt_tpu_matcher_compact_batches_total", "compact_batches"),
+            ("mqtt_tpu_matcher_d2h_bytes_total", "d2h_bytes"),
         ):
             r.counter(
                 name,

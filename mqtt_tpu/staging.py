@@ -12,11 +12,16 @@ pipelined batch engine:
   other client keeps being served.
 - A collector task gathers everything submitted within the accumulation
   window (or up to the batch cap) and issues ONE ``match_topics_async``
-  dispatch — the issue side runs on the event loop (host tokenization is
-  native C and the device dispatch is asynchronous), so batches are
-  dispatched ahead while earlier ones are still resolving (the
-  depth-``max_inflight`` pipeline that hides the host<->device round
-  trip).
+  dispatch. The issue leg (host tokenize + H2D + async device dispatch)
+  runs on its OWN dispatch thread (``mqtt-tpu-h2d``), the blocking D2H
+  sync + host materialization on another (``mqtt-tpu-resolve``), and the
+  kernel itself is asynchronous on the device — a ``pipeline_depth``-deep
+  (default 3) overlapped pipeline in which batch N+2 tokenizes while
+  N+1 matches and N drains, so the event loop never carries staging
+  work and the device never waits for the host between batches. Per-leg
+  handoff waits are measured into the telemetry plane
+  (``mqtt_tpu_staging_leg_wait_seconds{leg=h2d|d2h}``) — the numbers
+  that must sit near zero when the pipeline is actually full.
 - The window and the batch cap ADAPT to the measured per-batch service
   time against ``latency_budget_s`` (SURVEY §7 hard part 4: "adaptive
   batch window + host fast-path"): under light load the window shrinks
@@ -42,6 +47,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
@@ -67,9 +73,15 @@ class MatchStage:
         telemetry=None,
         profiler=None,
         predicates=None,
+        pipeline_depth: int = 3,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
+        # overlapped-staging depth: how many batches may be in flight
+        # across the h2d-tokenize / device-dispatch / d2h-drain legs
+        # (0 falls back to max_inflight for embedders pinning the old
+        # knob). Depth 3 keeps one batch per leg.
+        self.pipeline_depth = pipeline_depth if pipeline_depth > 0 else max_inflight
         # MQTT+ predicate engine (mqtt_tpu.predicates.PredicateEngine) or
         # None. When attached, each batch's payload-feature rows ride to
         # the device BESIDE the tokenized topics — one extra dispatch,
@@ -113,6 +125,14 @@ class MatchStage:
         # (mqtt_tpu.profiling) attributes the blocking D2H sync to the
         # staging pipeline instead of an anonymous default-executor slot
         self._executor: Optional[ThreadPoolExecutor] = None
+        # the issue leg's dedicated SINGLE dispatch thread
+        # ("mqtt-tpu-h2d-0"): tokenize + H2D + async device dispatch run
+        # here, in batch order, off the event loop — batch N+2 tokenizes
+        # while N+1's kernel runs and N drains on the resolve leg
+        self._h2d_executor: Optional[ThreadPoolExecutor] = None
+        # batches currently inside the pipeline (enqueued or draining);
+        # exported as mqtt_tpu_staging_pipeline_depth
+        self.inflight_batches = 0
         self._stopping = False
         self._ewma_s = 0.0  # per-batch service-time EWMA (drainer-updated)
         self._batch_cap = max_batch if latency_budget_s is None else max(
@@ -180,9 +200,15 @@ class MatchStage:
             max_workers=max(2, self.max_inflight),
             thread_name_prefix="mqtt-tpu-resolve",
         )
+        # ONE issue thread: the h2d leg must stay in batch order (the
+        # drain loop completes futures in submission order)
+        self._h2d_executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="mqtt-tpu-h2d"
+        )
         # bounded: if resolution falls behind, collection backpressures
         # instead of queueing unbounded device batches
-        self._queue = asyncio.Queue(maxsize=self.max_inflight)
+        self._queue = asyncio.Queue(maxsize=self.pipeline_depth)
+        self.inflight_batches = 0  # a restarted stage begins empty
         self._tasks = [
             loop.create_task(self._collect_loop(), name="mqtt-tpu-stage-collect"),
             loop.create_task(self._drain_loop(), name="mqtt-tpu-stage-drain"),
@@ -202,15 +228,17 @@ class MatchStage:
         queue = self._queue
         if queue is not None:
             while not queue.empty():
-                _resolver, futs, topics, _clocks, _rec, _pred, _feats = (
-                    queue.get_nowait()
-                )
+                _resolver, futs, topics, *_rest = queue.get_nowait()
+                self.inflight_batches -= 1
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
         if self._executor is not None:
             # in-flight resolves may finish on their own time; queued
             # ones are dead (their futures just resolved via fallback)
             self._executor.shutdown(wait=False, cancel_futures=True)
             self._executor = None
+        if self._h2d_executor is not None:
+            self._h2d_executor.shutdown(wait=False, cancel_futures=True)
+            self._h2d_executor = None
 
     # -- submission --------------------------------------------------------
 
@@ -278,8 +306,8 @@ class MatchStage:
         _pending growth is the real overload signal)."""
         p = len(self._pending) / self.max_pending
         q = 0.0
-        if self._queue is not None and self.max_inflight > 0:
-            q = self._queue.qsize() / self.max_inflight
+        if self._queue is not None and self.pipeline_depth > 0:
+            q = self._queue.qsize() / self.pipeline_depth
         return max(p, 0.5 * q)
 
     # -- pipeline ----------------------------------------------------------
@@ -324,46 +352,81 @@ class MatchStage:
             for c in clocks:
                 if c is not None:  # end of the accumulation/park wait
                     c.stamp("staging_wait")
-            try:
-                if self.profiler is not None:
+            # the ISSUE leg runs on the dedicated h2d dispatch thread,
+            # in batch order (single worker): host tokenize + H2D + the
+            # async device dispatch leave the event loop free, and batch
+            # N+2 tokenizes while N+1's kernel runs and N drains — the
+            # 3-deep overlap the device profiler's duty cycle gates on
+            t_formed = time.perf_counter()
+            profiler = self.profiler
+            predicates = self.predicates
+            matcher = self.matcher
+            telemetry = self.telemetry
+
+            def issue():
+                if telemetry is not None:
+                    # h2d-leg handoff wait: batch formed -> issue start
+                    telemetry.observe_leg_wait(
+                        "h2d", time.perf_counter() - t_formed
+                    )
+                if profiler is not None:
                     # per-batch device-timing record (mqtt_tpu.tracing):
                     # the matcher fills its dispatch/D2H windows, the
                     # drain loop sub-stamps sampled clocks from it — the
                     # batch's OWN record, so concurrent or out-of-order
                     # resolution (the resilience guard pool) can never
                     # cross-attribute boundaries
-                    rec = self.profiler.open_batch()
-                    resolver = self.matcher.match_topics_async(
+                    rec = profiler.open_batch()
+                    resolver = matcher.match_topics_async(
                         topics, profile=rec
                     )
                 else:
                     rec = None
-                    resolver = self.matcher.match_topics_async(topics)
+                    resolver = matcher.match_topics_async(topics)
+                # MQTT+ predicate evaluation rides the SAME staged
+                # batch: one extra async dispatch against the device
+                # rule table, resolved in the same drain-loop executor
+                # leg as the match result — no additional device round
+                # trip. A None resolver (no rules, breaker open, eval
+                # error) leaves the carriers unstamped and the fan-out
+                # host interpreter decides.
+                pred_resolver = None
+                if predicates is not None:
+                    try:
+                        pred_resolver = predicates.eval_batch_async(feats)
+                    except Exception:
+                        _log.exception(
+                            "predicate eval issue failed; host interpreter"
+                        )
+                return resolver, pred_resolver, rec
+
+            loop = asyncio.get_running_loop()
+            try:
+                resolver, pred_resolver, rec = await loop.run_in_executor(
+                    self._h2d_executor, issue
+                )
+            except asyncio.CancelledError:
+                # stop() cancelled us with this batch in hand (in neither
+                # _pending nor the queue): resolve it before going down.
+                # An issue that already reached the device is harmless —
+                # its result is simply never synced.
+                self._fallback_all(batch, klass="stop")
+                raise
             except Exception:
                 _log.exception("stage issue failed; host fallback for batch")
                 self._fallback_all(batch, klass="issue_error")
                 continue
-            # MQTT+ predicate evaluation rides the SAME staged batch:
-            # one extra async dispatch against the device rule table,
-            # resolved in the same drain-loop executor leg as the match
-            # result — no additional device round trip. A None resolver
-            # (no rules, breaker open, eval error) leaves the carriers
-            # unstamped and the fan-out host interpreter decides.
-            pred_resolver = None
-            if self.predicates is not None:
-                try:
-                    pred_resolver = self.predicates.eval_batch_async(feats)
-                except Exception:
-                    _log.exception(
-                        "predicate eval issue failed; host interpreter"
-                    )
+            t_ready = time.perf_counter()
+            self.inflight_batches += 1
             try:
                 await queue.put(
-                    (resolver, futs, topics, clocks, rec, pred_resolver, feats)
+                    (
+                        resolver, futs, topics, clocks, rec, pred_resolver,
+                        feats, t_ready,
+                    )
                 )
             except asyncio.CancelledError:
-                # stop() cancelled us with this batch in hand (in neither
-                # _pending nor the queue): resolve it before going down
+                self.inflight_batches -= 1
                 self._fallback_all(batch, klass="stop")
                 raise
 
@@ -371,10 +434,12 @@ class MatchStage:
         loop = asyncio.get_running_loop()
         queue = self._queue
         assert queue is not None  # start() created us
+        telemetry = self.telemetry
         while True:
-            resolver, futs, topics, clocks, rec, pred_resolver, feats = (
-                await queue.get()
-            )
+            (
+                resolver, futs, topics, clocks, rec, pred_resolver, feats,
+                t_ready,
+            ) = await queue.get()
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
                 # sampled at resolve time: batches still queued waited for
@@ -383,29 +448,40 @@ class MatchStage:
                 # pred resolver never raises — failures degrade to None).
                 depth = queue.qsize() + 1
                 t0 = loop.time()
-                if pred_resolver is None:
-                    results = await loop.run_in_executor(
-                        self._executor, resolver
-                    )
-                else:
-                    pr, mr = pred_resolver, resolver
-                    results, pred_rows = await loop.run_in_executor(
-                        self._executor, lambda: (mr(), pr())
-                    )
+                pr, mr = pred_resolver, resolver
+
+                def sync():
+                    if telemetry is not None:
+                        # d2h-leg handoff wait: dispatch returned (batch
+                        # queued behind the pipeline) -> sync start
+                        telemetry.observe_leg_wait(
+                            "d2h", time.perf_counter() - t_ready
+                        )
+                    if pr is None:
+                        return mr(), None
+                    return mr(), pr()
+
+                results, pred_rows = await loop.run_in_executor(
+                    self._executor, sync
+                )
+                if pred_rows is not None and self.predicates is not None:
                     self.predicates.attach_rows(feats, pred_rows)
                 dt = loop.time() - t0
                 self._observe_service(dt, len(topics), depth)
-                if self.telemetry is not None:
-                    self.telemetry.observe_batch(dt, len(topics), self._batch_cap)
+                if telemetry is not None:
+                    telemetry.observe_batch(dt, len(topics), self._batch_cap)
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch already popped: it is
                 # invisible to stop()'s queue drain, so resolve it here
+                self.inflight_batches -= 1
                 self._fallback_all(list(zip(topics, futs)), klass="stop")
                 raise
             except Exception:
+                self.inflight_batches -= 1
                 _log.exception("stage resolve failed; host fallback for batch")
                 self._fallback_all(list(zip(topics, futs)), klass="resolve_error")
                 continue
+            self.inflight_batches -= 1
             # this batch's own device-timing record: both windows are
             # set only when the batch actually dispatched AND synced —
             # the exact-map fast path and host fallbacks leave them

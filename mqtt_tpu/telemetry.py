@@ -660,6 +660,20 @@ class Telemetry:
             "mqtt_tpu_outbound_queue_wait_seconds",
             "Sampled wait of an outbound publish in a client queue",
         )
+        # per-leg pipeline handoff waits (ROADMAP item 1's 3-deep
+        # overlapped staging): how long a formed batch waited before the
+        # h2d issue thread picked it up, and how long an issued batch
+        # waited before the d2h drain thread started its sync — both sit
+        # near zero when the pipeline is actually full
+        self.leg_wait = {
+            leg: r.histogram(
+                "mqtt_tpu_staging_leg_wait_seconds",
+                "Per-batch handoff wait before a staging pipeline leg "
+                "started",
+                leg=leg,
+            )
+            for leg in ("h2d", "d2h")
+        }
         self.fallback = {
             k: r.counter(
                 "mqtt_tpu_stage_fallback_total",
@@ -891,6 +905,13 @@ class Telemetry:
         if cap > 0:
             self.batch_fill.observe(min(1.0, n / cap))
 
+    def observe_leg_wait(self, leg: str, dt: float) -> None:
+        """One pipeline-leg handoff wait (called from the staging loop's
+        h2d/resolve dispatch threads)."""
+        h = self.leg_wait.get(leg)
+        if h is not None:
+            h.observe(dt)
+
     def note_fallback(self, klass: str, n: int = 1) -> None:
         c = self.fallback.get(klass)
         if c is not None:
@@ -974,6 +995,16 @@ class Telemetry:
         for s, h in self.stage_hist.items():
             if h.count:
                 stages[s] = {
+                    "count": h.count,
+                    "p50_ms": round(h.percentile(0.5) * 1e3, 3),
+                    "p99_ms": round(h.percentile(0.99) * 1e3, 3),
+                }
+        for leg, h in self.leg_wait.items():
+            # per-leg pipeline handoff waits render as stage rows so
+            # exp/stage_gate.py diffs them round over round (new names
+            # pass through its new_stage_names notice on round one)
+            if h.count:
+                stages[f"leg_wait_{leg}"] = {
                     "count": h.count,
                     "p50_ms": round(h.percentile(0.5) * 1e3, 3),
                     "p99_ms": round(h.percentile(0.99) * 1e3, 3),

@@ -274,7 +274,10 @@ class BatchProfile:
     any "most recent resolve" pairing. Tuple assignments are atomic
     under the GIL; a reader sees either None or a complete window."""
 
-    __slots__ = ("dispatch", "d2h")
+    __slots__ = (
+        "dispatch", "d2h", "d2h_bytes", "d2h_bytes_ranges",
+        "d2h_bytes_dense", "compact", "compact_overflow",
+    )
 
     def __init__(self) -> None:
         # (start, end) of the tokenize+dispatch issue leg; None until
@@ -283,6 +286,18 @@ class BatchProfile:
         self.dispatch: Optional[tuple[float, float]] = None
         # (start, end) of the blocking D2H result sync
         self.d2h: Optional[tuple[float, float]] = None
+        # transfer accounting (ROADMAP item 1's compaction gap): the
+        # actual D2H result bytes this batch moved, beside the bytes the
+        # pre-compaction geometries would have moved — ranges = the
+        # packed [B, 2P+2] form, dense = the padded [B, max_hits] slot
+        # buffer. 0 = the matcher did not stamp this batch.
+        self.d2h_bytes = 0
+        self.d2h_bytes_ranges = 0
+        self.d2h_bytes_dense = 0
+        # True when the result came back as compacted (topic, sid) pairs;
+        # compact_overflow marks the per-batch padded-path fallback
+        self.compact = False
+        self.compact_overflow = False
 
 
 class DeviceProfiler:
@@ -320,6 +335,16 @@ class DeviceProfiler:
         self._busy_s = 0.0  # union of device windows
         self._window_s = 0.0  # sum of device windows
         self._overlap_s = 0.0
+        # device-resident compaction accounting (ROADMAP item 1): bytes
+        # actually transferred vs the pre-compaction geometries, and the
+        # compacted-batch / overflow-fallback split — stamped per batch
+        # on its BatchProfile by the matcher
+        self.compact_batches = 0
+        self.compact_overflows = 0
+        self.d2h_bytes_total = 0
+        self.d2h_bytes_ranges_total = 0
+        self.d2h_bytes_dense_total = 0
+        self._bytes_batches = 0  # batches that stamped transfer bytes
         if registry is not None:
             self.issue_hist = registry.histogram(
                 "mqtt_tpu_device_issue_seconds",
@@ -332,6 +357,11 @@ class DeviceProfiler:
             self.idle_gap_hist = registry.histogram(
                 "mqtt_tpu_device_idle_gap_seconds",
                 "Device-idle stretches between consecutive batch windows",
+            )
+            self.compact_d2h_hist = registry.histogram(
+                "mqtt_tpu_device_compact_d2h_seconds",
+                "Blocking D2H sync wall time of compacted-result batches "
+                "(the compaction d2h leg)",
             )
             registry.gauge(
                 "mqtt_tpu_device_duty_cycle_ratio",
@@ -348,6 +378,7 @@ class DeviceProfiler:
             self.issue_hist = Histogram()
             self.d2h_hist = Histogram()
             self.idle_gap_hist = Histogram()
+            self.compact_d2h_hist = Histogram()
 
     # -- recording (matcher hooks) -----------------------------------------
 
@@ -369,10 +400,22 @@ class DeviceProfiler:
         boundaries live on the batch's own record."""
         rec.d2h = (sync_start, sync_end)
         self.d2h_hist.observe(sync_end - sync_start)
+        if getattr(rec, "compact", False):
+            self.compact_d2h_hist.observe(sync_end - sync_start)
         if rec.dispatch is None:
             return  # never dispatched (shouldn't happen): histogram only
         t_disp = rec.dispatch[1]
         with self._lock:
+            if getattr(rec, "d2h_bytes", 0):
+                self._bytes_batches += 1
+                self.d2h_bytes_total += rec.d2h_bytes
+                self.d2h_bytes_ranges_total += rec.d2h_bytes_ranges
+                self.d2h_bytes_dense_total += rec.d2h_bytes_dense
+            if getattr(rec, "compact", False):
+                if rec.compact_overflow:
+                    self.compact_overflows += 1
+                else:
+                    self.compact_batches += 1
             end = max(sync_end, t_disp)
             self.batches += 1
             if self._first_t is None:
@@ -404,7 +447,7 @@ class DeviceProfiler:
         """The BENCH-json device-pipeline block (configs 2 and 8): the
         exact numbers ROADMAP item 1's overlapped-staging work must
         move, baselined per round so the gap is diffable."""
-        return {
+        out = {
             "batches": self.batches,
             "duty_cycle": round(self.duty_cycle(), 4),
             "overlap_ratio": round(self.overlap_ratio(), 4),
@@ -415,3 +458,30 @@ class DeviceProfiler:
             ),
             "idle_gap_count": self.idle_gap_hist.count,
         }
+        with self._lock:
+            nb = self._bytes_batches
+            if nb:
+                # the compaction transfer ledger (ROADMAP item 1's D2H
+                # criterion): actual result bytes per batch beside the
+                # pre-compaction geometries and the reduction they imply
+                out["d2h_bytes_per_batch"] = round(self.d2h_bytes_total / nb)
+                out["d2h_bytes_ranges_per_batch"] = round(
+                    self.d2h_bytes_ranges_total / nb
+                )
+                out["d2h_bytes_padded_per_batch"] = round(
+                    self.d2h_bytes_dense_total / nb
+                )
+                out["d2h_reduction_vs_padded"] = round(
+                    self.d2h_bytes_dense_total / max(1, self.d2h_bytes_total), 2
+                )
+                out["d2h_reduction_vs_ranges"] = round(
+                    self.d2h_bytes_ranges_total / max(1, self.d2h_bytes_total),
+                    2,
+                )
+            out["compact_batches"] = self.compact_batches
+            out["compact_overflows"] = self.compact_overflows
+        if self.compact_d2h_hist.count:
+            out["compact_d2h_p99_ms"] = round(
+                self.compact_d2h_hist.percentile(0.99) * 1e3, 3
+            )
+        return out

@@ -44,5 +44,11 @@ def test_device_down_run_is_flagged_and_partial():
     assert "2_1m_plus" not in out["configs"]
     cfg7 = out["configs"]["7_materializer_host"]
     assert cfg7["python_oracle_topics_per_sec"] > 0
-    # the headline honestly reads 0 (nothing e2e ran), with the flag
-    assert out["value"] == 0
+    # the headline is SKIPPED (nothing e2e ran) — null value and
+    # vs_baseline with an explicit reason, never a silent 0 that poisons
+    # vs_baseline trend lines (ISSUE 11 satellite: the r05 artifact
+    # published 0.0 for a run that never touched the device)
+    assert out["value"] is None
+    assert out["vs_baseline"] is None
+    assert out["skipped"] is True
+    assert "device unreachable" in out["skip_reason"]

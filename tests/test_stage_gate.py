@@ -137,6 +137,61 @@ class TestDeviceSubStages:
         reg, _ = stage_gate.compare(cur, prev)
         assert len(reg) == 1 and "device_batch" in reg[0]
 
+    def test_pipeline_substages_pass_through_without_baseline(self):
+        """The 3-deep pipeline's per-leg waits and the compaction d2h
+        leg (ISSUE 11) land as new stage rows on their first round: the
+        gate must notice them, never fail them vacuously."""
+        cur = _multi_stage_doc(
+            {
+                "device_batch": 1.0,
+                "leg_wait_h2d": 0.05,
+                "leg_wait_d2h": 0.04,
+                "compact_d2h": 0.3,
+            }
+        )
+        prev = _multi_stage_doc({"device_batch": 1.0})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not reg
+        assert cmp_ == ["/parsed/configs/2/telemetry:device_batch"]
+        assert stage_gate.new_stage_names(cur, prev) == [
+            "compact_d2h", "leg_wait_d2h", "leg_wait_h2d",
+        ]
+
+    def test_pipeline_substages_diff_once_both_rounds_have_them(self):
+        cur = _multi_stage_doc({"leg_wait_d2h": 2.0, "compact_d2h": 0.3})
+        prev = _multi_stage_doc({"leg_wait_d2h": 1.0, "compact_d2h": 0.3})
+        reg, _ = stage_gate.compare(cur, prev)
+        assert len(reg) == 1 and "leg_wait_d2h" in reg[0]
+
+    def test_retired_stage_is_noticed_never_failed(self):
+        """A stage present only in the PREVIOUS round (renamed/retired
+        by the pipeline split) is surfaced as a notice and never
+        diffed."""
+        cur = _multi_stage_doc({"leg_wait_h2d": 0.1})
+        prev = _multi_stage_doc({"device_batch": 1.0})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not reg and not cmp_
+        assert stage_gate.removed_stage_names(cur, prev) == ["device_batch"]
+        assert stage_gate.removed_stage_names(prev, prev) == []
+
+    def test_cli_prints_retired_stage_notice(self, tmp_path):
+        cur = tmp_path / "BENCH_r02.json"
+        prev = tmp_path / "BENCH_r01.json"
+        cur.write_text(
+            json.dumps(_multi_stage_doc({"leg_wait_h2d": 0.1, "fanout": 1.0}))
+        )
+        prev.write_text(
+            json.dumps(_multi_stage_doc({"device_batch": 1.0, "fanout": 1.0}))
+        )
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "exp", "stage_gate.py"),
+             "--current", str(cur), "--previous", str(prev)],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert r.returncode == 0, r.stdout
+        assert "retired" in r.stdout
+        assert "device_batch" in r.stdout
+
     def test_cli_prints_new_stage_notice(self, tmp_path):
         cur = tmp_path / "BENCH_r02.json"
         prev = tmp_path / "BENCH_r01.json"
