@@ -742,6 +742,301 @@ def run_cfg9(fast: bool, rng) -> dict:
     return out
 
 
+def run_cfg10(fast: bool, rng) -> dict:
+    """Tenants x keys x fan-out re-encryption matrix (ISSUE 12 /
+    ROADMAP item 6): the MQT-TZ stage measured at the engine seam —
+    decrypt-once + ONE batched per-subscriber keystream dispatch per
+    fan-out tick — against the plaintext fan-out baseline: the
+    per-subscriber Packet copy + encode the unencrypted per-subscriber
+    delivery path pays (re-encrypted fan-out can never share frames,
+    so THAT is the path it displaces). Each cell A/Bs the device
+    keystream against the vectorized-host path (the breaker's
+    degradation target — on a CPU-jax box the host path is usually the
+    deployable config; on a real accelerator the device path wins) and
+    the acceptance ratio takes the better deployable path. Sampled
+    device dispatches are differentially checked (mismatches must be
+    zero)."""
+    from mqtt_tpu.packets import ENCODERS, PUBLISH, FixedHeader, Packet
+    from mqtt_tpu.tenancy import KeyRegistry, RecryptEngine, TenantPlane
+
+    n_tenants = 2 if fast else 4
+    keys_per_tenant = int(
+        os.environ.get("BENCH_RECRYPT_KEYS", 16 if fast else 128)
+    )
+    fanouts = (10, 100)
+    payload_sizes = (256, 4096)
+    iters = 20 if fast else 100
+    reg = KeyRegistry()
+    plane = TenantPlane()
+    tenants = []
+    t0 = time.perf_counter()
+    for t in range(n_tenants):
+        name = f"bt{t}"
+        tenant = plane.register(name, encrypted=("e/",))
+        tenants.append(tenant)
+        for k in range(keys_per_tenant):
+            reg.set_key(name, f"c{k}", bytes([t, k % 256]) * 8)
+    build_s = time.perf_counter() - t0
+    eng = RecryptEngine(reg, oracle_sample=16, device_min_blocks=1)
+    eng.reseed_nonce(b"bnch")
+    out: dict = {
+        "tenants": n_tenants,
+        "keys_per_tenant": keys_per_tenant,
+        "key_setup_seconds": round(build_s, 3),
+        "matrix": {},
+        "oracle_mismatches": 0,
+    }
+    worst_ratio_at_100 = 0.0
+    for size in payload_sizes:
+        plaintext = (bytes(range(256)) * (size // 256 + 1))[:size]
+        for fanout in fanouts:
+            tenant = tenants[0]
+            targets = [
+                (f"c{i % keys_per_tenant}", (f"c{i % keys_per_tenant}",))
+                for i in range(fanout)
+            ]
+            wire = eng.seal_with_key(bytes([0, 0]) * 8, plaintext)
+            # plaintext baseline: per-subscriber Packet copy + encode
+            # (what the per-subscriber plaintext delivery path pays; the
+            # recrypt path pays the same copies PLUS the crypto)
+            pk = Packet(
+                fixed_header=FixedHeader(type=PUBLISH),
+                topic_name="e/bench/topic",
+                payload=plaintext,
+            )
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                for _t in targets:
+                    o = pk.copy(False)
+                    buf = bytearray()
+                    ENCODERS[PUBLISH](o, buf)
+            base_dt = time.perf_counter() - t0
+
+            def recrypt_leg(engine) -> float:
+                # warmup (jit compile / first-touch of the shapes)
+                job = engine.decrypt_job(tenant, ("c0",), wire)
+                pt = engine.open_publish(tenant, ("c0",), wire, job)
+                assert pt == plaintext
+                engine.seal_fanout(tenant, pt, targets)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    job = engine.decrypt_job(tenant, ("c0",), wire)
+                    pt = engine.open_publish(tenant, ("c0",), wire, job)
+                    sealed = engine.seal_fanout(tenant, pt, targets)
+                    for _t in targets:
+                        o = pk.copy(False)
+                        o.payload = sealed.get(_t[0], b"")
+                        buf = bytearray()
+                        ENCODERS[PUBLISH](o, buf)
+                return time.perf_counter() - t0
+
+            dev_dt = recrypt_leg(eng)
+            # A/B: the vectorized-host keystream path (the breaker's
+            # degradation target; usually the deployable config on a
+            # CPU-jax box)
+            host_eng = RecryptEngine(
+                reg, oracle_sample=0, device_min_blocks=1 << 30
+            )
+            host_eng.reseed_nonce(b"bnhh")
+            host_dt = recrypt_leg(host_eng)
+            rec_dt, path = min((dev_dt, "device"), (host_dt, "host"))
+            base_rate = iters * fanout / base_dt if base_dt else 0.0
+            ratio = rec_dt / base_dt if base_dt else float("inf")
+            if fanout == 100:
+                worst_ratio_at_100 = max(worst_ratio_at_100, ratio)
+            out["matrix"][f"payload{size}_fanout{fanout}"] = {
+                "plaintext_deliveries_per_sec": round(base_rate),
+                "recrypt_deliveries_per_sec": round(
+                    iters * fanout / rec_dt
+                )
+                if rec_dt
+                else 0,
+                "recrypt_vs_plaintext_ratio": round(ratio, 3),
+                "best_path": path,
+                "device_path_ratio": round(dev_dt / base_dt, 3)
+                if base_dt
+                else None,
+                "host_path_ratio": round(host_dt / base_dt, 3)
+                if base_dt
+                else None,
+            }
+    out["device_batches"] = eng.device_batches
+    out["oracle_mismatches"] = eng.oracle_mismatches
+    out["kernel_worst_ratio_at_fanout100"] = round(worst_ratio_at_100, 3)
+    # the acceptance leg: a REAL broker A/B at 100-subscriber fan-out.
+    # QoS1 deliveries (the at-least-once class trust-sensitive
+    # workloads run on) pay the per-subscriber copy+encode path either
+    # way, so the measured ratio is what re-encryption actually costs a
+    # deployment: plaintext namespace vs encrypted namespace, same
+    # broker, same subscribers.
+    try:
+        out["broker"] = _recrypt_broker_ab(fast)
+        ratio = out["broker"]["recrypt_vs_plaintext_ratio"]
+        out["within_2x_at_fanout100"] = ratio <= 2.0
+    except Exception as e:
+        out["broker"] = {"skipped": f"error: {e}"}
+        out["within_2x_at_fanout100"] = None
+    if eng.oracle_mismatches:
+        log(f"cfg10 ORACLE MISMATCHES: {eng.oracle_mismatches}")
+    return out
+
+
+def _recrypt_broker_ab(fast: bool) -> dict:
+    """The cfg 10 acceptance leg: one in-process broker, 100 QoS1
+    subscribers over real TCP, a publisher driving the SAME payloads
+    through a plaintext topic and an encrypted-namespace topic; the
+    ratio of wall-clock fan-out rates is the re-encryption overhead a
+    deployment actually pays."""
+    import asyncio
+
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+
+    port = 18845
+    fanout = 100
+    msgs = 30 if fast else 120
+    payload_size = 256
+    pub_key = bytes(range(16))
+    sub_key_of = lambda i: bytes([i % 256]) * 16  # noqa: E731
+
+    async def read_publishes(reader, counter, done_evt, want):
+        """Count PUBLISH frames off one subscriber connection."""
+        try:
+            while counter[0] < want:
+                first = await reader.readexactly(1)
+                rl = 0
+                mult = 1
+                while True:
+                    b = (await reader.readexactly(1))[0]
+                    rl += (b & 0x7F) * mult
+                    mult *= 128
+                    if not (b & 0x80):
+                        break
+                body = await reader.readexactly(rl) if rl else b""
+                if first[0] >> 4 == 3:  # PUBLISH
+                    counter[0] += 1
+                del body
+            done_evt.set()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            done_evt.set()
+
+    async def main() -> dict:
+        tenants = {
+            "bt": {
+                "encrypted": ["e/"],
+                "keys": {"pub": pub_key.hex()},
+            }
+        }
+        users = {"pub": "bt"}
+        for i in range(fanout):
+            tenants["bt"]["keys"][f"s{i}"] = sub_key_of(i).hex()
+            users[f"s{i}"] = "bt"
+        opts = Options(
+            tenancy=True,
+            tenants=tenants,
+            tenant_users=users,
+            telemetry=False,
+            profile=False,
+            # the CPU-jax box serves keystreams faster from the
+            # vectorized host path (BENCH_RECRYPT_DEVICE=1 forces the
+            # device kernel — the right config on a real accelerator)
+            recrypt_device_min_blocks=(
+                4 if os.environ.get("BENCH_RECRYPT_DEVICE") == "1" else 1 << 30
+            ),
+        )
+        srv = Server(opts)
+        srv.add_hook(AllowHook())
+        srv.add_listener(
+            TCP(LConfig(type="tcp", id="recrypt", address=f"127.0.0.1:{port}"))
+        )
+        await srv.serve()
+        eng = srv._recrypt
+        try:
+            subs = []
+            for i in range(fanout):
+                r, w = await asyncio.open_connection("127.0.0.1", port)
+                w.write(_connect_bytes(f"s{i}", version=4))
+                await w.drain()
+                await r.readexactly(4)
+                w.write(_subscribe_bytes(1, "p/#", qos=1))
+                await w.drain()
+                await r.readexactly(5)
+                w.write(_subscribe_bytes(2, "e/#", qos=1))
+                await w.drain()
+                await r.readexactly(5)
+                subs.append((r, w))
+            pr, pw = await asyncio.open_connection("127.0.0.1", port)
+            pw.write(_connect_bytes("pub", version=4))
+            await pw.drain()
+            await pr.readexactly(4)
+
+            plaintext = (bytes(range(256)) * 2)[:payload_size]
+
+            async def leg(topic, payloads) -> float:
+                counters = []
+                dones = []
+                for r, _w in subs:
+                    counter = [0]
+                    done = asyncio.Event()
+                    counters.append(counter)
+                    dones.append(done)
+                    asyncio.get_running_loop().create_task(
+                        read_publishes(r, counter, done, len(payloads))
+                    )
+                t0 = time.perf_counter()
+                tb = topic.encode()
+                for i, body in enumerate(payloads):
+                    var = (
+                        len(tb).to_bytes(2, "big")
+                        + tb
+                        + (i % 65534 + 1).to_bytes(2, "big")
+                        + body
+                    )
+                    # QoS1 PUBLISH frame
+                    hdr = bytearray([0x32])
+                    rl = len(var)
+                    while True:
+                        e = rl % 128
+                        rl //= 128
+                        hdr.append(e | (0x80 if rl else 0))
+                        if not rl:
+                            break
+                    pw.write(bytes(hdr) + var)
+                await pw.drain()
+                await asyncio.wait_for(
+                    asyncio.gather(*[d.wait() for d in dones]), timeout=120
+                )
+                return time.perf_counter() - t0
+
+            plain_wall = await leg("p/bench", [plaintext] * msgs)
+            enc_wall = await leg(
+                "e/bench",
+                [eng.seal_with_key(pub_key, plaintext) for _ in range(msgs)],
+            )
+            total = fanout * msgs
+            return {
+                "fanout": fanout,
+                "msgs": msgs,
+                "payload_bytes": payload_size,
+                "qos": 1,
+                "plaintext_deliveries_per_sec": round(total / plain_wall),
+                "recrypt_deliveries_per_sec": round(total / enc_wall),
+                "recrypt_vs_plaintext_ratio": round(
+                    enc_wall / plain_wall, 3
+                ),
+                "recrypt_fanouts": eng.fanouts,
+                "oracle_mismatches": eng.oracle_mismatches,
+                "no_key_drops": eng.no_key_drops,
+            }
+        finally:
+            await srv.close()
+
+    return asyncio.run(main())
+
+
 def run_materializer_bench(fast: bool) -> dict:
     """Config 7: the host result materializer in isolation — NO device, no
     jax. Synthetic snapshot tables and packed range rows shaped like cfg2's
@@ -1133,7 +1428,9 @@ def main() -> None:
     iters = int(os.environ.get("BENCH_ITERS", 5 if fast else 20))
     which = {
         int(c)
-        for c in os.environ.get("BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9").split(",")
+        for c in os.environ.get(
+            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10"
+        ).split(",")
         if c.strip()
     }
     rng = random.Random(7)
@@ -1289,6 +1586,16 @@ def main() -> None:
         except ImportError as e:
             configs["9_predicate_sweep"] = {"skipped": f"no jax: {e}"}
         log(f"cfg9 {configs['9_predicate_sweep']} ({time.perf_counter()-t0:.0f}s)")
+    if 10 in which:
+        # tenants x keys x fan-out re-encryption matrix: runs on any
+        # jax backend (keystream shapes are tiny); the engine degrades
+        # to the vectorized host path on jax-less hosts by itself
+        t0 = time.perf_counter()
+        try:
+            configs["10_recrypt_matrix"] = run_cfg10(fast, rng)
+        except Exception as e:  # never take the whole artifact down
+            configs["10_recrypt_matrix"] = {"skipped": f"error: {e}"}
+        log(f"cfg10 {configs['10_recrypt_matrix']} ({time.perf_counter()-t0:.0f}s)")
     if not device_ok and device_wanted:
         # the broker bench bought the tunnel a few minutes: one more chance
         device_ok, probe_err = probe_device(2)

@@ -38,6 +38,24 @@ async def main(out_path: str) -> int:
         device_matcher=device,
         matcher_opts={"max_levels": 4, "background": False} if device else None,
         telemetry_sample=1,  # sample everything: a 2s burst must register
+        # two-tenant burst (ISSUE 12): the scrape must carry the
+        # tenant-labeled families and the recrypt series
+        tenancy=True,
+        tenants={
+            "scrape-a": {
+                "encrypted": ["enc/"],
+                "keys": {
+                    "scrape-ta": "000102030405060708090a0b0c0d0e0f",
+                    "scrape-ta2": "101112131415161718191a1b1c1d1e1f",
+                },
+            },
+            "scrape-b": {},
+        },
+        tenant_users={
+            "scrape-ta": "scrape-a",
+            "scrape-ta2": "scrape-a",
+            "scrape-tb": "scrape-b",
+        },
     )
     srv = Server(opts)
     srv.add_hook(AllowHook())
@@ -110,6 +128,53 @@ async def main(out_path: str) -> int:
         ):
             await asyncio.sleep(0.2)
 
+        # two-tenant burst (ISSUE 12): tenant A exchanges an ENCRYPTED
+        # publish (re-keyed per subscriber), tenant B a plaintext one;
+        # the tenant-scoped series must validate below and tenant B's
+        # subscriber must see nothing of tenant A's traffic
+        ta_r, ta_w = await asyncio.open_connection(host, int(port))
+        ta_w.write(_connect_bytes("scrape-ta", version=4))
+        await ta_w.drain()
+        await ta_r.readexactly(4)
+        ta2_r, ta2_w = await asyncio.open_connection(host, int(port))
+        ta2_w.write(_connect_bytes("scrape-ta2", version=4))
+        await ta2_w.drain()
+        await ta2_r.readexactly(4)
+        ta2_w.write(_subscribe_bytes(1, "enc/#"))
+        await ta2_w.drain()
+        await ta2_r.readexactly(5)
+        tb_r, tb_w = await asyncio.open_connection(host, int(port))
+        tb_w.write(_connect_bytes("scrape-tb", version=4))
+        await tb_w.drain()
+        await tb_r.readexactly(4)
+        tb_w.write(_subscribe_bytes(1, "#"))
+        await tb_w.drain()
+        await tb_r.readexactly(5)
+        if srv.matcher is not None:
+            srv.matcher.flush()
+        eng_r = srv._recrypt
+        sealed = eng_r.seal_with_key(
+            bytes.fromhex("000102030405060708090a0b0c0d0e0f"), b"tenant secret"
+        )
+        for topic_s, payload, writer in (
+            ("enc/x", sealed, ta_w),
+            ("plain/x", b"tenant-b", tb_w),
+        ):
+            tb_topic = topic_s.encode()
+            body = len(tb_topic).to_bytes(2, "big") + tb_topic + payload
+            writer.write(bytes([0x30, len(body)]) + body)
+            await writer.drain()
+        # tenant A's keyed subscriber must receive the re-keyed publish
+        data = await asyncio.wait_for(ta2_r.read(4096), 10.0)
+        if b"enc/x" not in data:
+            print("FAIL: encrypted-namespace delivery missing", file=sys.stderr)
+            return 1
+        # tenant B's catch-all sees ITS publish and nothing of tenant A's
+        data_b = await asyncio.wait_for(tb_r.read(4096), 10.0)
+        if b"plain/x" not in data_b or b"enc/x" in data_b:
+            print(f"FAIL: tenant isolation broken: {data_b!r}", file=sys.stderr)
+            return 1
+
         srv.publish_sys_topics()
         from scrapelib import http_get
 
@@ -125,6 +190,12 @@ async def main(out_path: str) -> int:
             "mqtt_tpu_predicate_rules",
             "mqtt_tpu_predicate_filtered_total",
             "mqtt_tpu_predicate_oracle_mismatches_total",
+            # tenant-scoped series (ISSUE 12): labeled per-tenant
+            # families and the recrypt engine's counters
+            'mqtt_tpu_tenant_messages_in_total{tenant="scrape-a"}',
+            'mqtt_tpu_tenant_connected{tenant="scrape-b"}',
+            "mqtt_tpu_recrypt_fanouts_total",
+            "mqtt_tpu_recrypt_oracle_mismatches_total",
         ]
         missing = [m for m in required if m not in text]
         if missing:
@@ -137,6 +208,14 @@ async def main(out_path: str) -> int:
             print(
                 f"FAIL: predicate plane inert or mismatched "
                 f"(filtered={eng.filtered} mismatches={eng.oracle_mismatches})",
+                file=sys.stderr,
+            )
+            return 1
+        if eng_r.fanouts == 0 or eng_r.oracle_mismatches:
+            print(
+                f"FAIL: recrypt plane inert or mismatched "
+                f"(fanouts={eng_r.fanouts} "
+                f"mismatches={eng_r.oracle_mismatches})",
                 file=sys.stderr,
             )
             return 1

@@ -17,7 +17,7 @@ import logging
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Awaitable, Callable, Optional
+from typing import Any, Awaitable, Callable, Optional
 
 from . import packets as pkts
 from .inflight import Inflight
@@ -161,6 +161,11 @@ class Client:
         # so it lives here as a plain attribute, not a config lookup.
         self.priority_class = ""
         self.priority_weight = 1.0
+        # the tenant this client resolved to at CONNECT
+        # (mqtt_tpu.tenancy.Tenant) or None for the global namespace;
+        # set once by server._resolve_tenant, read on every publish /
+        # subscribe to decide namespace scoping
+        self.tenant: Optional[Any] = None
 
     # -- lifecycle ---------------------------------------------------------
 

@@ -110,7 +110,16 @@ from .mesh_topology import (
 )
 from .packets import PUBLISH, FixedHeader, Packet
 from .packets import Subscription
-from .topics import SHARE_PREFIX, InlineSubscription, TopicsIndex, summary_base
+from .topics import (
+    NS_CHAR,
+    SHARE_PREFIX,
+    InlineSubscription,
+    TopicsIndex,
+    ns_local,
+    ns_scope_topic,
+    ns_tenant,
+    summary_base,
+)
 
 _log = logging.getLogger("mqtt_tpu.cluster")
 
@@ -301,6 +310,11 @@ class Cluster:
         self._seq = itertools.count(1)  # origin seq stamp (GIL-atomic next())
         self._dial_tasks: dict[int, asyncio.Task] = {}
         self._peer_advert_sigs: dict[int, dict[str, float]] = {}
+        # per-peer gossiped admission-reserve spend (ISSUE 12 satellite:
+        # the admin-ACL CONNECT reserve is a MESH budget — see
+        # OverloadGovernor.note_peer_reserve); tree mode folds these by
+        # SUM per subtree the way pressures fold by max
+        self._peer_advert_reserve: dict[int, int] = {}
         self.duplicates_suppressed = 0  # (origin, boot, seq) window hits
         self.stale_epoch_frames = 0  # re-forwarded under the live tree, counted
         self.summary_filtered_forwards = 0  # edges skipped by a fresh summary
@@ -336,6 +350,10 @@ class Cluster:
                     self._gossip_soon()
 
                 governor.on_transition = _gossip_transition
+                # a reserve admission gossips IMMEDIATELY so the spend
+                # lands mesh-wide before the next ping tick — the
+                # admin-ACL budget is shared, not per-worker x N
+                governor.on_reserve_admit = self._gossip_soon
         tele = getattr(server, "telemetry", None)
         if tele is not None:
             tracer = getattr(tele, "tracer", None)
@@ -1249,6 +1267,11 @@ class Cluster:
         c.protocol_version = 5
         c.fixed_header.qos = pk.fixed_header.qos
         c.packet_id = pk.packet_id or pk.fixed_header.qos  # encoder guard
+        if topic[0] == NS_CHAR:
+            # tenant-scoped keys never ride an MQTT frame (the wire
+            # format forbids U+0000): the frame carries the LOCAL topic
+            # and the head carries the namespace, re-scoped at delivery
+            c.topic_name = ns_local(topic)
         body = bytearray()
         c.publish_encode(body)
         body_b = bytes(body)
@@ -1261,6 +1284,11 @@ class Cluster:
             "qos": qos,
             "rt": self._route_stamp(),
         }
+        if topic[0] == NS_CHAR:
+            head["ns"] = ns_tenant(topic)
+            u = self._origin_username(pk.origin)
+            if u:
+                head["u"] = u
         tracer = self._tracer()
         clock = getattr(pk, "_tclock", None)
         traced = tracer is not None and getattr(clock, "trace_id", None) is not None
@@ -1320,6 +1348,14 @@ class Cluster:
         if not self._epoch_current(rt):
             self.stale_epoch_frames += 1
         topic = self._frame_topic(frame)
+        ns = head.get("ns")
+        if ns and topic:
+            # tenant-scoped publish (mqtt_tpu.tenancy): the frame rides
+            # the mesh with its LOCAL topic, but edge interest summaries
+            # hold namespace-SCOPED prefixes — route (and park) on the
+            # re-scoped key or a fresh summary filters the publish out
+            # at every intermediate hop
+            topic = ns_scope_topic(str(ns), topic)
         retain = bool(head.get("retain"))
         qos = int(head.get("qos", 0) or 0)
         tier_qos = 1 if retain else qos
@@ -1630,6 +1666,12 @@ class Cluster:
         if local is None:
             return None
         s, p, sigs = local
+        governor = getattr(self.server, "overload", None)
+        reserve = (
+            governor.reserve_advert()
+            if governor is not None and hasattr(governor, "reserve_advert")
+            else 0
+        )
         if self.topo is not None:
             now = time.monotonic()
             for peer, (ps, pp, t) in list(self._peer_adverts.items()):
@@ -1637,10 +1679,17 @@ class Cluster:
                     continue
                 s = max(s, ps)
                 p = max(p, pp)
+                # reserve spend folds by SUM: tree edges partition the
+                # mesh, so each neighbor's subtree total plus the local
+                # spend reconstructs the mesh-wide budget draw
+                reserve += self._peer_advert_reserve.get(peer, 0)
                 for k, v in self._peer_advert_sigs.get(peer, {}).items():
                     if v > sigs.get(k, 0.0):
                         sigs[k] = v
-        return json.dumps({"s": s, "p": p, "sig": sigs}).encode()
+        body = {"s": s, "p": p, "sig": sigs}
+        if reserve:
+            body["r"] = reserve
+        return json.dumps(body).encode()
 
     def _gossip_now(self) -> None:
         """Advertise this worker's governor posture to every live peer
@@ -1704,6 +1753,7 @@ class Cluster:
             d = json.loads(payload)
             state_code = int(d.get("s", 0))
             pressure = float(d.get("p", 0.0))
+            reserve = int(d.get("r", 0))
             raw_sigs = d.get("sig")
             sigs = (
                 {str(k): float(v) for k, v in raw_sigs.items()}
@@ -1715,7 +1765,15 @@ class Cluster:
         self._peer_adverts[peer] = (state_code, pressure, time.monotonic())
         if sigs:
             self._peer_advert_sigs[peer] = sigs
+        if reserve:
+            self._peer_advert_reserve[peer] = reserve
+        else:
+            self._peer_advert_reserve.pop(peer, None)
         governor = getattr(self.server, "overload", None)
+        if governor is not None and hasattr(governor, "note_peer_reserve"):
+            # mesh-wide admission reserve: this edge's (subtree) spend
+            # draws from the local governor's budget too
+            governor.note_peer_reserve(peer, reserve)
         sig = getattr(governor, "peer_signal", None)
         if sig is not None:
             known = sig.signal_names()
@@ -2005,6 +2063,21 @@ class Cluster:
                 {"peer": p, "topic": topic, "sent": bool(sent)},
             )
 
+    def _origin_username(self, origin: str) -> str:
+        """The origin client's username (tenant key identity) — carried
+        on encrypted-namespace forwards so a username-keyed publisher
+        still resolves on workers where its session does not exist."""
+        clients = getattr(self.server, "clients", None)
+        cl = clients.get(origin) if clients is not None else None
+        if cl is None:
+            return ""
+        u = cl.properties.username
+        return (
+            u.decode("utf-8", "replace")
+            if isinstance(u, (bytes, bytearray))
+            else (u or "")
+        )
+
     def forward_packet(self, pk: Packet) -> None:
         """Forward a decoded publish (QoS>0 / v5 / retained) to interested
         peers; retained messages go to ALL peers so every worker converges
@@ -2012,6 +2085,10 @@ class Cluster:
         topic = pk.topic_name
         if not topic or topic.startswith("$"):
             return  # $SYS is per-worker; never forwarded
+        if topic[0] == NS_CHAR and ns_local(topic).startswith("$"):
+            # per-tenant $SYS ticks (mqtt_tpu.tenancy) are per-worker
+            # too: the scoped key hides the local "$" from the gate above
+            return
         if self.topo is not None:
             self._route_packet_tree(pk)
             return
@@ -2027,6 +2104,11 @@ class Cluster:
         c.protocol_version = 5
         c.fixed_header.qos = pk.fixed_header.qos
         c.packet_id = pk.packet_id or pk.fixed_header.qos  # encoder guard
+        if topic[0] == NS_CHAR:
+            # tenant-scoped keys never ride an MQTT frame (the wire
+            # format forbids U+0000): the frame carries the LOCAL topic
+            # and the head carries the namespace, re-scoped at delivery
+            c.topic_name = ns_local(topic)
         body = bytearray()
         c.publish_encode(body)
         head = {
@@ -2036,6 +2118,11 @@ class Cluster:
             "retain": bool(pk.fixed_header.retain),
             "qos": pk.fixed_header.qos,
         }
+        if topic[0] == NS_CHAR:
+            head["ns"] = ns_tenant(topic)
+            u = self._origin_username(pk.origin)
+            if u:
+                head["u"] = u
         body_b = bytes(body)
         # trace plane: a traced publish's context rides the json head
         # ("trace" key — older peers ignore it) with a DISTINCT forward
@@ -2261,6 +2348,17 @@ class Cluster:
         pk.origin = head.get("origin", "")
         pk.created = head.get("created", 0)
         pk.expiry = head.get("expiry", 0)
+        ns = head.get("ns")
+        if ns:
+            # tenant-scoped publish (mqtt_tpu.tenancy): the frame rode
+            # the mesh with the LOCAL topic (MQTT frames forbid U+0000);
+            # restore the namespace before matching/retaining
+            pk.topic_name = ns_scope_topic(str(ns), pk.topic_name)
+            if head.get("u"):
+                # the origin's username rides the head: a username-keyed
+                # publisher's key still resolves on THIS worker, where
+                # the publishing session does not exist
+                setattr(pk, "_origin_user", str(head["u"]))
         if head.get("retain"):
             self.server.retain_message(self._system_client(), pk)
         self._deliver_local(pk)

@@ -204,6 +204,15 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "cluster_tree_degree",
         "cluster_summary_bits",
         "cluster_dup_window",
+        # secure multi-tenant plane: per-tenant namespaces, quota
+        # classes, and the MQT-TZ re-encryption stage (mqtt_tpu.tenancy)
+        "tenancy",
+        "tenants",
+        "tenant_users",
+        "tenant_default",
+        "recrypt",
+        "recrypt_oracle_sample",
+        "recrypt_device_min_blocks",
         # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates):
         # suffix parsing, device rule-table cap, differential-oracle
         # sampling cadence

@@ -291,6 +291,20 @@ class OverloadGovernor:
         self.epoch = 0  # evaluation-window counter (per-client quotas key on it)
         self._admitted_in_epoch: dict[str, int] = {}
         self._reserve_in_epoch = 0  # admin-reserve CONNECTs this window
+        # mesh-wide admission reserve (ISSUE 12 / PR 5 residual): peer
+        # workers gossip their own per-window reserve spend, and
+        # admit_connect budgets LOCAL + peer spend against ONE
+        # admission_reserve — the reserve is a mesh budget, not
+        # per-worker x N. Entries age out after a quota window (the
+        # clocks are per-process monotonic, so freshness — not epoch
+        # numbers — is the cross-worker alignment; a spend may be
+        # counted slightly past its window, which only errs on the
+        # refusing side).
+        self._peer_reserve: dict[int, tuple[int, float]] = {}
+        # fired (off-lock) after each reserve admission so the cluster
+        # can gossip the new spend immediately instead of at the next
+        # ping tick (mqtt_tpu.cluster wires it to _gossip_soon)
+        self.on_reserve_admit: Optional[Callable[[], None]] = None
         # mesh-federation peer-pressure signal (None until a Cluster
         # enables federation via enable_federation)
         self.peer_signal: Optional[PeerPressureSignal] = None
@@ -487,6 +501,37 @@ class OverloadGovernor:
             self.sheds += 1
             return False
 
+    def _reserve_window_s(self) -> float:
+        return self.config.quota_window_s or self.config.eval_interval_s
+
+    def note_peer_reserve(self, peer: int, spent: int) -> None:
+        """Fold one peer's gossiped per-window reserve spend into the
+        mesh budget (mqtt_tpu.cluster feeds this from _T_GOSSIP)."""
+        with self._lock:
+            self._peer_reserve[peer] = (max(0, int(spent)), self.clock())
+
+    def _peer_reserve_spent_locked(self) -> int:
+        """Sum of fresh peer reserve spends (call under the lock);
+        stale entries age out at one quota window."""
+        now = self.clock()
+        win = self._reserve_window_s()
+        total = 0
+        stale = []
+        for peer, (spent, t) in self._peer_reserve.items():
+            if now - t >= max(win, 1e-3):
+                stale.append(peer)
+                continue
+            total += spent
+        for peer in stale:
+            del self._peer_reserve[peer]
+        return total
+
+    def reserve_advert(self) -> int:
+        """This worker's reserve spend in the current window — the
+        value its gossip advert carries."""
+        with self._lock:
+            return self._reserve_in_epoch
+
     def admit_connect(self, admin: "bool | Callable[[], bool]" = False) -> bool:
         """Per-listener CONNECT admission (mesh-federation tentpole):
         while THROTTLE/SHED a new CONNECT is refused — the caller sends
@@ -509,13 +554,29 @@ class OverloadGovernor:
         with self._lock:
             if self._state == NORMAL:
                 return True
-            reserve_open = self._reserve_in_epoch < self.config.admission_reserve
+            # the reserve is a MESH budget: local spend plus every
+            # peer's freshly gossiped spend draw from one pool
+            spent = self._reserve_in_epoch + self._peer_reserve_spent_locked()
+            reserve_open = spent < self.config.admission_reserve
         if reserve_open and (admin() if callable(admin) else admin):
+            granted = False
             with self._lock:
-                if self._reserve_in_epoch < self.config.admission_reserve:
+                spent = (
+                    self._reserve_in_epoch + self._peer_reserve_spent_locked()
+                )
+                if spent < self.config.admission_reserve:
                     self._reserve_in_epoch += 1
                     self.reserve_admits += 1
-                    return True
+                    granted = True
+            if granted:
+                cb = self.on_reserve_admit
+                if cb is not None:
+                    try:
+                        # off-lock: the cluster gossips the new spend now
+                        cb()
+                    except Exception:
+                        _log.exception("reserve-admit observer failed")
+                return True
         with self._lock:
             self.connects_refused += 1
             return False
@@ -584,6 +645,12 @@ class OverloadGovernor:
                 "admitted": self.admitted,
                 "connects_refused": self.connects_refused,
                 "reserve_admits": self.reserve_admits,
+                # mesh-wide reserve budget: local + fresh peer spend
+                "reserve_spent_local": self._reserve_in_epoch,
+                "reserve_spent_mesh": (
+                    self._reserve_in_epoch
+                    + self._peer_reserve_spent_locked()
+                ),
             }
             for name, v in self.signal_pressures.items():
                 d[f"signal/{name}"] = round(v, 4)

@@ -148,9 +148,13 @@ def compile_suffix(suffix: str) -> PredicateSpec:
 def payload_number(payload: bytes, field: str, doc: Any = None) -> float:
     """Extract the numeric feature ``field`` from a payload; NaN when the
     payload has no such number (skip-to-pass upstream). ``field=""``
-    reads the whole payload as one number. ``doc`` is an optional
-    pre-parsed JSON document (or any non-dict marker) so a publish with
-    several field rules parses its payload once."""
+    reads the whole payload as one number. A dotted field
+    (``battery.level``) traverses nested JSON objects — unless the
+    payload carries the dotted string as a FLAT key, which wins (a
+    pre-nested-paths deployment whose devices publish literal dotted
+    keys keeps its exact semantics). ``doc`` is an optional pre-parsed
+    JSON document (or any non-dict marker) so a publish with several
+    field rules parses its payload once."""
     if field == "":
         try:
             return float(payload)
@@ -164,6 +168,16 @@ def payload_number(payload: bytes, field: str, doc: Any = None) -> float:
     if not isinstance(doc, dict):
         return math.nan
     v = doc.get(field)
+    if v is None and "." in field and field not in doc:
+        # nested path (ISSUE 12 satellite / PR 8 residual): walk the
+        # dotted segments through nested objects; any non-object hop or
+        # missing key is NaN (skip-to-pass, like a missing flat field)
+        v = doc
+        for seg in field.split("."):
+            if not isinstance(v, dict):
+                v = None
+                break
+            v = v.get(seg)
     # bool is an int subclass: True > 0.5 would be a surprising predicate
     if isinstance(v, (int, float)) and not isinstance(v, bool):
         return float(v)

@@ -89,12 +89,16 @@ from .system import Info
 from .utils.mempool import get_buffer, put_buffer
 from .utils.proc import rss_bytes
 from .topics import (
+    NS_CHAR,
     SYS_PREFIX,
     InlineSubFn,
     InlineSubscription,
     TopicsIndex,
     is_shared_filter,
     is_valid_filter,
+    ns_local,
+    ns_scope_filter,
+    ns_scope_topic,
     split_predicate_suffix,
 )
 
@@ -321,6 +325,36 @@ class Options:
     # every device verdict from the raw payload on the host and count
     # mismatches (0 disables sampling)
     predicate_oracle_sample: int = 64
+    # secure multi-tenant plane (mqtt_tpu.tenancy): clients resolve to a
+    # tenant at CONNECT (username first, then client id — the
+    # overload_priority_users idiom) and from then on every broker key
+    # they touch — trie filters, retained topics, $SHARE groups, the
+    # client-registry id, cluster interest summaries — carries the
+    # tenant's namespace prefix, so cross-tenant delivery is impossible
+    # by construction. Off by default: with it off, no tenancy code runs.
+    tenancy: bool = False
+    # tenant registry: name -> {quota_class: str, encrypted: [topic
+    # prefix, ...], keys: {client-id-or-username: 32-hex-char AES-128
+    # key, ...}}. quota_class rides the governor's priority-class
+    # machinery (overload_priority_classes supplies the weights).
+    tenants: Optional[dict] = None
+    # username-or-client-id -> tenant name (resolved at CONNECT)
+    tenant_users: Optional[dict] = None
+    # tenant for unmapped clients; "" keeps them in the global namespace
+    tenant_default: str = ""
+    # MQT-TZ re-encryption stage (mqtt_tpu.tenancy.RecryptEngine +
+    # ops/recrypt): publishes in a tenant's `encrypted` namespaces are
+    # decrypted once with the publisher's key and re-encrypted per
+    # subscriber as ONE batched AES-CTR keystream dispatch per fan-out
+    # tick (vectorized-host oracle + breaker degradation, the
+    # matcher/predicate posture). Requires tenancy.
+    recrypt: bool = True
+    # differential-oracle cadence: 1-in-N device keystream dispatches
+    # are re-derived on the host and compared bit-for-bit (0 disables)
+    recrypt_oracle_sample: int = 64
+    # dispatches below this many 16-byte keystream blocks run on the
+    # host outright (a tiny batch's device round trip only adds latency)
+    recrypt_device_min_blocks: int = 4
     # unified telemetry plane (mqtt_tpu.telemetry): per-publish stage
     # clock sampled 1-in-N, histogram metrics, Prometheus exposition at
     # GET /metrics (sysinfo listener), the retained
@@ -510,6 +544,12 @@ class Options:
             self.predicate_max_rules = 1 << 20
         if self.predicate_oracle_sample < 0:
             self.predicate_oracle_sample = 64
+        # tenancy knobs are config-reachable: a negative oracle sample
+        # means "default", the block floor needs >= 1
+        if self.recrypt_oracle_sample < 0:
+            self.recrypt_oracle_sample = 64
+        if self.recrypt_device_min_blocks < 1:
+            self.recrypt_device_min_blocks = 4
         # telemetry knobs are config-reachable: a negative sample rate
         # means "default", a zero one disables stage sampling outright
         if self.telemetry_sample < 0:
@@ -790,6 +830,35 @@ class Server:
                     else None
                 ),
             )
+        # secure multi-tenant plane (mqtt_tpu.tenancy): tenant registry +
+        # CONNECT resolution + the MQT-TZ re-encryption engine. Built
+        # before the matcher so the staging loop can carry decrypt jobs.
+        self._tenancy: Optional[Any] = None
+        self._recrypt: Optional[Any] = None
+        if opts.tenancy:
+            from .tenancy import RecryptEngine, TenantPlane
+
+            self._tenancy = TenantPlane(
+                registry=(
+                    self.telemetry.registry
+                    if self.telemetry is not None
+                    else None
+                )
+            )
+            self._tenancy.configure(
+                opts.tenants, opts.tenant_users, opts.tenant_default
+            )
+            if opts.recrypt:
+                self._recrypt = RecryptEngine(
+                    self._tenancy.keys,
+                    oracle_sample=opts.recrypt_oracle_sample,
+                    device_min_blocks=opts.recrypt_device_min_blocks,
+                    registry=(
+                        self.telemetry.registry
+                        if self.telemetry is not None
+                        else None
+                    ),
+                )
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
@@ -880,6 +949,22 @@ class Server:
                         )
 
                     breaker.on_trip = _trip_dump
+            if self._recrypt is not None:
+                rbreaker = self._recrypt.breaker
+                prev_rtrip = rbreaker.on_trip
+
+                def _recrypt_trip_dump(_prev=prev_rtrip):
+                    # fires AFTER the breaker lock is released
+                    # (_fire_on_trip, brokerlint R5) — a failing crypto
+                    # device leaves a flight-recorder trace, exactly
+                    # like the matcher and predicate breakers
+                    if _prev is not None:
+                        _prev()
+                    self.telemetry.trigger_dump(
+                        "breaker_trip", {"trigger": "recrypt_breaker"}
+                    )
+
+                rbreaker.on_trip = _recrypt_trip_dump
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -992,6 +1077,7 @@ class Server:
                 profiler=self.profiler,
                 predicates=self._predicates,
                 pipeline_depth=self.options.matcher_stage_pipeline_depth,
+                recrypt=self._recrypt,
             )
             self._stage.start()
             if self.overload is not None:
@@ -1280,6 +1366,37 @@ class Server:
                 except Code:
                     pass
 
+    def _resolve_tenant(self, cl: Client) -> None:
+        """CONNECT-time tenant resolution (mqtt_tpu.tenancy): map the
+        client (username first, then client id) to its tenant, scope the
+        registry identity into the tenant namespace — two tenants' equal
+        client ids can never collide or take each other's sessions over
+        — and apply the tenant's quota class through the governor's
+        priority-class machinery. Runs AFTER authentication (an
+        unauthenticated client must not resolve into a tenant) and
+        BEFORE _assign_priority_class (a per-user class mapping
+        overrides the tenant-wide one)."""
+        plane = self._tenancy
+        if plane is None or cl.net.inline:
+            return
+        from .tenancy import scope_client_id
+
+        username = cl.properties.username
+        uname = (
+            username.decode("utf-8", "replace")
+            if isinstance(username, (bytes, bytearray))
+            else (username or "")
+        )
+        tenant = plane.resolve(uname, cl.id)
+        if tenant is None:
+            return
+        cl.tenant = tenant
+        cl.id = scope_client_id(tenant.name, cl.id)
+        if tenant.quota_class:
+            weights = self.options.overload_priority_classes or {}
+            cl.priority_class = tenant.quota_class
+            cl.priority_weight = float(weights.get(tenant.quota_class, 1.0))
+
     def _assign_priority_class(self, cl: Client) -> None:
         """Resolve the client's shed-priority class at CONNECT
         (mqtt_tpu.overload priority-weighted shedding): the config map
@@ -1296,7 +1413,15 @@ class Server:
             if isinstance(username, (bytes, bytearray))
             else username
         )
-        klass = users.get(uname) or users.get(cl.id)
+        cid = cl.id
+        if cid[:1] == NS_CHAR:
+            # tenant clients are registered under their SCOPED id
+            # (_resolve_tenant); the operator's map keys on the id the
+            # client actually sent
+            from .tenancy import local_client_id
+
+            cid = local_client_id(cid)
+        klass = users.get(uname) or users.get(cid)
         if klass is None:
             return
         cl.priority_class = klass
@@ -1372,6 +1497,7 @@ class Server:
                 self.send_connack(cl, ERR_BAD_USERNAME_OR_PASSWORD, False, None)
                 raise ERR_BAD_USERNAME_OR_PASSWORD()
 
+            self._resolve_tenant(cl)
             self._assign_priority_class(cl)
             # per-listener admission (mqtt_tpu.overload federation): a
             # broker in THROTTLE/SHED refuses NEW connections up front —
@@ -1393,6 +1519,8 @@ class Server:
 
             self.info.clients_connected += 1
             connected = True
+            if cl.tenant is not None and self._tenancy is not None:
+                self._tenancy.note_connect(cl.tenant)
 
             self.hooks.on_session_establish(cl, pk)
 
@@ -1436,6 +1564,8 @@ class Server:
         finally:
             if connected:
                 self.info.clients_connected -= 1
+                if cl.tenant is not None and self._tenancy is not None:
+                    self._tenancy.note_disconnect(cl.tenant)
             cl.stop(err)
         if err is not None and not isinstance(
             err, (asyncio.IncompleteReadError, ConnectionError, ConnectionClosedError)
@@ -1882,6 +2012,10 @@ class Server:
             and not self.overload.admit(cl)
         ):
             self.info.messages_dropped += 1
+            if cl.tenant is not None:
+                # per-tenant shed accounting: quota classes must be
+                # visibly shaping who sheds (mqtt_tpu.tenancy)
+                cl.tenant.messages_dropped += 1
             if pk.fixed_header.qos == 0:
                 return
             ack_type = pkts.PUBREC if pk.fixed_header.qos == 2 else pkts.PUBACK
@@ -1938,6 +2072,16 @@ class Server:
                 return
             # other errors: continue with the original packet (reference
             # server.go:912-925 falls through)
+
+        if cl.tenant is not None:
+            # tenant namespace (mqtt_tpu.tenancy): validation, the ACL,
+            # aliases, admission, and the on_publish hook all saw the
+            # LOCAL topic above; matching, retention, staging, and
+            # cluster forwarding operate on the scoped key from here
+            # (deliveries strip it back off at the fan-out choke point)
+            pk.topic_name = ns_scope_topic(cl.tenant.name, pk.topic_name)
+            cl.tenant.messages_in += 1
+            cl.tenant.bytes_in += len(pk.payload)
 
         if pk.fixed_header.retain:  # [MQTT-3.3.1-5]
             self.retain_message(cl, pk)
@@ -2007,10 +2151,14 @@ class Server:
                 if eng is not None and eng.active
                 else None
             )
+            # encrypted-namespace publishes carry a decrypt job whose
+            # keystream dispatch rides the same staged batch
+            # (mqtt_tpu.tenancy.RecryptJob through MatchStage)
+            rjob = self._recrypt_job_for(cl, pk)
             subscribers = await self._stage.submit(
-                pk.topic_name, getattr(pk, "_tclock", None), feats
+                pk.topic_name, getattr(pk, "_tclock", None), feats, rjob
             )
-            self._fan_out(pk, subscribers, feats)
+            self._fan_out(pk, subscribers, feats, rjob)
             if self._cluster is not None:
                 self._cluster.forward_packet(pk)
             self._finish_publish_clock(pk)
@@ -2063,6 +2211,10 @@ class Server:
         if cl.net.inline or cl.properties.protocol_version != 4:
             return False
         if self._stage is not None or cl.state.inflight.receive_quota == 0:
+            return False
+        if cl.tenant is not None:
+            # tenant publishes need namespace scoping (and possibly the
+            # re-encryption leg) — the decode path owns both
             return False
         gen = self.hooks.generation
         if gen != self._fastpub_gate_gen:
@@ -2335,7 +2487,7 @@ class Server:
         self._stamp_publish_expiry(pk)
         return pk
 
-    def _fan_out(self, pk: Packet, subscribers, feats=None) -> None:
+    def _fan_out(self, pk: Packet, subscribers, feats=None, rjob=None) -> None:
         """Deliver one matched publish: shared-group selection, inline
         handlers, per-subscriber delivery (server.go:1000-1021).
 
@@ -2345,7 +2497,15 @@ class Server:
         the publish's PublishFeatures carrier when the staged pipeline
         evaluated the rule table on device (mqtt_tpu.staging); without
         it the host interpreter decides. With no live rules this is one
-        attribute read — the unpredicated path stays bit-identical."""
+        attribute read — the unpredicated path stays bit-identical.
+
+        Tenant-namespace publishes (mqtt_tpu.tenancy) strip their scope
+        prefix here — every subscriber of a scoped topic is in the same
+        tenant BY CONSTRUCTION, so one copy serves the whole fan-out —
+        and encrypted-namespace publishes take the batched
+        re-encryption leg instead of the shared-frame path (``rjob`` is
+        the staged decrypt carrier when the pipeline generated the
+        keystream on device)."""
         emissions = ()
         eng = self._predicates
         if eng is not None and eng.active:
@@ -2358,45 +2518,71 @@ class Server:
                 subscribers.select_shared()
             subscribers.merge_shared_selected()
 
-        for inline_sub in subscribers.inline_subscriptions.values():
-            inline_sub.handler(self.inline_client, inline_sub, pk)
+        # tenant namespace: deliveries carry the tenant-LOCAL topic
+        # (clients never see the scope prefix); the scoped pk itself
+        # stays untouched — the caller still forwards it to the cluster
+        dpk = pk
+        enc_tenant = None
+        if pk.topic_name[:1] == NS_CHAR and self._tenancy is not None:
+            dpk = pk.copy(False)
+            dpk.topic_name = ns_local(pk.topic_name)
+            tenant = self._tenancy.tenant_of_topic(pk.topic_name)
+            if (
+                self._recrypt is not None
+                and tenant is not None
+                and tenant.is_encrypted(dpk.topic_name)
+            ):
+                enc_tenant = tenant
 
-        # QoS0 fast path: encode the outbound frame ONCE per publish and
-        # enqueue the shared bytes per subscriber. Eligible only when no
-        # per-subscriber state can differ (effective QoS is 0 for every
-        # subscriber, no encode/sent hooks attached); clients with
-        # aliases/identifiers/size limits fall back per subscriber inside
-        # publish_to_client.
-        fast = None
-        if pk.fixed_header.qos == 0 and not self.hooks.provides(
-            ON_PACKET_ENCODE, ON_PACKET_SENT
-        ):
-            # $SYS housekeeping republishes every interval with no
-            # inbound publish behind it: keep it out of the encode/
-            # delivery amplification accounting (ROADMAP item 3's metric
-            # must measure client fan-out, not the $SYS tick)
-            fast = _FrameCache(
-                pk,
-                None
-                if pk.topic_name.startswith("$SYS")
-                else self.telemetry,
-            )
+        if enc_tenant is None:
+            for inline_sub in subscribers.inline_subscriptions.values():
+                inline_sub.handler(self.inline_client, inline_sub, dpk)
 
-        for id_, subs in subscribers.subscriptions.items():
-            cl = self.clients.get(id_)
-            if cl is not None:
-                try:
-                    self.publish_to_client(cl, subs, pk, fast)
-                except Exception as e:
-                    self.log.debug(
-                        "failed publishing packet: error=%s client=%s", e, id_
-                    )
+        if enc_tenant is not None:
+            self._fan_out_encrypted(enc_tenant, pk, dpk, subscribers, rjob)
+        else:
+            # QoS0 fast path: encode the outbound frame ONCE per publish
+            # and enqueue the shared bytes per subscriber. Eligible only
+            # when no per-subscriber state can differ (effective QoS is 0
+            # for every subscriber, no encode/sent hooks attached);
+            # clients with aliases/identifiers/size limits fall back per
+            # subscriber inside publish_to_client.
+            fast = None
+            if dpk.fixed_header.qos == 0 and not self.hooks.provides(
+                ON_PACKET_ENCODE, ON_PACKET_SENT
+            ):
+                # $SYS housekeeping republishes every interval with no
+                # inbound publish behind it: keep it out of the encode/
+                # delivery amplification accounting (ROADMAP item 3's
+                # metric must measure client fan-out, not the $SYS tick)
+                fast = _FrameCache(
+                    dpk,
+                    None
+                    if dpk.topic_name.startswith("$SYS")
+                    else self.telemetry,
+                )
+
+            for id_, subs in subscribers.subscriptions.items():
+                cl = self.clients.get(id_)
+                if cl is not None:
+                    try:
+                        self.publish_to_client(cl, subs, dpk, fast)
+                    except Exception as e:
+                        self.log.debug(
+                            "failed publishing packet: error=%s client=%s",
+                            e,
+                            id_,
+                        )
+                    else:
+                        if cl.tenant is not None:
+                            cl.tenant.messages_out += 1
+                            cl.tenant.bytes_out += len(dpk.payload)
 
         # MQTT+ aggregation windows that completed on this publish emit
         # ONE synthesized publish each (payload = the aggregate), riding
         # the same fan-out tick — no extra timers (mqtt_tpu.predicates)
         for kind, target, sub, agg_payload in emissions:
-            out = pk.copy(False)
+            out = dpk.copy(False)
             out.payload = agg_payload
             if kind == "inline":
                 try:
@@ -2415,6 +2601,97 @@ class Server:
                         target,
                     )
 
+    def _key_idents(self, cid: str, cl: Optional[Client] = None) -> tuple:
+        """The key-identity candidates for a client id: the tenant-LOCAL
+        client id first, then the connected client's username — whatever
+        the operator keyed the tenant's key map on (mqtt_tpu.tenancy)."""
+        from .tenancy import local_client_id
+
+        if cl is None:
+            cl = self.clients.get(cid)
+        uname = ""
+        if cl is not None:
+            u = cl.properties.username
+            uname = (
+                u.decode("utf-8", "replace")
+                if isinstance(u, (bytes, bytearray))
+                else (u or "")
+            )
+        return (local_client_id(cid), uname)
+
+    def _origin_idents(self, pk: Packet) -> tuple:
+        """Key-identity candidates for a publish's ORIGIN: the live
+        session's identities plus the username rider cluster forwards
+        carry (mqtt_tpu.cluster head["u"]) — a username-keyed publisher
+        must resolve on workers where its session does not exist."""
+        idents = self._key_idents(pk.origin)
+        rider = getattr(pk, "_origin_user", "")
+        if rider and rider not in idents:
+            idents = idents + (rider,)
+        return idents
+
+    def _recrypt_job_for(self, cl: Client, pk: Packet):
+        """The staged decrypt carrier for an encrypted-namespace publish
+        (None for everything else). Built at submit time so the
+        keystream dispatch rides the match batch (mqtt_tpu.staging)."""
+        renc = self._recrypt
+        tenant = cl.tenant
+        if renc is None or tenant is None:
+            return None
+        local = ns_local(pk.topic_name)
+        if not tenant.is_encrypted(local):
+            return None
+        return renc.decrypt_job(
+            tenant, self._key_idents(pk.origin, cl), bytes(pk.payload)
+        )
+
+    def _fan_out_encrypted(
+        self, tenant, pk: Packet, dpk: Packet, subscribers, rjob
+    ) -> None:
+        """The MQT-TZ re-encryption fan-out (mqtt_tpu.tenancy): decrypt
+        the publish once with the publisher's key (the staged keystream
+        when the batch rode the device, the host path otherwise),
+        re-encrypt per subscriber in ONE batched keystream dispatch, and
+        deliver each subscriber its own ``nonce || ciphertext``. Keyless
+        subscribers receive nothing (counted) — an encrypted namespace
+        never leaks plaintext or someone else's ciphertext."""
+        renc = self._recrypt
+        plaintext = renc.open_publish(
+            tenant, self._origin_idents(pk), bytes(pk.payload), rjob
+        )
+        if plaintext is None:
+            # keyless publisher / malformed framing: the publish is
+            # undeliverable (engine counters carry the reason)
+            self.info.messages_dropped += 1
+            tenant.messages_dropped += 1
+            return
+        targets = [
+            (cid, self._key_idents(cid))
+            for cid in subscribers.subscriptions
+        ]
+        sealed = renc.seal_fanout(tenant, plaintext, targets)
+        for id_, subs in subscribers.subscriptions.items():
+            data = sealed.get(id_)
+            if data is None:
+                continue  # keyless subscriber: withheld, counted
+            cl = self.clients.get(id_)
+            if cl is None:
+                continue
+            out = dpk.copy(False)
+            out.payload = data
+            try:
+                self.publish_to_client(cl, subs, out)
+            except Exception as e:
+                self.log.debug(
+                    "failed publishing recrypted packet: error=%s "
+                    "client=%s",
+                    e,
+                    id_,
+                )
+            else:
+                tenant.messages_out += 1
+                tenant.bytes_out += len(data)
+
     def publish_to_client(
         self,
         cl: Client,
@@ -2422,14 +2699,24 @@ class Server:
         pk: Packet,
         fast: Optional["_FrameCache"] = None,
     ) -> Packet:
-        """Deliver one publish to one subscriber (server.go:1023-1113)."""
+        """Deliver one publish to one subscriber (server.go:1023-1113).
+
+        A namespace-scoped ``pk`` (retained deliveries walk the trie
+        directly, so their packets still carry the tenant prefix —
+        mqtt_tpu.tenancy) is delivered under its tenant-LOCAL topic:
+        the ACL, aliasing, and the wire all see what the client
+        subscribed to."""
         if sub.no_local and pk.origin == cl.id:
             return pk  # [MQTT-3.8.3-3]
+
+        topic = pk.topic_name
+        if topic[:1] == NS_CHAR:
+            topic = ns_local(topic)
 
         # zero-valued identifiers never reach the wire (properties.py
         # encodes only v > 0), so they don't disqualify the shared frame
         if fast is not None and self._shared_frame_ok(cl.properties, sub):
-            if not self.hooks.on_acl_check(cl, pk.topic_name, False):
+            if not self.hooks.on_acl_check(cl, topic, False):
                 raise ERR_NOT_AUTHORIZED()
             retain = pk.fixed_header.retain and (
                 sub.fwd_retained_flag
@@ -2442,13 +2729,14 @@ class Server:
                 cl,
                 data,
                 lambda: pk,
-                count_delivery=not pk.topic_name.startswith("$SYS"),
+                count_delivery=not topic.startswith("$SYS"),
             ):
                 raise ERR_PENDING_CLIENT_WRITES_EXCEEDED()
             return pk
 
         out = pk.copy(False)
-        if not self.hooks.on_acl_check(cl, pk.topic_name, False):
+        out.topic_name = topic
+        if not self.hooks.on_acl_check(cl, topic, False):
             raise ERR_NOT_AUTHORIZED()
         if not sub.fwd_retained_flag and (
             (cl.properties.protocol_version == 5 and not sub.retain_as_published)
@@ -2467,7 +2755,7 @@ class Server:
             out.fixed_header.qos = self.options.capabilities.maximum_qos  # [MQTT-3.2.2-9]
 
         if cl.properties.props.topic_alias_maximum > 0:
-            alias, alias_exists = cl.state.topic_aliases.outbound.set(pk.topic_name)
+            alias, alias_exists = cl.state.topic_aliases.outbound.set(topic)
             out.properties.topic_alias = alias
             if alias > 0:
                 out.properties.topic_alias_flag = True
@@ -2544,6 +2832,17 @@ class Server:
                 sub, bytes(pkv.payload)
             ):
                 continue
+            if (
+                self._recrypt is not None
+                and pkv.topic_name[:1] == NS_CHAR
+            ):
+                # an encrypted-namespace retained message is stored as
+                # the PUBLISHER's ciphertext; deliver it re-keyed to
+                # this subscriber (or not at all — mqtt_tpu.tenancy)
+                pkv2 = self._recrypt_retained(cl, pkv)
+                if pkv2 is None:
+                    continue
+                pkv = pkv2
             try:
                 self.publish_to_client(cl, sub, pkv)
             except Exception as e:
@@ -2552,6 +2851,35 @@ class Server:
                 )
                 continue
             self.hooks.on_retain_published(cl, pkv)
+
+    def _recrypt_retained(self, cl: Client, pkv: Packet) -> Optional[Packet]:
+        """Re-key one retained encrypted-namespace message for a fresh
+        subscriber (mqtt_tpu.tenancy): the store holds the publisher's
+        ciphertext, the wire carries this subscriber's. None = withhold
+        (keyless publisher or subscriber, malformed framing — counted by
+        the engine). Scoped-but-unencrypted topics pass through."""
+        tenant = (
+            self._tenancy.tenant_of_topic(pkv.topic_name)
+            if self._tenancy is not None
+            else None
+        )
+        if tenant is None or not tenant.is_encrypted(ns_local(pkv.topic_name)):
+            return pkv
+        renc = self._recrypt
+        plaintext = renc.open_publish(
+            tenant, self._origin_idents(pkv), bytes(pkv.payload)
+        )
+        if plaintext is None:
+            return None
+        sealed = renc.seal_fanout(
+            tenant, plaintext, [(cl.id, self._key_idents(cl.id, cl))]
+        )
+        data = sealed.get(cl.id)
+        if data is None:
+            return None
+        out = pkv.copy(False)
+        out.payload = data
+        return out
 
     def build_ack(
         self, packet_id: int, pkt: int, qos: int, properties: Properties, reason: Code
@@ -2668,6 +2996,15 @@ class Server:
                 if caps.compatibilities.obscure_not_authorized:
                     reason_codes[i] = ERR_UNSPECIFIED_ERROR.code
             else:
+                if cl.tenant is not None:
+                    # tenant namespace (mqtt_tpu.tenancy): validation,
+                    # $SHARE parsing, and the ACL all saw the LOCAL
+                    # filter above; everything stored or matched from
+                    # here — trie, client state, retained walk,
+                    # persistence, cluster presence — carries the
+                    # scoped key, so two tenants' identical filter
+                    # strings live on disjoint subtrees
+                    sub.filter = ns_scope_filter(cl.tenant.name, sub.filter)
                 if pred_suffix:
                     self._predicates.register(pred_suffix)
                     sub.predicates = (pred_suffix,)
@@ -2726,6 +3063,10 @@ class Server:
                 base, pred_suffix = split_predicate_suffix(sub.filter)
                 if pred_suffix:
                     sub.filter = base
+            if cl.tenant is not None:
+                # the stored key is namespace-scoped (process_subscribe)
+                sub.filter = ns_scope_filter(cl.tenant.name, sub.filter)
+            if self._predicates is not None:
                 old = cl.state.subscriptions.get(sub.filter)
                 if old is not None and old.predicates:
                     self._predicates.release(old.predicates)
@@ -2866,6 +3207,27 @@ class Server:
             # emissions, oracle verdicts, breaker posture
             for key, val in self._predicates.gauges().items():
                 topics[SYS_PREFIX + "/broker/predicates/" + key] = str(val)
+        if self._recrypt is not None:
+            # re-encryption observability (mqtt_tpu.tenancy): batch/block
+            # split, oracle verdicts, key count, breaker posture
+            for key, val in self._recrypt.gauges().items():
+                topics[SYS_PREFIX + "/broker/recrypt/" + key] = str(val)
+        if self._tenancy is not None:
+            # per-tenant $SYS scoping: each ACTIVE tenant's counters
+            # publish INTO its own namespace (a tenant subscribing
+            # $SYS/broker/tenant/# sees only its own broker stats —
+            # structurally, like everything else) plus a global
+            # operator mirror under $SYS/broker/tenants/<name>/
+            for t in self._tenancy.active_tenants():
+                for key, val in t.sys_rows().items():
+                    topics[
+                        ns_scope_topic(
+                            t.name, SYS_PREFIX + "/broker/tenant/" + key
+                        )
+                    ] = str(val)
+                    topics[
+                        SYS_PREFIX + f"/broker/tenants/{t.name}/" + key
+                    ] = str(val)
         if self.overload is not None:
             # overload-governor observability (mqtt_tpu.overload): state,
             # transition/shed/eviction/throttle counters, per-signal
@@ -3042,6 +3404,10 @@ class Server:
             origin=cl.id,
             created=now,
         )
+        if cl.tenant is not None:
+            # a tenant's will fires into its own namespace — exactly
+            # like its live publishes (mqtt_tpu.tenancy)
+            pk.topic_name = ns_scope_topic(cl.tenant.name, pk.topic_name)
         if cl.properties.will.will_delay_interval > 0:
             pk.connect.will_properties.will_delay_interval = (
                 cl.properties.will.will_delay_interval

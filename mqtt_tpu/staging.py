@@ -74,6 +74,7 @@ class MatchStage:
         profiler=None,
         predicates=None,
         pipeline_depth: int = 3,
+        recrypt=None,
     ) -> None:
         self.matcher = matcher
         self.host_fallback = host_fallback
@@ -90,6 +91,13 @@ class MatchStage:
         # back onto the per-publish feature carriers before the futures
         # complete, so fan-out receives the already-filtered set.
         self.predicates = predicates
+        # tenant re-encryption engine (mqtt_tpu.tenancy.RecryptEngine)
+        # or None. When attached, each batch's publisher-decrypt
+        # keystream jobs (RecryptJob carriers) dispatch beside the
+        # tokenized topics and resolve in the same drain-loop executor
+        # leg — the MQT-TZ decrypt rides the staged batch with zero
+        # extra device round trips, exactly like predicate rows.
+        self.recrypt = recrypt
         # telemetry plane (mqtt_tpu.telemetry.Telemetry) or None: batch
         # service-time + fill-ratio histograms, fallback-class counters,
         # and the per-publish stage clock's staging_wait / device_batch
@@ -243,7 +251,7 @@ class MatchStage:
     # -- submission --------------------------------------------------------
 
     def submit(
-        self, topic: str, clock=None, feats=None
+        self, topic: str, clock=None, feats=None, rjob=None
     ) -> "asyncio.Future[Subscribers]":
         """Park one publish; the future resolves with its Subscribers.
         ``clock`` is an optional sampled stage clock (mqtt_tpu.telemetry)
@@ -252,7 +260,11 @@ class MatchStage:
         (mqtt_tpu.predicates.PublishFeatures): the batch ships it to the
         device rule table and the resolved pass bits come back ON the
         carrier — host-fallback resolutions simply leave it unstamped
-        and the fan-out path's host interpreter decides.
+        and the fan-out path's host interpreter decides. ``rjob`` is
+        the publish's optional decrypt carrier
+        (mqtt_tpu.tenancy.RecryptJob) for encrypted-namespace publishes:
+        its keystream dispatch rides the same batch and the resolved
+        rows come back on the carrier the same way.
 
         Admission is bounded: once ``max_pending`` publishes are parked,
         or the pipeline's projected wait already exceeds the deadline
@@ -270,7 +282,7 @@ class MatchStage:
                 self.telemetry.note_fallback("admission")
             fut.set_result(self.host_fallback(topic))
             return fut
-        self._pending.append((topic, fut, clock, feats))
+        self._pending.append((topic, fut, clock, feats, rjob))
         if len(self._pending) > self.peak_pending:
             self.peak_pending = len(self._pending)
         wake.set()
@@ -340,15 +352,14 @@ class MatchStage:
             # during accumulation) is dead weight: drop it here so the
             # device never matches for it and no resolver path trips on
             # an already-cancelled future
-            batch = [
-                (t, f, c, p) for t, f, c, p in batch if not f.cancelled()
-            ]
+            batch = [item for item in batch if not item[1].cancelled()]
             if not batch:
                 continue
-            topics = [t for t, _, _, _ in batch]
-            futs = [f for _, f, _, _ in batch]
-            clocks = [c for _, _, c, _ in batch]
-            feats = [p for _, _, _, p in batch]
+            topics = [t for t, _, _, _, _ in batch]
+            futs = [f for _, f, _, _, _ in batch]
+            clocks = [c for _, _, c, _, _ in batch]
+            feats = [p for _, _, _, p, _ in batch]
+            rjobs = [r for _, _, _, _, r in batch]
             for c in clocks:
                 if c is not None:  # end of the accumulation/park wait
                     c.stamp("staging_wait")
@@ -360,6 +371,7 @@ class MatchStage:
             t_formed = time.perf_counter()
             profiler = self.profiler
             predicates = self.predicates
+            recrypt = self.recrypt
             matcher = self.matcher
             telemetry = self.telemetry
 
@@ -398,13 +410,26 @@ class MatchStage:
                         _log.exception(
                             "predicate eval issue failed; host interpreter"
                         )
-                return resolver, pred_resolver, rec
+                # the tenant decrypt leg rides the same batch: one
+                # fused keystream dispatch for every encrypted-namespace
+                # publish here; a None resolver (no jobs, breaker open,
+                # no backend) leaves the carriers unstamped and the
+                # fan-out's host keystream serves (mqtt_tpu.tenancy)
+                rec_resolver = None
+                if recrypt is not None:
+                    try:
+                        rec_resolver = recrypt.issue_batch(rjobs)
+                    except Exception:
+                        _log.exception(
+                            "recrypt issue failed; host keystream"
+                        )
+                return resolver, pred_resolver, rec_resolver, rec
 
             loop = asyncio.get_running_loop()
             try:
-                resolver, pred_resolver, rec = await loop.run_in_executor(
-                    self._h2d_executor, issue
-                )
+                (
+                    resolver, pred_resolver, rec_resolver, rec,
+                ) = await loop.run_in_executor(self._h2d_executor, issue)
             except asyncio.CancelledError:
                 # stop() cancelled us with this batch in hand (in neither
                 # _pending nor the queue): resolve it before going down.
@@ -422,7 +447,7 @@ class MatchStage:
                 await queue.put(
                     (
                         resolver, futs, topics, clocks, rec, pred_resolver,
-                        feats, t_ready,
+                        feats, rec_resolver, t_ready,
                     )
                 )
             except asyncio.CancelledError:
@@ -438,7 +463,7 @@ class MatchStage:
         while True:
             (
                 resolver, futs, topics, clocks, rec, pred_resolver, feats,
-                t_ready,
+                rec_resolver, t_ready,
             ) = await queue.get()
             try:
                 # the D2H sync blocks — run it off the loop. Queue depth is
@@ -448,7 +473,7 @@ class MatchStage:
                 # pred resolver never raises — failures degrade to None).
                 depth = queue.qsize() + 1
                 t0 = loop.time()
-                pr, mr = pred_resolver, resolver
+                pr, mr, rr = pred_resolver, resolver, rec_resolver
 
                 def sync():
                     if telemetry is not None:
@@ -457,15 +482,19 @@ class MatchStage:
                         telemetry.observe_leg_wait(
                             "d2h", time.perf_counter() - t_ready
                         )
-                    if pr is None:
-                        return mr(), None
-                    return mr(), pr()
+                    return (
+                        mr(),
+                        pr() if pr is not None else None,
+                        rr() if rr is not None else None,
+                    )
 
-                results, pred_rows = await loop.run_in_executor(
+                results, pred_rows, rec_rows = await loop.run_in_executor(
                     self._executor, sync
                 )
                 if pred_rows is not None and self.predicates is not None:
                     self.predicates.attach_rows(feats, pred_rows)
+                if rec_rows is not None and self.recrypt is not None:
+                    self.recrypt.attach(rec_rows)
                 dt = loop.time() - t0
                 self._observe_service(dt, len(topics), depth)
                 if telemetry is not None:

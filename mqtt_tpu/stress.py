@@ -50,13 +50,13 @@ def _connect_bytes(client_id: str, version: int = 4) -> bytes:
     )
 
 
-def _subscribe_bytes(pid: int, topic: str) -> bytes:
+def _subscribe_bytes(pid: int, topic: str, qos: int = 0) -> bytes:
     return encode_packet(
         Packet(
             fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
             protocol_version=4,
             packet_id=pid,
-            filters=[Subscription(filter=topic, qos=0)],
+            filters=[Subscription(filter=topic, qos=qos)],
         )
     )
 

@@ -35,6 +35,69 @@ from .utils import LockedMap
 SHARE_PREFIX = "$SHARE"  # prefix indicating a shared-subscription filter
 SYS_PREFIX = "$SYS"  # prefix indicating a system info topic
 
+# -- tenant namespaces (mqtt_tpu.tenancy) -----------------------------------
+#
+# A tenant's topic space is a structurally enforced namespace: every key
+# the broker stores or matches for a tenant client — trie filters,
+# retained topics, $SHARE inner filters, cluster interest summaries —
+# is prefixed with one extra level ``NS_CHAR + tenant`` before it
+# reaches this module. NS_CHAR is U+0000, which no client-supplied
+# topic or filter may contain ([MQTT-4.7.3-2], enforced by
+# ``is_valid_filter``), so a scoped key can never be forged from the
+# wire and two tenants' identical topic strings land on disjoint trie
+# subtrees. Cross-tenant delivery is therefore impossible by
+# construction; the only cross-namespace reach a wildcard has is a
+# GLOBAL (untenanted) top-level ``+``/``#`` filter, which the gather
+# guards below exclude from namespace subtrees the same way the
+# [MQTT-4.7.1-1/2] rule excludes ``$``-topics.
+
+NS_CHAR = "\x00"
+
+
+def ns_scope_topic(tenant: str, topic: str) -> str:
+    """Prefix a tenant-local topic NAME into its namespace."""
+    return NS_CHAR + tenant + "/" + topic
+
+
+def ns_scope_filter(tenant: str, filter: str) -> str:
+    """Prefix a tenant-local FILTER into its namespace. A shared
+    subscription scopes its inner filter (the group is a delivery
+    policy, not an address): ``$SHARE/g/f`` -> ``$SHARE/g/<ns>/f`` —
+    the trie roots shared subtrees at depth 2, so two tenants' identical
+    groups+filters still land on disjoint particles."""
+    if is_shared_filter(filter):
+        parts = filter.split("/", 2)
+        inner = parts[2] if len(parts) > 2 else ""
+        return f"{parts[0]}/{parts[1]}/{NS_CHAR}{tenant}/{inner}"
+    return NS_CHAR + tenant + "/" + filter
+
+
+def ns_tenant(key: str) -> str:
+    """The tenant a scoped key belongs to ("" for global keys)."""
+    if key[:1] != NS_CHAR:
+        return ""
+    i = key.find("/")
+    return key[1:i] if i > 0 else key[1:]
+
+
+def ns_local(key: str) -> str:
+    """Strip the namespace level off a scoped key (identity for global
+    keys) — the tenant-local topic/filter the client sees on the wire."""
+    if key[:1] != NS_CHAR:
+        return key
+    i = key.find("/")
+    return key[i + 1 :] if i >= 0 else ""
+
+
+def _ns_local0(key: str) -> str:
+    """First character of the tenant-local portion of a (possibly
+    scoped) key — the character the [MQTT-4.7.1-1/2] ``$``-rules apply
+    to inside a namespace."""
+    if key[:1] != NS_CHAR:
+        return key[:1]
+    i = key.find("/")
+    return key[i + 1 : i + 2] if i >= 0 else ""
+
 # -- MQTT+ predicate suffixes (mqtt_tpu.predicates) -------------------------
 #
 # An MQTT+ subscription rides a standard SUBSCRIBE filter with a payload
@@ -159,6 +222,12 @@ def is_valid_filter(filter: str, for_publish: bool = False) -> bool:
     still pass those byte gates, extend the fast-path gates too."""
     if not for_publish and len(filter) == 0:
         return False  # [MQTT-4.7.3-1]
+    if NS_CHAR in filter:
+        # [MQTT-4.7.3-2]: topic names and filters must not include
+        # U+0000 — and NS_CHAR doubles as the tenant-namespace marker
+        # (mqtt_tpu.tenancy), so a wire topic can never alias into (or
+        # out of) another tenant's scoped key space
+        return False
     if for_publish:
         # 4.7.2: the server prevents clients using $SYS topic names to
         # exchange messages with other clients.
@@ -569,23 +638,36 @@ class TopicsIndex:
                         stack.append((particle, d + 1))
                     else:
                         self._gather_subscriptions(topic, particle, subs)
-                        self._gather_shared(particle, subs)
-                        self._gather_inline(particle, subs)
+                        self._gather_shared(topic, particle, subs)
+                        self._gather_inline(topic, particle, subs)
                         wild = particle.particles.get("#")
                         if wild is not None and part_key != "+":
                             # filter/# matches filter itself, per spec 4.7.1.2
                             self._gather_subscriptions(topic, wild, subs)
-                            self._gather_shared(wild, subs)
+                            self._gather_shared(topic, wild, subs)
                             # reference quirk (topics.go:615): gathers the
                             # parent particle's inline subs, not the wild
                             # child's
-                            self._gather_inline(particle, subs)
+                            self._gather_inline(topic, particle, subs)
             particle = n.particles.get("#")
             if particle is not None:
                 self._gather_subscriptions(topic, particle, subs)
-                self._gather_shared(particle, subs)
-                self._gather_inline(particle, subs)
+                self._gather_shared(topic, particle, subs)
+                self._gather_inline(topic, particle, subs)
         return subs
+
+    @staticmethod
+    def _ns_excluded(topic: str, filter: str) -> bool:
+        """The namespace gather guards (mqtt_tpu.tenancy): a GLOBAL
+        top-level-wildcard filter never reaches into a tenant namespace,
+        and inside a namespace the [MQTT-4.7.1-1/2] ``$``-rule applies
+        to the tenant-LOCAL first level. Zero-cost for global topics
+        (one char compare)."""
+        if topic[:1] != NS_CHAR or not filter:
+            return False
+        if filter[0] in "+#":
+            return True  # global wildcard vs scoped topic
+        return _ns_local0(topic) == "$" and _ns_local0(filter) in "+#"
 
     def _gather_subscriptions(self, topic: str, particle: _Particle, subs: Subscribers) -> None:
         """Merge a particle's subscriptions into the result set, excluding
@@ -594,15 +676,29 @@ class TopicsIndex:
         for client, sub in particle.subscriptions.get_all().items():
             if sub.filter and topic[0] == "$" and sub.filter[0] in "+#":
                 continue
+            if self._ns_excluded(topic, sub.filter):
+                continue
             cls = subs.subscriptions.get(client, sub)
             subs.subscriptions[client] = cls.merge(sub)
 
-    def _gather_shared(self, particle: _Particle, subs: Subscribers) -> None:
+    def _gather_shared(self, topic: str, particle: _Particle, subs: Subscribers) -> None:
         for shares in particle.shared.get_all().values():
             for client, sub in shares.items():
+                if topic[:1] == NS_CHAR:
+                    # the namespace guard applies to the INNER filter
+                    # (publishes match the inner topic space)
+                    parts = sub.filter.split("/", 2)
+                    inner = parts[2] if len(parts) > 2 else ""
+                    if self._ns_excluded(topic, inner):
+                        continue
                 subs.shared.setdefault(sub.filter, {})[client] = sub
 
-    def _gather_inline(self, particle: _Particle, subs: Subscribers) -> None:
+    def _gather_inline(self, topic: str, particle: _Particle, subs: Subscribers) -> None:
+        if topic[:1] == NS_CHAR:
+            for iid, isub in particle.inline_subscriptions.get_all().items():
+                if not self._ns_excluded(topic, isub.filter):
+                    subs.inline_subscriptions[iid] = isub
+            return
         subs.inline_subscriptions.update(particle.inline_subscriptions.get_all())
 
     def messages(self, filter: str) -> list[Packet]:
@@ -618,6 +714,9 @@ class TopicsIndex:
             return pks
         parts = filter.split("/")
         last = len(parts) - 1
+        # a namespace-scoped filter's local top level sits at depth 1;
+        # the $SYS wildcard exclusion applies there (mqtt_tpu.tenancy)
+        sys_d = 1 if parts[0][:1] == NS_CHAR else 0
         stack: list[tuple[_Particle, int]] = [(self.root, 0)]
         while stack:
             n, d = stack.pop()
@@ -625,7 +724,12 @@ class TopicsIndex:
             has_next = d < last
             if key in ("+", "#"):
                 for adjacent in list(n.particles.values()):
-                    if d == 0 and adjacent.key == SYS_PREFIX:
+                    if d == sys_d and adjacent.key == SYS_PREFIX:
+                        continue
+                    if d == 0 and adjacent.key[:1] == NS_CHAR:
+                        # a GLOBAL wildcard never descends into a
+                        # tenant namespace (scoped filters address it
+                        # by its literal level instead)
                         continue
                     if not has_next and adjacent.retain_path:
                         pk = self.retained.get(adjacent.retain_path)

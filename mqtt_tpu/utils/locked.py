@@ -67,6 +67,8 @@ V = TypeVar("V")
 # telemetry plane never decides what /metrics shows
 LOCK_NAMES = (
     "clients",
+    "tenants",
+    "recrypt_keys",
     "topics_trie",
     "cluster_remote_trie",
     "retained",

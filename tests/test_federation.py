@@ -278,6 +278,109 @@ def _bare_cluster(tmp_path, with_governor=True):
     return c, gov
 
 
+class TestMeshReserve:
+    """Cross-worker admission-reserve coordination (ISSUE 12 satellite /
+    PR 5 residual): the admin-ACL CONNECT reserve is a MESH budget —
+    reserve spend gossips on _T_GOSSIP and exhausting it on one worker
+    refuses reserve CONNECTs on the others."""
+
+    def _shed(self, gov, clock):
+        gov._sources["test"] = lambda: 1.0
+        gov.evaluate(force=True)
+        assert gov.state == SHED
+
+    def test_reserve_exhausted_on_one_worker_refuses_on_the_other(
+        self, tmp_path
+    ):
+        # two workers, reserve of 2 mesh-wide
+        c0, gov0 = _bare_cluster(tmp_path)
+        c1, gov1 = _bare_cluster(tmp_path)
+        for gov in (gov0, gov1):
+            gov.config.admission_reserve = 2
+            gov.config.quota_window_s = 60.0
+        self._shed(gov0, None)
+        self._shed(gov1, None)
+        # worker 0 burns the whole reserve locally
+        assert gov0.admit_connect(admin=True)
+        assert gov0.admit_connect(admin=True)
+        assert not gov0.admit_connect(admin=True)
+        assert gov0.reserve_advert() == 2
+        # its advert (with the spend) reaches worker 1 over gossip
+        payload = c0._advert_payload()
+        assert b'"r": 2' in payload or b'"r":2' in payload
+        c1._on_gossip(0, payload)
+        # worker 1 now refuses reserve CONNECTs too: the budget is shared
+        assert not gov1.admit_connect(admin=True)
+        assert gov1.connects_refused >= 1
+        assert gov1.gauges()["reserve_spent_mesh"] == 2
+        assert gov1.gauges()["reserve_spent_local"] == 0
+
+    def test_peer_reserve_spend_ages_out_after_a_window(self, tmp_path):
+        c1, gov1 = _bare_cluster(tmp_path)
+        gov1.config.admission_reserve = 1
+        gov1.config.quota_window_s = 60.0
+        self._shed(gov1, None)
+        c1._on_gossip(0, b'{"s": 2, "p": 1.0, "r": 1}')
+        assert not gov1.admit_connect(admin=True)
+        # a window later the stale spend no longer draws from the budget
+        gov1.clock.t += 61.0
+        gov1.evaluate(force=True)
+        assert gov1.admit_connect(admin=True)
+
+    def test_zero_spend_advert_clears_the_peer_entry(self, tmp_path):
+        c1, gov1 = _bare_cluster(tmp_path)
+        gov1.config.admission_reserve = 1
+        gov1.config.quota_window_s = 60.0
+        self._shed(gov1, None)
+        c1._on_gossip(0, b'{"s": 2, "p": 1.0, "r": 1}')
+        assert gov1.gauges()["reserve_spent_mesh"] == 1
+        # the peer's window rolled: its next advert carries no spend
+        c1._on_gossip(0, b'{"s": 2, "p": 1.0}')
+        assert gov1.gauges()["reserve_spent_mesh"] == 0
+        assert 0 not in c1._peer_advert_reserve
+
+    def test_reserve_admit_fires_immediate_gossip_observer(self):
+        gov, clock, pressure = make_governor(
+            admission_reserve=2, quota_window_s=60.0
+        )
+        pressure[0] = 1.0
+        gov.evaluate(force=True)
+        fired = []
+        gov.on_reserve_admit = lambda: fired.append(1)
+        assert gov.admit_connect(admin=True)
+        assert fired == [1]
+        # a refused connect fires nothing
+        gov._reserve_in_epoch = 99
+        assert not gov.admit_connect(admin=True)
+        assert fired == [1]
+
+    def test_tree_advert_folds_reserve_by_sum(self, tmp_path):
+        c0, gov0 = _bare_cluster(tmp_path)
+        gov0.config.admission_reserve = 8
+        gov0.config.quota_window_s = 60.0
+        self._shed(gov0, None)
+        assert gov0.admit_connect(admin=True)
+        # fake a tree topology with two live edges carrying spends
+        import time as _time
+
+        from mqtt_tpu.mesh_topology import Topology
+
+        c0.topo = Topology(0, range(3), 2, boot_id=1)
+        now = _time.monotonic()
+        c0._peer_adverts[1] = (0, 0.0, now)
+        c0._peer_adverts[2] = (0, 0.0, now)
+        c0._peer_advert_reserve[1] = 2
+        c0._peer_advert_reserve[2] = 3
+        import json as _json
+
+        # the advert toward a NEW edge folds local + both subtrees
+        body = _json.loads(c0._advert_payload(exclude=None))
+        assert body["r"] == 1 + 2 + 3
+        # the advert toward edge 1 excludes edge 1's own spend
+        body = _json.loads(c0._advert_payload(exclude=1))
+        assert body["r"] == 1 + 3
+
+
 class TestGossip:
     def test_on_gossip_feeds_adverts_and_governor(self, tmp_path):
         c, gov = _bare_cluster(tmp_path)

@@ -17,6 +17,7 @@ import json
 import math
 import random
 
+import numpy as np
 import pytest
 
 from mqtt_tpu import Options, Server
@@ -100,6 +101,74 @@ class TestGrammar:
         assert spec.op == OP_CONTAINS and spec.text == b"alarm"
         spec = compile_suffix("$MEAN{v:10}")
         assert spec.op == OP_MEAN and spec.window == 10 and spec.is_agg
+
+
+class TestNestedFieldPaths:
+    """Dotted JSON field paths (ISSUE 12 satellite / PR 8 residual):
+    ``$GT{battery.level:20}`` traverses nested objects in the
+    once-per-publish extraction; flat fields keep their exact slots and
+    a literal dotted FLAT key wins over traversal."""
+
+    NESTED = json.dumps(
+        {"battery": {"level": 17.5, "meta": {"v": 3}}, "temp": 21.0}
+    ).encode()
+
+    def test_dotted_path_extraction(self):
+        assert payload_number(self.NESTED, "battery.level") == 17.5
+        assert payload_number(self.NESTED, "battery.meta.v") == 3.0
+        assert payload_number(self.NESTED, "temp") == 21.0
+
+    def test_missing_path_is_nan_skip_to_pass(self):
+        assert math.isnan(payload_number(self.NESTED, "battery.volts"))
+        assert math.isnan(payload_number(self.NESTED, "battery.level.deep"))
+        assert math.isnan(payload_number(self.NESTED, "nope.x"))
+        spec = compile_suffix("$GT{battery.volts:1}")
+        assert eval_rule_host(spec, self.NESTED)  # missing path: pass
+
+    def test_flat_dotted_key_wins_over_traversal(self):
+        flat = json.dumps(
+            {"battery.level": 99.0, "battery": {"level": 1.0}}
+        ).encode()
+        assert payload_number(flat, "battery.level") == 99.0
+
+    def test_nested_predicate_through_engine_and_device(self):
+        """The device kernel sees only the extracted feature slot, so
+        host and device agree on nested paths by construction — drive
+        the full eval_batch path and cross-check the host oracle."""
+        eng = PredicateEngine(oracle_sample=1)
+        eng.register("$GT{battery.level:20}")
+        eng.register("$LTE{battery.meta.v:3}")
+        passing = json.dumps({"battery": {"level": 33, "meta": {"v": 3}}}).encode()
+        failing = json.dumps({"battery": {"level": 5, "meta": {"v": 9}}}).encode()
+        feats = [eng.features_for(p) for p in (passing, failing)]
+        resolver = eng.eval_batch_async(feats)
+        assert resolver is not None
+        resolved = resolver()
+        assert resolved is not None
+        rows, eligible, _gen = resolved
+        assert eligible == [0, 1]
+        gt = eng._rules["$GT{battery.level:20}"]
+        lte = eng._rules["$LTE{battery.meta.v:3}"]
+        for row, payload in zip(rows, (passing, failing)):
+            for rule in (gt, lte):
+                bit = bool((row[rule.idx >> 5] >> np.uint32(rule.idx & 31)) & 1)
+                assert bit == eval_rule_host(rule.spec, payload)
+
+    def test_nested_subscribe_end_to_end(self):
+        async def scenario():
+            s = Server(staged_options())
+            _collect = []
+
+            def handler(cl, sub, pk):
+                _collect.append(bytes(pk.payload))
+
+            s.subscribe("batt/+$GT{battery.level:20}", 9, handler)
+            s.publish("batt/a", json.dumps({"battery": {"level": 42}}).encode(), False, 0)
+            s.publish("batt/a", json.dumps({"battery": {"level": 3}}).encode(), False, 0)
+            await asyncio.sleep(0)
+            assert _collect == [json.dumps({"battery": {"level": 42}}).encode()]
+
+        run(scenario())
 
 
 class TestHostInterpreter:

@@ -1,0 +1,933 @@
+"""Secure multi-tenant plane (ISSUE 12): structural namespace isolation,
+quota classes riding the governor's priority machinery, per-tenant $SYS
+scoping, and the batched per-subscriber re-encryption stage with its
+device-vs-host differential oracle and breaker degradation.
+
+The isolation tests drive TWO tenants (plus an untenanted bystander)
+through IDENTICAL topic/filter strings — exact, wildcard, $SHARE,
+retained, predicated — and assert zero cross-tenant deliveries. The
+point is that isolation holds by construction (disjoint trie subtrees),
+not by any per-delivery filtering."""
+
+import asyncio
+import math
+import random
+
+import numpy as np
+import pytest
+
+import mqtt_tpu.packets as pkts
+from mqtt_tpu.packets import FixedHeader, Packet, Subscription
+from mqtt_tpu.server import Options, Server
+from mqtt_tpu.tenancy import (
+    KeyRegistry,
+    RecryptEngine,
+    TenantPlane,
+    local_client_id,
+    scope_client_id,
+)
+from mqtt_tpu.topics import (
+    NS_CHAR,
+    is_valid_filter,
+    ns_local,
+    ns_scope_filter,
+    ns_scope_topic,
+    ns_tenant,
+)
+from mqtt_tpu.ops.recrypt import (
+    aes_encrypt_blocks,
+    ctr_counters,
+    expand_key,
+    host_keystream,
+    keystream_async,
+    xor_into,
+)
+
+from tests.test_server import (
+    Harness,
+    pub_packet,
+    read_wire_packet,
+    run,
+    sub_packet,
+)
+
+KEY_A = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+KEY_S = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+
+
+def tenant_options(**kw):
+    tenants = kw.pop(
+        "tenants",
+        {
+            "acme": {"quota_class": "vip"},
+            "bulkco": {"quota_class": "bulk"},
+        },
+    )
+    users = kw.pop(
+        "tenant_users",
+        {"cidA": "acme", "cidA2": "acme", "cidB": "bulkco", "cidB2": "bulkco"},
+    )
+    return Options(
+        inline_client=False,
+        tenancy=True,
+        tenants=tenants,
+        tenant_users=users,
+        **kw,
+    )
+
+
+class TestScoping:
+    def test_scope_round_trip(self):
+        scoped = ns_scope_topic("acme", "a/b")
+        assert scoped == NS_CHAR + "acme/a/b"
+        assert ns_tenant(scoped) == "acme"
+        assert ns_local(scoped) == "a/b"
+        assert ns_local("a/b") == "a/b" and ns_tenant("a/b") == ""
+
+    def test_scope_filter_shapes(self):
+        assert ns_scope_filter("t", "#") == NS_CHAR + "t/#"
+        assert (
+            ns_scope_filter("t", "$SHARE/g/s/#")
+            == f"$SHARE/g/{NS_CHAR}t/s/#"
+        )
+        assert ns_scope_filter("t", "$SYS/broker/tenant/#") == (
+            NS_CHAR + "t/$SYS/broker/tenant/#"
+        )
+
+    def test_client_id_scoping(self):
+        sid = scope_client_id("acme", "dev1")
+        assert sid.startswith(NS_CHAR) and local_client_id(sid) == "dev1"
+
+    def test_nul_filters_rejected_on_the_wire(self):
+        # [MQTT-4.7.3-2] — and the structural guarantee that a wire
+        # topic can never alias into a scoped key
+        assert not is_valid_filter(NS_CHAR + "acme/a", False)
+        assert not is_valid_filter("a/" + NS_CHAR + "b", True)
+        assert is_valid_filter("a/b", True)
+
+    def test_invalid_tenant_names_refused(self):
+        plane = TenantPlane()
+        for bad in ("", "a/b", "a+", "c#", NS_CHAR + "x"):
+            with pytest.raises(ValueError):
+                plane.register(bad)
+
+    def test_resolution_order_username_then_cid_then_default(self):
+        plane = TenantPlane()
+        plane.configure(
+            {"t1": {}, "t2": {}, "dflt": {}},
+            {"alice": "t1", "cid9": "t2"},
+            default="dflt",
+        )
+        assert plane.resolve("alice", "cid9").name == "t1"
+        assert plane.resolve("", "cid9").name == "t2"
+        assert plane.resolve("nobody", "cidX").name == "dflt"
+        plane2 = TenantPlane()
+        plane2.configure({"t1": {}}, {"alice": "t1"}, default="")
+        assert plane2.resolve("nobody", "cidX") is None
+
+
+class TestAESVectors:
+    def test_fips_197_c1_block(self):
+        rk = expand_key(KEY_A)
+        pt = np.frombuffer(
+            bytes.fromhex("00112233445566778899aabbccddeeff"), dtype=np.uint8
+        ).reshape(1, 16)
+        ct = aes_encrypt_blocks(rk[None], pt).tobytes()
+        assert ct.hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+    def test_sp800_38a_f51_ctr_keystream(self):
+        # CTR-AES128.Encrypt: the first counter block's keystream XOR
+        # the known plaintext block must give the known ciphertext
+        rk = expand_key(KEY_S)
+        ctr = np.frombuffer(
+            bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff"), dtype=np.uint8
+        ).reshape(1, 16)
+        ks = aes_encrypt_blocks(rk[None], ctr)
+        pt1 = bytes.fromhex("6bc1bee22e409f96e93d7e117393172a")
+        ct1 = xor_into(pt1, ks)
+        assert ct1.hex() == "874d6191b620e3261bef6864990db6ce"
+
+    def test_device_matches_host_across_sizes(self):
+        """The seeded device-vs-host differential across payload sizes:
+        0, 1, block-aligned, block+1, and 256KiB (ISSUE acceptance)."""
+        rng = random.Random(7)
+        table = np.stack([expand_key(KEY_A), expand_key(KEY_S)])
+        for size in (0, 1, 16, 17, 256 * 1024):
+            n_blocks = (size + 15) // 16
+            if n_blocks == 0:
+                continue  # no keystream to generate at all
+            nonce = bytes(rng.randrange(256) for _ in range(12))
+            kidx = np.array(
+                [rng.randrange(2) for _ in range(n_blocks)], dtype=np.int32
+            )
+            counters = ctr_counters(nonce, n_blocks)
+            resolver = keystream_async(table, kidx, counters)
+            assert resolver is not None
+            dev = resolver()
+            host = host_keystream(table, kidx, counters)
+            assert np.array_equal(dev, host), f"mismatch at size {size}"
+
+    def test_engine_seal_open_round_trip_all_sizes(self):
+        reg = KeyRegistry()
+        reg.set_key("t", "pub", KEY_A)
+        reg.set_key("t", "sub", KEY_S)
+        eng = RecryptEngine(reg, oracle_sample=1)
+        eng.reseed_nonce(b"seed")
+        plane = TenantPlane()
+        t = plane.register("t", encrypted=("e/",))
+        for size in (0, 1, 16, 17, 256 * 1024):
+            plaintext = bytes(range(256)) * (size // 256) + bytes(size % 256)
+            plaintext = plaintext[:size]
+            wire = eng.seal_with_key(KEY_A, plaintext)
+            assert len(wire) == 12 + size
+            job = eng.decrypt_job(t, ("pub",), wire)
+            assert not job.error
+            got = eng.open_publish(t, ("pub",), wire, job)
+            assert got == plaintext
+            sealed = eng.seal_fanout(t, plaintext, [("s1", ("sub",))])
+            assert eng.open_with_key(KEY_S, sealed["s1"]) == plaintext
+            if size:
+                assert sealed["s1"][12:] != plaintext
+        assert eng.oracle_mismatches == 0
+
+    def test_staged_issue_batch_attaches_keystreams(self):
+        reg = KeyRegistry()
+        reg.set_key("t", "pub", KEY_A)
+        eng = RecryptEngine(reg, oracle_sample=1, device_min_blocks=1)
+        plane = TenantPlane()
+        t = plane.register("t", encrypted=("e/",))
+        wire = eng.seal_with_key(KEY_A, b"x" * 40)
+        jobs = [None, eng.decrypt_job(t, ("pub",), wire), None]
+        resolver = eng.issue_batch(jobs)
+        assert resolver is not None
+        eng.attach(resolver())
+        assert jobs[1].keystream is not None
+        assert jobs[1].keystream.shape == (3, 16)
+        # and the attached keystream decrypts correctly
+        assert eng.open_publish(t, ("pub",), wire, jobs[1]) == b"x" * 40
+
+    def test_keyless_and_malformed_jobs(self):
+        reg = KeyRegistry()
+        eng = RecryptEngine(reg)
+        plane = TenantPlane()
+        t = plane.register("t", encrypted=("e/",))
+        job = eng.decrypt_job(t, ("nobody", ""), b"\x00" * 64)
+        assert job.error == "no_key" and eng.no_key_drops == 1
+        reg.set_key("t", "pub", KEY_A)
+        job = eng.decrypt_job(t, ("pub",), b"short")
+        assert job.error == "malformed" and eng.malformed == 1
+        assert eng.open_publish(t, ("pub",), b"short") is None
+
+
+# -- broker-level helpers ----------------------------------------------------
+
+
+async def _connect_many(h, cids, version=4):
+    out = {}
+    for cid in cids:
+        r, w, _t = await h.connect(client_id=cid, version=version)
+        out[cid] = (r, w)
+    return out
+
+
+async def _drain_payloads(reader, n_expected=None, idle_s=0.25):
+    """Read PUBLISH frames until the stream idles; returns
+    [(topic, payload)]. ``n_expected`` stops early once reached."""
+    got = []
+    while True:
+        try:
+            pk = await asyncio.wait_for(read_wire_packet(reader), idle_s)
+        except asyncio.TimeoutError:
+            return got
+        if pk.fixed_header.type == pkts.PUBLISH:
+            got.append((pk.topic_name, bytes(pk.payload)))
+            if n_expected is not None and len(got) >= n_expected:
+                return got
+
+
+class TestStructuralIsolation:
+    def test_identical_filters_zero_cross_tenant_deliveries(self):
+        """The acceptance property: tenants acme and bulkco (and an
+        untenanted bystander) subscribe IDENTICAL filter strings —
+        exact, +, #, $SHARE, predicated — and every publish lands only
+        inside its own namespace. Seeded, multi-round."""
+
+        async def scenario():
+            h = Harness(tenant_options())
+            subs = {}
+            try:
+                conns = await _connect_many(
+                    h, ["cidA", "cidA2", "cidB", "cidB2", "cidU"]
+                )
+                filters = [
+                    "s/1/t",
+                    "s/+/t",
+                    "top/#",
+                    "$SHARE/grp/s/#",
+                    "alerts/#$CONTAINS{alarm}",
+                ]
+                for cid, (r, w) in conns.items():
+                    w.write(
+                        sub_packet(
+                            1,
+                            [Subscription(filter=f, qos=0) for f in filters],
+                        )
+                    )
+                    await w.drain()
+                    ack = await read_wire_packet(r)
+                    assert ack.fixed_header.type == pkts.SUBACK
+                rng = random.Random(12)
+                topics = ["s/1/t", "s/9/t", "top/x/y", "alerts/fire"]
+                sent = []  # (publisher cid, topic, payload)
+                for i in range(24):
+                    pub_cid = rng.choice(["cidA", "cidB", "cidU"])
+                    topic = rng.choice(topics)
+                    payload = f"alarm {pub_cid} {topic} {i}".encode()
+                    _r, w = conns[pub_cid]
+                    w.write(pub_packet(topic, payload))
+                    await w.drain()
+                    sent.append((pub_cid, topic, payload))
+                await asyncio.sleep(0.3)
+                tenant_of = {
+                    "cidA": "acme",
+                    "cidA2": "acme",
+                    "cidB": "bulkco",
+                    "cidB2": "bulkco",
+                    "cidU": "",
+                }
+                for cid, (r, _w) in conns.items():
+                    got = await _drain_payloads(r)
+                    for topic, payload in got:
+                        assert not topic.startswith(NS_CHAR), (
+                            "scope prefix leaked to the wire"
+                        )
+                        pub_cid = payload.split()[1].decode()
+                        assert tenant_of[pub_cid] == tenant_of[cid], (
+                            f"CROSS-TENANT LEAK: {cid} got {payload!r}"
+                        )
+                    subs[cid] = got
+                # every subscriber saw its own tenant's traffic at all
+                # (the test must not pass vacuously)
+                for cid in ("cidA", "cidB", "cidU"):
+                    assert subs[cid], f"{cid} received nothing"
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_retained_and_share_groups_stay_per_tenant(self):
+        async def scenario():
+            h = Harness(tenant_options())
+            try:
+                conns = await _connect_many(h, ["cidA", "cidB"])
+                # same retained topic string in both tenants
+                for cid, val in (("cidA", b"ra"), ("cidB", b"rb")):
+                    _r, w = conns[cid]
+                    w.write(pub_packet("cfg/x", val, retain=True))
+                    await w.drain()
+                await asyncio.sleep(0.2)
+                # fresh same-tenant subscribers see only their own copy
+                fresh = await _connect_many(h, ["cidA2", "cidB2"])
+                for cid, want in (("cidA2", b"ra"), ("cidB2", b"rb")):
+                    r, w = fresh[cid]
+                    w.write(
+                        sub_packet(2, [Subscription(filter="cfg/#", qos=0)])
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)  # SUBACK
+                    got = await _drain_payloads(r, n_expected=1)
+                    assert got == [("cfg/x", want)], (cid, got)
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_thousand_registered_tenants_resolution_and_isolation(self):
+        """1k registered tenants (the acceptance scale): resolution
+        stays correct and two of them exchanging identical topics leak
+        nothing — idle tenants cost the scrape nothing (no labeled
+        families registered before a first CONNECT)."""
+
+        async def scenario():
+            tenants = {f"t{i:04d}": {} for i in range(1000)}
+            users = {"cidA": "t0007", "cidB": "t0991"}
+            h = Harness(
+                tenant_options(tenants=tenants, tenant_users=users)
+            )
+            try:
+                assert len(h.server._tenancy) == 1000
+                conns = await _connect_many(h, ["cidA", "cidB"])
+                for cid in conns:
+                    r, w = conns[cid]
+                    w.write(
+                        sub_packet(1, [Subscription(filter="#", qos=0)])
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)
+                for cid in conns:
+                    _r, w = conns[cid]
+                    w.write(pub_packet("d/x", cid.encode()))
+                    await w.drain()
+                await asyncio.sleep(0.25)
+                for cid, (r, _w) in conns.items():
+                    got = await _drain_payloads(r)
+                    assert [p for _t, p in got] == [cid.encode()], (cid, got)
+                # only ACTIVE tenants registered metric families
+                if h.server.telemetry is not None:
+                    expo = h.server.telemetry.registry.exposition()
+                    assert 'tenant="t0007"' in expo
+                    assert expo.count('mqtt_tpu_tenant_connected{') == 2
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_predicated_subscriptions_scoped_per_tenant(self):
+        """The same predicated filter in two tenants gates on payload
+        within each namespace; the predicate engine is shared, the
+        namespaces are not."""
+
+        async def scenario():
+            h = Harness(tenant_options())
+            try:
+                conns = await _connect_many(h, ["cidA", "cidB"])
+                for cid in conns:
+                    r, w = conns[cid]
+                    w.write(
+                        sub_packet(
+                            1,
+                            [
+                                Subscription(
+                                    filter="sens/+/v$GT{val:10}", qos=0
+                                )
+                            ],
+                        )
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)
+                for cid, val in (("cidA", 20), ("cidB", 5)):
+                    _r, w = conns[cid]
+                    w.write(
+                        pub_packet("sens/1/v", b'{"val": %d}' % val)
+                    )
+                    await w.drain()
+                await asyncio.sleep(0.25)
+                got_a = await _drain_payloads(conns["cidA"][0])
+                got_b = await _drain_payloads(conns["cidB"][0])
+                # A's 20 passes its own predicate; B's 5 fails ITS OWN
+                # (and neither sees the other's publish at all)
+                assert got_a == [("sens/1/v", b'{"val": 20}')]
+                assert got_b == []
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_tenant_sys_scoping(self):
+        """A tenant subscribing $SYS/broker/tenant/# sees ONLY its own
+        counters; the untenanted operator view mirrors every active
+        tenant under $SYS/broker/tenants/<name>/#."""
+
+        async def scenario():
+            h = Harness(tenant_options(sys_topic_resend_interval=1))
+            try:
+                conns = await _connect_many(h, ["cidA", "cidB", "cidU"])
+                ra, wa = conns["cidA"]
+                wa.write(
+                    sub_packet(
+                        1,
+                        [
+                            Subscription(
+                                filter="$SYS/broker/tenant/#", qos=0
+                            ),
+                            Subscription(filter="#", qos=0),
+                        ],
+                    )
+                )
+                await wa.drain()
+                await read_wire_packet(ra)
+                ru, wu = conns["cidU"]
+                wu.write(
+                    sub_packet(
+                        1,
+                        [
+                            Subscription(
+                                filter="$SYS/broker/tenants/#", qos=0
+                            )
+                        ],
+                    )
+                )
+                await wu.drain()
+                await read_wire_packet(ru)
+                # traffic from B so bulkco has counters too
+                _rb, wb = conns["cidB"]
+                wb.write(pub_packet("x/y", b"b"))
+                await wb.drain()
+                h.server.publish_sys_topics()
+                got_a = await _drain_payloads(ra)
+                assert got_a, "tenant $SYS tick delivered nothing"
+                for topic, _p in got_a:
+                    # ONLY the tenant-local $SYS tree — and the plain
+                    # `#` subscription must NOT have matched it
+                    # (the in-namespace $-rule)
+                    assert topic.startswith("$SYS/broker/tenant/"), topic
+                counts_a = dict(got_a)
+                assert counts_a["$SYS/broker/tenant/connected"] == b"1"
+                got_u = await _drain_payloads(ru)
+                names = {t.split("/")[3] for t, _p in got_u}
+                assert {"acme", "bulkco"} <= names
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+
+class TestQuotaClasses:
+    def test_vip_tenant_publishes_through_a_storm_bulk_sheds(self):
+        """Quota classes measurably shape shedding (acceptance): under
+        a forced SHED, the vip tenant's weighted budget absorbs the
+        whole burst (zero sheds) while the bulk tenant sheds."""
+
+        async def scenario():
+            h = Harness(
+                tenant_options(
+                    overload_priority_classes={"vip": 100.0, "bulk": 0.01},
+                    overload_shed_quota=10,
+                    overload_quota_window_ms=60000.0,
+                )
+            )
+            try:
+                gov = h.server.overload
+                gov.add_source("test_storm", lambda: 1.0)
+                gov.evaluate(force=True)
+                assert gov.state == "shed"
+                conns = await _connect_many(h, ["cidA", "cidB"])
+                for cid in conns:
+                    _r, w = conns[cid]
+                    for i in range(30):
+                        w.write(pub_packet("d/x", b"p%d" % i, qos=1, pid=i + 1))
+                    await w.drain()
+                await asyncio.sleep(0.4)
+                acme = h.server._tenancy.get("acme")
+                bulk = h.server._tenancy.get("bulkco")
+                assert acme.messages_dropped == 0, acme.sys_rows()
+                assert bulk.messages_dropped > 0, bulk.sys_rows()
+                assert acme.messages_in == 30
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+
+class TestRecryptEndToEnd:
+    OPTS = dict(
+        tenants={
+            "acme": {
+                "encrypted": ["secure/"],
+                "keys": {
+                    "cidA": KEY_A.hex(),
+                    "cidA2": KEY_S.hex(),
+                },
+            },
+            "bulkco": {},
+        },
+    )
+
+    def test_encrypted_fanout_rekeys_per_subscriber(self):
+        async def scenario():
+            h = Harness(tenant_options(**self.OPTS))
+            try:
+                eng = h.server._recrypt
+                conns = await _connect_many(
+                    h, ["cidA", "cidA2", "cidB", "cidB2"]
+                )
+                # cidA2 (keyed) and cidB/cidB2 (other tenant) subscribe
+                for cid in ("cidA2", "cidB", "cidB2"):
+                    r, w = conns[cid]
+                    w.write(
+                        sub_packet(
+                            1, [Subscription(filter="secure/#", qos=0)]
+                        )
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)
+                plaintext = b"the plans for the fusion plant"
+                wire = eng.seal_with_key(KEY_A, plaintext)
+                _r, wa = conns["cidA"]
+                wa.write(pub_packet("secure/plans", wire))
+                await wa.drain()
+                got = await _drain_payloads(conns["cidA2"][0], n_expected=1)
+                assert len(got) == 1
+                topic, payload = got[0]
+                assert topic == "secure/plans"
+                # re-keyed: decrypts under the SUBSCRIBER's key, bytes
+                # differ from the publisher's ciphertext
+                assert payload != wire
+                assert eng.open_with_key(KEY_S, payload) == plaintext
+                # nothing crossed the tenant boundary
+                assert await _drain_payloads(conns["cidB"][0]) == []
+                assert await _drain_payloads(conns["cidB2"][0]) == []
+                assert eng.fanouts >= 1 and eng.oracle_mismatches == 0
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_keyless_subscriber_withheld_and_retained_rekeyed(self):
+        async def scenario():
+            h = Harness(tenant_options(**self.OPTS))
+            try:
+                eng = h.server._recrypt
+                conns = await _connect_many(h, ["cidA", "cidA3"])
+                # cidA3 resolves to acme via... not mapped: map it
+                # through the default path instead — use an explicitly
+                # mapped but KEYLESS member
+                plaintext = b"retained secret"
+                wire = eng.seal_with_key(KEY_A, plaintext)
+                _r, wa = conns["cidA"]
+                wa.write(pub_packet("secure/cfg", wire, retain=True))
+                await wa.drain()
+                await asyncio.sleep(0.2)
+                # keyed subscriber arriving later gets the RETAINED
+                # message re-keyed to it
+                fresh = await _connect_many(h, ["cidA2"])
+                r2, w2 = fresh["cidA2"]
+                w2.write(
+                    sub_packet(1, [Subscription(filter="secure/#", qos=0)])
+                )
+                await w2.drain()
+                await read_wire_packet(r2)
+                got = await _drain_payloads(r2, n_expected=1)
+                assert len(got) == 1
+                assert eng.open_with_key(KEY_S, got[0][1]) == plaintext
+                drops_before = eng.no_key_drops
+                # a keyless same-tenant subscriber receives NOTHING
+                h.server._tenancy.map_user("cidA9", "acme")
+                keyless = await _connect_many(h, ["cidA9"])
+                r9, w9 = keyless["cidA9"]
+                w9.write(
+                    sub_packet(1, [Subscription(filter="secure/#", qos=0)])
+                )
+                await w9.drain()
+                await read_wire_packet(r9)
+                assert await _drain_payloads(r9) == []
+                assert eng.no_key_drops > drops_before
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+    def test_malformed_ciphertext_drops_counted(self):
+        async def scenario():
+            h = Harness(tenant_options(**self.OPTS))
+            try:
+                eng = h.server._recrypt
+                conns = await _connect_many(h, ["cidA", "cidA2"])
+                r2, w2 = conns["cidA2"]
+                w2.write(
+                    sub_packet(1, [Subscription(filter="secure/#", qos=0)])
+                )
+                await w2.drain()
+                await read_wire_packet(r2)
+                _r, wa = conns["cidA"]
+                wa.write(pub_packet("secure/x", b"tiny"))  # < nonce size
+                await wa.drain()
+                assert await _drain_payloads(r2) == []
+                assert eng.malformed >= 1
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+
+class TestRecryptChaos:
+    def test_device_fault_storm_degrades_to_host_everything_delivered(self):
+        """The chaos leg (acceptance): a seeded device keystream fault
+        storm trips the breaker to the host path — with EVERY publish
+        still delivered and decrypting correctly — and the flight
+        recorder dumps on trip."""
+
+        async def scenario():
+            h = Harness(
+                tenant_options(
+                    recrypt_device_min_blocks=1, **TestRecryptEndToEnd.OPTS
+                )
+            )
+            try:
+                eng = h.server._recrypt
+                dumps = []
+                if h.server.telemetry is not None:
+                    orig_dump = h.server.telemetry.trigger_dump
+                    h.server.telemetry.trigger_dump = (
+                        lambda kind, extra=None: dumps.append((kind, extra))
+                    )
+                import mqtt_tpu.tenancy as tmod
+
+                orig_async = tmod.RecryptEngine.seal_fanout
+                # seed a fault window: the device dispatch path raises
+                # until the breaker opens
+                import mqtt_tpu.ops.recrypt as rmod
+
+                real_ks = rmod.keystream_async
+                fault = {"n": 0}
+
+                def faulty(*a, **kw):
+                    fault["n"] += 1
+                    raise RuntimeError("injected keystream fault")
+
+                rmod.keystream_async = faulty
+                try:
+                    conns = await _connect_many(h, ["cidA", "cidA2"])
+                    r2, w2 = conns["cidA2"]
+                    w2.write(
+                        sub_packet(
+                            1, [Subscription(filter="secure/#", qos=0)]
+                        )
+                    )
+                    await w2.drain()
+                    await read_wire_packet(r2)
+                    _r, wa = conns["cidA"]
+                    sent = []
+                    for i in range(12):
+                        plaintext = b"storm payload %d" % i
+                        wire = eng.seal_with_key(KEY_A, plaintext)
+                        wa.write(pub_packet("secure/s", wire))
+                        sent.append(plaintext)
+                    await wa.drain()
+                    got = await _drain_payloads(
+                        r2, n_expected=len(sent), idle_s=0.6
+                    )
+                    # EVERY publish delivered via the host path, in order
+                    assert [
+                        eng.open_with_key(KEY_S, p) for _t, p in got
+                    ] == sent
+                    assert eng.breaker.state == "open"
+                    assert fault["n"] >= 1
+                    assert eng.device_errors >= 1
+                    assert ("breaker_trip", {"trigger": "recrypt_breaker"}) in dumps
+                finally:
+                    rmod.keystream_async = real_ks
+                    assert orig_async is tmod.RecryptEngine.seal_fanout
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
+
+class TestStagedBroker:
+    def test_staged_pipeline_carries_decrypt_jobs(self):
+        """With the device matcher + staging loop on, the publisher
+        decrypt keystream rides the staged batch (RecryptJob through
+        MatchStage) and fan-out still re-keys correctly."""
+
+        async def scenario():
+            h = Harness(
+                tenant_options(
+                    device_matcher=True,
+                    matcher_opts={"max_levels": 4, "background": False},
+                    matcher_stage_window_ms=5.0,
+                    recrypt_device_min_blocks=1,
+                    **TestRecryptEndToEnd.OPTS,
+                )
+            )
+            try:
+                await h.server.serve()
+                eng = h.server._recrypt
+                conns = await _connect_many(h, ["cidA", "cidA2"])
+                r2, w2 = conns["cidA2"]
+                w2.write(
+                    sub_packet(1, [Subscription(filter="secure/#", qos=0)])
+                )
+                await w2.drain()
+                await read_wire_packet(r2)
+                _r, wa = conns["cidA"]
+                sent = []
+                for i in range(8):
+                    plaintext = b"staged %d" % i
+                    wire = eng.seal_with_key(KEY_A, plaintext)
+                    wa.write(pub_packet("secure/st", wire))
+                    sent.append(plaintext)
+                await wa.drain()
+                got = await _drain_payloads(
+                    r2, n_expected=len(sent), idle_s=0.8
+                )
+                assert [
+                    eng.open_with_key(KEY_S, p) for _t, p in got
+                ] == sent
+                assert eng.oracle_mismatches == 0
+            finally:
+                await h.shutdown()
+                await h.server.close()
+
+        run(scenario())
+
+
+class TestReviewRegressions:
+    """Review-caught seams: tree-mode re-forward routing of scoped
+    topics, per-user priority overrides under scoped registry ids, the
+    username rider on encrypted forwards, and the widened nonce base."""
+
+    def test_reforward_routes_on_the_rescoped_topic(self, tmp_path):
+        """An intermediate tree hop must probe edge summaries with the
+        namespace-SCOPED key (summaries hold scoped filter prefixes);
+        routing on the frame's local topic would filter every tenant
+        publish out at hop 2+."""
+        from mqtt_tpu.cluster import Cluster
+        from mqtt_tpu.mesh_topology import Topology
+
+        class FakeServer:
+            pass
+
+        srv = FakeServer()
+        from mqtt_tpu.topics import TopicsIndex
+
+        srv.topics = TopicsIndex()
+        c = Cluster(srv, 0, 3, str(tmp_path))
+        c.topo = Topology(0, range(3), 2, boot_id=1)
+        seen = []
+        c._route_edges = lambda topic, peer, always: (seen.append(topic), [])[1]
+        c._epoch_current = lambda rt: True
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH),
+            protocol_version=5,
+            topic_name="e/x",
+            payload=b"p",
+            packet_id=1,
+        )
+        body = bytearray()
+        pk.publish_encode(body)
+        frame = bytes(body)
+        c._reforward_packet(
+            1, {"ns": "acme", "qos": 0}, {}, b"payload", frame
+        )
+        assert seen == [ns_scope_topic("acme", "e/x")]
+        # and a GLOBAL frame stays unscoped
+        c._reforward_packet(1, {"qos": 0}, {}, b"payload", frame)
+        assert seen[1] == "e/x"
+
+    def test_priority_user_override_sees_local_client_id(self):
+        """overload_priority_users keyed on the CLIENT-SENT id must
+        still override the tenant-wide quota class after the registry
+        id was scoped."""
+        h = Harness(
+            tenant_options(
+                overload_priority_classes={"vip": 4.0, "bulk": 0.5},
+                overload_priority_users={"cidA": "vip"},
+            )
+        )
+        s = h.server
+        cl = s.new_client(None, None, "t", "cidA", False)
+        s._resolve_tenant(cl)  # tenant acme (quota_class vip... use bulk)
+        # tenant class applied first, per-user override wins after
+        s._assign_priority_class(cl)
+        assert cl.id.startswith(NS_CHAR)
+        assert cl.priority_class == "vip" and cl.priority_weight == 4.0
+
+    def test_origin_username_rider_resolves_remote_publisher_key(self):
+        """A username-keyed publisher's key must resolve from the
+        cluster head rider when the publishing session does not exist
+        on this worker."""
+        h = Harness(
+            tenant_options(
+                tenants={
+                    "acme": {
+                        "encrypted": ["e/"],
+                        "keys": {"alice": KEY_A.hex(), "cidA2": KEY_S.hex()},
+                    }
+                },
+            )
+        )
+        s = h.server
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH),
+            topic_name=ns_scope_topic("acme", "e/t"),
+            payload=b"x" * 20,
+            origin=scope_client_id("acme", "dev-gone"),
+        )
+        # without the rider: no key (session absent, id-keyed lookup misses)
+        assert s._origin_idents(pk) == ("dev-gone", "")
+        setattr(pk, "_origin_user", "alice")
+        idents = s._origin_idents(pk)
+        assert "alice" in idents
+        eng = s._recrypt
+        wire = eng.seal_with_key(KEY_A, b"from alice")
+        tenant = s._tenancy.get("acme")
+        assert eng.open_publish(tenant, idents, wire) == b"from alice"
+
+    def test_nonce_base_is_48_bits_and_nonces_are_unique(self):
+        reg = KeyRegistry()
+        eng = RecryptEngine(reg)
+        assert len(eng._nonce_base) == 6
+        n1 = eng.next_nonce()
+        batch = eng._next_nonces(64)
+        assert len(n1) == 12 and batch.shape == (64, 12)
+        all_nonces = {bytes(n) for n in batch} | {n1}
+        assert len(all_nonces) == 65  # no collisions, counter advances
+        assert all(bytes(n[:6]) == eng._nonce_base for n in batch)
+
+
+class TestCrossWorker:
+    def test_cross_worker_forwards_stay_per_tenant(self, tmp_path):
+        """Two in-process workers: a tenant's publish forwarded across
+        the mesh delivers only to the SAME tenant's subscriber on the
+        other worker — and the other tenant's identical filter on that
+        worker sees nothing."""
+        from mqtt_tpu.cluster import Cluster
+
+        async def scenario():
+            opts0, opts1 = tenant_options(), tenant_options()
+            from mqtt_tpu.hooks.auth import AllowHook
+
+            h0, h1 = Harness(opts0), Harness(opts1)
+            c0 = Cluster(h0.server, 0, 2, str(tmp_path))
+            c1 = Cluster(h1.server, 1, 2, str(tmp_path))
+            try:
+                await c0.start()
+                await c1.start()
+
+                async def wait_for(cond, timeout=10.0):
+                    deadline = asyncio.get_event_loop().time() + timeout
+                    while asyncio.get_event_loop().time() < deadline:
+                        if cond():
+                            return True
+                        await asyncio.sleep(0.02)
+                    return False
+
+                assert await wait_for(
+                    lambda: c0.peer_count == 1 and c1.peer_count == 1
+                )
+                # subscribers on worker 1: one per tenant, same filter
+                conns1 = await _connect_many(h1, ["cidA2", "cidB2"])
+                for cid in conns1:
+                    r, w = conns1[cid]
+                    w.write(
+                        sub_packet(1, [Subscription(filter="m/#", qos=1)])
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)
+                # presence propagation
+                assert await wait_for(
+                    lambda: len(c0._remote.subscribers("\x00acme/m/x").subscriptions) > 0
+                    if hasattr(c0, "_remote")
+                    else True,
+                    timeout=3.0,
+                )
+                await asyncio.sleep(0.3)
+                # publisher on worker 0, tenant acme, QoS1 (packet leg)
+                conns0 = await _connect_many(h0, ["cidA"])
+                _r, wa = conns0["cidA"]
+                wa.write(pub_packet("m/x", b"cross", qos=1, pid=7))
+                await wa.drain()
+                got_a = await _drain_payloads(conns1["cidA2"][0], idle_s=1.0)
+                got_b = await _drain_payloads(conns1["cidB2"][0], idle_s=0.3)
+                assert [
+                    (t, p) for t, p in got_a if t == "m/x"
+                ], f"same-tenant cross-worker delivery missing: {got_a}"
+                assert got_b == [], f"CROSS-TENANT LEAK over the mesh: {got_b}"
+            finally:
+                await c0.stop()
+                await c1.stop()
+                await h0.shutdown()
+                await h1.shutdown()
+
+        run(scenario())

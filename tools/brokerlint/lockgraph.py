@@ -72,6 +72,11 @@ LOCK_ORDER = (
     "overload_peer_pressure",
     "matcher_breaker",
     "clients",
+    # the tenant plane (mqtt_tpu.tenancy): CONNECT-time resolution and
+    # per-tenant counters; the key registry is a leaf beside it — both
+    # are registries consulted before any trie/retained work
+    "tenants",
+    "recrypt_keys",
     # the tries and their retained stores: the trie lock wraps
     # subscribe/unsubscribe/set_retained, which touch the retained
     # PacketStore (both the local and the cluster's remote trie share
