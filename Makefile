@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate stage-gate lockgraph pipeline-smoke
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -32,6 +32,11 @@ lockgraph:
 # gcc -fanalyzer (+ cppcheck when installed) over the native C sources
 c-gate:
 	PY=$(PY) tools/c_gate.sh
+
+# ASAN/UBSAN leg: sanitized rebuild of both native modules + the
+# native-facing test subset run under them (ISSUE 13)
+san-gate:
+	PY=$(PY) tools/c_gate.sh --san
 
 # the tier-1 gate: full non-slow suite on the CPU backend (ROADMAP.md);
 # lint runs first so an invariant break fails in seconds, not minutes

@@ -131,6 +131,14 @@ def probe_link():
     }
 
 
+def bench_lazy() -> bool:
+    """BENCH_LAZY=0 disables the zero-materialization fan-out A/B-wide:
+    matchers return eager Subscribers dicts (no lazy views) and the
+    in-process + serve-side brokers take the legacy per-subscriber
+    encode path instead of the batched variant flush (ISSUE 13)."""
+    return os.environ.get("BENCH_LAZY", "1") != "0"
+
+
 def bench_compact() -> bool:
     """BENCH_COMPACT=0 disables device-resident hit compaction for an
     A/B against the padded-ranges transfer (default: on, the production
@@ -304,6 +312,15 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
             for r in results:
                 for members in r.shared.values():
                     next(iter(members), None)  # SelectShared analog
+        else:
+            # consume every result the way _fan_out does (ISSUE 13): a
+            # lazy SubscribersView yields its (client, sub) plan, an
+            # eager dict is already built — either way the e2e number
+            # includes the cost fan-out actually pays
+            for r in results:
+                consume = getattr(r, "targets", None)
+                if consume is not None:
+                    consume()
         if i == 1:
             hits = sum(
                 len(r.subscriptions) + sum(len(m) for m in r.shared.values())
@@ -374,6 +391,7 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
     # i.e. the e2e ceiling once the tunnel's RTT/bandwidth tax is removed
     # (VERDICT r4 item 1: "report the link-normalized number too")
     resolve_rate = None
+    materialization_cost = None
     from mqtt_tpu.ops.matcher import _accel
 
     acc = _accel()
@@ -403,6 +421,56 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
                 packed_np, batch, P, flat.subs.snaps, flat.window, _Subscribers
             )
         resolve_rate = round(n_it * batch / (time.perf_counter() - t0))
+
+        # per-hit materialization / consume cost (ISSUE 13): over the
+        # SAME already-fetched device result, time (a) the lazy path —
+        # build views + consume their (client, sub) plans exactly like
+        # _fan_out — against (b) the eager dict expansion. The lazy
+        # number is the acceptance bar (< 300 ns/hit); both land in the
+        # artifact so the A/B is re-checkable every round.
+        if hasattr(acc, "resolve_batch_views"):
+            total_hits = int(packed_np[:, 2 * P].sum())
+            ovf_rows = int((packed_np[:, 2 * P + 1] != 0).sum())
+            n_it2 = max(3, min(12, iters))
+            t0 = time.perf_counter()
+            for _ in range(n_it2):
+                views, _o = acc.resolve_batch_views(
+                    packed_np, batch, P, flat.subs.snaps, flat.window,
+                    _Subscribers,
+                )
+                for v in views:
+                    if v is not None:
+                        v.targets()
+            dt_lazy = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            for _ in range(n_it2):
+                acc.resolve_batch(
+                    packed_np, batch, P, flat.subs.snaps, flat.window,
+                    _Subscribers,
+                )
+            dt_eager = time.perf_counter() - t0
+            denom = max(1, n_it2 * total_hits)
+            denom_t = max(1, n_it2 * batch)
+            materialization_cost = {
+                "total_hits": total_hits,
+                "overflow_rows": ovf_rows,
+                # per-HIT is the acceptance number at dense workloads
+                # (~11 hits/topic at 1M subs); per-TOPIC disambiguates
+                # sparse runs where per-row view overhead dominates
+                "lazy_consume_ns_per_hit": round(dt_lazy * 1e9 / denom, 1),
+                "lazy_consume_ns_per_topic": round(
+                    dt_lazy * 1e9 / denom_t, 1
+                ),
+                "eager_materialize_ns_per_hit": round(
+                    dt_eager * 1e9 / denom, 1
+                ),
+                "lazy_speedup": round(dt_eager / max(1e-9, dt_lazy), 2),
+                "lazy_consume_topics_per_sec": round(
+                    n_it2 * batch / max(1e-9, dt_lazy)
+                ),
+            }
+        else:
+            materialization_cost = None
 
     # device-compute only: resident pre-uploaded inputs, async dispatch
     # with one final sync — the kernel's sustained rate, transfers excluded.
@@ -494,6 +562,9 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         # the host materialization rate with transfers excluded: the e2e
         # ceiling on a directly-attached device (link-normalized)
         "link_normalized_resolve_per_sec": resolve_rate,
+        # per-hit consume cost A/B over the same device result (ISSUE
+        # 13): lazy targets() vs eager dict expansion; None sans C
+        "materialization_cost": materialization_cost,
     }
 
 
@@ -505,7 +576,7 @@ def run_cfg1(rng, fast, batch):
 
     index, topic_gen = build_cfg1(rng)
     host_rate = time_host(index, topic_gen, 2000 if fast else 20000)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=32, transfer_slots=8, compact=bench_compact())
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=32, transfer_slots=8, compact=bench_compact(), lazy=bench_lazy())
     matcher.rebuild()
     parity_check(matcher, index, topic_gen)
     # same batch as the other configs: the tunnel's per-dispatch overhead
@@ -520,7 +591,7 @@ def run_cfg2(n_subs, batch, iters, rng):
     from mqtt_tpu.ops import TpuMatcher
 
     index, topic_gen = build_cfg2(n_subs, rng)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16, compact=bench_compact())
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=64, transfer_slots=16, compact=bench_compact(), lazy=bench_lazy())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg2 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -534,7 +605,7 @@ def run_cfg3(n_subs, batch, iters, rng):
     index, topic_gen = build_cfg3(n_subs, rng)
     # deep fan-in: a topic can gather hundreds of '#' subs — bigger output
     # window keeps the device path useful instead of 100% host fallback
-    matcher = TpuMatcher(index, max_levels=8, frontier=8, out_slots=256, transfer_slots=32, compact=bench_compact())
+    matcher = TpuMatcher(index, max_levels=8, frontier=8, out_slots=256, transfer_slots=32, compact=bench_compact(), lazy=bench_lazy())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg3 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -546,7 +617,7 @@ def run_cfg4(n_groups, members, batch, iters, rng):
     from mqtt_tpu.ops import TpuMatcher
 
     index, topic_gen = build_cfg4(n_groups, members, rng)
-    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=128, transfer_slots=48, compact=bench_compact())
+    matcher = TpuMatcher(index, max_levels=4, frontier=8, out_slots=128, transfer_slots=48, compact=bench_compact(), lazy=bench_lazy())
     t0 = time.perf_counter()
     matcher.rebuild()
     log(f"cfg4 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
@@ -586,7 +657,7 @@ def run_cfg5(n_subs, batch, iters, rng):
 
     m = DeltaMatcher(index, max_levels=4, out_slots=64, transfer_slots=16,
                      rebuild_after=256, rebuild_interval=0.2, background=True,
-                     compact=bench_compact())
+                     compact=bench_compact(), lazy=bench_lazy())
 
     # same GC posture as the other configs (time_matcher does this): the
     # built index must not be young-gen-scanned every 700 allocations
@@ -1190,6 +1261,68 @@ def run_broker_bench(fast: bool) -> dict:
     return out
 
 
+def run_conn_rate_qos_matrix(fast: bool) -> dict:
+    """Config 8's connections × rate × QoS comparative matrix (the
+    PAPERS.md 2603.21600 reporting frame; ISSUE 13): a subprocess
+    broker (one SO_REUSEPORT worker per core, the run_broker_bench
+    posture) driven through every (clients, msgs/client, QoS) cell.
+    Every cell carries its OWN publish/receive medians so rounds diff
+    cell by cell; BENCH_LAZY=0 re-runs the whole matrix on the legacy
+    eager/per-subscriber path (the serve-side broker honors the knob)."""
+    import asyncio
+    import subprocess
+
+    from mqtt_tpu.stress import run_stress
+
+    port = 18852
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    workers = max(
+        1, int(os.environ.get("BENCH_BROKER_WORKERS", os.cpu_count() or 1))
+    )
+    cmd = [
+        sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
+        f"127.0.0.1:{port}",
+    ]
+    if workers > 1:
+        cmd += ["--workers", str(workers)]
+    proc = subprocess.Popen(
+        cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, cwd=repo, env=env
+    )
+    cells = (
+        [(2, 300, 0), (2, 300, 1), (6, 150, 0), (6, 150, 1)]
+        if fast
+        else [
+            (10, 2000, 0), (10, 2000, 1),
+            (100, 600, 0), (100, 600, 1),
+            (100, 2000, 0), (100, 2000, 1),
+        ]
+    )
+    matrix = []
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        for n, m, q in cells:
+            r = asyncio.run(run_stress("127.0.0.1", port, n, m, qos=q))
+            matrix.append(r)
+            log(
+                f"matrix {n}c x {m}m qos{q}: "
+                f"{r['aggregate_msgs_per_sec']}/s "
+                f"recv_median {r['receive_median_per_sec']}/s"
+            )
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+    return {
+        "lazy": bench_lazy(),
+        "broker_workers": workers,
+        "cells": matrix,
+    }
+
+
 async def _flatness_profile_block(fast: bool) -> dict:
     """Config 8's host-observatory leg (mqtt_tpu.profiling): the
     per-client receive-rate flatness ratio (10 vs 100 clients — ROADMAP
@@ -1257,6 +1390,8 @@ async def _flatness_profile_block(fast: bool) -> dict:
     return {
         "clients": flat_on["clients"],
         "receive_flatness_ratio": flat_on["receive_flatness_ratio"],
+        # per-cell medians (diffable cell-by-cell across rounds)
+        "cells": flat_on.get("cells"),
         "small": flat_on["small"],
         "large": flat_on["large"],
         "host_profile": profile,
@@ -1407,6 +1542,10 @@ def run_storm_bench(fast: bool) -> dict:
     # deliberately tiny quotas would shed the probe itself, and its
     # still-armed lock plane would contaminate the disabled A/B arm
     out["receive_flatness"] = asyncio.run(_flatness_profile_block(fast))
+    # the connections × rate × QoS comparative matrix runs last, on a
+    # subprocess broker (per-core workers) — the 2603.21600 reporting
+    # frame for the encode-once write path (ISSUE 13)
+    out["conn_rate_qos_matrix"] = run_conn_rate_qos_matrix(fast)
     return out
 
 

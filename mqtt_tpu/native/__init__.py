@@ -47,9 +47,33 @@ _ACCEL_TRIED = False
 MAX_FRAMES_PER_SCAN = 256
 
 
-def _so_path() -> str:
+def _extra_cflags() -> list[str]:
+    """Extra build flags from ``MQTT_TPU_NATIVE_CFLAGS`` — the sanitizer
+    leg (tools/c_gate.sh --san, CI) builds both native modules with
+    ``-fsanitize=address,undefined`` this way and runs the native test
+    suite under ASAN/UBSAN."""
+    flags = os.environ.get("MQTT_TPU_NATIVE_CFLAGS", "")
+    return flags.split() if flags else []
+
+
+def _so_tag() -> str:
     tag = f"{sys.implementation.cache_tag}-{os.uname().machine}"
-    return os.path.join(_HERE, f"libmqtt_native-{tag}.so")
+    flags = _extra_cflags()
+    if flags:
+        # a sanitized (or otherwise flag-modified) build must never
+        # poison the plain build's mtime cache — distinct artifact
+        # name, DETERMINISTIC across processes (hash() is seeded per
+        # process; a random tag would recompile on every run and leak
+        # uniquely-named .so files)
+        import hashlib
+
+        digest = hashlib.sha1(" ".join(flags).encode()).hexdigest()[:8]
+        tag += "-x" + digest
+    return tag
+
+
+def _so_path() -> str:
+    return os.path.join(_HERE, f"libmqtt_native-{_so_tag()}.so")
 
 
 def _build(so: str) -> bool:
@@ -62,7 +86,8 @@ def _build(so: str) -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
         try:
-            cmd = [cc, "-O3", "-shared", "-fPIC", "-o", tmp, _SRC]
+            cmd = [cc, "-O3", "-shared", "-fPIC", *_extra_cflags(),
+                   "-o", tmp, _SRC]
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
                 os.replace(tmp, so)
@@ -136,6 +161,25 @@ def _declare(l: ctypes.CDLL) -> None:
     ]
     l.mqtt_utf8_valid.restype = ctypes.c_int
     l.mqtt_utf8_valid.argtypes = [ctypes.c_char_p, ctypes.c_size_t]
+    l.mqtt_fan_flush.restype = ctypes.c_int64
+    l.mqtt_fan_flush.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64, ctypes.c_char_p,
+        ctypes.c_int64, ctypes.c_int64, ctypes.POINTER(ctypes.c_uint16),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    l.mqtt_frame_scan_multi.restype = None
+    l.mqtt_frame_scan_multi.argtypes = [
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_int64), u8p, ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    l.mqtt_assemble_frames.restype = None
+    l.mqtt_assemble_frames.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, u8p, ctypes.c_int64, u8p,
+        ctypes.c_int64, u8p, ctypes.c_int64, ctypes.c_int64, u8p,
+    ]
 
 
 def available() -> bool:
@@ -143,8 +187,7 @@ def available() -> bool:
 
 
 def _accel_so_path() -> str:
-    tag = f"{sys.implementation.cache_tag}-{os.uname().machine}"
-    return os.path.join(_HERE, f"mqtt_accel-{tag}.so")
+    return os.path.join(_HERE, f"mqtt_accel-{_so_tag()}.so")
 
 
 def _build_accel(so: str) -> bool:
@@ -161,8 +204,8 @@ def _build_accel(so: str) -> bool:
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
         try:
-            cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{include}", "-o", tmp,
-                   _ACCEL_SRC]
+            cmd = [cc, "-O3", "-shared", "-fPIC", *_extra_cflags(),
+                   f"-I{include}", "-o", tmp, _ACCEL_SRC]
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
                 os.replace(tmp, so)
@@ -388,6 +431,136 @@ def _fh_validate_py(b: int) -> bool:
         return qos < 3 and not (flags & 0x08 and qos == 0)
     want = _FH_FLAG_OK.get(type_)
     return want is not None and flags == want
+
+
+def fan_flush(
+    fds, frame: bytes, id_offset: int = -1, ids=None
+):
+    """Write one encoded PUBLISH variant frame to many ready sockets in
+    a single GIL-released native call (server._fan_out batched path).
+
+    ``fds`` is a sequence of socket fds whose transports the caller
+    verified idle; ``id_offset``/``ids`` patch per-target 2-byte packet
+    ids via writev iovecs for QoS>0 variants (no per-target copies).
+    Returns an int64 array of per-target results — bytes written, or
+    ``-errno`` — or None when the native library is unavailable (the
+    caller keeps the per-target transport path)."""
+    l = lib()
+    if l is None:
+        return None
+    n = len(fds)
+    fds_arr = np.asarray(fds, dtype=np.int32)
+    sent = np.zeros(n, dtype=np.int64)
+    if ids is None:
+        ids_arr = np.zeros(0, dtype=np.uint16)
+        id_offset = -1
+    else:
+        ids_arr = np.asarray(ids, dtype=np.uint16)
+    if n:
+        l.mqtt_fan_flush(
+            fds_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, frame, len(frame), id_offset,
+            ids_arr.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16)),
+            sent.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+    return sent
+
+
+def frame_scan_multi(
+    bufs: list, max_frames: int = 256, max_packet_size: int = 0
+) -> "Optional[list[tuple[list[Frame], int, int]]]":
+    """Scan K read buffers in ONE native call — the read-side decode
+    batched across ready sockets (the coalesced read path). Returns one
+    ``(frames, consumed, err)`` tuple per buffer with frame_scan's exact
+    contract, or None when the native library is unavailable."""
+    l = lib()
+    if l is None:
+        return None
+    k = len(bufs)
+    if k == 0:
+        return []
+    holders = []
+    ptrs = (ctypes.c_void_p * k)()
+    lens = np.zeros(k, dtype=np.int64)
+    for i, buf in enumerate(bufs):
+        lens[i] = len(buf)
+        if isinstance(buf, (bytearray, memoryview)):
+            h = (ctypes.c_char * len(buf)).from_buffer(buf) if len(buf) else b""
+            holders.append(h)
+            ptrs[i] = ctypes.addressof(h) if len(buf) else None
+        else:
+            holders.append(buf)
+            ptrs[i] = (
+                ctypes.cast(ctypes.c_char_p(buf), ctypes.c_void_p).value
+                if buf
+                else None
+            )
+    body_offsets = np.zeros(k * max_frames, dtype=np.int64)
+    first_bytes = np.zeros(k * max_frames, dtype=np.uint8)
+    remainings = np.zeros(k * max_frames, dtype=np.uint32)
+    counts = np.zeros(k, dtype=np.int64)
+    consumed = np.zeros(k, dtype=np.int64)
+    errs = np.zeros(k, dtype=np.int32)
+    try:
+        l.mqtt_frame_scan_multi(
+            k, ptrs,
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_frames, max_packet_size,
+            body_offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            first_bytes.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            remainings.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            consumed.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            errs.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+    finally:
+        # deterministic release of the from_buffer exports (the same
+        # BufferError hazard frame_scan documents)
+        del holders
+    out = []
+    for i in range(k):
+        base = i * max_frames
+        frames = [
+            Frame(
+                int(first_bytes[base + j]),
+                int(body_offsets[base + j]),
+                int(remainings[base + j]),
+            )
+            for j in range(int(counts[i]))
+        ]
+        out.append((frames, int(consumed[i]), int(errs[i])))
+    return out
+
+
+def assemble_frames(head: bytes, nonces, keystreams, plaintext: bytes):
+    """Assemble N per-subscriber encrypted PUBLISH frames — head ||
+    nonce_i || (plaintext XOR keystream_i) — in one GIL-released native
+    pass (the re-encrypt fan-out's encode-once path). ``nonces`` is
+    uint8 [N, nonce_len], ``keystreams`` uint8 [N, >= len(plaintext)].
+    Returns a uint8 array [N, frame_len], or None when the native
+    library is unavailable (callers keep the numpy path)."""
+    l = lib()
+    if l is None:
+        return None
+    nonces = np.ascontiguousarray(nonces, dtype=np.uint8)
+    keystreams = np.ascontiguousarray(keystreams, dtype=np.uint8)
+    n, nonce_len = nonces.shape
+    pt_len = len(plaintext)
+    ks_stride = keystreams.shape[1] if keystreams.ndim == 2 else 0
+    if n and pt_len > ks_stride:
+        return None  # keystream rows too short: let the caller's path run
+    out = np.empty((n, len(head) + nonce_len + pt_len), dtype=np.uint8)
+    if n:
+        pt = np.frombuffer(plaintext, dtype=np.uint8)
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        l.mqtt_assemble_frames(
+            head, len(head),
+            nonces.ctypes.data_as(u8), nonce_len,
+            keystreams.ctypes.data_as(u8), ks_stride,
+            pt.ctypes.data_as(u8), pt_len,
+            n, out.ctypes.data_as(u8),
+        )
+    return out
 
 
 def _frame_scan_py(

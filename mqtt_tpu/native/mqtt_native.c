@@ -22,6 +22,13 @@
 #include <stddef.h>
 #include <string.h>
 
+#if defined(__unix__) || defined(__APPLE__)
+#include <errno.h>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#define MQTT_HAVE_SOCKETS 1
+#endif
+
 /* ------------------------------------------------------------------ */
 /* blake2b (RFC 7693), fixed-output 8 bytes, 16-byte salt, no key     */
 /* ------------------------------------------------------------------ */
@@ -264,6 +271,133 @@ int64_t mqtt_frame_scan(const uint8_t *buf, int64_t len,
     }
     *consumed = pos;
     return n;
+}
+
+/* ------------------------------------------------------------------ */
+/* Batched fan-out flush (ISSUE 13 / ROADMAP item 3)                   */
+/* ------------------------------------------------------------------ */
+
+/* Write ONE encoded PUBLISH variant frame to many sockets in a single
+ * call. The caller (server._fan_out batched path, via ctypes — which
+ * releases the GIL for the duration) passes the sockets' fds, the
+ * shared frame bytes, and, for QoS>0 variants, the per-target packet
+ * ids plus the fixed offset of the 2-byte packet-id field: each target
+ * is then written as THREE iovecs (head | its own big-endian id | tail)
+ * — encode-once, zero per-target copies. ``id_offset < 0`` means the
+ * frame is fully shared (QoS0) and goes out with one send().
+ *
+ * Sockets are the caller's non-blocking asyncio fds whose transports
+ * were verified idle (empty write buffer, empty outbound queue), so a
+ * full write is the common case. Per-target results land in ``sent``:
+ * bytes written (possibly short on EAGAIN mid-frame), or -errno on
+ * error (including EAGAIN-before-anything as -EAGAIN); the caller
+ * finishes short/failed targets through the normal transport path,
+ * preserving ordering and backpressure accounting. Returns the number
+ * of COMPLETE writes. */
+int64_t mqtt_fan_flush(const int32_t *fds, int64_t n, const uint8_t *frame,
+                       int64_t frame_len, int64_t id_offset,
+                       const uint16_t *ids, int64_t *sent) {
+#ifdef MQTT_HAVE_SOCKETS
+    int64_t complete = 0, i;
+    for (i = 0; i < n; i++) {
+        int64_t wrote;
+        if (id_offset >= 0 && id_offset + 2 <= frame_len) {
+            uint8_t idb[2];
+            struct iovec iov[3];
+            int iovcnt = 0;
+            idb[0] = (uint8_t)(ids[i] >> 8);
+            idb[1] = (uint8_t)(ids[i] & 0xff);
+            if (id_offset > 0) {
+                iov[iovcnt].iov_base = (void *)frame;
+                iov[iovcnt].iov_len = (size_t)id_offset;
+                iovcnt++;
+            }
+            iov[iovcnt].iov_base = idb;
+            iov[iovcnt].iov_len = 2;
+            iovcnt++;
+            if (id_offset + 2 < frame_len) {
+                iov[iovcnt].iov_base = (void *)(frame + id_offset + 2);
+                iov[iovcnt].iov_len = (size_t)(frame_len - id_offset - 2);
+                iovcnt++;
+            }
+            wrote = (int64_t)writev(fds[i], iov, iovcnt);
+        } else {
+#ifdef MSG_NOSIGNAL
+            wrote = (int64_t)send(fds[i], frame, (size_t)frame_len,
+                                  MSG_NOSIGNAL);
+#else
+            wrote = (int64_t)send(fds[i], frame, (size_t)frame_len, 0);
+#endif
+        }
+        if (wrote < 0) {
+            sent[i] = -(int64_t)errno;
+        } else {
+            sent[i] = wrote;
+            if (wrote == frame_len)
+                complete++;
+        }
+    }
+    return complete;
+#else
+    (void)fds; (void)n; (void)frame; (void)frame_len; (void)id_offset;
+    (void)ids; (void)sent;
+    return -1; /* platform without writev: caller keeps the Python path */
+#endif
+}
+
+/* ------------------------------------------------------------------ */
+/* Batched read-side frame scanning                                    */
+/* ------------------------------------------------------------------ */
+
+/* Scan K read buffers for complete MQTT packets in ONE call — the
+ * read-side twin of mqtt_fan_flush: read loops that woke in the same
+ * event-loop tick coalesce their buffers so the whole tick pays one
+ * GIL-released native call instead of K. Output arrays are strided
+ * ``max_frames`` per buffer; per-buffer packet counts land in
+ * ``counts``, consumed/err exactly as mqtt_frame_scan. */
+void mqtt_frame_scan_multi(int64_t k, const uint8_t *const *bufs,
+                           const int64_t *lens, int64_t max_frames,
+                           uint32_t max_packet_size, int64_t *body_offsets,
+                           uint8_t *first_bytes, uint32_t *remainings,
+                           int64_t *counts, int64_t *consumed,
+                           int32_t *errs) {
+    int64_t i;
+    for (i = 0; i < k; i++) {
+        counts[i] = mqtt_frame_scan(
+            bufs[i], lens[i], max_frames, max_packet_size,
+            body_offsets + i * max_frames, first_bytes + i * max_frames,
+            remainings + i * max_frames, consumed + i, errs + i);
+    }
+}
+
+/* ------------------------------------------------------------------ */
+/* Re-encrypt fan-out frame assembly (ISSUE 13 satellite, PR 12        */
+/* residual)                                                           */
+/* ------------------------------------------------------------------ */
+
+/* Assemble N per-subscriber encrypted PUBLISH frames from one shared
+ * encoded head and the batched keystream: frame_i = head || nonce_i ||
+ * (plaintext XOR keystream_i). One GIL-released pass replaces N
+ * per-subscriber Packet copies + encodes — the encode-once path for
+ * encrypted namespaces, whose payload bytes necessarily differ per
+ * subscriber but whose frame head does not. ``ks_stride`` is the byte
+ * stride between keystream rows (>= pt_len); ``out`` is [n,
+ * head_len + nonce_len + pt_len] row-major. */
+void mqtt_assemble_frames(const uint8_t *head, int64_t head_len,
+                          const uint8_t *nonces, int64_t nonce_len,
+                          const uint8_t *keystreams, int64_t ks_stride,
+                          const uint8_t *plaintext, int64_t pt_len,
+                          int64_t n, uint8_t *out) {
+    int64_t frame_len = head_len + nonce_len + pt_len;
+    int64_t i, j;
+    for (i = 0; i < n; i++) {
+        uint8_t *row = out + i * frame_len;
+        const uint8_t *ks = keystreams + i * ks_stride;
+        memcpy(row, head, (size_t)head_len);
+        memcpy(row + head_len, nonces + i * nonce_len, (size_t)nonce_len);
+        for (j = 0; j < pt_len; j++)
+            row[head_len + nonce_len + j] = plaintext[j] ^ ks[j];
+    }
 }
 
 /* ------------------------------------------------------------------ */

@@ -162,6 +162,7 @@ class DeltaMatcher:
         compact: bool = True,
         compact_capacity: int = 0,
         hits_estimate: float = 2.0,
+        lazy: bool = True,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
@@ -204,6 +205,7 @@ class DeltaMatcher:
                 compact=compact,
                 compact_capacity=compact_capacity,
                 hits_estimate=hits_estimate,
+                lazy=lazy,
             )
         snap.rebuild()
         self._snap = snap

@@ -241,16 +241,47 @@ def materialize_compact_pairs(
     window: int,
     true_overflow: np.ndarray,
     tables: Optional[list] = None,
+    lazy: bool = False,
 ) -> list[Subscribers]:
     """Expand one device-compacted batch into Subscribers results —
     shared by the single-device and mesh-sharded matchers. ``totals``
     drives a cursor over the topic-major pair stream (padded rows
     included); host-routed topics skip their pairs and re-walk the live
-    trie. ``pair_shard``/``tables`` serve the sharded form."""
+    trie. ``pair_shard``/``tables`` serve the sharded form.
+
+    ``lazy=True`` (and the C module present) returns
+    ``SubscribersView`` results instead of materialized dicts: the pair
+    stream stays the result currency and per-hit objects are built only
+    when fan-out (or any dict-semantics consumer) actually asks
+    (ISSUE 13). Host-routed rows still carry real Subscribers from the
+    live trie walk; without the C module the eager expansion serves —
+    laziness is an optimization, never a semantic."""
     acc = _accel()
     results: Optional[list] = None
     ovf_idx: list[int] = []
-    if acc is not None and hasattr(acc, "resolve_compact"):
+    if lazy and acc is not None and hasattr(acc, "resolve_compact_views"):
+        try:
+            results, ovf_idx = acc.resolve_compact_views(
+                np.ascontiguousarray(pair_sid),
+                None if pair_shard is None
+                else np.ascontiguousarray(pair_shard),
+                np.ascontiguousarray(totals),
+                np.ascontiguousarray(host_route.astype(np.int32)),
+                int(n_hits),
+                len(topics),
+                subs_table.snaps if tables is None
+                else [t.snaps for t in tables],
+                window,
+                Subscribers,
+            )
+        except ValueError:
+            # the same geometry tripwire as the eager path: mixed-batch
+            # buffers must never degrade to a silent mis-expansion
+            raise
+        except Exception:  # pragma: no cover - C/py parity is pinned
+            _log.exception("C resolve_compact_views failed; eager path")
+            results = None
+    if results is None and acc is not None and hasattr(acc, "resolve_compact"):
         try:
             results, ovf_idx = acc.resolve_compact(
                 np.ascontiguousarray(pair_sid),
@@ -386,6 +417,7 @@ class TpuMatcher:
         compact: bool = True,
         compact_capacity: int = 0,
         hits_estimate: float = 2.0,
+        lazy: bool = True,
     ) -> None:
         self.topics = topics
         self.max_levels = max_levels
@@ -405,6 +437,13 @@ class TpuMatcher:
         # the server wires TopicSketch's avg_hits_per_topic here).
         self.compact = compact
         self.compact_capacity = max(0, compact_capacity)
+        # zero-materialization fan-out (ISSUE 13): results come back as
+        # lazy SubscribersView objects over the device pair stream /
+        # ranges rows instead of eagerly-built dicts; any consumer that
+        # needs dict semantics transparently materializes (bit-identical
+        # — the eager path remains the differential oracle). No C module
+        # = no views; the flag simply has no effect then.
+        self.lazy = lazy
         self._hits_ewma = max(1.0, float(hits_estimate))
         # sticky per-batch-bucket capacities (see _compact_capacity_for):
         # every distinct capacity is one XLA executable, so the pick must
@@ -811,6 +850,7 @@ class TpuMatcher:
             flat.window,
             true_overflow,
             tables=tables,
+            lazy=self.lazy,
         )
 
     def _resolve_ranges(
@@ -963,9 +1003,20 @@ class TpuMatcher:
             packed[:, col] |= len_overflow
             if len(routed):
                 packed[np.asarray(routed, dtype=np.int64), col] = 1
-        results, ovf_idx = acc.resolve_batch(
-            packed, len(topics), P, flat.subs.snaps, flat.window, Subscribers
-        )
+        if self.lazy and hasattr(acc, "resolve_batch_views"):
+            # lazy ranges views (ISSUE 13): the packed row itself is the
+            # result; per-hit objects build on demand at fan-out. The
+            # buffer is pinned by the views, so hand them a contiguous
+            # copy-independent array (packed may be a slice).
+            results, ovf_idx = acc.resolve_batch_views(
+                np.ascontiguousarray(packed), len(topics), P,
+                flat.subs.snaps, flat.window, Subscribers,
+            )
+        else:
+            results, ovf_idx = acc.resolve_batch(
+                packed, len(topics), P, flat.subs.snaps, flat.window,
+                Subscribers,
+            )
         subscribers = self.topics.subscribers
         for i in ovf_idx:
             topic = topics[i]

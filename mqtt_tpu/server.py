@@ -192,6 +192,28 @@ class Options:
     # hits-per-topic EWMA (seeded by the TopicSketch's avg_hits_per_topic
     # when the host observatory is on)
     matcher_compact_capacity: int = 0
+    # zero-materialization fan-out (ISSUE 13): device match results stay
+    # lazy SubscribersView objects over the compacted pair stream /
+    # ranges rows (native/accelmod.c); fan-out consumes (client, sub)
+    # targets straight off the view and per-hit objects come from a
+    # bounded freelist pool. Consumers needing dict semantics
+    # (predicates, shared groups, the resilience differential)
+    # transparently materialize — bit-identical to the eager path, which
+    # stays on as the differential oracle. No C toolchain = eager.
+    matcher_lazy_views: bool = True
+    # encode-once batched fan-out (ISSUE 13 / ROADMAP item 3): group
+    # fan-out targets by (protocol version, effective QoS, retain)
+    # variant, encode each variant's wire frame ONCE, patch per-target
+    # packet ids in a C writev-style flush that releases the GIL across
+    # the delivery batch (per-socket backpressure, slow-consumer
+    # eviction and overload accounting all preserved). False = the
+    # per-subscriber encode path everywhere.
+    fanout_batch: bool = True
+    # read-side decode batching: coalesce frame scans from read loops
+    # that wake in the same event-loop tick into one native multi-buffer
+    # scan call. Opt-in: it adds one loop-callback hop per socket read,
+    # which only pays off at high connection counts.
+    scan_coalesce: bool = False
     # degradation manager (mqtt_tpu.resilience): wrap every device dispatch
     # in a circuit breaker + hang watchdog; timeouts/errors/corrupt results
     # route matching to the bit-identical host trie and background probes
@@ -576,6 +598,24 @@ class Options:
             self.logger = logging.getLogger("mqtt_tpu")
 
 
+_VIEW_CLS: Any = None
+_VIEW_CLS_RESOLVED = False
+
+
+def _view_class():
+    """The C ``SubscribersView`` type (native/accelmod.c) or None —
+    resolved once. Without the C module no view can ever reach
+    ``_fan_out``, so None simply disables the lazy branch."""
+    global _VIEW_CLS, _VIEW_CLS_RESOLVED
+    if not _VIEW_CLS_RESOLVED:
+        from .native import accel
+
+        mod = accel()
+        _VIEW_CLS = getattr(mod, "SubscribersView", None) if mod else None
+        _VIEW_CLS_RESOLVED = True
+    return _VIEW_CLS
+
+
 def publish_frame_body_offset(frame: bytes) -> int:
     """Offset of a raw PUBLISH frame's variable header (skips the fixed
     header's remaining-length varint). The caller guarantees a frame the
@@ -667,6 +707,9 @@ class _Ops:
         # Clients consult it for the publish stage clock and the sampled
         # outbound queue-wait stamps.
         self.telemetry: Optional[Any] = None
+        # read-side scan coalescer (clients.ScanGate); None = per-socket
+        # scans. Set by the server when Options.scan_coalesce is on.
+        self.scan_gate: Optional[Any] = None
 
 
 class Server:
@@ -694,6 +737,16 @@ class Server:
         self._ops.fast_publish_eligible = self.fast_publish_eligible
         self._fastpub_gate_gen = -1  # hooks generation the gate was cached at
         self._fastpub_gate_ok = False
+        # encode-once batched fan-out (ISSUE 13): variant grouping + the
+        # GIL-released native flush; False = legacy per-subscriber path
+        self._fanout_batch = opts.fanout_batch
+        if opts.scan_coalesce:
+            # read-side decode batching: frame scans from read loops that
+            # wake in the same event-loop tick coalesce into one native
+            # multi-buffer call (clients.ScanGate)
+            from .clients import ScanGate
+
+            self._ops.scan_gate = ScanGate()
         self._fastpub_plans: dict = {}  # topic -> (trie version, fan-out plan)
         # multi-core worker fabric (mqtt_tpu.cluster); None = single process
         self._cluster: Optional[Any] = None
@@ -869,6 +922,7 @@ class Server:
             mopts: dict = {
                 "compact": opts.matcher_compact,
                 "compact_capacity": opts.matcher_compact_capacity,
+                "lazy": opts.matcher_lazy_views,
             }
             if self.topic_sketch is not None:
                 mopts["hits_estimate"] = max(
@@ -1147,6 +1201,16 @@ class Server:
 
     # -- telemetry plane (mqtt_tpu.telemetry) ------------------------------
 
+    @staticmethod
+    def _view_materializations() -> int:
+        """The C view module's materialization count (0 sans toolchain)."""
+        from .ops.matcher import _accel
+
+        acc = _accel()
+        if acc is None or not hasattr(acc, "view_stats"):
+            return 0
+        return acc.view_stats()["materializations"]
+
     def _register_core_gauges(self) -> None:
         """Scrape-time gauges over state other layers already maintain:
         the $SYS Info counters, matcher stats, and governor posture all
@@ -1203,6 +1267,16 @@ class Server:
             fn=lambda: (
                 0 if self._stage is None else self._stage.inflight_batches
             ),
+        )
+        # zero-materialization fan-out (ISSUE 13): how often a lazy
+        # SubscribersView was forced into the eager dicts (any dict-
+        # semantics consumer — shared groups, predicates, differential
+        # verification). Near zero on the pure client fan-out path.
+        r.counter(
+            "mqtt_tpu_fanout_view_materializations_total",
+            "Lazy fan-out views forced into materialized Subscribers "
+            "dicts (the C view module's own count)",
+            fn=self._view_materializations,
         )
         r.counter(
             "mqtt_tpu_staging_compact_overflow_total",
@@ -2130,7 +2204,12 @@ class Server:
         clock = getattr(pk, "_tclock", None)
         if clock is not None:
             setattr(pk, "_tclock", None)  # a clock observes exactly once
-            clock.stamp("fanout")
+            if not any(s in ("encode", "flush") for s, _ in clock.stages):
+                # the batched path already split the fan-out leg into
+                # encode/flush sub-stamps; telemetry synthesizes the
+                # coarse ``fanout`` stage from their sum (continuity
+                # with pre-split rounds — exp/stage_gate.py)
+                clock.stamp("fanout")
             self.telemetry.observe_publish(
                 clock, pk.topic_name, pk.fixed_header.qos
             )
@@ -2243,13 +2322,16 @@ class Server:
         per-subscriber rewrite of the encoded publish): no positive
         subscription identifiers, no outbound aliasing, no size cap.
 
-        Used verbatim by publish_to_client's frame-cache branch.
+        Used verbatim by publish_to_client's frame-cache branch and by
+        BOTH batched fan-out paths (_fan_out_batched's variant/slow
+        split and _fan_out_encrypted_batched's shareable gate).
         try_fast_publish intentionally SPLITS the same predicate: the
         subscription half (identifiers) is precomputed into the cached
         fan-out plan, the session half (alias/size, plus its extra
         version==4 requirement) re-checks at delivery because cids can
-        reconnect with different properties under a live plan. Keep all
-        three sites in sync when extending the rule."""
+        reconnect with different properties under a live plan — that
+        split is the ONE remaining site that must track rule changes by
+        hand."""
         ids = sub.identifiers
         return (
             props.props.topic_alias_maximum == 0
@@ -2505,18 +2587,40 @@ class Server:
         and encrypted-namespace publishes take the batched
         re-encryption leg instead of the shared-frame path (``rjob`` is
         the staged decrypt carrier when the pipeline generated the
-        keystream on device)."""
+        keystream on device).
+
+        Zero-materialization fan-out (ISSUE 13): a lazy
+        ``SubscribersView`` result (the device pair stream as the
+        currency — native/accelmod.c) is consumed through its
+        ``targets()`` plan without ever building the dicts, as long as
+        no dict-semantics consumer is ahead (shared groups, inline
+        handlers, live predicate rules). Otherwise it materializes
+        here, counted, and the eager path serves bit-identically."""
         emissions = ()
         eng = self._predicates
-        if eng is not None and eng.active:
-            subscribers, emissions = eng.apply(
-                subscribers, bytes(pk.payload), feats
-            )
-        if subscribers.shared:
-            subscribers = self.hooks.on_select_subscribers(subscribers, pk)
-            if not subscribers.shared_selected:
-                subscribers.select_shared()
-            subscribers.merge_shared_selected()
+        targets = None  # the lazy (client_id, Subscription) plan
+        vcls = _view_class()
+        if vcls is not None and type(subscribers) is vcls:
+            if (
+                (eng is None or not eng.active)
+                and not subscribers.has_shared
+                and not subscribers.has_inline
+            ):
+                targets = subscribers.targets()
+            else:
+                subscribers = subscribers.materialize()
+        if targets is None:
+            if eng is not None and eng.active:
+                subscribers, emissions = eng.apply(
+                    subscribers, bytes(pk.payload), feats
+                )
+            if subscribers.shared:
+                subscribers = self.hooks.on_select_subscribers(
+                    subscribers, pk
+                )
+                if not subscribers.shared_selected:
+                    subscribers.select_shared()
+                subscribers.merge_shared_selected()
 
         # tenant namespace: deliveries carry the tenant-LOCAL topic
         # (clients never see the scope prefix); the scoped pk itself
@@ -2534,49 +2638,60 @@ class Server:
             ):
                 enc_tenant = tenant
 
-        if enc_tenant is None:
+        if enc_tenant is None and targets is None:
             for inline_sub in subscribers.inline_subscriptions.values():
                 inline_sub.handler(self.inline_client, inline_sub, dpk)
 
         if enc_tenant is not None:
-            self._fan_out_encrypted(enc_tenant, pk, dpk, subscribers, rjob)
+            self._fan_out_encrypted(
+                enc_tenant, pk, dpk, subscribers, rjob, targets
+            )
         else:
-            # QoS0 fast path: encode the outbound frame ONCE per publish
-            # and enqueue the shared bytes per subscriber. Eligible only
-            # when no per-subscriber state can differ (effective QoS is 0
-            # for every subscriber, no encode/sent hooks attached);
-            # clients with aliases/identifiers/size limits fall back per
-            # subscriber inside publish_to_client.
-            fast = None
-            if dpk.fixed_header.qos == 0 and not self.hooks.provides(
+            items = (
+                targets
+                if targets is not None
+                else subscribers.subscriptions.items()
+            )
+            if self._fanout_batch and not self.hooks.provides(
                 ON_PACKET_ENCODE, ON_PACKET_SENT
             ):
-                # $SYS housekeeping republishes every interval with no
-                # inbound publish behind it: keep it out of the encode/
-                # delivery amplification accounting (ROADMAP item 3's
-                # metric must measure client fan-out, not the $SYS tick)
-                fast = _FrameCache(
-                    dpk,
-                    None
-                    if dpk.topic_name.startswith("$SYS")
-                    else self.telemetry,
-                )
+                # encode-once variant-grouped delivery with the batched
+                # GIL-released flush (ISSUE 13 / ROADMAP item 3)
+                self._fan_out_batched(pk, dpk, items)
+            else:
+                # legacy path (hooks that observe encodes/sends, or the
+                # batching knob off): QoS0 still shares frames through
+                # the per-publish cache; QoS>0 re-encodes per subscriber
+                fast = None
+                if dpk.fixed_header.qos == 0 and not self.hooks.provides(
+                    ON_PACKET_ENCODE, ON_PACKET_SENT
+                ):
+                    # $SYS housekeeping republishes every interval with no
+                    # inbound publish behind it: keep it out of the encode/
+                    # delivery amplification accounting (ROADMAP item 3's
+                    # metric must measure client fan-out, not the $SYS tick)
+                    fast = _FrameCache(
+                        dpk,
+                        None
+                        if dpk.topic_name.startswith("$SYS")
+                        else self.telemetry,
+                    )
 
-            for id_, subs in subscribers.subscriptions.items():
-                cl = self.clients.get(id_)
-                if cl is not None:
-                    try:
-                        self.publish_to_client(cl, subs, dpk, fast)
-                    except Exception as e:
-                        self.log.debug(
-                            "failed publishing packet: error=%s client=%s",
-                            e,
-                            id_,
-                        )
-                    else:
-                        if cl.tenant is not None:
-                            cl.tenant.messages_out += 1
-                            cl.tenant.bytes_out += len(dpk.payload)
+                for id_, subs in items:
+                    cl = self.clients.get(id_)
+                    if cl is not None:
+                        try:
+                            self.publish_to_client(cl, subs, dpk, fast)
+                        except Exception as e:
+                            self.log.debug(
+                                "failed publishing packet: error=%s client=%s",
+                                e,
+                                id_,
+                            )
+                        else:
+                            if cl.tenant is not None:
+                                cl.tenant.messages_out += 1
+                                cl.tenant.bytes_out += len(dpk.payload)
 
         # MQTT+ aggregation windows that completed on this publish emit
         # ONE synthesized publish each (payload = the aggregate), riding
@@ -2600,6 +2715,330 @@ class Server:
                         e,
                         target,
                     )
+
+    def _fan_out_batched(self, pk: Packet, dpk: Packet, items) -> None:
+        """Encode-once variant-grouped fan-out (ISSUE 13 / ROADMAP item
+        3). Targets are grouped by (protocol version, effective QoS,
+        retain) — the complete set of per-target wire differences once
+        aliasing/size-caps/positive-identifier sessions are excluded —
+        and each variant's frame is encoded ONCE. QoS>0 targets get
+        their packet id patched inside the batched native flush (writev
+        iovecs, GIL released across the whole delivery batch); targets
+        whose session forces a per-subscriber rewrite take the legacy
+        path. Per-socket backpressure (bounded outbound queues), the
+        slow-consumer eviction clock and every drop/overload counter
+        behave exactly as the legacy path — only the encode count and
+        the GIL profile change."""
+        clock = getattr(pk, "_tclock", None)
+        topic = dpk.topic_name
+        sys_topic = topic.startswith("$SYS")
+        tele = self.telemetry
+        amp_tele = None if sys_topic else tele
+        caps = self.options.capabilities
+        origin = dpk.origin
+        clients_get = self.clients.get
+        groups: dict[tuple, list] = {}
+        slow: list = []
+        for cid, sub in items:
+            cl = clients_get(cid)
+            if cl is None or (sub.no_local and cid == origin):
+                continue  # [MQTT-3.8.3-3]
+            props = cl.properties
+            if not self._shared_frame_ok(props, sub):
+                slow.append((cl, sub))
+                continue
+            eff = dpk.fixed_header.qos
+            if eff > sub.qos:
+                eff = sub.qos
+            if eff > caps.maximum_qos:
+                eff = caps.maximum_qos  # [MQTT-3.2.2-9]
+            pv = props.protocol_version
+            retain = dpk.fixed_header.retain and (
+                sub.fwd_retained_flag
+                or (pv == 5 and sub.retain_as_published)
+            )  # [MQTT-3.3.1-12] / [MQTT-3.3.1-13]
+            groups.setdefault((pv, eff, bool(retain)), []).append((cl, sub))
+
+        variants = []
+        for (pv, eff, retain), group in groups.items():
+            out = dpk.copy(False)
+            out.fixed_header.qos = eff
+            out.fixed_header.retain = retain
+            out.protocol_version = pv
+            if eff > 0:
+                # nonzero placeholder (the encoder rejects pid 0 on
+                # QoS>0); every target's real id is patched at flush
+                out.packet_id = 1
+            if out.expiry > 0:
+                # the send-time expiry rewrite [MQTT-3.3.2-6], once per
+                # variant instead of per subscriber
+                out.properties.message_expiry_interval = max(
+                    1, out.expiry - int(time.time())  # brokerlint: ok=R3 message expiry is an absolute wall-clock stamp
+                )
+            buf = get_buffer()
+            try:
+                pkts.ENCODERS[pkts.PUBLISH](out, buf)
+                data = bytes(buf)
+            finally:
+                put_buffer(buf)
+            if amp_tele is not None:
+                amp_tele.publish_encodes.inc()
+                amp_tele.fanout_variants.inc()
+            id_off = -1
+            if eff > 0:
+                # packet id sits right after the topic in the variable
+                # header (no aliasing in this path, so the topic is
+                # always present)
+                id_off = (
+                    publish_frame_body_offset(data)
+                    + 2
+                    + len(topic.encode("utf-8"))
+                )
+            variants.append((pv, eff, retain, data, id_off, group))
+        if clock is not None:
+            clock.stamp("encode")
+
+        for pv, eff, retain, data, id_off, group in variants:
+            self._flush_variant(dpk, eff, retain, data, id_off, group,
+                                sys_topic)
+        for cl, sub in slow:
+            try:
+                self.publish_to_client(cl, sub, dpk)
+            except Exception as e:
+                self.log.debug(
+                    "failed publishing packet: error=%s client=%s", e, cl.id
+                )
+            else:
+                if cl.tenant is not None:
+                    cl.tenant.messages_out += 1
+                    cl.tenant.bytes_out += len(dpk.payload)
+        if clock is not None:
+            clock.stamp("flush")
+
+    def _flush_variant(
+        self,
+        dpk: Packet,
+        eff: int,
+        retain: bool,
+        data: bytes,
+        id_off: int,
+        group: list,
+        sys_topic: bool,
+    ) -> None:
+        """Deliver one encoded variant to its target group: ready
+        sockets (idle transport + empty outbound queue, no TLS) flush
+        through ONE GIL-released native call; everything else rides the
+        bounded outbound queue with the existing backpressure, eviction
+        and drop accounting."""
+        from .native import fan_flush
+
+        count_delivery = not sys_topic
+        topic = dpk.topic_name
+        if topic[:1] == NS_CHAR:
+            topic = ns_local(topic)
+        on_acl = self.hooks.on_acl_check
+        flush: list = []
+        for cl, sub in group:
+            try:
+                if not on_acl(cl, topic, False):
+                    continue
+                if cl.closed or cl.net.writer is None:
+                    continue
+                pid = 0
+                if eff > 0:
+                    pid = self._begin_qos_delivery(cl, dpk, eff, retain)
+                    if pid < 0:
+                        continue  # quota-refused or parked for resend
+                writer = cl.net.writer
+                fd = -1
+                if (
+                    cl.state.outbound_qty == 0
+                    and writer.get_extra_info("sslcontext") is None
+                    and writer.transport.get_write_buffer_size() == 0
+                ):
+                    sock = writer.get_extra_info("socket")
+                    if sock is not None:
+                        try:
+                            fd = sock.fileno()
+                        except OSError:
+                            fd = -1
+                if fd >= 0:
+                    # tenant accounting deferred to the flush outcome
+                    flush.append((cl, fd, pid))
+                    continue
+                frame = (
+                    data if id_off < 0
+                    else self._patch_id(data, id_off, pid)
+                )
+                if not self._enqueue_frame(
+                    cl, frame, lambda: dpk,
+                    count_delivery=count_delivery,
+                ):
+                    if eff > 0:
+                        self._rollback_qos_delivery(cl, pid)
+                    continue
+            except Exception as e:
+                self.log.debug(
+                    "failed publishing packet: error=%s client=%s", e, cl.id
+                )
+                continue
+            self._note_tenant_out(cl, dpk)
+        if not flush:
+            return
+        sent = fan_flush(
+            [fd for _, fd, _ in flush],
+            data,
+            id_off,
+            [pid for _, _, pid in flush] if id_off >= 0 else None,
+        )
+        if self.telemetry is not None:
+            self.telemetry.fanout_writev_batches.inc()
+        if sent is None:
+            # no native library: encode-once still holds, delivery goes
+            # through the per-target transport write
+            for cl, _fd, pid in flush:
+                frame = (
+                    data if id_off < 0 else self._patch_id(data, id_off, pid)
+                )
+                if self._transport_write_frame(cl, frame, count_delivery):
+                    self._note_tenant_out(cl, dpk)
+            return
+        n = len(data)
+        for (cl, _fd, pid), wrote in zip(flush, sent.tolist()):
+            if wrote == n:
+                self._note_direct_write(cl, n, count_delivery)
+            elif wrote >= 0:
+                # short write (kernel buffer filled mid-frame): finish
+                # through the transport — ordering-safe, the transport
+                # buffer was empty and we never left the loop thread
+                frame = (
+                    data if id_off < 0 else self._patch_id(data, id_off, pid)
+                )
+                try:
+                    cl.net.writer.write(frame[wrote:])
+                except Exception as e:
+                    self.log.debug(
+                        "fan-out flush tail failed: error=%s client=%s",
+                        e, cl.id,
+                    )
+                    continue
+                self._note_direct_write(cl, n, count_delivery)
+            else:
+                # -errno (EAGAIN-before-anything, or the connection is
+                # going away): the transport path owns delivery + errors
+                frame = (
+                    data if id_off < 0 else self._patch_id(data, id_off, pid)
+                )
+                if not self._transport_write_frame(
+                    cl, frame, count_delivery
+                ):
+                    continue
+            # accounting only on a delivery that actually went out (the
+            # legacy path counts after publish_to_client succeeds)
+            self._note_tenant_out(cl, dpk)
+
+    @staticmethod
+    def _patch_id(data: bytes, id_off: int, pid: int) -> bytes:
+        """A copy of the variant frame with this target's packet id."""
+        b = bytearray(data)
+        b[id_off] = (pid >> 8) & 0xFF
+        b[id_off + 1] = pid & 0xFF
+        return bytes(b)
+
+    def _begin_qos_delivery(
+        self, cl: Client, dpk: Packet, eff: int, retain: bool
+    ) -> int:
+        """The QoS>0 per-target bookkeeping of publish_to_client —
+        inflight cap, packet-id allocation, inflight store, send quota —
+        WITHOUT the per-target encode. Returns the allocated packet id,
+        or -1 when nothing must be written now (quota refusal, or the
+        send-quota park that resends once quota frees)."""
+        caps = self.options.capabilities
+        if len(cl.state.inflight) >= caps.maximum_inflight:
+            self.info.inflight_dropped += 1
+            self.log.warning(
+                "client store quota reached: client=%s listener=%s",
+                cl.id, cl.net.listener,
+            )
+            return -1
+        try:
+            i = cl.next_packet_id()  # [MQTT-4.3.2-1] [MQTT-4.3.3-1]
+        except Code:
+            self.hooks.on_packet_id_exhausted(cl, dpk)
+            self.info.inflight_dropped += 1
+            self.log.warning(
+                "packet ids exhausted: client=%s listener=%s",
+                cl.id, cl.net.listener,
+            )
+            return -1
+        out = dpk.copy(False)
+        out.topic_name = (
+            ns_local(dpk.topic_name)
+            if dpk.topic_name[:1] == NS_CHAR
+            else dpk.topic_name
+        )
+        out.fixed_header.qos = eff
+        out.fixed_header.retain = retain
+        out.packet_id = i & 0xFFFF  # [MQTT-2.2.1-4]
+        sent_quota = cl.state.inflight.send_quota
+        if cl.state.inflight.set(out):  # [MQTT-4.3.2-3] [MQTT-4.3.3-3]
+            self.info.inflight += 1
+            self.hooks.on_qos_publish(cl, out, out.created, 0)
+            cl.state.inflight.decrease_send_quota()
+        if sent_quota == 0 and cl.state.inflight.maximum_send_quota > 0:
+            out.expiry = -1  # mark for immediate resend once quota frees
+            cl.state.inflight.set(out)
+            return -1
+        return out.packet_id
+
+    def _rollback_qos_delivery(self, cl: Client, pid: int) -> None:
+        """Undo _begin_qos_delivery after a failed enqueue — the exact
+        rollback publish_to_client performs on a full outbound queue."""
+        cl.state.inflight.delete(pid)
+        cl.state.inflight.increase_send_quota()
+
+    def _note_direct_write(
+        self, cl: Client, nbytes: int, count_delivery: bool
+    ) -> None:
+        """Accounting for one completed direct-socket delivery — the
+        union of clients.write_frame's io counters and _enqueue_frame's
+        delivery count."""
+        self.info.bytes_sent += nbytes
+        self.info.packets_sent += 1
+        self.info.messages_sent += 1
+        st = cl.state
+        st.out_bytes += nbytes
+        st.out_writes += 1
+        tele = self.telemetry
+        if tele is not None:
+            tele.outbound_bytes.inc(nbytes)
+            tele.outbound_writes.inc()
+            if count_delivery:
+                tele.fanout_deliveries.inc()
+
+    @staticmethod
+    def _note_tenant_out(cl: Client, dpk: Packet) -> None:
+        """Per-tenant outbound accounting for one completed delivery."""
+        if cl.tenant is not None:
+            cl.tenant.messages_out += 1
+            cl.tenant.bytes_out += len(dpk.payload)
+
+    def _transport_write_frame(
+        self, cl: Client, frame: bytes, count_delivery: bool
+    ) -> bool:
+        """Fallback delivery of a pre-encoded frame through the asyncio
+        transport (native flush unavailable or refused the socket);
+        False = the write was not accepted."""
+        try:
+            cl.write_frame(frame)
+        except Exception as e:
+            self.log.debug(
+                "failed publishing packet: error=%s client=%s", e, cl.id
+            )
+            return False
+        if count_delivery and self.telemetry is not None:
+            self.telemetry.fanout_deliveries.inc()
+        return True
 
     def _key_idents(self, cid: str, cl: Optional[Client] = None) -> tuple:
         """The key-identity candidates for a client id: the tenant-LOCAL
@@ -2646,7 +3085,8 @@ class Server:
         )
 
     def _fan_out_encrypted(
-        self, tenant, pk: Packet, dpk: Packet, subscribers, rjob
+        self, tenant, pk: Packet, dpk: Packet, subscribers, rjob,
+        targets=None,
     ) -> None:
         """The MQT-TZ re-encryption fan-out (mqtt_tpu.tenancy): decrypt
         the publish once with the publisher's key (the staged keystream
@@ -2654,7 +3094,17 @@ class Server:
         re-encrypt per subscriber in ONE batched keystream dispatch, and
         deliver each subscriber its own ``nonce || ciphertext``. Keyless
         subscribers receive nothing (counted) — an encrypted namespace
-        never leaks plaintext or someone else's ciphertext."""
+        never leaks plaintext or someone else's ciphertext.
+
+        ``targets`` is the lazy view's (client_id, Subscription) plan
+        when the zero-materialization path resolved this publish — the
+        encrypted leg consumes sid pairs directly too (ISSUE 13).
+        Shareable-QoS0 targets additionally skip the per-subscriber
+        Packet+encode entirely: one shared frame HEAD is encoded per
+        (version, retain) variant and the native layer assembles
+        ``head || nonce_i || ciphertext_i`` frames from the batched
+        keystream XOR in a single pass (PR 12 residual closed for the
+        host path)."""
         renc = self._recrypt
         plaintext = renc.open_publish(
             tenant, self._origin_idents(pk), bytes(pk.payload), rjob
@@ -2665,12 +3115,21 @@ class Server:
             self.info.messages_dropped += 1
             tenant.messages_dropped += 1
             return
-        targets = [
-            (cid, self._key_idents(cid))
-            for cid in subscribers.subscriptions
-        ]
-        sealed = renc.seal_fanout(tenant, plaintext, targets)
-        for id_, subs in subscribers.subscriptions.items():
+        items = (
+            list(targets)
+            if targets is not None
+            else list(subscribers.subscriptions.items())
+        )
+        if self._fanout_batch and not self.hooks.provides(
+            ON_PACKET_ENCODE, ON_PACKET_SENT
+        ):
+            if self._fan_out_encrypted_batched(
+                tenant, dpk, plaintext, items
+            ):
+                return
+        key_targets = [(cid, self._key_idents(cid)) for cid, _sub in items]
+        sealed = renc.seal_fanout(tenant, plaintext, key_targets)
+        for id_, subs in items:
             data = sealed.get(id_)
             if data is None:
                 continue  # keyless subscriber: withheld, counted
@@ -2691,6 +3150,158 @@ class Server:
             else:
                 tenant.messages_out += 1
                 tenant.bytes_out += len(data)
+
+    def _fan_out_encrypted_batched(
+        self, tenant, dpk: Packet, plaintext: bytes, items: list
+    ) -> bool:
+        """The re-encrypt fan-out's encode-once leg (ISSUE 13 satellite,
+        PR 12 residual): ONE keystream dispatch for every keyed target,
+        then per-subscriber frames assembled in C as ``head || nonce_i
+        || (plaintext XOR keystream_i)`` — the frame HEAD is encoded
+        once per (version, retain) variant, so encrypted namespaces no
+        longer pay a per-subscriber Packet copy + encode. Targets whose
+        session forces a per-subscriber rewrite (QoS>0, aliasing, size
+        caps, positive identifiers) still ride publish_to_client with
+        their sealed payloads — same keystream dispatch, no second one.
+        Returns True when delivery was fully handled here."""
+        from .native import assemble_frames
+
+        renc = self._recrypt
+        caps = self.options.capabilities
+        clients_get = self.clients.get
+        origin = dpk.origin
+        live: list = []  # (cid, cl, sub, eff, pv, retain, shareable)
+        for cid, sub in items:
+            cl = clients_get(cid)
+            if cl is None or (sub.no_local and cid == origin):
+                continue
+            props = cl.properties
+            eff = dpk.fixed_header.qos
+            if eff > sub.qos:
+                eff = sub.qos
+            if eff > caps.maximum_qos:
+                eff = caps.maximum_qos
+            pv = props.protocol_version
+            retain = dpk.fixed_header.retain and (
+                sub.fwd_retained_flag
+                or (pv == 5 and sub.retain_as_published)
+            )
+            shareable = eff == 0 and self._shared_frame_ok(props, sub)
+            live.append((cid, cl, sub, eff, pv, bool(retain), shareable))
+        if not any(s for *_x, s in live):
+            return False  # nothing shareable: the legacy path is simpler
+        raw = renc.seal_fanout_raw(
+            tenant, plaintext,
+            [(cid, self._key_idents(cid, cl)) for cid, cl, *_r in live],
+        )
+        if raw is None:
+            # keyless everything: withheld (counted by the engine)
+            return True
+        keyed, nonces, rows = raw
+        kmap = {tkey: i for i, (tkey, _kid) in enumerate(keyed)}
+        n_blocks = (len(plaintext) + 15) // 16
+        ks2d = (
+            rows.reshape(len(keyed), n_blocks * 16)
+            if rows is not None
+            else None
+        )
+        payload_len = renc.nonce_bytes + len(plaintext)
+
+        # group shareable targets by head variant; deliver the rest
+        # per-subscriber with their sealed payload slices
+        groups: dict[tuple, list] = {}
+        import numpy as _np
+
+        pt_arr = _np.frombuffer(plaintext, dtype=_np.uint8)
+        for cid, cl, sub, eff, pv, retain, shareable in live:
+            ki = kmap.get(cid)
+            if ki is None:
+                continue  # keyless subscriber: withheld, counted
+            if shareable:
+                groups.setdefault((pv, retain), []).append((cl, ki))
+                continue
+            data = nonces[ki].tobytes() + (
+                (ks2d[ki][: len(plaintext)] ^ pt_arr).tobytes()
+                if ks2d is not None
+                else b""
+            )
+            out = dpk.copy(False)
+            out.payload = data
+            try:
+                self.publish_to_client(cl, sub, out)
+            except Exception as e:
+                self.log.debug(
+                    "failed publishing recrypted packet: error=%s "
+                    "client=%s", e, cid,
+                )
+            else:
+                tenant.messages_out += 1
+                tenant.bytes_out += len(data)
+
+        amp_tele = self.telemetry
+        # the tenant-LOCAL topic (what the subscriber subscribed to):
+        # the ACL below must judge what the client sees on the wire
+        topic = dpk.topic_name
+        if topic[:1] == NS_CHAR:
+            topic = ns_local(topic)
+        for (pv, retain), group in groups.items():
+            out = dpk.copy(False)
+            out.fixed_header.qos = 0
+            out.fixed_header.retain = retain
+            out.protocol_version = pv
+            out.payload = b"\x00" * payload_len  # placeholder bytes only
+            if out.expiry > 0:
+                out.properties.message_expiry_interval = max(
+                    1, out.expiry - int(time.time())  # brokerlint: ok=R3 message expiry is an absolute wall-clock stamp
+                )
+            buf = get_buffer()
+            try:
+                pkts.ENCODERS[pkts.PUBLISH](out, buf)
+                frame = bytes(buf)
+            finally:
+                put_buffer(buf)
+            head = frame[: len(frame) - payload_len]
+            if amp_tele is not None:
+                amp_tele.publish_encodes.inc()
+                amp_tele.fanout_variants.inc()
+            idxs = [ki for _cl, ki in group]
+            frames = None
+            if ks2d is not None:
+                frames = assemble_frames(
+                    head, nonces[idxs], ks2d[idxs], plaintext
+                )
+            if frames is None:
+                # no native library (or empty plaintext): numpy assembly,
+                # still encode-once
+                ct = (
+                    (ks2d[idxs][:, : len(plaintext)] ^ pt_arr[None, :])
+                    if ks2d is not None
+                    else _np.zeros((len(idxs), 0), dtype=_np.uint8)
+                )
+                rows_bytes = [
+                    head + nonces[ki].tobytes() + ct[i].tobytes()
+                    for i, ki in enumerate(idxs)
+                ]
+            else:
+                rows_bytes = [f.tobytes() for f in frames]
+            for (cl, _ki), fbytes in zip(group, rows_bytes):
+                try:
+                    # the per-target read ACL every delivery path
+                    # enforces (publish_to_client raises on the slow
+                    # legs; here denial withholds the frame)
+                    if not self.hooks.on_acl_check(cl, topic, False):
+                        continue
+                    if cl.closed or cl.net.writer is None:
+                        continue
+                    if self._enqueue_frame(cl, fbytes, lambda: dpk):
+                        tenant.messages_out += 1
+                        tenant.bytes_out += payload_len
+                except Exception as e:
+                    self.log.debug(
+                        "failed publishing recrypted packet: error=%s "
+                        "client=%s", e, cl.id,
+                    )
+        return True
 
     def publish_to_client(
         self,

@@ -61,15 +61,40 @@ def _subscribe_bytes(pid: int, topic: str, qos: int = 0) -> bytes:
     )
 
 
-def _publish_bytes(topic: str, payload: bytes) -> bytes:
+def _publish_bytes(topic: str, payload: bytes, qos: int = 0, pid: int = 0) -> bytes:
     return encode_packet(
         Packet(
-            fixed_header=FixedHeader(type=PUBLISH),
+            fixed_header=FixedHeader(type=PUBLISH, qos=qos),
             protocol_version=4,
             topic_name=topic,
             payload=payload,
+            packet_id=pid,
         )
     )
+
+
+def _publish_chunk(topic: str, payload: bytes, count: int, qos: int,
+                   pid0: int) -> tuple[bytes, int]:
+    """``count`` back-to-back PUBLISH frames in one buffer. QoS0 frames
+    are byte-identical; QoS1 frames cycle distinct packet ids starting
+    at ``pid0`` by patching the 2-byte id over one template encode (the
+    generator must not pay a per-message encode it is trying to measure
+    on the broker). Returns ``(buffer, next_pid)``."""
+    if qos == 0:
+        return _publish_bytes(topic, payload) * count, pid0
+    template = bytearray(_publish_bytes(topic, payload, qos=qos, pid=1))
+    off = 1
+    while template[off] & 0x80:
+        off += 1
+    id_off = off + 1 + 2 + len(topic.encode("utf-8"))
+    out = bytearray()
+    pid = pid0
+    for _ in range(count):
+        template[id_off] = (pid >> 8) & 0xFF
+        template[id_off + 1] = pid & 0xFF
+        out += template
+        pid = pid + 1 if pid < 0xFFFF else 1
+    return bytes(out), pid
 
 
 async def _read_packet_type(reader) -> int:
@@ -126,13 +151,16 @@ def _scan_frames(buf: bytearray):
     return frames, pos
 
 
-async def _count_publishes(reader, want: int) -> None:
+async def _count_publishes(reader, want: int, writer=None) -> None:
     """Count inbound PUBLISH frames (bulk reads, minimal parsing).
 
     Drains whatever the socket has and walks complete frames in the
     buffer — the load generator must not be the bottleneck it is
     measuring (three awaits per frame was costing more than the broker's
-    own per-message path on a shared core)."""
+    own per-message path on a shared core). With ``writer`` given, QoS1
+    deliveries are PUBACKed (one batched write per read chunk) so the
+    broker's inflight store drains — the QoS1 matrix cells need a
+    spec-complete subscriber, not a silent one."""
     got = 0
     buf = bytearray()
     while got < want:
@@ -141,14 +169,27 @@ async def _count_publishes(reader, want: int) -> None:
             raise asyncio.IncompleteReadError(b"", None)
         buf += data
         frames, consumed = _scan_frames(buf)
-        for first, _bs, _be in frames:
+        acks = bytearray() if writer is not None else None
+        for first, bs, be in frames:
             if (first >> 4) == PUBLISH:
                 got += 1
+                if acks is not None and (first >> 1) & 0x03 == 1:
+                    # QoS1 delivery: topic-length-prefixed topic, then
+                    # the packet id — echo it back as a PUBACK
+                    tl = (buf[bs] << 8) | buf[bs + 1]
+                    pid_at = bs + 2 + tl
+                    if pid_at + 2 <= be:
+                        acks += bytes(
+                            (0x40, 0x02, buf[pid_at], buf[pid_at + 1])
+                        )
         del buf[:consumed]
+        if acks:
+            writer.write(bytes(acks))
 
 
 async def _worker(
-    host: str, port: int, cid: str, n_msgs: int, payload: bytes, write_chunk: int
+    host: str, port: int, cid: str, n_msgs: int, payload: bytes,
+    write_chunk: int, qos: int = 0,
 ) -> dict:
     reader, writer = await asyncio.open_connection(host, port)
     try:
@@ -156,15 +197,22 @@ async def _worker(
         await writer.drain()
         assert await _read_packet_type(reader) == CONNACK
         topic = f"stress/{cid}"
-        writer.write(_subscribe_bytes(1, topic))
+        writer.write(_subscribe_bytes(1, topic, qos=qos))
         await writer.drain()
         assert await _read_packet_type(reader) == SUBACK
 
-        recv_task = asyncio.ensure_future(_count_publishes(reader, n_msgs))
-        msg = _publish_bytes(topic, payload)
+        recv_task = asyncio.ensure_future(
+            _count_publishes(
+                reader, n_msgs, writer=writer if qos > 0 else None
+            )
+        )
+        pid = 1
         t0 = time.perf_counter()
         for i in range(0, n_msgs, write_chunk):
-            writer.write(msg * min(write_chunk, n_msgs - i))
+            chunk, pid = _publish_chunk(
+                topic, payload, min(write_chunk, n_msgs - i), qos, pid
+            )
+            writer.write(chunk)
             await writer.drain()
         pub_s = time.perf_counter() - t0
         await recv_task
@@ -189,14 +237,21 @@ async def run_stress(
     payload_size: int = 64,
     write_chunk: int = 64,
     timeout: float = 300.0,
+    qos: int = 0,
 ) -> dict:
-    """Run the N-client workload; returns mqtt-stresser-style aggregates."""
+    """Run the N-client workload; returns mqtt-stresser-style aggregates.
+    ``qos`` drives both the publish and subscription QoS (the matrix's
+    QoS axis): QoS1 publishers carry cycling packet ids, QoS1
+    subscribers PUBACK every delivery."""
     payload = b"x" * payload_size
     t0 = time.perf_counter()
     results = await asyncio.wait_for(
         asyncio.gather(
             *(
-                _worker(host, port, f"w{i}", n_msgs, payload, write_chunk)
+                _worker(
+                    host, port, f"w{i}", n_msgs, payload, write_chunk,
+                    qos=qos,
+                )
                 for i in range(n_clients)
             )
         ),
@@ -208,6 +263,7 @@ async def run_stress(
     return {
         "clients": n_clients,
         "msgs_per_client": n_msgs,
+        "qos": qos,
         "publish_median_per_sec": round(statistics.median(pub)),
         "publish_min_per_sec": round(pub[0]),
         "publish_max_per_sec": round(pub[-1]),
@@ -242,6 +298,21 @@ async def run_flatness(
         "clients": [clients_small, clients_large],
         "small": small,
         "large": large,
+        # per-cell medians in one flat, diffable list (the matrix shape
+        # rounds diff cell-by-cell — ISSUE 13 satellite): each cell is
+        # keyed by (clients, qos) and carries ITS OWN medians instead of
+        # only the cross-cell ratio
+        "cells": [
+            {
+                "clients": r["clients"],
+                "qos": r.get("qos", 0),
+                "msgs_per_client": r["msgs_per_client"],
+                "publish_median_per_sec": r["publish_median_per_sec"],
+                "receive_median_per_sec": r["receive_median_per_sec"],
+                "aggregate_msgs_per_sec": r["aggregate_msgs_per_sec"],
+            }
+            for r in (small, large)
+        ],
         "receive_flatness_ratio": round(
             large["receive_median_per_sec"]
             / max(1e-9, small["receive_median_per_sec"]),
@@ -968,6 +1039,12 @@ def broker_main(
             # publishers — v4 PUBACK has no reason code), which reads as
             # a routing loss when it is the overload plane doing its job
             opt_kw["overload_control"] = False
+        if os.environ.get("BENCH_LAZY", "1") == "0":
+            # bench A/B knob (ISSUE 13): the serve-side broker honors
+            # the same switch the in-process bench brokers use, so the
+            # subprocess config-8 legs A/B cleanly too
+            opt_kw["matcher_lazy_views"] = False
+            opt_kw["fanout_batch"] = False
         srv = Server(Options(device_matcher=device_matcher, **opt_kw))
         srv.add_hook(AllowHook())
         clustered = wid_env is not None

@@ -61,12 +61,21 @@ PUBLISH_STAGES = (
     "device_dispatch",
     "d2h",
     "device_batch",
+    "encode",
+    "flush",
     "fanout",
 )
 
 # the device sub-stages the staging drain loop stamps when a device
 # profiler is attached (canonical here — mqtt_tpu.tracing re-exports)
 DEVICE_SUBSTAGES = ("h2d", "device_dispatch", "d2h")
+
+# the fan-out sub-stages the batched write path stamps (ISSUE 13):
+# ``encode`` covers variant grouping + the per-variant frame encodes,
+# ``flush`` the delivery flush (batched writev + queue fallbacks).
+# ``fanout`` stays populated as their sum — same continuity contract as
+# the device_batch split (exp/stage_gate.py diffs old rounds unchanged).
+FANOUT_SUBSTAGES = ("encode", "flush")
 
 # the MQTT v5 user-property key a trace id rides on (client-visible
 # traces, and adoption of client-supplied ids — mqtt_tpu.tracing)
@@ -714,6 +723,21 @@ class Telemetry:
             "mqtt_tpu_outbound_writes_total",
             "Socket write calls issued by the outbound write paths",
         )
+        # zero-materialization fan-out accounting (ISSUE 13): variants
+        # are the encode-once unit (amplification ~1 per variant is the
+        # success metric), writev batches count the GIL-released flush
+        # calls. View materializations are exported separately as a
+        # callback counter over the C module's own stats (server wiring).
+        self.fanout_variants = r.counter(
+            "mqtt_tpu_fanout_variants_total",
+            "Distinct (version, QoS, retain) encode variants the batched "
+            "fan-out produced — one wire encode each",
+        )
+        self.fanout_writev_batches = r.counter(
+            "mqtt_tpu_fanout_writev_batches_total",
+            "GIL-released batched socket flush calls issued by the "
+            "fan-out write path",
+        )
 
     # -- publish stage sampling --------------------------------------------
 
@@ -791,12 +815,25 @@ class Telemetry:
             "fanout_deliveries": self.fanout_deliveries.value,
             "outbound_bytes": self.outbound_bytes.value,
             "outbound_writes": self.outbound_writes.value,
+            "fanout_variants": self.fanout_variants.value,
+            "fanout_writev_batches": self.fanout_writev_batches.value,
             "encode_amplification": round(
                 self.publish_encodes.value / inbound, 4
             ),
             "delivery_amplification": round(
                 self.fanout_deliveries.value / inbound, 4
             ),
+            # encodes per VARIANT-GROUPED fan-out tick: ~1 when the
+            # batched path is doing its job (the ISSUE 13 acceptance
+            # number). Ticks that never grouped (legacy path) keep the
+            # plain encode_amplification as their signal.
+            "encode_per_variant": round(
+                self.publish_encodes.value
+                / max(1, self.fanout_variants.value),
+                4,
+            )
+            if self.fanout_variants.value
+            else None,
         }
 
     def publish_clock(self) -> Optional[StageClock]:
@@ -855,6 +892,9 @@ class Telemetry:
         sub_total = 0.0
         have_sub = False
         explicit_batch = False
+        fan_total = 0.0
+        have_fan = False
+        explicit_fanout = False
         for stage, dt in clock.stages:
             h = hist.get(stage)
             if h is not None:
@@ -864,12 +904,22 @@ class Telemetry:
                 have_sub = True
             elif stage == "device_batch":
                 explicit_batch = True
+            elif stage in FANOUT_SUBSTAGES:
+                fan_total += dt
+                have_fan = True
+            elif stage == "fanout":
+                explicit_fanout = True
         if have_sub and not explicit_batch:
             # continuity across the sub-stage split: device_batch stays
             # populated as the sum, so stage_gate diffs old rounds (an
             # explicitly-stamped device_batch — the exact-map / host
             # fallback path — must not be observed twice)
             hist["device_batch"].observe(sub_total, trace_id)
+        if have_fan and not explicit_fanout:
+            # same continuity contract for the fan-out split: the batched
+            # write path stamps encode/flush, legacy paths stamp fanout —
+            # either way the coarse stage keeps diffing across rounds
+            hist["fanout"].observe(fan_total, trace_id)
         self.sampled_publishes.inc()
         record = {
             # brokerlint: ok=R3 flight records carry wall-clock stamps

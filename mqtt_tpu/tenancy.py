@@ -654,36 +654,38 @@ class RecryptEngine:
             ks = self._host_keystream_for(job.key_id, job.nonce, job.n_blocks)
         return xor_into(payload[self.nonce_bytes :], ks)
 
-    def seal_fanout(
+    def seal_fanout_raw(
         self, tenant: Tenant, plaintext: bytes, targets: list
-    ) -> dict:
-        """Re-encrypt one plaintext for every keyed target in ONE
-        batched keystream generation (device when the batch is worth a
-        dispatch and the breaker admits it; vectorized host otherwise).
-        ``targets`` yield (target_key, idents) where ``idents`` are the
-        key-identity candidates; returns target_key ->
-        ``nonce || ciphertext`` for keyed targets only (keyless targets
-        are counted and withheld)."""
+    ):
+        """The batched keystream half of :meth:`seal_fanout`: ONE
+        keystream generation for every keyed target (device when the
+        batch is worth a dispatch and the breaker admits it; vectorized
+        host otherwise), WITHOUT the per-target ciphertext assembly.
+        Returns ``(keyed, nonces, rows)`` — ``keyed`` the [(target_key,
+        key_id), ...] that resolved a key (aligned with ``nonces``
+        uint8 [J, 12] and ``rows`` uint8 [J*n_blocks, 16]; ``rows`` is
+        None for zero-length plaintexts) — or None when no target is
+        keyed. The zero-materialization fan-out consumes this directly
+        and assembles per-subscriber frames from the shared keystream
+        XOR in C (native.assemble_frames); keyless targets are counted
+        and absent from ``keyed``."""
         from .ops.recrypt import keystream_async
 
         n_blocks = (len(plaintext) + 15) // 16
-        out: dict = {}
         kids = self.keys.key_ids(tenant.name, [t[1] for t in targets])
         keyed = [(t[0], kid) for t, kid in zip(targets, kids) if kid >= 0]
         dropped = len(targets) - len(keyed)
         if dropped:
             self.no_key_drops += dropped
         if not keyed:
-            return out
+            return None
         self.fanouts += 1
         tenant.recrypt_fanouts += 1
         j = len(keyed)
         nonces = self._next_nonces(j)  # uint8 [J, 12]
         if n_blocks == 0:
             # zero-length plaintext: the wire payload is the bare nonce
-            for i, (tkey, _kid) in enumerate(keyed):
-                out[tkey] = nonces[i].tobytes()
-            return out
+            return keyed, nonces, None
         total = n_blocks * j
         table = self.keys.table()
         # one vectorized counter build for the whole tick: each job's
@@ -721,7 +723,30 @@ class RecryptEngine:
 
             self.host_blocks += total
             rows = host_keystream(table, kidx, counters)
+        return keyed, nonces, rows
+
+    def seal_fanout(
+        self, tenant: Tenant, plaintext: bytes, targets: list
+    ) -> dict:
+        """Re-encrypt one plaintext for every keyed target in ONE
+        batched keystream generation (device when the batch is worth a
+        dispatch and the breaker admits it; vectorized host otherwise).
+        ``targets`` yield (target_key, idents) where ``idents`` are the
+        key-identity candidates; returns target_key ->
+        ``nonce || ciphertext`` for keyed targets only (keyless targets
+        are counted and withheld)."""
+        out: dict = {}
+        raw = self.seal_fanout_raw(tenant, plaintext, targets)
+        if raw is None:
+            return out
+        keyed, nonces, rows = raw
+        if rows is None:
+            for i, (tkey, _kid) in enumerate(keyed):
+                out[tkey] = nonces[i].tobytes()
+            return out
         # one vectorized XOR for the whole tick, then per-target slices
+        j = len(keyed)
+        n_blocks = (len(plaintext) + 15) // 16
         pt = np.frombuffer(plaintext, dtype=np.uint8)
         ct = (
             rows.reshape(j, n_blocks * 16)[:, : len(plaintext)] ^ pt[None, :]

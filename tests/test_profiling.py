@@ -444,12 +444,13 @@ class TestTopicSketch:
 
 
 class TestFanoutAmplification:
-    def test_qos1_fanout_encodes_per_target(self):
-        """QoS1 publish to N QoS1 subscribers: every target needs its
-        own packet id, so the write path encodes PER SUBSCRIBER —
-        encodes == deliveries == N and the amplification block reports
-        N per inbound publish (the exact waste ROADMAP item 3's
-        encode-once rewrite attacks)."""
+    def test_qos1_fanout_encodes_once_per_variant(self):
+        """QoS1 publish to N same-variant QoS1 subscribers: the batched
+        fan-out (ISSUE 13) encodes the wire frame ONCE and patches each
+        target's packet id at flush — encodes == variants == 1,
+        deliveries == N, amplification ~1 (the exact waste ROADMAP
+        item 3 named, eliminated). Every subscriber still receives a
+        distinct, valid packet id."""
 
         async def scenario():
             h = Harness(Options(inline_client=True, telemetry_sample=1))
@@ -472,14 +473,58 @@ class TestFanoutAmplification:
                 pk = await read_wire_packet(r, 5)
                 assert pk.topic_name == "amp/t"
                 assert pk.fixed_header.qos == 1
+                # a real per-target id was patched over the shared
+                # encode (ids are per-client spaces [MQTT-2.2.1])
+                assert pk.packet_id > 0
             tele = h.server.telemetry
             block = tele.fanout_block(h.server.info.messages_received)
             assert block["inbound_publishes"] == 1
+            assert block["publish_encodes"] == 1
+            assert block["fanout_variants"] == 1
+            assert block["fanout_deliveries"] == n
+            assert block["encode_amplification"] == pytest.approx(1)
+            assert block["encode_per_variant"] == pytest.approx(1)
+            assert block["outbound_bytes"] > 0
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_qos1_fanout_legacy_knob_encodes_per_target(self):
+        """``fanout_batch=False`` restores the per-subscriber encode
+        path — the A/B the bench's BENCH_LAZY knob drives, kept as the
+        differential oracle for the batched path."""
+
+        async def scenario():
+            h = Harness(
+                Options(
+                    inline_client=True, telemetry_sample=1,
+                    fanout_batch=False,
+                )
+            )
+            subs = []
+            n = 4
+            for i in range(n):
+                r, w, _ = await h.connect(f"s{i}", version=5)
+                w.write(
+                    sub_packet(
+                        1, [Subscription(filter="amp/t", qos=1)], version=5
+                    )
+                )
+                await w.drain()
+                assert (await read_wire_packet(r, 5)).fixed_header.type == SUBACK
+                subs.append((r, w))
+            pr, pw, _ = await h.connect("pub", version=5)
+            pw.write(pub_packet("amp/t", b"x", qos=1, pid=9, version=5))
+            await pw.drain()
+            for r, _w in subs:
+                pk = await read_wire_packet(r, 5)
+                assert pk.topic_name == "amp/t"
+                assert pk.fixed_header.qos == 1
+            tele = h.server.telemetry
+            block = tele.fanout_block(h.server.info.messages_received)
             assert block["publish_encodes"] == n
             assert block["fanout_deliveries"] == n
-            assert block["encode_amplification"] == pytest.approx(n)
-            assert block["outbound_bytes"] > 0
-            assert block["outbound_writes"] >= n
+            assert block["fanout_variants"] == 0
             await h.shutdown()
 
         run(scenario())

@@ -163,6 +163,169 @@ def test_churn_while_matching_two_writers():
     assert m.stats.rebuilds + m.stats.folds > 2
 
 
+def _lazy_view_churn(duration_s: float, seed: int) -> int:
+    """Lazy-view lifetime drill (ISSUE 13 satellite): writer threads
+    churn subscriptions (subscribe/unsubscribe/$SHARE, plus whole-client
+    unsubscribes — the disconnect/session-takeover analog) while the
+    main thread resolves LAZY SubscribersView batches and consumes them
+    only AFTER a delay + forced GC — so unsubscribes land exactly
+    between device resolve and fan-out consumption. The snapshot table
+    must keep every captured (client, Subscription) alive and coherent
+    (no UAF, no torn objects); quiescent parity checkpoints pin the
+    materialized views against the live host walk. Returns batches
+    consumed."""
+    import gc
+
+    from mqtt_tpu import native
+
+    if native.accel() is None:
+        pytest.skip("no C toolchain: lazy views cannot exist")
+    index = TopicsIndex()
+    r0 = random.Random(seed)
+    for i in range(800):
+        index.subscribe(
+            f"base{i}", Subscription(filter=_rand_filter(r0), qos=i % 3)
+        )
+    faulthandler.dump_traceback_later(110, exit=True)
+    m = DeltaMatcher(
+        index, max_levels=4, rebuild_after=32, rebuild_interval=0.05,
+        background=True, lazy=True,
+    )
+    stop = threading.Event()
+    pause = threading.Event()
+    resume = threading.Event()
+    paused = threading.Barrier(3, timeout=30)
+    errors: list = []
+
+    def writer(wseed: int) -> None:
+        r = random.Random(wseed)
+        i = 0
+        owned: dict = {}  # this writer's client -> [filters] mirror
+        try:
+            while not stop.is_set():
+                if pause.is_set():
+                    paused.wait()
+                    resume.wait()
+                    continue
+                flt = _rand_filter(r)
+                kind = r.random()
+                if kind < 0.4:
+                    cid = f"w{wseed}_{i}"
+                    index.subscribe(cid, Subscription(filter=flt, qos=1))
+                    owned.setdefault(cid, []).append(flt)
+                elif kind < 0.8:
+                    index.unsubscribe(
+                        flt, f"w{wseed}_{r.randint(0, max(1, i))}"
+                    )
+                elif kind < 0.9:
+                    index.subscribe(
+                        f"w{wseed}_{i}",
+                        Subscription(
+                            filter=f"{SHARE_PREFIX}/g{wseed}/{flt}", qos=1
+                        ),
+                    )
+                elif owned:
+                    # the disconnect/takeover analog: drop EVERY filter
+                    # a client holds, like server.unsubscribe_client
+                    victim = r.choice(list(owned))
+                    for f2 in owned.pop(victim):
+                        index.unsubscribe(f2, victim)
+                i += 1
+                time.sleep(0.0005)
+        except Exception as e:  # pragma: no cover - the assertion target
+            errors.append(e)
+
+    writers = [
+        threading.Thread(target=writer, args=(s,), daemon=True)
+        for s in (seed + 1, seed + 2)
+    ]
+    for t in writers:
+        t.start()
+
+    r = random.Random(seed + 99)
+    t_end = time.time() + duration_s
+    batches = 0
+    held: list = []  # views outliving several churn windows
+    try:
+        while time.time() < t_end:
+            topics = [_rand_topic(r) for _ in range(128)]
+            views = m.match_topics(topics)
+            # let unsubscribes/disconnects land between resolve and
+            # consumption, then drop any dead references they freed
+            time.sleep(0.002)
+            if batches % 7 == 0:
+                gc.collect()
+            for v in views:
+                consume = getattr(v, "targets", None)
+                if consume is None:
+                    continue  # host-routed row: plain Subscribers
+                for cid, sub in consume():
+                    # snapshot-time coherence: every captured object is
+                    # intact, whatever the trie did since
+                    assert isinstance(cid, str) and cid
+                    assert isinstance(sub.filter, str)
+                    assert sub.qos in (0, 1, 2)
+            # a slice of views deliberately outlives the batch (the
+            # slow-consumer analog): consuming them batches later must
+            # still be safe
+            if batches % 3 == 0:
+                held.extend(v for v in views[:4] if v is not None)
+                if len(held) > 32:
+                    for v in held[:16]:
+                        mzd = v.materialize()
+                        assert mzd.subscriptions is not None
+                    del held[:16]
+            batches += 1
+            if batches % 10 == 0:
+                resume.clear()
+                pause.set()
+                paused.wait()
+                check = [_rand_topic(r) for _ in range(32)]
+                got = m.match_topics(check)
+                for topic, res in zip(check, got):
+                    assert canon(res) == canon(index.subscribers(topic)), topic
+                pause.clear()
+                resume.set()
+    finally:
+        stop.set()
+        pause.clear()
+        resume.set()
+        for t in writers:
+            t.join(timeout=10)
+        # final parity checkpoint (always runs, however slow the box
+        # was: the writers are joined, so the trie is quiescent) —
+        # lazy views materialized against the live host walk
+        try:
+            check = [_rand_topic(r) for _ in range(32)]
+            got = m.match_topics(check)
+            for topic, res in zip(check, got):
+                assert canon(res) == canon(index.subscribers(topic)), topic
+        finally:
+            m.close()
+    faulthandler.cancel_dump_traceback_later()
+    assert not errors, errors
+    return batches
+
+
+def test_lazy_view_lifetime_churn_quick():
+    """Tier-1 leg of the lazy-view lifetime drill (one seed, short).
+    The floor is a LIVENESS bar (a wedged pipeline yields 0-1 batches
+    on any box); the invariants are per-batch asserts + the final
+    quiescent parity checkpoint inside the drill."""
+    assert _lazy_view_churn(4.0, seed=17) >= 2
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("interval_s", [1e-6, 1e-5])
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_lazy_view_lifetime_switch_sweep(interval_s, seed):
+    """Nightly seeded schedule sweep over the lazy-view lifetime drill:
+    pathological GIL handover points between resolve, churn, GC and
+    consumption."""
+    with switch_interval(interval_s):
+        assert _lazy_view_churn(5.0, seed=seed) >= 2
+
+
 @pytest.mark.slow
 @pytest.mark.parametrize("interval_s", [1e-6, 1e-5, 1e-4])
 def test_churn_switch_interval_sweep(interval_s):

@@ -163,6 +163,25 @@ class TestDeviceSubStages:
         reg, _ = stage_gate.compare(cur, prev)
         assert len(reg) == 1 and "leg_wait_d2h" in reg[0]
 
+    def test_fanout_split_passes_through_and_keeps_diffing(self):
+        """The ISSUE 13 fan-out split: encode/flush land as new stage
+        rows on their first round (noticed, never vacuously failed)
+        while the coarse fanout row — still populated as their sum —
+        keeps diffing against pre-split rounds."""
+        cur = _multi_stage_doc(
+            {"fanout": 1.1, "encode": 0.3, "flush": 0.8}
+        )
+        prev = _multi_stage_doc({"fanout": 1.0})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not reg
+        assert cmp_ == ["/parsed/configs/2/telemetry:fanout"]
+        assert stage_gate.new_stage_names(cur, prev) == ["encode", "flush"]
+        # a fanout regression across the split is still caught via the
+        # shared sum row
+        cur2 = _multi_stage_doc({"fanout": 5.0, "encode": 0.2, "flush": 4.8})
+        reg2, _ = stage_gate.compare(cur2, prev)
+        assert len(reg2) == 1 and "fanout" in reg2[0]
+
     def test_retired_stage_is_noticed_never_failed(self):
         """A stage present only in the PREVIOUS round (renamed/retired
         by the pipeline split) is surfaced as a notice and never

@@ -577,7 +577,11 @@ class TestStagedPipelineTelemetry:
                 assert tele.stage_hist[stage].count > 0, stage
             assert tele.batch_service.count > 0
             assert tele.batch_fill.count > 0
-            assert tele.outbound_wait.count > 0
+            # NOTE: outbound_wait deliberately unasserted here — the
+            # batched fan-out (ISSUE 13) delivers to idle sockets
+            # directly, so nothing queues and there is no queue wait to
+            # observe; the queued path's sampling is covered by
+            # test_outbound_queue_wait_sampling
             assert tele.sampled_publishes.value >= n
 
             # $SYS tree surfaces the same aggregates
@@ -598,6 +602,40 @@ class TestStagedPipelineTelemetry:
                 assert f'stage="{stage}"' in text
             assert "mqtt_tpu_stage_batch_fill_ratio_bucket" in text
             assert "mqtt_tpu_matcher_batches_total" in text
+
+            await h.server.close()
+            await h.shutdown()
+
+        run(scenario())
+
+    def test_outbound_queue_wait_sampling(self):
+        """The legacy (non-batched) fan-out delivers through the
+        bounded outbound queue, so sampled enqueues observe a queue
+        wait — the path the batched flush deliberately skips for idle
+        sockets (ISSUE 13)."""
+
+        async def scenario():
+            h = Harness(
+                Options(
+                    inline_client=True,
+                    telemetry_sample=1,
+                    fanout_batch=False,
+                )
+            )
+            await h.server.serve()
+            tele = h.server.telemetry
+            sub_r, sub_w, _ = await h.connect("sub")
+            sub_w.write(sub_packet(1, [Subscription(filter="t/#", qos=0)]))
+            await sub_w.drain()
+            assert (await read_wire_packet(sub_r)).fixed_header.type == SUBACK
+            pub_r, pub_w, _ = await h.connect("pub")
+            for i in range(8):
+                pub_w.write(pub_packet(f"t/{i}", b"m"))
+            await pub_w.drain()
+            for _ in range(8):
+                pk = await read_wire_packet(sub_r)
+                assert pk.fixed_header.type == PUBLISH
+            assert tele.outbound_wait.count > 0
 
             await h.server.close()
             await h.shutdown()

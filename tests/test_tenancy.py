@@ -573,6 +573,74 @@ class TestRecryptEndToEnd:
 
         run(scenario())
 
+    def test_acl_denied_keyed_subscriber_is_withheld(self):
+        """Regression (ISSUE 13 review): the batched encrypted fan-out
+        must enforce the per-target read ACL like every other delivery
+        path — a KEYED subscriber the ACL denies receives nothing, a
+        keyed+allowed one still gets its re-keyed copy."""
+        from mqtt_tpu.hooks import ON_ACL_CHECK, ON_CONNECT_AUTHENTICATE, Hook
+        from mqtt_tpu.tenancy import local_client_id
+
+        class DenyA2Reads(Hook):
+            def id(self):
+                return "deny-a2"
+
+            def provides(self, b):
+                return b in (ON_ACL_CHECK, ON_CONNECT_AUTHENTICATE)
+
+            def on_connect_authenticate(self, cl, pk):
+                return True
+
+            def on_acl_check(self, cl, topic, write):
+                return write or local_client_id(cl.id) != "cidA2"
+
+        async def scenario():
+            opts = tenant_options(
+                tenants={
+                    "acme": {
+                        "encrypted": ["secure/"],
+                        "keys": {
+                            "cidA": KEY_A.hex(),
+                            "cidA2": KEY_S.hex(),
+                            "cidA4": KEY_S.hex(),
+                        },
+                    },
+                    "bulkco": {},
+                },
+                tenant_users={
+                    "cidA": "acme", "cidA2": "acme", "cidA4": "acme",
+                },
+            )
+            h = Harness(opts, allow=False)
+            h.server.add_hook(DenyA2Reads())
+            try:
+                eng = h.server._recrypt
+                conns = await _connect_many(h, ["cidA", "cidA2", "cidA4"])
+                for cid in ("cidA2", "cidA4"):
+                    r, w = conns[cid]
+                    w.write(
+                        sub_packet(
+                            1, [Subscription(filter="secure/#", qos=0)]
+                        )
+                    )
+                    await w.drain()
+                    await read_wire_packet(r)
+                plaintext = b"need to know only"
+                wire = eng.seal_with_key(KEY_A, plaintext)
+                _r, wa = conns["cidA"]
+                wa.write(pub_packet("secure/ops", wire))
+                await wa.drain()
+                got = await _drain_payloads(conns["cidA4"][0], n_expected=1)
+                assert len(got) == 1
+                assert eng.open_with_key(KEY_S, got[0][1]) == plaintext
+                # the denied subscriber holds a valid key and a live
+                # subscription — the ACL alone withholds delivery
+                assert await _drain_payloads(conns["cidA2"][0]) == []
+            finally:
+                await h.shutdown()
+
+        run(scenario())
+
     def test_keyless_subscriber_withheld_and_retained_rekeyed(self):
         async def scenario():
             h = Harness(tenant_options(**self.OPTS))

@@ -239,8 +239,9 @@ class TestStagedSpanTree:
     def test_full_pipeline_span_tree_and_invariants(self):
         """Every sampled publish through the staged device pipeline
         yields one root with decode -> admission -> staging_wait -> h2d
-        -> device_dispatch -> d2h -> fanout children that tile the root
-        window, and the export passes the validator."""
+        -> device_dispatch -> d2h -> encode -> flush children that tile
+        the root window (the batched fan-out splits the old fanout span
+        — ISSUE 13), and the export passes the validator."""
 
         async def scenario():
             h = Harness(
@@ -277,7 +278,7 @@ class TestStagedSpanTree:
             assert len(trees) == n
             expected = {
                 "decode", "admission", "staging_wait",
-                "h2d", "device_dispatch", "d2h", "fanout",
+                "h2d", "device_dispatch", "d2h", "encode", "flush",
             }
             for events in trees.values():
                 assert_publish_tree(events)
@@ -289,6 +290,11 @@ class TestStagedSpanTree:
             for s in DEVICE_SUBSTAGES:
                 assert tele.stage_hist[s].count == n
             assert tele.stage_hist["device_batch"].count == n
+            # same continuity for the fan-out split: encode/flush land
+            # in their own histograms AND the coarse fanout stage keeps
+            # populating as their sum (exactly once per publish)
+            for s in ("encode", "flush", "fanout"):
+                assert tele.stage_hist[s].count == n, s
 
             await h.server.close()
             await h.shutdown()
@@ -383,7 +389,7 @@ class TestMeshTraceJoin:
             names = {e["name"] for e in origin if e["cat"] == "stage"}
             assert names == {
                 "decode", "admission", "staging_wait",
-                "h2d", "device_dispatch", "d2h", "fanout",
+                "h2d", "device_dispatch", "d2h", "encode", "flush",
             }, names
             root = [e for e in origin if e["name"] == "publish"][0]
             assert fwd[0]["args"]["parent_id"] == root["args"]["span_id"]
@@ -572,7 +578,8 @@ class TestUserPropertyTraces:
             trees = spans_by_trace(doc)
             assert "client-id-1" in trees
             names = {e["name"] for e in trees["client-id-1"]}
-            assert "publish" in names and "fanout" in names
+            assert "publish" in names
+            assert {"fanout"} <= names or {"encode", "flush"} <= names
             await h.server.close()
             await h.shutdown()
 

@@ -9,9 +9,25 @@
 #
 # Every finding must be FIXED or suppressed in the source with a comment
 # explaining why it is safe — this script takes no suppression flags by
-# design. Usage: tools/c_gate.sh [output-log]
+# design.
+#
+# --san adds the ASAN/UBSAN leg (ISSUE 13 satellite): both native
+# modules are REBUILT with -fsanitize=address,undefined (a distinct
+# artifact tag, so the plain build's cache is never poisoned) and the
+# native-facing test suite runs under them — the lazy-view/freelist C
+# code needs runtime lifetime verification, not just -fanalyzer.
+# detect_leaks stays off (CPython interns allocate for process lifetime
+# by design); UBSan runs -fno-sanitize-recover so any finding is fatal.
+#
+# Usage: tools/c_gate.sh [--san] [output-log]
 set -u
 cd "$(dirname "$0")/.."
+
+SAN=0
+if [ "${1:-}" = "--san" ]; then
+    SAN=1
+    shift
+fi
 
 LOG="${1:-/tmp/c_gate.log}"
 : > "$LOG"
@@ -58,6 +74,37 @@ if command -v cppcheck >/dev/null 2>&1; then
     fi
 else
     say "cppcheck unavailable; skipping"
+fi
+
+if [ "$SAN" = 1 ]; then
+    LIBASAN="$(gcc -print-file-name=libasan.so 2>/dev/null || true)"
+    if [ -n "$LIBASAN" ] && [ -e "$LIBASAN" ]; then
+        ran=1
+        say "== ASAN/UBSAN native test leg =="
+        # the sanitizer flags change the artifact tag (native/_so_tag),
+        # so this leg builds its own .so pair and the plain build's
+        # mtime cache stays untouched
+        # MQTT_TPU_SAN=1 deselects the jax-backed e2e tests: jaxlib is
+        # not ASAN-instrumented and its XLA compiler aborts under the
+        # preloaded runtime — the leg verifies OUR C (views, pool,
+        # flush, framing), not XLA
+        if env \
+            MQTT_TPU_NATIVE_CFLAGS="-fsanitize=address,undefined -fno-sanitize-recover=undefined -g" \
+            ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+            LD_PRELOAD="$LIBASAN" \
+            MQTT_TPU_SAN=1 \
+            "$PY" -m pytest tests/test_native.py tests/test_fanout.py \
+                -q -m 'not slow' -p no:cacheprovider >>"$LOG" 2>&1; then
+            say "sanitizer leg: clean"
+        else
+            say "FAIL: native tests under ASAN/UBSAN"; rc=1
+        fi
+        # sanitized artifacts are throwaway (tagged -x<hash>)
+        rm -f mqtt_tpu/native/libmqtt_native-*-x????????.so \
+              mqtt_tpu/native/mqtt_accel-*-x????????.so
+    else
+        say "libasan unavailable; sanitizer leg skipped"
+    fi
 fi
 
 if [ "$ran" = 0 ]; then
