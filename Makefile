@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke bench-trend scrape-cluster
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -71,6 +71,19 @@ chaos-smoke:
 # (exp/stage_gate.py): fails on a >25% p99 regression in any stage
 stage-gate:
 	$(PY) exp/stage_gate.py
+
+# bench-history trend gate (exp/bench_trend.py): fails when the newest
+# ledger round's headline fell >25% below the median of the prior
+# rounds in the window (BENCH_HISTORY.jsonl, appended by bench.py)
+bench-trend:
+	$(PY) exp/bench_trend.py
+
+# mesh federation scrape gate (exp/scrape_cluster.py): boot a 3-worker
+# tree mesh, drive a cross-worker burst, scrape the root's
+# /metrics/cluster + /healthz, validate the federated exposition and
+# nonzero remote-path delivery-latency samples
+scrape-cluster:
+	env JAX_PLATFORMS=cpu $(PY) exp/scrape_cluster.py
 
 # staged-pipeline smoke (exp/pipeline_smoke.py): boot the broker with
 # compaction + the 3-deep pipeline on, 1k-publish burst vs wildcard

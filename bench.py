@@ -1403,6 +1403,97 @@ async def _flatness_profile_block(fast: bool) -> dict:
     }
 
 
+async def _slo_overhead_block(fast: bool) -> dict:
+    """Config 8's SLO-plane A/B (ISSUE 14 acceptance: SLI-stamping
+    overhead <= 2%): the same stress workload against two fresh brokers
+    — the SLO observatory fully ON (delivery SLIs + a live burn-rate
+    objective evaluating every housekeeping tick) vs ``Options.slo``
+    OFF — best-of-2 each so a sub-second scheduler hiccup cannot decide
+    the verdict. Production sampling rates (the default 1-in-64): the
+    claim under test is the plane's cost as shipped, not under
+    sample-everything instrumentation."""
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import run_stress
+
+    clients, msgs = (10, 500) if fast else (40, 1500)
+    reps = 3 if fast else 4
+
+    async def one_round(port: int, slo_on: bool) -> float:
+        srv = Server(
+            Options(
+                device_matcher=False,
+                overload_control=False,  # measure the SLI path, not sheds
+                slo=slo_on,
+                slo_objectives=(
+                    ["p99 delivery < 50ms over 5m", "shed ratio < 0.1%"]
+                    if slo_on
+                    else None
+                ),
+            )
+        )
+        srv.add_hook(AllowHook())
+        srv.add_listener(
+            TCP(LConfig(type="tcp", id="slo", address=f"127.0.0.1:{port}"))
+        )
+        await srv.serve()
+        try:
+            await run_stress("127.0.0.1", port, 2, 100)  # warmup
+            res = await run_stress("127.0.0.1", port, clients, msgs)
+            if slo_on and srv.slo is not None:
+                # prove the engine actually evaluated live objectives
+                # during the measured window (a dead engine would make
+                # the A/B vacuous)
+                srv.slo.evaluate()
+            return res["aggregate_msgs_per_sec"]
+        finally:
+            await srv.close()
+
+    # INTERLEAVED best-of-N: the in-process loopback workload is noisy
+    # (±20% between back-to-back identical rounds on a shared box), so
+    # sequential arm-then-arm would measure scheduler drift, not the
+    # plane; alternating rounds and taking each arm's best bounds the
+    # bias to within-pair jitter
+    on_rate = off_rate = 0.0
+    for rep in range(reps):
+        on_rate = max(on_rate, await one_round(18845 + 2 * rep, True))
+        off_rate = max(off_rate, await one_round(18846 + 2 * rep, False))
+    out = {
+        "enabled_msgs_per_sec": on_rate,
+        "disabled_msgs_per_sec": off_rate,
+        "reps": reps,
+        "overhead_pct": round(
+            (off_rate - on_rate) / max(1, off_rate) * 100, 2
+        ),
+    }
+    # deterministic micro-measurement of the EXACT added work: one
+    # sampled-path observe_delivery call (dict probe + histogram
+    # observe), amortized over the 1-in-telemetry_sample publishes that
+    # pay it. The macro A/B above inherits the loopback harness's
+    # scheduler noise; this number is the stamping cost itself, and the
+    # amortized-per-publish figure is what the <=2% acceptance bar is
+    # judged against on noisy boxes.
+    from mqtt_tpu.telemetry import Telemetry
+
+    tele = Telemetry(sample=64)
+    n = 200_000
+    t0 = time.perf_counter()
+    for i in range(n):
+        tele.observe_delivery(1e-4, "", 0, "local")
+    per_call_ns = (time.perf_counter() - t0) / n * 1e9
+    out["sampled_observe_ns"] = round(per_call_ns, 1)
+    out["amortized_ns_per_publish"] = round(per_call_ns / 64, 2)
+    if off_rate > 0:
+        # the stamping cost as a fraction of the measured per-publish
+        # wall budget (1/rate): the harness-noise-free overhead claim
+        out["amortized_overhead_pct"] = round(
+            (per_call_ns / 64) / (1e9 / off_rate) * 100, 4
+        )
+    return out
+
+
 def run_storm_bench(fast: bool) -> dict:
     """Config 8: the publish-storm overload drill. An in-process broker
     (tight overload caps, a deliberately slow consumer, the staging loop
@@ -1542,6 +1633,10 @@ def run_storm_bench(fast: bool) -> dict:
     # deliberately tiny quotas would shed the probe itself, and its
     # still-armed lock plane would contaminate the disabled A/B arm
     out["receive_flatness"] = asyncio.run(_flatness_profile_block(fast))
+    # the SLO-plane on/off A/B (ISSUE 14 acceptance: <=2% SLI overhead);
+    # BENCH_SLO=0 skips the arm for broker-only sweeps
+    if os.environ.get("BENCH_SLO") != "0":
+        out["slo_overhead"] = asyncio.run(_slo_overhead_block(fast))
     # the connections × rate × QoS comparative matrix runs last, on a
     # subprocess broker (per-core workers) — the 2603.21600 reporting
     # frame for the encode-once write path (ISSUE 13)
@@ -1799,6 +1894,64 @@ def main() -> None:
         out["device_unreachable"] = True
         out["device_probe_error"] = probe_err
     print(json.dumps(out))
+    append_history(out)
+
+
+def _history_config_block(cfg) -> dict:
+    """The compact per-config slice a history entry keeps: top-level
+    scalars only (rates, ratios, counts) — enough for trend lines
+    without duplicating whole artifacts into the ledger."""
+    if not isinstance(cfg, dict):
+        return {}
+    return {
+        k: v for k, v in cfg.items() if isinstance(v, (int, float, bool))
+    }
+
+
+def history_entry(doc: dict, round_tag: str = "", time_unix: int = 0) -> dict:
+    """The CANONICAL bench-history ledger entry for one bench document
+    — the single schema both the live append below and
+    exp/bench_trend.py's backfill write, so the two can never drift."""
+    return {
+        "round": round_tag,
+        "time_unix": time_unix,
+        "metric": doc.get("metric"),
+        "value": doc.get("value"),
+        "vs_baseline": doc.get("vs_baseline"),
+        "device_kernel_matches_per_sec": doc.get(
+            "device_kernel_matches_per_sec"
+        ),
+        "configs": {
+            name: _history_config_block(cfg)
+            for name, cfg in (doc.get("configs") or {}).items()
+        },
+    }
+
+
+def append_history(out: dict) -> None:
+    """Append this round's headline + per-config scalar blocks to the
+    bench-history ledger (ISSUE 14 satellite: ``BENCH_HISTORY.jsonl``,
+    gated by exp/bench_trend.py in CI). SKIPPED rounds never append —
+    a null headline must not enter the trend window (the r05 lesson) —
+    and ``BENCH_HISTORY=0`` disables the ledger outright (subprocess
+    test runs). ``BENCH_HISTORY_PATH`` overrides the destination."""
+    if os.environ.get("BENCH_HISTORY") == "0" or out.get("skipped"):
+        return
+    path = os.environ.get("BENCH_HISTORY_PATH") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_HISTORY.jsonl"
+    )
+    entry = history_entry(
+        out,
+        round_tag=os.environ.get("BENCH_ROUND", ""),
+        time_unix=int(time.time()),  # ledger stamps are operator-correlatable wall clock
+    )
+    try:
+        with open(path, "a") as f:
+            f.write(json.dumps(entry) + "\n")
+    except OSError as e:
+        log(f"bench-history append failed ({e}); continuing")
+    else:
+        log(f"bench-history entry appended to {path}")
 
 
 if __name__ == "__main__":

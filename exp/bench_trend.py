@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""Bench-history trend gate (ISSUE 14 satellite, wired into CI beside
+exp/stage_gate.py).
+
+``bench.py`` appends each non-skipped round's headline + per-config
+scalar blocks to ``BENCH_HISTORY.jsonl`` (one JSON object per line).
+This gate reads the last K usable rounds and FAILS when the newest
+headline regressed more than ``--threshold`` (default 25%) below the
+MEDIAN of the preceding rounds — median, not max, so one lucky round on
+a quiet box cannot turn every successor red, and not newest-vs-previous
+alone, so a two-round noise dip does not slip through as the new
+baseline.
+
+Robustness rules (the stage-gate posture: a gate that cries wolf gets
+deleted):
+- entries with a null/zero headline never enter the window (bench.py
+  already refuses to append skipped rounds; this end double-checks);
+- fewer than 2 usable rounds passes with a notice — absence of history
+  is not a regression;
+- ``--backfill`` seeds the ledger from the repo's canonical
+  ``BENCH_rNN.json`` artifacts (skipped rounds excluded), deduped by
+  round tag, so the trajectory starts from the rounds that already
+  exist instead of an empty file.
+
+Usage:
+    python exp/bench_trend.py                    # gate the ledger
+    python exp/bench_trend.py --backfill         # seed from BENCH_rNN.json
+    python exp/bench_trend.py --last 8 --threshold 0.3
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+
+_CANONICAL_RE = re.compile(r"^BENCH_r(\d+)\.json$")
+
+
+def load_history(path: str) -> list[dict]:
+    """Ledger entries in file order; malformed lines are skipped with a
+    notice (a half-written line from a crashed bench run must not brick
+    the gate)."""
+    if not os.path.exists(path):
+        return []
+    out: list[dict] = []
+    with open(path, encoding="utf-8") as f:
+        for i, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                entry = json.loads(line)
+            except ValueError:
+                print(f"bench-trend: skipping malformed line {i} in {path}")
+                continue
+            if isinstance(entry, dict):
+                out.append(entry)
+    return out
+
+
+def usable_rounds(entries: list[dict]) -> list[dict]:
+    """Entries that carry a real headline (positive numeric value)."""
+    out = []
+    for e in entries:
+        v = e.get("value")
+        if isinstance(v, (int, float)) and v > 0:
+            out.append(e)
+    return out
+
+
+def check_trend(
+    entries: list[dict], last: int = 5, threshold: float = 0.25
+) -> tuple[bool, str]:
+    """(ok, message) over the last ``last`` usable rounds: the newest
+    must hold >= (1 - threshold) x median(previous rounds). Only rounds
+    measuring the SAME metric as the newest participate — a headline
+    redefinition (r01's kernel-rate metric vs the e2e metric) starts a
+    fresh trend line instead of comparing apples to oranges."""
+    rounds = usable_rounds(entries)
+    if rounds:
+        metric = rounds[-1].get("metric")
+        rounds = [e for e in rounds if e.get("metric") == metric]
+    rounds = rounds[-last:]
+    if len(rounds) < 2:
+        return True, (
+            f"bench-trend: {len(rounds)} usable round(s) in the window; "
+            "nothing to gate"
+        )
+    newest = rounds[-1]
+    prev = [float(e["value"]) for e in rounds[:-1]]
+    baseline = statistics.median(prev)
+    value = float(newest["value"])
+    floor = baseline * (1.0 - threshold)
+    tag = newest.get("round") or f"t={newest.get('time_unix')}"
+    if value < floor:
+        return False, (
+            f"bench-trend REGRESSION: newest headline ({tag}) "
+            f"{value:.1f} fell below {floor:.1f} "
+            f"(median of {len(prev)} prior round(s) {baseline:.1f}, "
+            f"threshold -{100 * threshold:.0f}%)"
+        )
+    return True, (
+        f"bench-trend: newest headline ({tag}) {value:.1f} vs prior-median "
+        f"{baseline:.1f} across {len(rounds)} round(s); within "
+        f"-{100 * threshold:.0f}%"
+    )
+
+
+def backfill(repo: str, history_path: str) -> int:
+    """Seed the ledger from the canonical BENCH_rNN.json artifacts in
+    round order, skipping rounds already present (by tag) and rounds
+    with no usable headline. Returns the number appended. Entries come
+    from bench.history_entry — the ONE ledger schema, shared with the
+    live append in bench.append_history."""
+    sys.path.insert(0, repo)
+    from bench import history_entry
+    have = {
+        e.get("round")
+        for e in load_history(history_path)
+        if e.get("round")
+    }
+    files = []
+    for f in glob.glob(os.path.join(repo, "BENCH_*.json")):
+        m = _CANONICAL_RE.match(os.path.basename(f))
+        if m is not None:
+            files.append((int(m.group(1)), f))
+    appended = 0
+    with open(history_path, "a", encoding="utf-8") as out:
+        for _num, f in sorted(files):
+            tag = os.path.splitext(os.path.basename(f))[0]
+            if tag in have:
+                continue
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+            except (OSError, ValueError) as e:
+                print(f"bench-trend: skipping unreadable {f}: {e}")
+                continue
+            if isinstance(doc.get("parsed"), dict):
+                # driver-wrapped artifact: {"n","cmd","rc","tail","parsed"}
+                doc = doc["parsed"]
+            value = doc.get("value")
+            if not isinstance(value, (int, float)) or value <= 0:
+                print(f"bench-trend: {tag} has no usable headline; skipped")
+                continue
+            entry = history_entry(
+                doc, round_tag=tag, time_unix=int(os.path.getmtime(f))
+            )
+            out.write(json.dumps(entry) + "\n")
+            appended += 1
+            print(f"bench-trend: backfilled {tag} (headline {value:.1f})")
+    return appended
+
+
+def main() -> int:
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "--history", default=os.path.join(repo, "BENCH_HISTORY.jsonl")
+    )
+    ap.add_argument("--repo", default=repo)
+    ap.add_argument("--last", type=int, default=5)
+    ap.add_argument("--threshold", type=float, default=0.25)
+    ap.add_argument(
+        "--backfill",
+        action="store_true",
+        help="seed the ledger from BENCH_rNN.json artifacts, then gate",
+    )
+    args = ap.parse_args()
+
+    if args.backfill:
+        n = backfill(args.repo, args.history)
+        print(f"bench-trend: backfill appended {n} round(s)")
+
+    entries = load_history(args.history)
+    if not entries:
+        print(
+            f"bench-trend: no history at {args.history}; run bench.py (or "
+            "--backfill) to start the ledger"
+        )
+        return 0
+    ok, msg = check_trend(entries, last=args.last, threshold=args.threshold)
+    print(msg)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""CI cluster-federation scrape gate (ISSUE 14): boot a 3-worker tree
+mesh in one process, drive a cross-worker burst (QoS0 passthrough AND
+QoS1 packet legs, so both forward encodings carry the origin's elapsed
+stamp), wait for the metric summaries to federate up the tree, then
+scrape the ROOT worker's ``GET /metrics/cluster`` and ``GET /healthz``
+and validate:
+
+- the federated exposition parses (telemetry.check_exposition), carries
+  ``worker``-labeled samples from every worker AND cluster-folded rows;
+- the remote-path delivery-latency SLI recorded nonzero samples on the
+  subscriber's worker and is visible from the root;
+- /healthz answers 200 with ok=true on a healthy mesh.
+
+The snapshot is written to disk and uploaded as a CI artifact — every
+run carries a real federated-scrape baseline.
+
+Usage: python exp/scrape_cluster.py [--out cluster-metrics-snapshot.txt]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import re
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_WORKERS = 3
+
+
+async def main(out_path: str) -> int:
+    from mqtt_tpu.cluster import Cluster
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+    from mqtt_tpu.telemetry import check_exposition
+
+    sock_dir = tempfile.mkdtemp(prefix="mqtt_tpu_scrape_cluster_")
+    servers = []
+    clusters = []
+    for i in range(N_WORKERS):
+        opts = Options(
+            telemetry_sample=1,  # sample everything: a short burst must land
+            cluster_topology="tree",
+            cluster_tree_degree=2,
+            slo_objectives=["p99 delivery < 5s over 30s/2m"],
+        )
+        srv = Server(opts)
+        srv.add_hook(AllowHook())
+        srv.add_listener(
+            TCP(LConfig(type="tcp", id=f"t{i}", address="127.0.0.1:0"))
+        )
+        if i == 0:
+            # the ROOT's scrape surface: /metrics/cluster + /healthz
+            srv.add_listener(
+                HTTPStats(
+                    LConfig(type="sysinfo", id="s0", address="127.0.0.1:0"),
+                    srv.info,
+                    telemetry=srv.telemetry,
+                    health=srv.health_report,
+                )
+            )
+        servers.append(srv)
+    try:
+        for srv in servers:
+            await srv.serve()
+        for i, srv in enumerate(servers):
+            c = Cluster(srv, i, N_WORKERS, sock_dir)
+            c.PING_INTERVAL_S = 0.2  # fast gossip/federation cadence
+            clusters.append(c)
+        for c in clusters:
+            await c.start()
+        loop = asyncio.get_event_loop()
+
+        async def wait_for(cond, timeout, what):
+            deadline = loop.time() + timeout
+            while loop.time() < deadline:
+                if cond():
+                    return True
+                await asyncio.sleep(0.05)
+            print(f"FAIL: timed out waiting for {what}", file=sys.stderr)
+            return False
+
+        if not await wait_for(
+            lambda: all(
+                all(p in c._writers for p in c.topo.neighbors())
+                for c in clusters
+            ),
+            20,
+            "tree links",
+        ):
+            return 1
+
+        def addr(i):
+            host, port = servers[i].listeners.get(f"t{i}").address().rsplit(":", 1)
+            return host, int(port)
+
+        # subscriber on worker 2, publisher on worker 0: every delivery
+        # crosses the mesh and lands in worker 2's remote-path SLI
+        host2, port2 = addr(2)
+        sr, sw = await asyncio.open_connection(host2, port2)
+        sw.write(_connect_bytes("fed-sub", version=4))
+        await sw.drain()
+        await sr.readexactly(4)
+        sw.write(_subscribe_bytes(1, "fed/#"))
+        await sw.drain()
+        await sr.readexactly(5)
+        # interest summaries must settle on the root's edges (tree mode
+        # replaces per-filter presence with counted blooms; forwards
+        # pass conservatively before this, so the wait is about making
+        # the scrape deterministic, not about deliverability)
+        if not await wait_for(
+            lambda: all(
+                p in clusters[0]._edge_summaries
+                for p in clusters[0].topo.neighbors()
+            ),
+            20,
+            "edge interest summaries",
+        ):
+            return 1
+
+        host0, port0 = addr(0)
+        pr, pw = await asyncio.open_connection(host0, port0)
+        pw.write(_connect_bytes("fed-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        # QoS0 leg: the v4 passthrough frames ride _T_RFRAME with the
+        # route json carrying the origin's elapsed stamp
+        for i in range(60):
+            topic = f"fed/{i % 5}".encode()
+            body = len(topic).to_bytes(2, "big") + topic + b"p%d" % i
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+        # QoS1 leg: decoded packets ride _T_PACKET with "el" in the head
+        for i in range(20):
+            topic = b"fed/q1"
+            payload = b"q%d" % i
+            body = (
+                len(topic).to_bytes(2, "big")
+                + topic
+                + (i + 1).to_bytes(2, "big")
+                + payload
+            )
+            pw.write(bytes([0x32, len(body)]) + body)
+        await pw.drain()
+
+        # the subscriber must actually receive the burst (frames flushed)
+        got = 0
+        deadline = loop.time() + 20
+        while got < 70 and loop.time() < deadline:
+            try:
+                data = await asyncio.wait_for(sr.read(65536), 3.0)
+            except asyncio.TimeoutError:
+                break
+            if not data:
+                break
+            got += data.count(b"fed/")
+        print(f"# delivered ~{got}/80 cross-worker publishes", file=sys.stderr)
+        if got == 0:
+            print("FAIL: no cross-worker deliveries", file=sys.stderr)
+            return 1
+
+        # remote-path SLI samples recorded on the subscriber's worker
+        tele2 = servers[2].telemetry
+        if not await wait_for(
+            lambda: any(
+                p == "remote" and h.count
+                for (_t, _q, p), h in tele2._delivery_cache.items()
+            ),
+            20,
+            "remote-path delivery samples",
+        ):
+            return 1
+
+        # federation: the root must hold BOTH children's summaries, and
+        # worker 2's copy must already carry the delivery samples
+        # recorded above (the next federation tick after the burst)
+        cm0 = servers[0].telemetry.cluster_metrics
+
+        def _w2_delivery_federated():
+            ent = (cm0.entries() if cm0 is not None else {}).get("2")
+            if ent is None:
+                return False
+            fam = ent["f"].get("mqtt_tpu_delivery_latency_seconds")
+            return bool(fam and fam.get("c"))
+
+        if not await wait_for(
+            lambda: cm0 is not None
+            and cm0.worker_count >= N_WORKERS - 1
+            and _w2_delivery_federated(),
+            30,
+            "federated summaries (incl. worker 2's delivery samples)",
+        ):
+            return 1
+
+        from scrapelib import http_get
+
+        http_addr = servers[0].listeners.get("s0").address()
+        head, body = await http_get(http_addr, "/metrics/cluster")
+        if b"200" not in head.split(b"\r\n", 1)[0]:
+            print(f"FAIL: /metrics/cluster -> {head!r}", file=sys.stderr)
+            return 1
+        text = body.decode()
+        samples = check_exposition(text)
+
+        # per-worker labels from every worker + the cluster fold
+        for wid in range(N_WORKERS):
+            if f'worker="{wid}"' not in text:
+                print(f"FAIL: no samples labeled worker={wid}", file=sys.stderr)
+                return 1
+        remote_counts = [
+            int(m.group(1))
+            for m in re.finditer(
+                r'^mqtt_tpu_delivery_latency_seconds_count\{[^}]*'
+                r'path="remote"[^}]*\} (\d+)$',
+                text,
+                re.M,
+            )
+        ]
+        if not remote_counts or max(remote_counts) == 0:
+            print(
+                "FAIL: no remote-path delivery-latency samples federated",
+                file=sys.stderr,
+            )
+            return 1
+        # the cluster FOLD: a delivery-latency count row with NO worker
+        # label must exist beside the per-worker rows
+        folded = re.search(
+            r"^mqtt_tpu_delivery_latency_seconds_count\{(?![^}]*worker=)"
+            r"[^}]*\} (\d+)$",
+            text,
+            re.M,
+        )
+        if folded is None:
+            print("FAIL: no cluster-folded delivery rows", file=sys.stderr)
+            return 1
+
+        head_h, body_h = await http_get(http_addr, "/healthz")
+        if b"200" not in head_h.split(b"\r\n", 1)[0]:
+            print(f"FAIL: /healthz -> {head_h!r}", file=sys.stderr)
+            return 1
+        health = json.loads(body_h)
+        if not health.get("ok"):
+            print(f"FAIL: /healthz not ok: {health}", file=sys.stderr)
+            return 1
+
+        head_s, body_s = await http_get(http_addr, "/cluster/slo")
+        if b"200" not in head_s.split(b"\r\n", 1)[0]:
+            print(f"FAIL: /cluster/slo -> {head_s!r}", file=sys.stderr)
+            return 1
+        slo = json.loads(body_s)
+        if not slo.get("local"):
+            print(f"FAIL: /cluster/slo has no local objectives", file=sys.stderr)
+            return 1
+
+        with open(out_path, "w") as f:
+            f.write(text)
+        print(
+            f"OK: {samples} federated samples; remote delivery counts "
+            f"{remote_counts}; {cm0.worker_count + 1} workers visible; "
+            f"snapshot -> {out_path}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        for c in clusters:
+            try:
+                await c.stop()
+            except Exception:
+                pass
+        for srv in servers:
+            try:
+                await srv.close()
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="cluster-metrics-snapshot.txt")
+    sys.exit(asyncio.run(main(ap.parse_args().out)))

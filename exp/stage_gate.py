@@ -18,12 +18,15 @@ Robustness rules (a gate that cries wolf gets deleted):
   device_batch never diffs against config 8's;
 - stage names present only in the CURRENT run — e.g. the trace plane's
   h2d/device_dispatch/d2h sub-stages against a round recorded before
-  the device_batch split, or the staging pipeline's per-leg waits
+  the device_batch split, the staging pipeline's per-leg waits
   (``leg_wait_h2d`` / ``leg_wait_d2h``) and the compaction d2h leg
   (``compact_d2h``) against a round recorded before the 3-deep
-  overlapped pipeline — pass through with a notice, never a failure: a
-  new sub-stage has no baseline to regress against (``device_batch``
-  stays populated as their sum for continuity);
+  overlapped pipeline, or the delivery-latency SLI rows
+  (``delivery_local`` / ``delivery_remote``, the ISSUE 14 per-path
+  folds of ``mqtt_tpu_delivery_latency_seconds``) against a round
+  recorded before the SLO observatory — pass through with a notice,
+  never a failure: a new stage has no baseline to regress against
+  (``device_batch`` stays populated as their sum for continuity);
 - stage names present only in the PREVIOUS run are reported as a
   retirement notice (renames are visible, never silently un-diffed)
   and never fail the gate;

@@ -159,6 +159,16 @@ _T_TFRAME = 0x54  # 'T' u16 origin_len | origin | u16 tlen | trace json | frame
 _T_EPOCH = 0x45  # 'E' json {e: [num, boot, proposer], m: {worker: boot}}
 _T_SUMMARY = 0x55  # 'U' json {e, g, all} | 0x00 | bloom bitset
 _T_RFRAME = 0x58  # 'X' u16 origin_len | origin | u16 rlen | route json | frame
+# metric federation (ISSUE 14): per-worker registry summaries ride the
+# mesh at gossip cadence — {"w": {worker: {b: boot, q: seq, f: fams}}}.
+# Tree mode folds per SUBTREE at each hop (a worker forwards its own
+# summary plus everything learned on child edges up to its parent, so
+# the root aggregates the whole mesh over O(depth) hops); all-pairs
+# mode broadcasts each worker's own summary. Old peers ignore the type.
+# Deliberately NOT a control type: summaries are orders of magnitude
+# bigger than pings/gossip, and counting them into control_bytes would
+# invalidate the drill's O(degree) control-plane-rate assertion.
+_T_METRICS = 0x4D  # 'M' json {w: {worker: {b, q, f}}}
 
 # control-plane frame types: byte volume is accounted (``control_bytes``,
 # the drill's O(degree) gossip-volume assertion) and presence/sync keep
@@ -320,6 +330,14 @@ class Cluster:
         self.summary_filtered_forwards = 0  # edges skipped by a fresh summary
         self.summary_passthrough_forwards = 0  # conservative sends on stale/absent summaries
         self.control_bytes = 0  # wire bytes spent on control-plane frames
+        # metric federation (ISSUE 14): the per-worker summary store fed
+        # by _T_METRICS frames (telemetry.ClusterMetrics; attached below
+        # when the telemetry plane is on), the outbound sequence stamp,
+        # and the frame accounting
+        self.metrics_fed: Optional[Any] = None
+        self._metrics_seq = 0
+        self.metrics_frames_tx = 0
+        self.metrics_frames_rx = 0
         if self.topology_mode == "tree":
             self.topo = Topology(
                 worker_id, range(n_workers), self.tree_degree, boot_id=self.boot_id
@@ -392,6 +410,44 @@ class Cluster:
                 "O(degree) gossip-volume number",
                 fn=lambda: self.control_bytes,
             )
+            if getattr(opts, "cluster_metrics", True):
+                # metric federation (ISSUE 14): per-worker registry
+                # summaries ride _T_METRICS at gossip cadence; the store
+                # renders GET /metrics/cluster and /cluster/slo at any
+                # worker that has aggregated them (the tree root sees
+                # the whole mesh)
+                from .telemetry import ClusterMetrics
+
+                cm = getattr(tele, "cluster_metrics", None)
+                if cm is None:
+                    cm = ClusterMetrics(
+                        max_age_s=float(
+                            getattr(opts, "cluster_metrics_max_age_s", 120.0)
+                            or 120.0
+                        )
+                    )
+                    tele.attach_cluster_metrics(cm)
+                self.metrics_fed = cm
+                # the federation label every local sample renders under
+                tele.local_worker = str(worker_id)
+                for direction, fn in (
+                    ("tx", lambda: self.metrics_frames_tx),
+                    ("rx", lambda: self.metrics_frames_rx),
+                ):
+                    r.counter(
+                        "mqtt_tpu_cluster_metrics_frames_total",
+                        "Mesh metric-federation frames (_T_METRICS) sent "
+                        "and accepted, by direction",
+                        fn=fn,
+                        direction=direction,
+                    )
+                r.gauge(
+                    "mqtt_tpu_cluster_metrics_workers",
+                    "Workers with a fresh federated metric summary in "
+                    "this worker's store (the tree root's count covers "
+                    "the mesh)",
+                    fn=lambda: cm.worker_count,
+                )
             if self.topo is not None:
                 topo = self.topo
                 r.gauge(
@@ -1218,6 +1274,14 @@ class Cluster:
         tracer = self._tracer()
         traced = tracer is not None and getattr(clock, "trace_id", None) is not None
         route = self._route_stamp()
+        if clock is not None:
+            # the route json already rides every _T_RFRAME, so ANY
+            # sampled clock (traced or not) contributes its origin
+            # elapsed stamp to the remote-path delivery SLI
+            route["el"] = round(time.perf_counter() - clock.t0, 6)
+            tid = getattr(clock, "trace_id", None)
+            if tid is not None:
+                route["tid"] = tid
         payload = b""
         if not traced:
             rj = json.dumps(route).encode()
@@ -1291,6 +1355,10 @@ class Cluster:
                 head["u"] = u
         tracer = self._tracer()
         clock = getattr(pk, "_tclock", None)
+        if clock is not None:
+            # origin elapsed-at-forward duration for the remote-path
+            # delivery SLI (see forward_packet)
+            head["el"] = round(time.perf_counter() - clock.t0, 6)
         traced = tracer is not None and getattr(clock, "trace_id", None) is not None
         payload = b"" if traced else json.dumps(head).encode() + b"\x00" + body_b
         tier_qos = 1 if retain else qos
@@ -1415,7 +1483,9 @@ class Cluster:
         if verdict == ROUTE_REFORWARD:
             return  # already delivered here under an older tree
         t0 = time.perf_counter()
-        self._deliver_frame(frame, origin)
+        self._deliver_frame(
+            frame, origin, el=rt.get("el"), tid=rt.get("tid")
+        )
         if rt.get("tid"):
             self._remote_span(
                 "remote_fanout",
@@ -1565,10 +1635,21 @@ class Cluster:
         aborted so the dial machinery re-runs) — asymmetric partitions,
         where writes still succeed but nothing comes back, are caught
         here rather than waiting for a socket error that never comes."""
+        metrics_tick = 0
+        # metric federation rides the gossip cadence, FLOOR-BOUNDED to
+        # ~1 frame/s per edge: a registry summary is orders of magnitude
+        # bigger than a ping, and the drill-grade fast clocks (0.1s
+        # pings, 32 workers on 2 cores) must not spend their CPU
+        # re-encoding an unchanged registry 10x a second
+        metrics_every = max(1, math.ceil(1.0 / self.PING_INTERVAL_S))
         while not self._stopping:
             await asyncio.sleep(self.PING_INTERVAL_S)
             self._gossip_now()
             self._send_summaries()  # tree mode: the summary refresh cadence
+            metrics_tick += 1
+            if metrics_tick >= metrics_every:
+                metrics_tick = 0
+                self._metrics_gossip_now()  # metric federation (ISSUE 14)
             if self.topo is not None:
                 # anti-entropy: a proposal flood can be LOST mid-storm
                 # (the link it rode was being severed), leaving two live
@@ -1719,6 +1800,87 @@ class Cluster:
                 self.control_bytes += len(payload) + 5
             except (ConnectionError, RuntimeError):
                 continue  # link teardown races: the dial loop heals it
+
+    # -- metric federation (ISSUE 14) --------------------------------------
+
+    def _metrics_gossip_now(self) -> None:
+        """Ship this worker's registry summary at gossip cadence. Tree
+        mode sends the per-SUBTREE fold — this worker's own summary plus
+        every entry learned on child edges — up to its parent only, so
+        the root aggregates the whole mesh over O(depth) hops while each
+        edge carries each worker's summary exactly once per tick.
+        All-pairs mode broadcasts the own summary to every peer (each
+        worker then holds the full mesh view). Frames ride the QoS>0
+        buffer tier (a storm is exactly when operators need the metrics
+        plane to keep federating) but are data-tier, never control."""
+        cm = self.metrics_fed
+        tele = getattr(self.server, "telemetry", None)
+        if cm is None or tele is None:
+            return
+        # resolve targets BEFORE building the summary: the tree root
+        # (and a worker with every target link dark) must not pay a
+        # full registry walk per tick just to throw it away
+        if self.topo is not None:
+            parent = self.topo.parent_of(self.worker_id)
+            if parent is None:
+                cm.entries()  # still age out dead children's summaries
+                return  # the root only aggregates; nothing flows upward
+            targets = [parent]
+        else:
+            targets = list(self._writers)
+        if not any(p in self._writers for p in targets):
+            return
+        self._metrics_seq += 1
+        workers: dict = {
+            str(self.worker_id): {
+                "b": self.boot_id,
+                "q": self._metrics_seq,
+                "f": tele.registry.summary(),
+            }
+        }
+        if self.topo is not None:
+            for wid, ent in cm.entries().items():
+                workers.setdefault(
+                    str(wid), {"b": ent["b"], "q": ent["q"], "f": ent["f"]}
+                )
+        payload = json.dumps({"w": workers}).encode()
+        for p in targets:
+            w = self._writers.get(p)
+            if w is None:
+                continue
+            try:
+                if self._send_nowait(p, w, _T_METRICS, payload, qos=1):
+                    self.metrics_frames_tx += 1
+            except (ConnectionError, RuntimeError):
+                continue  # link teardown races: the dial loop heals it
+
+    def _on_metrics(self, peer: int, payload: bytes) -> None:
+        """Ingest a peer's federated summaries; (boot, seq) keying makes
+        a re-delivered or reordered frame a no-op (counter folding stays
+        idempotent)."""
+        cm = self.metrics_fed
+        if cm is None:
+            return
+        try:
+            d = json.loads(payload)
+            workers = d.get("w")
+        except (ValueError, TypeError):
+            return  # a malformed frame must not kill the read loop
+        if not isinstance(workers, dict):
+            return
+        self.metrics_frames_rx += 1
+        for wid, ent in workers.items():
+            if str(wid) == str(self.worker_id) or not isinstance(ent, dict):
+                continue  # this worker's own summary never loops back in
+            fams = ent.get("f")
+            if not isinstance(fams, dict):
+                continue
+            try:
+                cm.ingest(
+                    str(wid), int(ent.get("b", 0)), int(ent.get("q", 0)), fams
+                )
+            except (ValueError, TypeError):
+                continue  # one bad entry must not drop its siblings
 
     def _dispatch_on_loop(self, fn: Callable[[], None]) -> None:
         """Run ``fn`` on the cluster's loop from ANY thread: inline when
@@ -2024,14 +2186,31 @@ class Cluster:
         ob = origin.encode()
         tracer = self._tracer()
         if tracer is None or getattr(clock, "trace_id", None) is None:
-            payload = struct.pack(">H", len(ob)) + ob + frame
+            if clock is not None:
+                # sampled-but-untraced publish: the origin's elapsed
+                # stamp still rides a _T_TFRAME json head (tid-less —
+                # the receiver's _remote_span no-ops, only the
+                # remote-path delivery SLI records), so the DEFAULT
+                # all-pairs topology federates remote QoS0 latency even
+                # with tracing off (tree mode's route json always did)
+                tj = json.dumps(
+                    {"el": round(time.perf_counter() - clock.t0, 6)}
+                ).encode()
+                payload = (
+                    struct.pack(">H", len(ob)) + ob
+                    + struct.pack(">H", len(tj)) + tj + frame
+                )
+                mtype = _T_TFRAME
+            else:
+                payload = struct.pack(">H", len(ob)) + ob + frame
+                mtype = _T_FRAME
             for p in peers:
                 w = self._writers.get(p)
                 if w is None:  # link down but interest not yet withdrawn
                     self._count_drop(p, partition=True)
                     continue
                 try:
-                    self._send_nowait(p, w, _T_FRAME, payload, qos=0)
+                    self._send_nowait(p, w, mtype, payload, qos=0)
                 except (ConnectionError, RuntimeError):
                     self._count_drop(p)
             return
@@ -2040,7 +2219,14 @@ class Cluster:
             # a fresh forward-span id per peer rides the wire: the
             # peer's remote_fanout span parents on exactly this one
             fsid = tracer.new_span_id()
-            tj = json.dumps({"tid": clock.trace_id, "sid": fsid}).encode()
+            tj = json.dumps(
+                {
+                    "tid": clock.trace_id,
+                    "sid": fsid,
+                    # origin elapsed-at-forward for the remote-path SLI
+                    "el": round(time.perf_counter() - clock.t0, 6),
+                }
+            ).encode()
             payload = prefix + struct.pack(">H", len(tj)) + tj + frame
             t0 = time.perf_counter()
             sent = False
@@ -2129,6 +2315,12 @@ class Cluster:
         # span id per peer; untraced publishes encode the payload once
         tracer = self._tracer()
         clock = getattr(pk, "_tclock", None)
+        if clock is not None:
+            # delivery-latency SLI (ISSUE 14): the origin's elapsed
+            # DURATION at forward time rides the head — monotonic clocks
+            # do not align cross-process, so only the duration travels;
+            # the receiver adds its own delivery segment (path=remote)
+            head["el"] = round(time.perf_counter() - clock.t0, 6)
         traced = tracer is not None and getattr(clock, "trace_id", None) is not None
         payload = b"" if traced else json.dumps(head).encode() + b"\x00" + body_b
         qos = pk.fixed_header.qos
@@ -2260,7 +2452,12 @@ class Cluster:
                     (tlen,) = struct.unpack(">H", payload[off : off + 2])
                     tr = json.loads(payload[off + 2 : off + 2 + tlen])
                     t0 = time.perf_counter()
-                    self._deliver_frame(payload[off + 2 + tlen :], origin)
+                    self._deliver_frame(
+                        payload[off + 2 + tlen :],
+                        origin,
+                        el=tr.get("el") if isinstance(tr, dict) else None,
+                        tid=tr.get("tid") if isinstance(tr, dict) else None,
+                    )
                     self._remote_span(
                         "remote_fanout", tr, t0, {"from_peer": peer}
                     )
@@ -2306,6 +2503,8 @@ class Cluster:
                     self._on_pong(peer, payload)
                 elif mtype == _T_GOSSIP:
                     self._on_gossip(peer, payload)
+                elif mtype == _T_METRICS:
+                    self._on_metrics(peer, payload)
                 elif mtype == _T_SYNC:
                     d = json.loads(payload)
                     self._apply_sync(peer, int(d["gen"]), d.get("boot"))
@@ -2317,13 +2516,32 @@ class Cluster:
             except Exception:
                 _log.exception("cluster delivery failed (peer %d)", peer)
 
-    def _deliver_frame(self, frame: bytes, origin: str) -> None:
+    def _deliver_frame(
+        self,
+        frame: bytes,
+        origin: str,
+        el: Any = None,
+        tid: Any = None,
+    ) -> None:
         """Deliver a forwarded v4 QoS0 frame to local subscribers through
         the server's fast-path plans; write ACL was enforced at the origin
-        worker, so only per-target read ACL applies here."""
+        worker, so only per-target read ACL applies here.
+
+        ``el`` is the origin worker's elapsed-at-forward stamp when the
+        frame rode a sampled publish (ISSUE 14): the whole local
+        delivery is timed around it and lands in the remote-path
+        delivery-latency SLI (frames are v4 QoS0 and never
+        tenant-scoped, so the label cell is the global namespace)."""
         from .server import publish_frame_body_offset
 
         s = self.server
+        tele = getattr(s, "telemetry", None)
+        timed = (
+            el is not None
+            and tele is not None
+            and getattr(tele, "delivery_sli", False)
+        )
+        t0 = time.perf_counter() if timed else 0.0
         if not s.fast_deliver_frame(frame, origin):
             # a local shared/inline/v5 case: decode and take the full path
             pk = Packet(
@@ -2333,10 +2551,44 @@ class Cluster:
             pk.origin = origin
             s._stamp_publish_expiry(pk)
             self._deliver_local(pk)
+        if timed:
+            try:
+                base = float(el)
+            except (TypeError, ValueError):
+                return
+            tele.observe_delivery(
+                base + time.perf_counter() - t0,
+                "",
+                0,
+                "remote",
+                trace_id=tid if isinstance(tid, str) else None,
+            )
 
     def _deliver_packet(self, head: dict, frame: bytes) -> None:
         from .server import publish_frame_body_offset
+        from .telemetry import RemoteStageClock
 
+        srv_tele = getattr(self.server, "telemetry", None)
+        clock = None
+        el = head.get("el")
+        if (
+            el is not None
+            and srv_tele is not None
+            and getattr(srv_tele, "delivery_sli", False)
+        ):
+            # receiving-side delivery clock (ISSUE 14): starts before
+            # the decode below so the remote-path SLI covers this
+            # worker's whole delivery segment; the origin's trace id
+            # (when present) joins the sample's exemplar to the
+            # cross-worker trace
+            tr = head.get("trace")
+            try:
+                clock = RemoteStageClock(
+                    float(el),
+                    tr.get("tid") if isinstance(tr, dict) else None,
+                )
+            except (TypeError, ValueError):
+                clock = None
         # publish_encode produced a full frame; decode wants only the body
         pk = Packet(
             fixed_header=FixedHeader(
@@ -2348,6 +2600,9 @@ class Cluster:
         pk.origin = head.get("origin", "")
         pk.created = head.get("created", 0)
         pk.expiry = head.get("expiry", 0)
+        if clock is not None:
+            clock.stamp("decode")
+            setattr(pk, "_tclock", clock)
         ns = head.get("ns")
         if ns:
             # tenant-scoped publish (mqtt_tpu.tenancy): the frame rode
@@ -2384,6 +2639,9 @@ class Cluster:
         s = self.server
         pk.packet_id = 0  # QoS state is owned per-worker per-subscriber
         s._fan_out(pk, s.topics.subscribers(pk.topic_name))
+        # remote-path delivery SLI: close the receiving-side clock a
+        # sampled forward attached in _deliver_packet (no-op without one)
+        s._finish_remote_clock(pk)
 
 
 def worker_env(

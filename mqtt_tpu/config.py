@@ -243,6 +243,14 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "profile_ring",
         "profile_locks",
         "profile_topics",
+        # cluster-wide SLO observatory: delivery-latency SLIs, the
+        # burn-rate engine, and mesh metric federation (mqtt_tpu.slo +
+        # mqtt_tpu.telemetry.ClusterMetrics)
+        "slo",
+        "slo_objectives",
+        "slo_burn_threshold",
+        "cluster_metrics",
+        "cluster_metrics_max_age_s",
     ):
         if k in top:
             setattr(opts, k, top[k])

@@ -120,17 +120,73 @@ class HTTPStats(_HttpListener):
     load the body straight into Perfetto), and the host profiler's
     exports at ``GET /profile`` (mqtt_tpu.profiling) — collapsed
     flamegraph text by default, ``?format=trace`` for the
-    Perfetto-loadable flame chart."""
+    Perfetto-loadable flame chart.
 
-    def __init__(self, config: Config, sys_info: Info, telemetry=None) -> None:
+    Cluster-wide SLO observatory surfaces (ISSUE 14): ``GET
+    /metrics/cluster`` renders the mesh-federated per-worker + folded
+    exposition (telemetry.ClusterMetrics — the tree root serves the
+    whole mesh), ``GET /cluster/slo`` the mesh-wide objective state
+    (local SLOEngine + federated slo gauges), and ``GET /healthz`` the
+    readiness probe (``health`` is the server's health_report; 200 when
+    ready, 503 with the failing components named when not)."""
+
+    def __init__(
+        self, config: Config, sys_info: Info, telemetry=None, health=None
+    ) -> None:
         super().__init__(config)
         self.sys_info = sys_info
         self.telemetry = telemetry
+        self.health = health
 
     def _respond(self, method: str, path: str):
         # known paths match on the bare path; the query string only
         # selects an export format (/profile?format=trace)
         path, _, query = path.partition("?")
+        if path == "/healthz":
+            if self.health is None:  # no server wired (bare listener)
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            ok, detail = self.health()
+            body = json.dumps(detail, indent=1).encode()
+            status = "200 OK" if ok else "503 Service Unavailable"
+            return status, body, "application/json", _NO_STORE
+        if path == "/metrics/cluster":
+            cm = getattr(self.telemetry, "cluster_metrics", None)
+            if cm is None:  # telemetry off, or federation disabled
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            body = cm.exposition(
+                self.telemetry.registry,
+                str(getattr(self.telemetry, "local_worker", "0")),
+            ).encode()
+            return (
+                "200 OK",
+                body,
+                "text/plain; version=0.0.4; charset=utf-8",
+                _NO_STORE,
+            )
+        if path == "/cluster/slo":
+            cm = getattr(self.telemetry, "cluster_metrics", None)
+            engine = getattr(self.telemetry, "slo", None)
+            if cm is None and engine is None:
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            out = {
+                "local": engine.state() if engine is not None else {},
+                "workers": (
+                    cm.slo_state(
+                        self.telemetry.registry,
+                        str(getattr(self.telemetry, "local_worker", "0")),
+                    )
+                    if cm is not None
+                    else {}
+                ),
+            }
+            body = json.dumps(out, indent=1).encode()
+            return "200 OK", body, "application/json", _NO_STORE
         if path == "/profile":
             profiler = getattr(self.telemetry, "host_profiler", None)
             if profiler is None:  # telemetry off, or the profiler disabled

@@ -200,6 +200,18 @@ class Topology:
         with self._lock:
             return dict(self._parents)
 
+    def parent_of(self, worker: int) -> Optional[int]:
+        """The worker's tree parent under the CURRENT epoch (None for
+        the root, or for a worker outside the adopted view) — the uphill
+        edge metric federation rides (cluster._metrics_gossip_now)."""
+        with self._lock:
+            return self._parents.get(worker)
+
+    def is_root(self) -> bool:
+        """Whether THIS worker is the current tree's aggregation root
+        (the scrape target for GET /metrics/cluster in tree mode)."""
+        return self.root() == self.worker_id
+
     def root(self) -> int:
         with self._lock:
             return min(self._view)

@@ -11,6 +11,7 @@ identical in shape to the reference's.
 from __future__ import annotations
 
 import asyncio
+import json
 import logging
 import threading
 import time
@@ -99,6 +100,7 @@ from .topics import (
     ns_local,
     ns_scope_filter,
     ns_scope_topic,
+    ns_tenant,
     split_predicate_suffix,
 )
 
@@ -444,6 +446,30 @@ class Options:
     # + avg-hits-per-topic, observed on stage-clock-sampled publishes);
     # 0 disables the sketch
     profile_topics: int = 512
+    # cluster-wide SLO observatory (ISSUE 14, mqtt_tpu.slo): the
+    # per-tenant delivery-latency SLI (publish arrival at decode ->
+    # frame flushed, riding the sampled stage clocks — the unsampled
+    # hot path pays nothing, the sampled path one dict probe) and the
+    # multi-window burn-rate engine over declared objectives. Default
+    # on; False disables SLI stamping AND the engine (the bench A/B
+    # arm).
+    slo: bool = True
+    # declarative objectives, e.g. ["p99 delivery < 50ms over 5m",
+    # "shed ratio < 0.1%"] — grammar in mqtt_tpu.slo; unparseable lines
+    # are logged and skipped, never fatal. None/empty = SLIs recorded,
+    # no engine.
+    slo_objectives: Optional[list] = None
+    # burn-rate level both windows must exceed to breach (1.0 = the
+    # budget is being spent exactly as fast as allowed)
+    slo_burn_threshold: float = 1.0
+    # mesh metric federation (mqtt_tpu.cluster _T_METRICS): per-worker
+    # registry summaries ride the mesh at gossip cadence with
+    # per-subtree fold; the tree root serves GET /metrics/cluster and
+    # /cluster/slo for the whole mesh. False disables send AND store.
+    cluster_metrics: bool = True
+    # federated summaries older than this age out of scrapes (a dead
+    # worker must not pin stale totals)
+    cluster_metrics_max_age_s: float = 120.0
 
     def ensure_defaults(self) -> None:
         """Sane defaults when unset (server.go:208-235)."""
@@ -840,6 +866,24 @@ class Server:
                 from .utils.locked import DEFAULT_PLANE
 
                 self.telemetry.attach_lock_plane(DEFAULT_PLANE)
+        # cluster-wide SLO observatory (ISSUE 14, mqtt_tpu.slo): the
+        # delivery-latency SLI gate plus the burn-rate engine when
+        # objectives are declared; evaluate() rides the housekeeping tick
+        self.slo: Optional[Any] = None
+        if self.telemetry is not None:
+            self.telemetry.delivery_sli = bool(opts.slo)
+            if opts.slo and opts.slo_objectives:
+                from .slo import SLOEngine, parse_objectives
+
+                objectives = parse_objectives(opts.slo_objectives)
+                if objectives:
+                    self.slo = SLOEngine(
+                        self.telemetry,
+                        objectives,
+                        burn_threshold=opts.slo_burn_threshold,
+                        publish=self._publish_slo_transition,
+                    )
+                    self.telemetry.attach_slo(self.slo)
         if opts.overload_control:
             from .overload import OverloadConfig, OverloadGovernor
 
@@ -1069,8 +1113,13 @@ class Server:
             if builder is not None:
                 if t == TYPE_SYSINFO:
                     # the stats listener also serves GET /metrics when
-                    # the telemetry plane is on (mqtt_tpu.telemetry)
-                    return builder(conf, self.info, self.telemetry)
+                    # the telemetry plane is on (mqtt_tpu.telemetry),
+                    # plus /healthz, /metrics/cluster and /cluster/slo
+                    # (ISSUE 14 — the SLO observatory's scrape surfaces)
+                    return builder(
+                        conf, self.info, self.telemetry,
+                        health=self.health_report,
+                    )
                 return builder(conf)
         self.log.error("listener type unavailable by config: %s", conf.type)
         return None
@@ -1195,6 +1244,15 @@ class Server:
             self.send_delayed_lwt(now)
             self.clear_expired_inflights(now)
             self.sweep_overload()
+            if self.slo is not None:
+                # SLO burn-rate evaluation rides the housekeeping tick
+                # (mqtt_tpu.slo): a handful of histogram-children walks
+                # per second, transitions publish $SYS + dump from here
+                # (the event-loop context the $SYS publisher requires)
+                try:
+                    self.slo.evaluate()
+                except Exception:
+                    self.log.exception("SLO evaluation failed")
             if time.monotonic() >= next_sys:
                 self.publish_sys_topics()
                 next_sys = time.monotonic() + sys_interval
@@ -1323,6 +1381,101 @@ class Server:
                     else getattr(self.matcher.stats, f, 0)
                 ),
             )
+
+    def _publish_slo_transition(self, name: str, payload: dict) -> None:
+        """Publish one objective's breach/recovery as a retained
+        ``$SYS/broker/slo/<name>`` message (mqtt_tpu.slo calls this on
+        transitions only, from the housekeeping tick's event-loop
+        context — the same path the periodic $SYS publisher uses)."""
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
+            created=int(time.time()),  # brokerlint: ok=R3 $SYS transition stamps are wall-clock (operator-correlatable)
+        )
+        pk.topic_name = SYS_PREFIX + "/broker/slo/" + name
+        pk.payload = json.dumps(payload).encode()
+        self.topics.retain_message(pk.copy(False))
+        self.publish_to_subscribers(pk)
+
+    def health_report(self) -> tuple[bool, dict]:
+        """The ``GET /healthz`` readiness snapshot (ISSUE 14 satellite).
+
+        503 (not ready) only for conditions under which the broker
+        should be pulled from rotation: draining/shutdown, a governor
+        in SHED, or a dead staging pipeline. A tripped matcher breaker
+        or dark mesh edges DEGRADE (reported in the body, readiness
+        holds) — the broker still serves through its fallback paths,
+        and flapping a load balancer on a self-healing breaker would
+        amplify the incident."""
+        not_ready: list[str] = []
+        degraded: list[str] = []
+        detail: dict = {}
+        if self._draining or self.done.is_set():
+            not_ready.append("draining")
+        gov = self.overload
+        if gov is not None:
+            from .overload import SHED
+
+            detail["governor"] = {
+                "state": str(gov.state),
+                "pressure": round(gov.pressure, 4),
+            }
+            if gov.state == SHED:
+                not_ready.append("governor_shed")
+        stage = self._stage
+        if stage is not None:
+            alive = stage.alive()
+            detail["staging"] = {
+                "alive": alive,
+                "pending": stage.pending_depth,
+                "inflight": stage.inflight_batches,
+            }
+            if not alive:
+                not_ready.append("staging_dead")
+        if self.matcher is not None:
+            breaker = getattr(self.matcher, "breaker", None)
+            if breaker is not None:
+                state = str(breaker.state)
+                detail["matcher_breaker"] = {"state": state}
+                if state != "closed":
+                    degraded.append("matcher_breaker_" + state)
+        c = self._cluster
+        if c is not None:
+            from .cluster import PEER_PARTITIONED
+
+            ch: dict = {"worker": c.worker_id, "peers": c.peer_count}
+            partitioned = sorted(
+                p
+                for p, ph in c._health.items()
+                if ph.state == PEER_PARTITIONED
+            )
+            if partitioned:
+                ch["partitioned_peers"] = partitioned
+                degraded.append("cluster_partitioned_peers")
+            if c.topo is not None:
+                neighbors = c.topo.neighbors()
+                links = sum(1 for p in neighbors if p in c._writers)
+                ch["epoch"] = c.topo.epoch_num()
+                ch["tree_links"] = links
+                ch["tree_neighbors"] = len(neighbors)
+                ch["is_root"] = c.topo.is_root()
+                if links < len(neighbors):
+                    degraded.append("cluster_tree_edges_down")
+            detail["cluster"] = ch
+        if self.slo is not None:
+            breached = sorted(
+                name
+                for name, st in self.slo.state().items()
+                if st.get("breached")
+            )
+            detail["slo"] = {"objectives": len(self.slo.objectives)}
+            if breached:
+                detail["slo"]["breached"] = breached
+                degraded.append("slo_breached")
+        ok = not not_ready
+        detail["ok"] = ok
+        detail["not_ready"] = not_ready
+        detail["degraded"] = degraded
+        return ok, detail
 
     def _overload_transition(self, old: str, new: str) -> None:
         """Governor transition observer: entering SHED dumps the flight
@@ -2200,7 +2353,10 @@ class Server:
     def _finish_publish_clock(self, pk: Packet) -> None:
         """Close out a sampled publish's stage clock after fan-out: the
         final stamp is the fanout write leg, then the record lands in
-        the per-stage histograms + flight-recorder ring."""
+        the per-stage histograms + flight-recorder ring — and the
+        arrival->flush total lands in the per-tenant delivery-latency
+        SLI (path=local), the number the SLO engine burns against
+        (ISSUE 14)."""
         clock = getattr(pk, "_tclock", None)
         if clock is not None:
             setattr(pk, "_tclock", None)  # a clock observes exactly once
@@ -2213,6 +2369,40 @@ class Server:
             self.telemetry.observe_publish(
                 clock, pk.topic_name, pk.fixed_header.qos
             )
+            self._observe_delivery_sli(clock, pk, "local")
+
+    def _observe_delivery_sli(self, clock, pk: Packet, path: str) -> None:
+        """Fold one finished clock into the delivery-latency SLI: the
+        tenant label comes off the scoped topic, the value is the
+        clock's decode->flush total plus (remote path) the origin
+        worker's elapsed stamp."""
+        tele = self.telemetry
+        if tele is None or not tele.delivery_sli:
+            return
+        topic = pk.topic_name
+        tenant = ns_tenant(topic) if topic[:1] == NS_CHAR else ""
+        tele.observe_delivery(
+            clock.total() + getattr(clock, "remote_base", 0.0),
+            tenant,
+            pk.fixed_header.qos,
+            path,
+            trace_id=getattr(clock, "trace_id", None),
+        )
+
+    def _finish_remote_clock(self, pk: Packet) -> None:
+        """Close a mesh-forwarded publish's receiving-side clock
+        (telemetry.RemoteStageClock, attached by cluster delivery): the
+        remote-path delivery SLI reads origin-elapsed + local segment.
+        Never routed through observe_publish — remote deliveries must
+        not skew this worker's pipeline-stage histograms or flight
+        ring."""
+        clock = getattr(pk, "_tclock", None)
+        if clock is None:
+            return
+        setattr(pk, "_tclock", None)
+        if not any(s in ("encode", "flush") for s, _ in clock.stages):
+            clock.stamp("fanout")
+        self._observe_delivery_sli(clock, pk, "remote")
 
     async def _staged_fan_out(self, cl: Client, pk: Packet) -> None:
         """Fan out one publish through the staging loop: the device match
@@ -2458,6 +2648,17 @@ class Server:
         if clock is not None:
             clock.stamp("fanout")
             self.telemetry.observe_publish(clock, topic, 0)
+            if self.telemetry.delivery_sli:
+                # the passthrough leg's delivery SLI: tenants never ride
+                # this path (fast_publish_eligible), so the label is the
+                # global namespace
+                self.telemetry.observe_delivery(
+                    clock.total(),
+                    "",
+                    0,
+                    "local",
+                    trace_id=getattr(clock, "trace_id", None),
+                )
         return True
 
     def _plan_for_topic(self, topic: str):

@@ -311,6 +311,16 @@ class MatchStage:
     def pending_depth(self) -> int:
         return len(self._pending)
 
+    def alive(self) -> bool:
+        """Pipeline liveness for ``GET /healthz`` (ISSUE 14 satellite):
+        started, not stopping, and BOTH loop tasks still running — a
+        crashed collector/drainer would otherwise strand every parked
+        publish until its caller's timeout, which is exactly the state
+        a readiness probe must surface."""
+        if self._stopping or self._wake is None:
+            return False
+        return bool(self._tasks) and all(not t.done() for t in self._tasks)
+
     def pressure(self) -> float:
         """Normalized staging pressure for the overload governor: parked
         admission depth against its cap, plus the batch queue's fill at

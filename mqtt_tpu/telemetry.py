@@ -81,6 +81,14 @@ FANOUT_SUBSTAGES = ("encode", "flush")
 # traces, and adoption of client-supplied ids — mqtt_tpu.tracing)
 TRACE_USER_PROPERTY = "trace-id"
 
+# delivery-path labels on the per-tenant delivery-latency SLI
+# (ISSUE 14): "local" is arrival-at-decode -> frame-flush on one
+# worker; "remote" is the origin worker's elapsed stamp plus the
+# receiving worker's delivery segment (network transit between the two
+# is not measurable without synced clocks — the trace plane joins the
+# two segments by id instead)
+DELIVERY_PATHS = ("local", "remote")
+
 
 def _fmt(v) -> str:
     """A Prometheus-compatible number: integral floats render without
@@ -210,6 +218,19 @@ class Histogram:
             self.counts[i] += c
         self.count += other.count
         self.sum += other.sum
+
+    def count_le(self, v: float) -> int:
+        """Observations in buckets whose upper bound is <= ``v`` — the
+        'good event' count for a latency SLO threshold. The threshold is
+        snapped DOWN to the largest bucket bound at or below it, so an
+        off-bucket threshold errs toward counting borderline
+        observations as bad (an SLO gate should alarm early, not late —
+        mqtt_tpu.slo)."""
+        # bisect_right-style: first bound strictly greater than v
+        i = bisect_left(self.bounds, v)
+        if i < len(self.bounds) and self.bounds[i] == v:
+            i += 1
+        return sum(self.counts[:i])
 
     def summary(self) -> dict:
         return {
@@ -435,6 +456,54 @@ class MetricsRegistry:
                             out[f"{base}/{q}"] = round(s[q], 6)
         return out
 
+    def family_children(self, name: str) -> list:
+        """Snapshot of one family's ``(label-key, child)`` pairs (the
+        SLO engine walks the delivery-latency family through this — the
+        children themselves are read lock-free, like exposition())."""
+        with self._lock:
+            fam = self._families.get(name)
+            return [] if fam is None else list(fam.children.items())
+
+    def summary(self) -> dict:
+        """The wire summary one worker contributes to mesh metric
+        federation (ISSUE 14, cluster ``_T_METRICS`` frames): every
+        family's type plus per-child values — counters/gauges as
+        numbers, histograms as ``{n, s, c}`` (count, sum, bucket-count
+        vector with trailing zeros trimmed) beside the family's shared
+        ``le`` bounds. Values are ABSOLUTE cumulative snapshots, not
+        deltas: the receiver keys them by (worker, boot, seq), so a
+        re-delivered or reordered frame can never double-count and a
+        restarted worker's reset counters simply replace its entry."""
+        with self._lock:
+            families = sorted(self._families.items())
+        fams: dict[str, dict] = {}
+        for name, fam in families:
+            children: list = []
+            bounds: Optional[list] = None
+            for key, child in sorted(fam.children.items()):
+                labels = [[k, v] for k, v in key]
+                if isinstance(child, Counter):
+                    children.append([labels, child.value])
+                elif isinstance(child, Gauge):
+                    children.append([labels, child.value()])
+                else:
+                    h = child.live()
+                    if bounds is None:
+                        bounds = list(h.bounds)
+                    elif list(h.bounds) != bounds:
+                        continue  # a mixed-layout child cannot fold
+                    counts = list(h.counts)
+                    while counts and counts[-1] == 0:
+                        counts.pop()
+                    children.append(
+                        [labels, {"n": h.count, "s": round(h.sum, 9), "c": counts}]
+                    )
+            entry: dict = {"t": fam.mtype, "c": children}
+            if fam.mtype == "histogram" and bounds is not None:
+                entry["le"] = bounds
+            fams[name] = entry
+        return fams
+
 
 class StageClock:
     """One sampled publish's trip through the pipeline: ``stamp(stage)``
@@ -466,6 +535,26 @@ class StageClock:
 
     def total(self) -> float:
         return self.last - self.t0
+
+
+class RemoteStageClock(StageClock):
+    """The receiving-side stage clock of a mesh-forwarded publish
+    (ISSUE 14): carries the origin worker's elapsed-at-forward stamp
+    (``el`` on the frame head) so the remote-path delivery SLI reads
+    origin-segment + local-segment, and the origin's trace id (when the
+    forward was traced) so the sample's histogram exemplar joins the
+    cross-worker trace. Never routed through observe_publish — remote
+    deliveries must not skew the local pipeline-stage histograms or the
+    flight ring; only the delivery-latency family sees them."""
+
+    __slots__ = ("remote_base", "trace_id")
+
+    def __init__(
+        self, remote_base: float = 0.0, trace_id: Optional[str] = None
+    ) -> None:
+        super().__init__()
+        self.remote_base = remote_base
+        self.trace_id = trace_id
 
 
 class FlightRecorder:
@@ -640,6 +729,18 @@ class Telemetry:
         # the lock-contention plane (mqtt_tpu.utils.locked.LockPlane)
         # or None; attached via attach_lock_plane()
         self.lock_plane: Any = None
+        # cluster-wide SLO observatory (ISSUE 14): the delivery-latency
+        # SLI gate (one bool test on the sampled path; Options.slo), the
+        # SLO burn-rate engine (mqtt_tpu.slo.SLOEngine) and the mesh
+        # metric-federation store (ClusterMetrics, attached by the
+        # cluster so /metrics/cluster and /cluster/slo can render)
+        self.delivery_sli = True
+        self._delivery_cache: dict[tuple, Histogram] = {}
+        self.slo: Any = None
+        self.cluster_metrics: Any = None
+        # this worker's id as a federation label (the cluster stamps it
+        # when it attaches; single-worker brokers render as "0")
+        self.local_worker = "0"
         self.recorder = FlightRecorder(
             size=ring, dump_dir=dump_dir, min_interval_s=dump_min_interval_s
         )
@@ -738,6 +839,77 @@ class Telemetry:
             "GIL-released batched socket flush calls issued by the "
             "fan-out write path",
         )
+
+    # -- delivery-latency SLIs (ISSUE 14) ----------------------------------
+
+    def delivery_hist(self, tenant: str, qos: int, path: str) -> Histogram:
+        """The labeled delivery-latency child for one (tenant, qos,
+        path) cell, cached so the sampled path pays one dict probe
+        instead of the registry lock."""
+        key = (tenant, qos, path)
+        h = self._delivery_cache.get(key)
+        if h is None:
+            h = self.registry.histogram(
+                "mqtt_tpu_delivery_latency_seconds",
+                "Publish arrival (decode) to frame flushed toward the "
+                "subscriber socket, by tenant, publish QoS and delivery "
+                "path (sampled 1-in-N; path=remote adds the origin "
+                "worker's elapsed stamp to the receiving segment)",
+                tenant=tenant,
+                qos=str(qos),
+                path=path,
+            )
+            if self.registry.emit_exemplars:
+                h.enable_exemplars()
+            self._delivery_cache[key] = h
+        return h
+
+    def observe_delivery(
+        self,
+        seconds: float,
+        tenant: str,
+        qos: int,
+        path: str,
+        trace_id: Optional[str] = None,
+    ) -> None:
+        """Record one sampled publish's arrival->flush delivery latency
+        — the headline SLI the SLO engine burns against (mqtt_tpu.slo).
+        Disabled (one bool test) when Options.slo is off."""
+        if not self.delivery_sli:
+            return
+        self.delivery_hist(tenant, qos, path).observe(seconds, trace_id)
+
+    def delivery_summary(self) -> dict:
+        """Per-path delivery-latency fold across every (tenant, qos)
+        cell — the bench/stage-gate face of the SLI family (rows
+        ``delivery_local`` / ``delivery_remote`` in bench_block)."""
+        out: dict = {}
+        for path in DELIVERY_PATHS:
+            merged: Optional[Histogram] = None
+            for (_t, _q, p), h in list(self._delivery_cache.items()):
+                if p != path or not h.count:
+                    continue
+                if merged is None:
+                    merged = Histogram(bounds=h.bounds)
+                merged.merge(h)
+            if merged is not None and merged.count:
+                out[f"delivery_{path}"] = {
+                    "count": merged.count,
+                    "p50_ms": round(merged.percentile(0.5) * 1e3, 3),
+                    "p99_ms": round(merged.percentile(0.99) * 1e3, 3),
+                }
+        return out
+
+    def attach_slo(self, engine: Any) -> None:
+        """Attach the SLO burn-rate engine (mqtt_tpu.slo.SLOEngine):
+        GET /cluster/slo serves its state beside the federated view."""
+        self.slo = engine
+
+    def attach_cluster_metrics(self, cm: Any) -> None:
+        """Attach the mesh metric-federation store (ClusterMetrics,
+        fed by cluster ``_T_METRICS`` frames): GET /metrics/cluster
+        renders the per-worker + cluster-folded exposition from it."""
+        self.cluster_metrics = cm
 
     # -- publish stage sampling --------------------------------------------
 
@@ -1059,6 +1231,10 @@ class Telemetry:
                     "p50_ms": round(h.percentile(0.5) * 1e3, 3),
                     "p99_ms": round(h.percentile(0.99) * 1e3, 3),
                 }
+        # delivery-latency SLI rows (ISSUE 14): per-path folds render as
+        # stage rows so exp/stage_gate.py diffs them round over round
+        # (their first round passes through its new_stage_names notice)
+        stages.update(self.delivery_summary())
         fill = self.batch_fill.summary()
         return {
             "stages": stages,
@@ -1071,6 +1247,248 @@ class Telemetry:
             "fallbacks": {k: c.value for k, c in self.fallback.items()},
             "flight_dumps": self.recorder.dumps,
         }
+
+
+class ClusterMetrics:
+    """Mesh-federated metric summaries (ISSUE 14): the per-worker
+    registry snapshots that ride cluster ``_T_METRICS`` frames, stored
+    latest-wins per (worker, boot incarnation, sequence) and rendered
+    as ONE Prometheus exposition at ``GET /metrics/cluster`` — every
+    sample with a ``worker`` label, plus pre-folded cluster totals
+    (counters summed, histogram bucket vectors added) with no worker
+    label, so the 32-worker drill is scrapable from the root alone.
+
+    Idempotence: entries carry absolute cumulative values keyed by
+    (boot, seq) — a re-delivered or reordered frame is a no-op, and a
+    restarted worker's fresh boot nonce replaces its dead incarnation.
+    Entries older than ``max_age_s`` age out of scrapes (a dead worker
+    must not pin stale totals forever).
+
+    Loop-affine by design: ingest runs on the cluster's event loop and
+    the HTTP scrape handlers run on the same loop, so no lock is needed
+    (the multi-process drill gives each worker its own store)."""
+
+    def __init__(
+        self,
+        max_age_s: float = 120.0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.max_age_s = max_age_s
+        self.clock = clock
+        # worker id -> {"b": boot, "q": seq, "f": fams, "at": monotonic}
+        self._workers: dict[str, dict] = {}
+        self.frames_ingested = 0  # accepted summary entries
+        self.frames_stale = 0  # re-delivered/reordered entries dropped
+
+    def ingest(
+        self,
+        worker: str,
+        boot: int,
+        seq: int,
+        fams: dict,
+        now: Optional[float] = None,
+    ) -> bool:
+        """Store one worker's summary; False = already have this (or a
+        newer) snapshot from the same incarnation — the re-delivery
+        no-op that keeps counter folding idempotent."""
+        now = self.clock() if now is None else now
+        cur = self._workers.get(worker)
+        if cur is not None and cur["b"] == boot and seq <= cur["q"]:
+            self.frames_stale += 1
+            return False
+        self._workers[worker] = {"b": boot, "q": seq, "f": fams, "at": now}
+        self.frames_ingested += 1
+        return True
+
+    def entries(self, now: Optional[float] = None) -> dict[str, dict]:
+        """Fresh per-worker entries (aged ones pruned in place) — also
+        what an intermediate tree hop forwards up toward the root (the
+        per-subtree fold: its own summary plus everything learned on
+        child edges)."""
+        now = self.clock() if now is None else now
+        for wid in [
+            w
+            for w, e in self._workers.items()
+            if now - e["at"] > self.max_age_s
+        ]:
+            del self._workers[wid]
+        return dict(self._workers)
+
+    @property
+    def worker_count(self) -> int:
+        # through entries() so aged-out workers prune here too: the
+        # mqtt_tpu_cluster_metrics_workers gauge is often the ONLY
+        # reader on a worker nobody scrapes (the root never sends
+        # uphill), and a dead worker must drop out of it on time
+        return len(self.entries())
+
+    # -- rendering ---------------------------------------------------------
+
+    @staticmethod
+    def _label_str(pairs: list, extra: str = "") -> str:
+        # one label-rendering rule for both expositions: wire labels
+        # (json round-tripped) coerce to str, then the registry's own
+        # formatter applies the escaping
+        return MetricsRegistry._labels_str(
+            tuple((str(k), str(v)) for k, v in pairs), extra
+        )
+
+    def _sources(
+        self, local_registry: Optional["MetricsRegistry"], local_worker: str
+    ) -> dict[str, dict]:
+        """worker id -> family summary, the local registry's LIVE
+        summary shadowing any stale federated copy of this worker."""
+        sources: dict[str, dict] = {}
+        for wid, ent in sorted(self.entries().items()):
+            sources[str(wid)] = ent["f"]
+        if local_registry is not None:
+            sources[str(local_worker)] = local_registry.summary()
+        return sources
+
+    def exposition(
+        self,
+        local_registry: Optional["MetricsRegistry"] = None,
+        local_worker: str = "0",
+    ) -> str:
+        """The federated Prometheus text exposition: per-worker samples
+        labeled ``worker="<id>"`` plus cluster-folded totals (counters
+        and histograms only — point-in-time gauges do not fold
+        meaningfully) carrying no worker label in the same family."""
+        sources = self._sources(local_registry, local_worker)
+        # family name -> {"t": type, "le": bounds, "rows": [...]}
+        fams: dict[str, dict] = {}
+        for wid, summary in sources.items():
+            if not isinstance(summary, dict):
+                continue
+            for name, ent in summary.items():
+                if not isinstance(ent, dict) or not _NAME_RE.match(name):
+                    continue
+                fam = fams.setdefault(
+                    name, {"t": ent.get("t"), "le": ent.get("le"), "rows": []}
+                )
+                if fam["t"] != ent.get("t"):
+                    continue  # cross-worker type conflict: first type wins
+                if (
+                    ent.get("t") == "histogram"
+                    and ent.get("le") != fam["le"]
+                ):
+                    # cross-worker bucket-layout skew (a mid-upgrade
+                    # mesh): index-wise adding counts against mismatched
+                    # bounds would render silently-wrong folds — skip
+                    # this worker's children for the family instead
+                    # (the same posture summary() takes within a worker)
+                    continue
+                for child in ent.get("c") or []:
+                    if not isinstance(child, (list, tuple)) or len(child) != 2:
+                        continue
+                    labels, value = child
+                    fam["rows"].append((wid, list(labels), value))
+        out: list[str] = []
+        for name in sorted(fams):
+            fam = fams[name]
+            mtype = fam["t"]
+            if mtype not in ("counter", "gauge", "histogram"):
+                continue
+            out.append(f"# TYPE {name} {mtype}")
+            folds: dict[tuple, Any] = {}
+            for wid, labels, value in sorted(
+                fam["rows"], key=lambda r: (r[1], r[0])
+            ):
+                wl = labels + [["worker", wid]]
+                if mtype == "histogram":
+                    if not isinstance(value, dict):
+                        continue
+                    self._render_hist(out, name, wl, fam["le"], value)
+                    key = tuple((str(k), str(v)) for k, v in labels)
+                    agg = folds.get(key)
+                    if agg is None:
+                        folds[key] = {
+                            "n": int(value.get("n", 0)),
+                            "s": float(value.get("s", 0.0)),
+                            "c": list(value.get("c") or []),
+                        }
+                    else:
+                        agg["n"] += int(value.get("n", 0))
+                        agg["s"] += float(value.get("s", 0.0))
+                        counts = list(value.get("c") or [])
+                        if len(counts) > len(agg["c"]):
+                            agg["c"].extend(
+                                [0] * (len(counts) - len(agg["c"]))
+                            )
+                        for i, c in enumerate(counts):
+                            agg["c"][i] += c
+                elif isinstance(value, (int, float)):
+                    out.append(
+                        f"{name}{self._label_str(wl)} {_fmt(value)}"
+                    )
+                    if mtype == "counter":
+                        key = tuple((str(k), str(v)) for k, v in labels)
+                        folds[key] = folds.get(key, 0) + value
+            # pre-folded cluster totals (no worker label, same family)
+            for key in sorted(folds):
+                pairs = [list(kv) for kv in key]
+                agg = folds[key]
+                if mtype == "histogram":
+                    self._render_hist(out, name, pairs, fam["le"], agg)
+                else:
+                    out.append(
+                        f"{name}{self._label_str(pairs)} {_fmt(folds[key])}"
+                    )
+        return "\n".join(out) + "\n"
+
+    def _render_hist(
+        self, out: list, name: str, pairs: list, bounds: Any, value: dict
+    ) -> None:
+        if not isinstance(bounds, list):
+            return
+        counts = list(value.get("c") or [])
+        counts.extend([0] * (len(bounds) + 1 - len(counts)))
+        acc = 0
+        for i, bound in enumerate(bounds):
+            acc += counts[i]
+            le = self._label_str(pairs, f'le="{_fmt(float(bound))}"')
+            out.append(f"{name}_bucket{le} {acc}")
+        le = self._label_str(pairs, 'le="+Inf"')
+        out.append(f"{name}_bucket{le} {_fmt(int(value.get('n', 0)))}")
+        out.append(
+            f"{name}_sum{self._label_str(pairs)} "
+            f"{_fmt(float(value.get('s', 0.0)))}"
+        )
+        out.append(
+            f"{name}_count{self._label_str(pairs)} "
+            f"{_fmt(int(value.get('n', 0)))}"
+        )
+
+    def slo_state(
+        self,
+        local_registry: Optional["MetricsRegistry"] = None,
+        local_worker: str = "0",
+    ) -> dict:
+        """Mesh-wide SLO objective state for ``GET /cluster/slo``: every
+        worker's ``mqtt_tpu_slo_*`` gauge values keyed by worker id —
+        the federated face of each worker's own SLOEngine gauges."""
+        out: dict = {}
+        for wid, summary in self._sources(local_registry, local_worker).items():
+            rows: dict = {}
+            if isinstance(summary, dict):
+                for name, ent in summary.items():
+                    if not name.startswith("mqtt_tpu_slo_"):
+                        continue
+                    for child in (ent or {}).get("c") or []:
+                        if (
+                            not isinstance(child, (list, tuple))
+                            or len(child) != 2
+                            or not isinstance(child[1], (int, float))
+                        ):
+                            continue
+                        labels, value = child
+                        suffix = ",".join(
+                            f"{k}={v}" for k, v in sorted(map(tuple, labels))
+                        )
+                        rows[f"{name}{{{suffix}}}" if suffix else name] = value
+            if rows:
+                out[wid] = rows
+        return out
 
 
 def check_exposition(text: str) -> int:

@@ -194,7 +194,13 @@ class Tracer:
             t += dt
         spans.append(
             ("publish", "publish", trace.trace_id, trace.span_id, None,
-             trace.t0, trace.total(), {"topic": topic, "qos": qos})
+             trace.t0, trace.total(),
+             # the root span carries the delivery SLI headline (ISSUE
+             # 14): a Perfetto view of a breach exemplar shows the same
+             # arrival->flush number the delivery-latency histogram
+             # recorded, with the stage breakdown nested under it
+             {"topic": topic, "qos": qos,
+              "delivery_ms": round(trace.total() * 1e3, 3)})
         )
         with self._lock:
             self.ring.extend(spans)

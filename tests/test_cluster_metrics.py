@@ -1,0 +1,409 @@
+"""Mesh metric federation (ISSUE 14, telemetry.ClusterMetrics + cluster
+``_T_METRICS``): the registry wire summary, cross-worker fold semantics
+(labeled tenant x qos x worker families, histogram bucket-vector
+addition, counter-delta idempotence under re-delivered frames), the
+federated exposition's validity, and the 3-worker tree-mesh end-to-end
+drill — root-scraped /metrics/cluster with per-worker labels, folded
+delivery-latency histograms covering local AND remote paths, /healthz
+and /cluster/slo beside it.
+"""
+
+import asyncio
+import json
+import re
+
+import pytest
+
+from mqtt_tpu.server import Options
+from mqtt_tpu.telemetry import (
+    ClusterMetrics,
+    Histogram,
+    MetricsRegistry,
+    Telemetry,
+    check_exposition,
+)
+
+from tests.test_server import read_wire_packet, sub_packet
+from tests.test_tree_mesh import TreeMesh, run, wait_for
+
+
+# -- the wire summary --------------------------------------------------------
+
+
+class TestRegistrySummary:
+    def test_summary_round_trips_all_types(self):
+        r = MetricsRegistry()
+        r.counter("mqtt_tpu_c_total", "c").inc(3)
+        r.gauge("mqtt_tpu_g", "g").set(1.5)
+        h = r.histogram("mqtt_tpu_h_seconds", "h", tenant="a", qos="1")
+        h.observe(0.002)
+        h.observe(0.002)
+        s = r.summary()
+        assert s["mqtt_tpu_c_total"]["t"] == "counter"
+        assert s["mqtt_tpu_c_total"]["c"][0][1] == 3
+        assert s["mqtt_tpu_g"]["c"][0][1] == 1.5
+        ent = s["mqtt_tpu_h_seconds"]
+        assert ent["t"] == "histogram" and isinstance(ent["le"], list)
+        labels, val = ent["c"][0]
+        assert dict(map(tuple, labels)) == {"tenant": "a", "qos": "1"}
+        assert val["n"] == 2
+        # trailing zero buckets are trimmed off the wire
+        assert len(val["c"]) <= len(ent["le"]) + 1
+        assert sum(val["c"]) == 2
+        # and the whole thing survives a json round trip (the wire)
+        assert json.loads(json.dumps(s)) == s
+
+
+class TestIngestIdempotence:
+    def test_re_delivered_frame_is_a_no_op(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        fams = {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 7]]}}
+        assert cm.ingest("1", 42, 1, fams)
+        before = cm.exposition()
+        # the same (boot, seq) frame again: idempotent, fold unchanged
+        assert not cm.ingest("1", 42, 1, fams)
+        assert cm.frames_stale == 1
+        assert cm.exposition() == before
+        assert "mqtt_tpu_c_total 7" in before  # folded once, not twice
+
+    def test_reordered_older_seq_dropped_newer_accepted(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        fams_new = {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 9]]}}
+        fams_old = {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 5]]}}
+        assert cm.ingest("1", 42, 3, fams_new)
+        assert not cm.ingest("1", 42, 2, fams_old)  # late frame loses
+        assert "mqtt_tpu_c_total 9" in cm.exposition()
+
+    def test_restarted_boot_replaces_dead_incarnation(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest("1", 42, 100, {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 50]]}})
+        # fresh boot nonce, seq restarts at 1: must WIN
+        assert cm.ingest("1", 77, 1, {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 2]]}})
+        assert "mqtt_tpu_c_total 2" in cm.exposition()
+
+    def test_stale_workers_age_out(self):
+        now = [0.0]
+        cm = ClusterMetrics(max_age_s=10.0, clock=lambda: now[0])
+        cm.ingest("1", 1, 1, {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 1]]}})
+        assert cm.worker_count == 1
+        now[0] = 11.0
+        assert cm.entries() == {}
+        assert "mqtt_tpu_c_total" not in cm.exposition()
+
+
+# -- cross-worker folding ----------------------------------------------------
+
+
+def _delivery_summary(counts_by_cell):
+    """A summary fragment holding delivery-latency children:
+    {(tenant, qos, path): bucket_counts}."""
+    bounds = [0.001, 0.01, 0.1]
+    children = []
+    for (tenant, qos, path), counts in sorted(counts_by_cell.items()):
+        children.append(
+            [
+                [["path", path], ["qos", qos], ["tenant", tenant]],
+                {"n": sum(counts), "s": 0.01 * sum(counts), "c": counts},
+            ]
+        )
+    return {
+        "mqtt_tpu_delivery_latency_seconds": {
+            "t": "histogram",
+            "le": bounds,
+            "c": children,
+        }
+    }
+
+
+class TestFolding:
+    def test_labeled_family_folds_tenant_qos_across_workers(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest(
+            "1", 1, 1,
+            _delivery_summary({("acme", "1", "local"): [1, 2, 0]}),
+        )
+        cm.ingest(
+            "2", 1, 1,
+            _delivery_summary(
+                {
+                    ("acme", "1", "local"): [4, 0, 1],
+                    ("bulk", "0", "remote"): [0, 7, 0],
+                }
+            ),
+        )
+        text = cm.exposition()
+        check_exposition(text)
+        # per-worker rows keep their identity
+        assert (
+            'mqtt_tpu_delivery_latency_seconds_count{path="local",qos="1",'
+            'tenant="acme",worker="1"} 3' in text
+        )
+        assert (
+            'mqtt_tpu_delivery_latency_seconds_count{path="local",qos="1",'
+            'tenant="acme",worker="2"} 5' in text
+        )
+        # the fold sums the SAME (tenant, qos, path) cell across workers
+        assert (
+            'mqtt_tpu_delivery_latency_seconds_count{path="local",qos="1",'
+            'tenant="acme"} 8' in text
+        )
+        # a cell only one worker observed still folds (to itself)
+        assert (
+            'mqtt_tpu_delivery_latency_seconds_count{path="remote",'
+            'qos="0",tenant="bulk"} 7' in text
+        )
+
+    def test_histogram_bucket_vectors_add(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest("1", 1, 1, _delivery_summary({("", "0", "local"): [1, 0, 2]}))
+        cm.ingest("2", 1, 1, _delivery_summary({("", "0", "local"): [0, 5]}))
+        text = cm.exposition()
+        check_exposition(text)
+        # folded buckets: cumulative 1, 6, 8 then +Inf 8
+        fold = [
+            line
+            for line in text.splitlines()
+            if line.startswith("mqtt_tpu_delivery_latency_seconds_bucket")
+            and "worker=" not in line
+        ]
+        got = [int(line.rsplit(" ", 1)[1]) for line in fold]
+        assert got == [1, 6, 8, 8]
+
+    def test_local_registry_shadows_stale_self_summary(self):
+        r = MetricsRegistry()
+        r.counter("mqtt_tpu_c_total", "c").inc(10)
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        # a stale federated copy of worker 0 says 3; the live local
+        # registry says 10 — local wins
+        cm.ingest("0", 1, 1, {"mqtt_tpu_c_total": {"t": "counter", "c": [[[], 3]]}})
+        text = cm.exposition(r, "0")
+        assert 'mqtt_tpu_c_total{worker="0"} 10' in text
+        assert 'mqtt_tpu_c_total{worker="0"} 3' not in text
+
+    def test_gauges_render_per_worker_only(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest("1", 1, 1, {"mqtt_tpu_g": {"t": "gauge", "c": [[[], 5]]}})
+        cm.ingest("2", 1, 1, {"mqtt_tpu_g": {"t": "gauge", "c": [[[], 7]]}})
+        text = cm.exposition()
+        check_exposition(text)
+        assert 'mqtt_tpu_g{worker="1"} 5' in text
+        assert 'mqtt_tpu_g{worker="2"} 7' in text
+        # no folded (worker-less) gauge row: 5+7=12 means nothing
+        assert re.search(r"^mqtt_tpu_g (\d+)$", text, re.M) is None
+
+    def test_malformed_entries_are_skipped_not_fatal(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest(
+            "1", 1, 1,
+            {
+                "not a metric name!": {"t": "counter", "c": [[[], 1]]},
+                "mqtt_tpu_ok_total": {"t": "counter", "c": [[[], 2]]},
+                "mqtt_tpu_weird": {"t": "wat", "c": [[[], 3]]},
+                "mqtt_tpu_broken": "nope",
+            },
+        )
+        text = cm.exposition()
+        check_exposition(text)
+        assert "mqtt_tpu_ok_total" in text
+        assert "wat" not in text and "nope" not in text
+
+    def test_slo_state_extracts_federated_gauges(self):
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest(
+            "3", 1, 1,
+            {
+                "mqtt_tpu_slo_breached": {
+                    "t": "gauge",
+                    "c": [[[["objective", "p99"]], 1]],
+                },
+                "mqtt_tpu_c_total": {"t": "counter", "c": [[[], 1]]},
+            },
+        )
+        st = cm.slo_state()
+        assert st == {"3": {"mqtt_tpu_slo_breached{objective=p99}": 1}}
+
+
+# -- live telemetry -> summary -> fold (the real shapes) ---------------------
+
+
+class TestLiveRegistryFederation:
+    def test_two_live_telemetries_fold_validly(self):
+        t1 = Telemetry(sample=1)
+        t2 = Telemetry(sample=1)
+        for tele, tenant, n in ((t1, "acme", 3), (t2, "acme", 5)):
+            for i in range(n):
+                tele.observe_delivery(0.001 * (i + 1), tenant, 1, "local")
+            tele.publish_encodes.inc(n)
+        cm = ClusterMetrics(clock=lambda: 0.0)
+        cm.ingest("1", 1, 1, t1.registry.summary())
+        text = cm.exposition(t2.registry, "2")
+        samples = check_exposition(text)
+        assert samples > 0
+        assert (
+            'mqtt_tpu_delivery_latency_seconds_count{path="local",qos="1",'
+            'tenant="acme"} 8' in text
+        )
+        m = re.search(r"^mqtt_tpu_publish_encodes_total (\d+)$", text, re.M)
+        assert m is not None and int(m.group(1)) == 8
+
+
+# -- mesh-mode remote SLI stamping (the default topology) --------------------
+
+
+class TestMeshModeElStamp:
+    def test_sampled_untraced_qos0_frame_carries_el(self, tmp_path):
+        """The DEFAULT all-pairs topology must federate remote QoS0
+        latency with tracing off: a sampled-but-untraced clock switches
+        the forward to a _T_TFRAME whose json head carries the origin's
+        elapsed stamp (and no trace id — the receiver's remote span
+        no-ops, only the delivery SLI records)."""
+        import struct
+
+        from mqtt_tpu.cluster import _T_FRAME, _T_TFRAME
+        from mqtt_tpu.telemetry import StageClock
+        from tests.test_federation import _FakeWriter, _bare_cluster
+
+        c, _gov = _bare_cluster(tmp_path, with_governor=False)
+        c._apply_presence(1, "t/#", True, False)
+        w = c._writers[1] = _FakeWriter()
+        frame = b"\x30\x05\x00\x03t/xp"
+        # unsampled publish: the plain _T_FRAME encoding, byte-for-byte
+        c.forward_frame("t/x", frame, "orig", None)
+        assert w.sent and w.sent[-1][4] == _T_FRAME
+        # sampled (clock) but untraced: _T_TFRAME with {"el": ...}
+        clock = StageClock()
+        c.forward_frame("t/x", frame, "orig", clock)
+        raw = w.sent[-1]
+        assert raw[4] == _T_TFRAME
+        (olen,) = struct.unpack(">H", raw[5:7])
+        off = 7 + olen
+        (tlen,) = struct.unpack(">H", raw[off : off + 2])
+        tr = json.loads(raw[off + 2 : off + 2 + tlen])
+        assert tr.get("el", -1) >= 0 and "tid" not in tr
+        assert raw[off + 2 + tlen :] == frame
+
+
+# -- the 3-worker tree-mesh end-to-end drill ---------------------------------
+
+
+class TestTreeFederationE2E:
+    def test_root_scrapes_whole_mesh_with_remote_delivery(self, tmp_path):
+        """The acceptance drill at CI scale: a 3-worker tree, a
+        cross-worker QoS1 burst, and ONE valid exposition at the root
+        carrying per-worker labels, cluster-folded delivery-latency
+        histograms on BOTH paths, plus /cluster/slo's federated view."""
+
+        async def scenario():
+            mesh = TreeMesh(
+                3,
+                tmp_path,
+                telemetry_sample=1,
+                slo_objectives=["p99 delivery < 5s over 30s/2m"],
+            )
+            try:
+                await mesh.start()
+                root = mesh.harnesses[0].server
+                # subscriber on worker 2, publisher on worker 0: every
+                # delivery crosses the mesh
+                sr, sw = await mesh.subscribe(2, "fed-sub", "fed/#", qos=1)
+                await mesh.settle_summaries()
+                pr, pw, _ = await mesh.harnesses[0].connect(
+                    "fed-pub", version=4
+                )
+                from tests.test_server import pub_packet
+
+                for i in range(30):
+                    pw.write(
+                        pub_packet("fed/x", b"m%d" % i, qos=1, pid=i + 1)
+                    )
+                # every delivery arrives (QoS1: the packet leg carries
+                # the origin's elapsed stamp)
+                got = 0
+                while got < 30:
+                    pk = await read_wire_packet(sr, 4)
+                    if pk.fixed_header.type == 3:  # PUBLISH
+                        got += 1
+                # a local-path sample too: root-local subscriber
+                lr, lw, _ = await mesh.harnesses[0].connect(
+                    "loc-sub", version=4
+                )
+                from mqtt_tpu.packets import Subscription
+
+                lw.write(
+                    sub_packet(
+                        1, [Subscription(filter="fed/#", qos=0)], 4
+                    )
+                )
+                await read_wire_packet(lr, 4)
+                pw.write(pub_packet("fed/x", b"local", qos=0))
+                await read_wire_packet(lr, 4)
+
+                # worker 2 recorded remote-path samples
+                tele2 = mesh.harnesses[2].server.telemetry
+                await wait_for(
+                    lambda: any(
+                        p == "remote" and h.count
+                        for (_t, _q, p), h in tele2._delivery_cache.items()
+                    ),
+                    msg="remote-path delivery samples on worker 2",
+                )
+                # federation: the root aggregates both children (the
+                # post-delivery snapshot needs one more gossip tick)
+                cm = root.telemetry.cluster_metrics
+
+                def _w2_has_delivery():
+                    ent = cm.entries().get("2")
+                    if ent is None:
+                        return False
+                    fam = ent["f"].get(
+                        "mqtt_tpu_delivery_latency_seconds"
+                    )
+                    return bool(fam and fam.get("c"))
+
+                await wait_for(
+                    lambda: cm is not None and _w2_has_delivery(),
+                    msg="worker 2's delivery samples federated to root",
+                )
+                await wait_for(
+                    lambda: "1" in cm.entries(),
+                    msg="worker 1's summary at the root",
+                )
+
+                text = cm.exposition(
+                    root.telemetry.registry, root.telemetry.local_worker
+                )
+                check_exposition(text)
+                for wid in ("0", "1", "2"):
+                    assert f'worker="{wid}"' in text
+                # remote-path rows from worker 2, visible at the root
+                assert re.search(
+                    r'delivery_latency_seconds_count\{[^}]*path="remote"'
+                    r'[^}]*worker="2"\} [1-9]',
+                    text,
+                ), text[:2000]
+                # local-path rows from the root itself
+                assert re.search(
+                    r'delivery_latency_seconds_count\{[^}]*path="local"'
+                    r'[^}]*worker="0"\} [1-9]',
+                    text,
+                )
+                # the cluster folds carry BOTH paths with no worker label
+                for path in ("local", "remote"):
+                    assert re.search(
+                        r"delivery_latency_seconds_count\{(?![^}]*worker=)"
+                        rf'[^}}]*path="{path}"[^}}]*\}} [1-9]',
+                        text,
+                    ), path
+
+                # mesh-wide SLO state: every worker's slo gauges at root
+                slo = cm.slo_state(
+                    root.telemetry.registry, root.telemetry.local_worker
+                )
+                assert set(slo) == {"0", "1", "2"}
+
+                # the mesh-mode frames counter moved on the root
+                assert root._cluster.metrics_frames_rx > 0
+            finally:
+                await mesh.stop()
+
+        run(scenario(), timeout=90)

@@ -182,6 +182,31 @@ class TestDeviceSubStages:
         reg2, _ = stage_gate.compare(cur2, prev)
         assert len(reg2) == 1 and "fanout" in reg2[0]
 
+    def test_delivery_sli_rows_pass_through_and_keep_diffing(self):
+        """The ISSUE 14 delivery-latency SLI rows (delivery_local /
+        delivery_remote, folded per path from the labeled
+        mqtt_tpu_delivery_latency_seconds family) land as new stage rows
+        on their first round: noticed via new_stage_names, never a
+        vacuous failure — and once both rounds carry them, a real p99
+        regression IS caught."""
+        cur = _multi_stage_doc(
+            {"fanout": 1.0, "delivery_local": 2.0, "delivery_remote": 6.0}
+        )
+        prev = _multi_stage_doc({"fanout": 1.0})
+        reg, cmp_ = stage_gate.compare(cur, prev)
+        assert not reg
+        assert cmp_ == ["/parsed/configs/2/telemetry:fanout"]
+        assert stage_gate.new_stage_names(cur, prev) == [
+            "delivery_local", "delivery_remote",
+        ]
+        # second round: the rows have a baseline and diff for real
+        cur2 = _multi_stage_doc(
+            {"fanout": 1.0, "delivery_local": 3.0, "delivery_remote": 6.0}
+        )
+        reg2, cmp2 = stage_gate.compare(cur2, cur)
+        assert len(reg2) == 1 and "delivery_local" in reg2[0]
+        assert "/parsed/configs/2/telemetry:delivery_remote" in cmp2
+
     def test_retired_stage_is_noticed_never_failed(self):
         """A stage present only in the PREVIOUS round (renamed/retired
         by the pipeline split) is surfaced as a notice and never
