@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke bench-trend scrape-cluster
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke bench-trend scrape-cluster
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -91,3 +91,11 @@ scrape-cluster:
 # cycle; writes pipeline-smoke.json (uploaded as a CI artifact)
 pipeline-smoke:
 	env JAX_PLATFORMS=cpu $(PY) exp/pipeline_smoke.py
+
+# connection-scale smoke (exp/conn_smoke.py): boot the event-loop shard
+# fabric (loop_shards>1), ramp thousands of mostly-idle connections +
+# a publish burst, assert healthz 200, zero host-trie-oracle delivery
+# mismatches, and per-shard connection spread within 2x; writes
+# conn-smoke.json (uploaded as a CI artifact)
+conn-smoke:
+	env JAX_PLATFORMS=cpu $(PY) exp/conn_smoke.py

@@ -1323,6 +1323,133 @@ def run_conn_rate_qos_matrix(fast: bool) -> dict:
     }
 
 
+def run_idle_conn_matrix(fast: bool) -> dict:
+    """Config 8's connection-scale axis (ISSUE 15): a subprocess broker
+    running the event-loop shard fabric holds a MOSTLY-IDLE device
+    population (the 2603.21600 connection axis — 1k/10k attached
+    connections that never publish) while a small active set measures
+    per-cell receive medians. Each cell's ``receive_flatness_ratio`` is
+    its active-receive-median against the 0-idle baseline cell — a flat
+    front-end holds ~1.0 as the idle population grows.
+
+    ``BENCH_SHARDS=1`` re-runs the whole matrix on the single-loop
+    front-end (the serve-side broker honors the knob); the shard count
+    itself comes from ``BENCH_SHARD_COUNT`` (default ``max(2, cpus)``).
+    The idle ramp adapts to the bench process's fd budget (2 fds per
+    connection in this harness) — dropped cells are recorded, never
+    silently skipped."""
+    import asyncio
+    import resource
+    import subprocess
+
+    from mqtt_tpu.stress import ramp_idle, run_stress
+
+    port = 18862
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    shards = 1
+    if os.environ.get("BENCH_SHARDS") != "1":
+        shards = int(
+            os.environ.get("BENCH_SHARD_COUNT", max(2, os.cpu_count() or 1))
+        )
+        env["MQTT_TPU_LOOP_SHARDS"] = str(shards)
+    levels_env = os.environ.get("BENCH_IDLE_LEVELS")
+    if levels_env:
+        # operator override, e.g. BENCH_IDLE_LEVELS=0,1000,10000 — a
+        # fast-mode run can still measure the full connection axis
+        idle_levels = [int(x) for x in levels_env.split(",") if x.strip()]
+    else:
+        idle_levels = [0, 200] if fast else [0, 1000, 10000]
+    active, msgs = (4, 150) if fast else (10, 500)
+
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    # the broker runs in a SUBPROCESS with its own fd table: this
+    # process pays one fd per idle connection (the client side)
+    budget = max(0, soft - 1024)
+
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-m", "mqtt_tpu.stress", "--serve", "--broker",
+            f"127.0.0.1:{port}",
+        ],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, cwd=repo, env=env,
+    )
+    cells = []
+    dropped = []
+    idle_writers: list = []
+
+    async def drive() -> None:
+        attached = 0
+        baseline = None
+        for level in idle_levels:
+            if level > budget:
+                dropped.append(level)
+                log(f"idle-conn cell {level} dropped (fd budget {budget})")
+                continue
+            if level > attached:
+                t0 = time.perf_counter()
+                idle_writers.extend(
+                    await ramp_idle(
+                        "127.0.0.1", port, level - attached,
+                        client_prefix=f"bench-idle-{attached}",
+                    )
+                )
+                ramp_s = time.perf_counter() - t0
+                attached = level
+            else:
+                ramp_s = 0.0
+            r = await run_stress("127.0.0.1", port, active, msgs)
+            cell = {
+                "idle_connections": level,
+                "clients": active,
+                "msgs_per_client": msgs,
+                "ramp_seconds": round(ramp_s, 2),
+                "publish_median_per_sec": r["publish_median_per_sec"],
+                "receive_median_per_sec": r["receive_median_per_sec"],
+                "receive_min_per_sec": r["receive_min_per_sec"],
+                "aggregate_msgs_per_sec": r["aggregate_msgs_per_sec"],
+            }
+            if baseline is None:
+                baseline = max(1e-9, r["receive_median_per_sec"])
+            cell["receive_flatness_ratio"] = round(
+                r["receive_median_per_sec"] / baseline, 4
+            )
+            cells.append(cell)
+            log(
+                f"idle-conn cell {level}: recv_median "
+                f"{r['receive_median_per_sec']}/s flatness "
+                f"{cell['receive_flatness_ratio']}"
+            )
+        for w in idle_writers:
+            try:
+                w.close()
+            except Exception:
+                pass
+
+    try:
+        assert proc.stdout.readline().strip() == b"READY"
+        asyncio.run(drive())
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=10)
+        except Exception:
+            proc.kill()
+    return {
+        "loop_shards": shards,
+        "idle_levels": idle_levels,
+        "dropped_levels": dropped,
+        "cells": cells,
+    }
+
+
 async def _flatness_profile_block(fast: bool) -> dict:
     """Config 8's host-observatory leg (mqtt_tpu.profiling): the
     per-client receive-rate flatness ratio (10 vs 100 clients — ROADMAP
@@ -1633,6 +1760,15 @@ def run_storm_bench(fast: bool) -> dict:
     # deliberately tiny quotas would shed the probe itself, and its
     # still-armed lock plane would contaminate the disabled A/B arm
     out["receive_flatness"] = asyncio.run(_flatness_profile_block(fast))
+    # hoisted as a TOP-LEVEL scalar so the bench-history ledger keeps it
+    # (_history_config_block) and exp/bench_trend.py can gate the
+    # flatness trajectory beside the headline (ISSUE 15)
+    out["receive_flatness_ratio"] = out["receive_flatness"][
+        "receive_flatness_ratio"
+    ]
+    # the connection-scale axis (ISSUE 15): 1k/10k mostly-idle clients
+    # against the shard-fabric subprocess broker, BENCH_SHARDS=1 A/B
+    out["idle_conn_matrix"] = run_idle_conn_matrix(fast)
     # the SLO-plane on/off A/B (ISSUE 14 acceptance: <=2% SLI overhead);
     # BENCH_SLO=0 skips the arm for broker-only sweeps
     if os.environ.get("BENCH_SLO") != "0":

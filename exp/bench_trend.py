@@ -111,6 +111,60 @@ def check_trend(
     )
 
 
+def check_config_scalar(
+    entries: list[dict],
+    config: str,
+    key: str,
+    last: int = 5,
+    threshold: float = 0.25,
+) -> tuple[bool, str]:
+    """(ok, message) for one per-config scalar's trajectory — the same
+    median-window rule as the headline, over ``configs[config][key]``
+    (e.g. cfg 8's ``receive_flatness_ratio``, ISSUE 15). Entries that
+    never measured the scalar are skipped; fewer than 2 usable rounds
+    passes with a notice, and the NEWEST round not carrying it passes
+    too (a partial-config run must not be judged on a cell it skipped)."""
+    rounds = []
+    for e in entries:
+        v = ((e.get("configs") or {}).get(config) or {}).get(key)
+        if isinstance(v, (int, float)) and v > 0:
+            rounds.append((e, float(v)))
+    rounds = rounds[-last:]
+    if len(rounds) < 2:
+        return True, (
+            f"bench-trend[{config}.{key}]: {len(rounds)} usable round(s); "
+            "nothing to gate"
+        )
+    newest_entry, value = rounds[-1]
+    if entries and entries[-1] is not newest_entry:
+        return True, (
+            f"bench-trend[{config}.{key}]: newest round did not measure "
+            "it; nothing to gate"
+        )
+    prev = [v for _e, v in rounds[:-1]]
+    baseline = statistics.median(prev)
+    floor = baseline * (1.0 - threshold)
+    tag = newest_entry.get("round") or f"t={newest_entry.get('time_unix')}"
+    if value < floor:
+        return False, (
+            f"bench-trend[{config}.{key}] REGRESSION: newest ({tag}) "
+            f"{value:.4f} fell below {floor:.4f} "
+            f"(median of {len(prev)} prior round(s) {baseline:.4f}, "
+            f"threshold -{100 * threshold:.0f}%)"
+        )
+    return True, (
+        f"bench-trend[{config}.{key}]: newest ({tag}) {value:.4f} vs "
+        f"prior-median {baseline:.4f} across {len(rounds)} round(s); "
+        f"within -{100 * threshold:.0f}%"
+    )
+
+
+# per-config scalars gated beside the headline: (config, key)
+CONFIG_SCALARS = (
+    ("8_publish_storm", "receive_flatness_ratio"),
+)
+
+
 def backfill(repo: str, history_path: str) -> int:
     """Seed the ledger from the canonical BENCH_rNN.json artifacts in
     round order, skipping rounds already present (by tag) and rounds
@@ -186,7 +240,15 @@ def main() -> int:
         return 0
     ok, msg = check_trend(entries, last=args.last, threshold=args.threshold)
     print(msg)
-    return 0 if ok else 1
+    rc = 0 if ok else 1
+    for config, key in CONFIG_SCALARS:
+        sok, smsg = check_config_scalar(
+            entries, config, key, last=args.last, threshold=args.threshold
+        )
+        print(smsg)
+        if not sok:
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

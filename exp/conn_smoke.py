@@ -1,0 +1,255 @@
+#!/usr/bin/env python
+"""CI connection-scale gate for the event-loop shard fabric (ISSUE 15):
+boot a broker with ``loop_shards > 1``, ramp thousands of mostly-idle
+connections through the shard router, push a publish burst, and assert
+
+- ``GET /healthz`` answers 200 with the whole population attached,
+- ZERO delivery mismatches vs the host-trie oracle (cross-shard fan-out
+  must be delivery-identical to the single-loop walk),
+- the per-shard live-connection spread stays within 2x (the
+  least-loaded router actually balanced the ramp), and
+- every connection landed on a fabric shard (none fell back to the
+  main loop).
+
+The connection count adapts to the process fd budget (each idle
+connection costs two fds in this single-process harness); the gate
+FAILS only below a 512-connection floor. The spread/ramp/burst block is
+written to ``--out`` and uploaded as a CI artifact.
+
+Usage: python exp/conn_smoke.py [--conns 5000] [--shards 4] [--out conn-smoke.json]
+"""
+
+import argparse
+import asyncio
+import collections
+import json
+import os
+import resource
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+SUB_FILTERS = {
+    "wild-hash": "conns/#",
+    "wild-plus": "conns/+/x",
+    "exact": "conns/d3/x",
+}
+N_PUBLISHES = 500
+MIN_CONNS = 512
+
+
+def fd_budget(target: int) -> int:
+    """Raise RLIMIT_NOFILE toward the hard limit and clamp the ramp to
+    what the budget allows (2 fds per connection + 512 slack)."""
+    soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+    if soft < hard:
+        try:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+            soft = hard
+        except (ValueError, OSError):
+            pass
+    return max(MIN_CONNS, min(target, (soft - 512) // 2))
+
+
+async def _drain_topics(reader, counts, stop):
+    buf = b""
+    while not stop.is_set():
+        try:
+            data = await asyncio.wait_for(reader.read(65536), 0.5)
+        except asyncio.TimeoutError:
+            continue
+        if not data:
+            return
+        buf += data
+        while len(buf) >= 2:
+            if buf[0] >> 4 != 3:
+                buf = buf[1:]
+                continue
+            rl = buf[1]
+            if rl & 0x80 or len(buf) < 2 + rl:
+                break
+            frame = buf[2 : 2 + rl]
+            tlen = int.from_bytes(frame[:2], "big")
+            counts[frame[2 : 2 + tlen].decode()] += 1
+            buf = buf[2 + rl :]
+
+
+async def main(conns: int, shards: int, out_path: str) -> int:
+    from exp.scrapelib import http_get
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes, ramp_idle
+
+    conns = fd_budget(conns)
+    srv = Server(Options(loop_shards=shards))
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    srv.add_listener(
+        HTTPStats(
+            LConfig(type="sysinfo", id="s", address="127.0.0.1:0"),
+            srv.info,
+            telemetry=srv.telemetry,
+            health=srv.health_report,
+        )
+    )
+    await srv.serve()
+    stop = asyncio.Event()
+    drains = []
+    idle_writers = []
+    try:
+        host, port = srv.listeners.get("t").address().rsplit(":", 1)
+        http_addr = srv.listeners.get("s").address()
+
+        # -- ramp: mostly-idle device population (stress.ramp_idle:
+        # keepalive 0, so a slow CI box can never reap the population
+        # mid-gate) ------------------------------------------------------
+        t0 = time.monotonic()
+        idle_writers.extend(await ramp_idle(host, int(port), conns))
+        ramp_s = time.monotonic() - t0
+        attached = srv.info.clients_connected
+        print(
+            f"# ramped {conns} idle connections in {ramp_s:.1f}s "
+            f"(attached={attached})",
+            file=sys.stderr,
+        )
+
+        # -- oracle subscribers + publish burst --------------------------
+        counts: dict = {}
+        for name, flt in SUB_FILTERS.items():
+            r, w = await asyncio.open_connection(host, int(port))
+            w.write(_connect_bytes(f"smoke-{name}", version=4))
+            await w.drain()
+            await r.readexactly(4)
+            w.write(_subscribe_bytes(1, flt))
+            await w.drain()
+            await r.readexactly(5)
+            counts[name] = collections.Counter()
+            drains.append(
+                asyncio.get_event_loop().create_task(
+                    _drain_topics(r, counts[name], stop)
+                )
+            )
+
+        topics = [
+            f"conns/d{i % 10}/{'x' if i % 3 else 'y'}"
+            for i in range(N_PUBLISHES)
+        ]
+        expected = {name: collections.Counter() for name in SUB_FILTERS}
+        for t in topics:
+            subs = srv.topics.subscribers(t)
+            for cid in subs.subscriptions:
+                name = cid.removeprefix("smoke-")
+                if name in expected:
+                    expected[name][t] += 1
+
+        pr, pw = await asyncio.open_connection(host, int(port))
+        pw.write(_connect_bytes("smoke-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        for t in topics:
+            tb = t.encode()
+            body = len(tb).to_bytes(2, "big") + tb + b"p"
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+
+        want_total = sum(sum(c.values()) for c in expected.values())
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if sum(sum(c.values()) for c in counts.values()) >= want_total:
+                break
+            await asyncio.sleep(0.2)
+        stop.set()
+        await asyncio.gather(*drains, return_exceptions=True)
+
+        mismatches = 0
+        for name in SUB_FILTERS:
+            if counts[name] != expected[name]:
+                mismatches += 1
+                missing = expected[name] - counts[name]
+                surplus = counts[name] - expected[name]
+                print(
+                    f"FAIL: {name} diverged from the host-trie oracle "
+                    f"(missing={dict(list(missing.items())[:5])} "
+                    f"surplus={dict(list(surplus.items())[:5])})",
+                    file=sys.stderr,
+                )
+
+        # -- gates --------------------------------------------------------
+        head, body = await http_get(http_addr, "/healthz", timeout=15.0)
+        healthz_ok = b"200" in head.split(b"\r\n", 1)[0]
+        spread = srv._fabric.spread()
+        unowned = 0
+        for cl in srv.clients.get_all().values():
+            if cl.closed or cl.net.inline:
+                continue
+            if not srv._fabric.owns(cl.net.loop):
+                unowned += 1
+        block = {
+            "conns": conns,
+            "shards": shards,
+            "ramp_seconds": round(ramp_s, 2),
+            "conns_per_second": round(conns / max(ramp_s, 1e-9)),
+            "attached": attached,
+            "spread": {str(k): v for k, v in spread.items()},
+            "unowned_connections": unowned,
+            "healthz_ok": healthz_ok,
+            "publishes": N_PUBLISHES,
+            "oracle_checked_deliveries": want_total,
+            "oracle_mismatched_subscribers": mismatches,
+        }
+        with open(out_path, "w") as f:
+            json.dump(block, f, indent=2)
+        print(f"# conn block -> {out_path}: {json.dumps(block)}",
+              file=sys.stderr)
+
+        if not healthz_ok:
+            print(f"FAIL: /healthz -> {head!r}", file=sys.stderr)
+            return 1
+        if mismatches:
+            return 1
+        if attached < conns:
+            print(
+                f"FAIL: only {attached}/{conns} connections attached",
+                file=sys.stderr,
+            )
+            return 1
+        if unowned:
+            print(
+                f"FAIL: {unowned} connections not owned by any shard",
+                file=sys.stderr,
+            )
+            return 1
+        lo, hi = min(spread.values()), max(spread.values())
+        if lo <= 0 or hi > 2 * lo:
+            print(
+                f"FAIL: per-shard spread {spread} outside the 2x bound",
+                file=sys.stderr,
+            )
+            return 1
+        print(
+            f"OK: {conns} connections over {shards} shards "
+            f"(spread {spread}), healthz 200, {want_total} oracle-checked "
+            "deliveries, zero mismatches",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        stop.set()
+        for w in idle_writers:
+            try:
+                w.close()
+            except Exception:
+                pass
+        await srv.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--conns", type=int, default=5000)
+    ap.add_argument("--shards", type=int, default=4)
+    ap.add_argument("--out", default="conn-smoke.json")
+    args = ap.parse_args()
+    sys.exit(asyncio.run(main(args.conns, args.shards, args.out)))

@@ -14,6 +14,7 @@ from __future__ import annotations
 import asyncio
 import collections
 import logging
+import threading
 import time
 import uuid
 from dataclasses import dataclass, field
@@ -41,6 +42,88 @@ MINIMUM_KEEPALIVE = 5  # below this a warning is logged (clients.go:27)
 
 class ConnectionClosedError(Exception):
     """The client connection is not open (reference ErrConnectionClosed)."""
+
+
+class OutboundQueue:
+    """A thread-safe bounded outbound queue with asyncio.Queue's
+    data-plane surface (``put_nowait``/``QueueFull``, awaitable
+    ``get``, ``full``/``qsize``/``empty``).
+
+    asyncio.Queue is loop-affine: ``put_nowait`` wakes waiters with a
+    plain ``call_soon``, which is illegal from any other thread. Under
+    the event-loop shard fabric (mqtt_tpu.shards) a publisher's fan-out
+    runs on ITS shard's loop and enqueues onto subscribers owned by
+    OTHER shards — so the queue itself goes thread-safe: a lock-guarded
+    deque plus a single-consumer wakeup future that cross-thread
+    producers resolve via ``call_soon_threadsafe`` on the consumer's
+    loop. Single-loop brokers pay one uncontended lock acquire per
+    enqueue/dequeue and keep identical semantics.
+    """
+
+    __slots__ = ("maxsize", "_items", "_lock", "_waiter")
+
+    def __init__(self, maxsize: int = 0) -> None:
+        self.maxsize = maxsize
+        self._items: collections.deque = collections.deque()
+        self._lock = threading.Lock()
+        # the single consumer's parked (loop, future), or None; the
+        # write loop is the only get() caller, so one slot suffices
+        self._waiter: Optional[tuple] = None
+
+    def qsize(self) -> int:
+        return len(self._items)
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def full(self) -> bool:
+        return 0 < self.maxsize <= len(self._items)
+
+    @staticmethod
+    def _wake(fut: "asyncio.Future") -> None:
+        if not fut.done():
+            fut.set_result(None)
+
+    def put_nowait(self, item: Any) -> None:
+        """Enqueue from ANY thread; raises ``asyncio.QueueFull`` past
+        the bound (the drop-on-slow-consumer contract is unchanged)."""
+        wake = None
+        with self._lock:
+            if 0 < self.maxsize <= len(self._items):
+                raise asyncio.QueueFull()
+            self._items.append(item)
+            if self._waiter is not None:
+                wake, self._waiter = self._waiter, None
+        if wake is not None:
+            loop, fut = wake
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if loop is running:
+                self._wake(fut)
+            else:
+                try:
+                    loop.call_soon_threadsafe(self._wake, fut)
+                except RuntimeError:
+                    pass  # consumer loop closed; the writer task is gone
+
+    async def get(self) -> Any:
+        """Dequeue (single consumer: the client's write loop)."""
+        while True:
+            with self._lock:
+                if self._items:
+                    return self._items.popleft()
+                loop = asyncio.get_running_loop()
+                fut: asyncio.Future = loop.create_future()
+                self._waiter = (loop, fut)
+            try:
+                await fut
+            except asyncio.CancelledError:
+                with self._lock:
+                    if self._waiter is not None and self._waiter[1] is fut:
+                        self._waiter = None
+                raise
 
 
 class ScanGate:
@@ -142,6 +225,10 @@ class ClientConnection:
         self.remote = ""
         self.listener = ""
         self.inline = False
+        # the asyncio loop OWNING this transport (set at attach): under
+        # the shard fabric every transport write/close must happen on
+        # it; None (inline clients, unattached tests) means loop-local
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
         if writer is not None:
             peer = writer.get_extra_info("peername")
             if peer:
@@ -168,11 +255,12 @@ class ClientState:
         self.subscriptions = Subscriptions()  # filter -> Subscription (client mirror)
         self.disconnected = 0  # unix ts of disconnect, for expiry
         # Packet on the per-subscriber path, raw bytes on the shared
-        # QoS0 frame fast path (clients._write_loop dispatches on type)
-        self.outbound: "asyncio.Queue[Packet | bytes]" = asyncio.Queue(
+        # QoS0 frame fast path (clients._write_loop dispatches on type);
+        # thread-safe so cross-shard fan-out can enqueue directly
+        # (mqtt_tpu.shards)
+        self.outbound: OutboundQueue = OutboundQueue(
             maxsize=max_writes_pending
         )
-        self.outbound_qty = 0
         self.keepalive = DEFAULT_KEEPALIVE
         self.server_keepalive = False
         self.packet_id = 0  # current highest allocated packet id
@@ -205,6 +293,16 @@ class ClientState:
         # mqtt_tpu_outbound_{bytes,writes}_total counters
         self.out_bytes = 0
         self.out_writes = 0
+
+    @property
+    def outbound_qty(self) -> int:
+        """Queued outbound publishes — delegated to the thread-safe
+        queue's own count. A bare ``+=`` mirror would lose updates when
+        shard threads enqueue concurrently (mqtt_tpu.shards), and this
+        count gates the direct-socket flush eligibility
+        (server._flush_variant), where an undercount could reorder
+        frames past still-queued ones."""
+        return self.outbound.qsize()
 
 
 class Client:
@@ -239,6 +337,15 @@ class Client:
         # set once by server._resolve_tenant, read on every publish /
         # subscribe to decide namespace scoping
         self.tenant: Optional[Any] = None
+        # the owning shard's read-side ScanGate (mqtt_tpu.shards): set
+        # at attach when the fabric is on; None falls back to the
+        # server-wide gate (Options.scan_coalesce) or per-socket scans
+        self.scan_gate: Optional[ScanGate] = None
+        # the attach-handler task serving this connection (set by
+        # server.attach_client): the cross-shard takeover quiesce
+        # awaits it on the owning loop so the old session's disconnect
+        # epilogue fully runs before state migrates (mqtt_tpu.shards)
+        self._handler_task: Optional[asyncio.Task] = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -270,7 +377,6 @@ class Client:
                     self.write_packet(pk)
             except Exception as e:
                 self.ops.log.debug("failed publishing packet to %s: %s", self.id, e)
-            st.outbound_qty -= 1
 
     def write_frame(self, data: bytes) -> None:
         """Write a pre-encoded PUBLISH frame (the server's qos0 fan-out
@@ -309,7 +415,10 @@ class Client:
         if self.properties.props.receive_maximum > caps.maximum_inflight:  # 3.3.4 Non-normative
             self.properties.props.receive_maximum = caps.maximum_inflight
 
-        if pk.connect.keepalive <= MINIMUM_KEEPALIVE:
+        if 0 < pk.connect.keepalive <= MINIMUM_KEEPALIVE:
+            # keepalive 0 DISABLES the mechanism [MQTT-3.1.2-22] — a
+            # deliberate choice (mostly-idle device fleets), not a
+            # too-small value worth one warning per ramped connection
             self.ops.log.warning(
                 "client keepalive is below minimum recommended value: client=%s keepalive=%d recommended=%d",
                 self.id,
@@ -423,7 +532,10 @@ class Client:
         fast_eligible = self.ops.fast_publish_eligible
         fast_publish = self.ops.fast_publish
         telemetry = getattr(self.ops, "telemetry", None)
-        scan_gate = getattr(self.ops, "scan_gate", None)
+        # the shard's own gate wins (per-shard decode batching is
+        # default-on inside the fabric); the server-wide gate serves the
+        # single-loop opt-in (Options.scan_coalesce)
+        scan_gate = self.scan_gate or getattr(self.ops, "scan_gate", None)
         rbuf = bytearray()
         deferred: Optional[list] = None
         self.refresh_deadline(self.state.keepalive)
@@ -580,12 +692,39 @@ class Client:
 
     def stop(self, err: Optional[Exception] = None) -> None:
         """Idempotently end the client: close the transport, cancel the
-        writer task, record the stop cause and time (clients.go:391-407)."""
+        writer task, record the stop cause and time (clients.go:391-407).
+
+        Task.cancel and transport.close are loop-affine: when another
+        shard's loop owns this connection (cross-shard takeover, the
+        main loop's drain) the teardown is marshaled to the owner via
+        ``call_soon_threadsafe``; the closed flag flips immediately
+        either way, so every data-plane gate sees the stop at once."""
         if not self.state.open:
             return
         self.state.open = False
         if err is not None:
             self.state.stop_cause = err
+        loop = self.net.loop
+        marshaled = False
+        if loop is not None and loop.is_running():
+            try:
+                running = asyncio.get_running_loop()
+            except RuntimeError:
+                running = None
+            if loop is not running:
+                try:
+                    loop.call_soon_threadsafe(self._stop_teardown)
+                    marshaled = True
+                except RuntimeError:
+                    marshaled = False  # owner loop died first
+        if not marshaled:
+            self._stop_teardown()
+        # brokerlint: ok=R3 session-expiry bookkeeping is wall-clock (persists across restarts)
+        self.state.disconnected = int(time.time())
+
+    def _stop_teardown(self) -> None:
+        """The loop-affine half of stop(): cancel the writer task and
+        close the transport on the loop that owns them."""
         if self._writer_task is not None:
             self._writer_task.cancel()
         if self.net.writer is not None:
@@ -593,8 +732,6 @@ class Client:
                 self.net.writer.close()
             except Exception:  # brokerlint: ok=R4 teardown; the transport is already dead and close() has no one to report to
                 pass
-        # brokerlint: ok=R3 session-expiry bookkeeping is wall-clock (persists across restarts)
-        self.state.disconnected = int(time.time())
 
     @property
     def stop_cause(self) -> Optional[Exception]:

@@ -157,6 +157,14 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "matcher_stage_pipeline_depth",
         "matcher_compact",
         "matcher_compact_capacity",
+        # zero-materialization fan-out + encode-once write path
+        # (ISSUE 13) and read-side decode batching
+        "matcher_lazy_views",
+        "fanout_batch",
+        "scan_coalesce",
+        # event-loop shard fabric (mqtt_tpu.shards / ISSUE 15)
+        "loop_shards",
+        "loop_shard_accept",
         # degradation manager: breaker/backoff knobs (mqtt_tpu.resilience)
         "matcher_resilience",
         "breaker_failure_threshold",

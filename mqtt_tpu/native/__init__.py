@@ -479,15 +479,26 @@ def frame_scan_multi(
     k = len(bufs)
     if k == 0:
         return []
-    holders = []
+    holders: list = []
     ptrs = (ctypes.c_void_p * k)()
     lens = np.zeros(k, dtype=np.int64)
     for i, buf in enumerate(bufs):
         lens[i] = len(buf)
         if isinstance(buf, (bytearray, memoryview)):
-            h = (ctypes.c_char * len(buf)).from_buffer(buf) if len(buf) else b""
-            holders.append(h)
-            ptrs[i] = ctypes.addressof(h) if len(buf) else None
+            # NOTE: the export must live ONLY in `holders` — a loop
+            # local binding would survive the finally below and, with
+            # this frame pinned past return (the sampling wall
+            # profiler's sys._current_frames() references), keep the
+            # LAST buffer exported while its read loop resumes and
+            # `del rbuf[:consumed]` raises BufferError — the exact
+            # frame_scan hazard, multiplied by the shard fabric's
+            # default-on per-shard ScanGate
+            if len(buf):
+                holders.append((ctypes.c_char * len(buf)).from_buffer(buf))
+                ptrs[i] = ctypes.addressof(holders[-1])
+            else:
+                holders.append(b"")
+                ptrs[i] = None
         else:
             holders.append(buf)
             ptrs[i] = (
@@ -515,7 +526,9 @@ def frame_scan_multi(
         )
     finally:
         # deterministic release of the from_buffer exports (the same
-        # BufferError hazard frame_scan documents)
+        # BufferError hazard frame_scan documents): clear IN PLACE so
+        # the exports die even while something pins this frame
+        holders.clear()
         del holders
     out = []
     for i in range(k):

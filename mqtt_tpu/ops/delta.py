@@ -190,6 +190,7 @@ class DeltaMatcher:
                 compact=compact,
                 compact_capacity=compact_capacity,
                 hits_estimate=hits_estimate,
+                lazy=lazy,
             )
         else:
             snap = _Snapshot(

@@ -178,6 +178,7 @@ class ShardedTpuMatcher:
         compact: bool = True,
         compact_capacity: int = 0,
         hits_estimate: float = 2.0,
+        lazy: bool = False,
     ) -> None:
         self.topics = topics
         self.mesh = mesh or make_mesh()
@@ -192,6 +193,14 @@ class ShardedTpuMatcher:
         # _gather_compact_core); same knob contract as TpuMatcher
         self.compact = compact
         self.compact_capacity = max(0, compact_capacity)
+        # lazy SubscribersView results over the stitched per-tile pair
+        # stream (ISSUE 15 satellite closing the ISSUE 13 residual):
+        # resolve_compact_views consumes the sharded (sid, shard) form
+        # natively — per-hit objects are built only when fan-out asks.
+        # The eager expansion stays as the differential oracle, and
+        # without the C module laziness silently degrades to eager
+        # (materialize_compact_pairs' contract).
+        self.lazy = lazy
         self._hits_ewma = max(1.0, float(hits_estimate))
         # sticky per-batch-bucket capacities (TpuMatcher contract: grow
         # immediately, shrink only at 4x oversize — every distinct
@@ -806,6 +815,7 @@ class ShardedTpuMatcher:
                 self.window,
                 true_overflow,
                 tables=tables,
+                lazy=self.lazy,
             )
 
         return resolve_compact

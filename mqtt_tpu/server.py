@@ -214,8 +214,23 @@ class Options:
     # read-side decode batching: coalesce frame scans from read loops
     # that wake in the same event-loop tick into one native multi-buffer
     # scan call. Opt-in: it adds one loop-callback hop per socket read,
-    # which only pays off at high connection counts.
+    # which only pays off at high connection counts. Inside the shard
+    # fabric (loop_shards > 1) the gate is PER-SHARD and default-on
+    # regardless of this knob.
     scan_coalesce: bool = False
+    # event-loop shard fabric (mqtt_tpu.shards / ROADMAP item 4): the
+    # connection front-end as N threads each running its own event loop
+    # owning thousands of connections, with accepted sockets dispatched
+    # to the least-loaded shard. 1 (default) preserves today's
+    # single-loop behavior bit-for-bit — no fabric code runs at all.
+    loop_shards: int = 1
+    # fabric accept mode: "handoff" (default — the main loop accepts
+    # and routes each bare socket to the least-loaded shard; exact
+    # least-loaded spread) or "reuseport" (every shard binds its own
+    # SO_REUSEPORT socket and accepts on its own loop; kernel load
+    # balancing, no hand-off hop; falls back to handoff where
+    # SO_REUSEPORT is unavailable)
+    loop_shard_accept: str = "handoff"
     # degradation manager (mqtt_tpu.resilience): wrap every device dispatch
     # in a circuit breaker + hang watchdog; timeouts/errors/corrupt results
     # route matching to the bit-identical host trie and background probes
@@ -614,6 +629,15 @@ class Options:
             self.trace_ring = 4096
         if self.trace_adopt_max_per_s < 0:
             self.trace_adopt_max_per_s = 64
+        # fabric knobs are config-reachable: a negative shard count
+        # means single-loop, an unknown accept mode falls back to the
+        # hand-off router (never a refused boot)
+        if self.loop_shards < 1:
+            self.loop_shards = 1
+        if str(self.loop_shard_accept).lower() not in ("handoff", "reuseport"):
+            self.loop_shard_accept = "handoff"
+        else:
+            self.loop_shard_accept = str(self.loop_shard_accept).lower()
         if self.profile_hz <= 0:
             self.profile_hz = 29.0
         if self.profile_ring <= 0:
@@ -774,6 +798,19 @@ class Server:
 
             self._ops.scan_gate = ScanGate()
         self._fastpub_plans: dict = {}  # topic -> (trie version, fan-out plan)
+        # event-loop shard fabric (mqtt_tpu.shards); None = single loop.
+        # Built in serve() when Options.loop_shards > 1.
+        self._fabric: Optional[Any] = None
+        # the loop serve() ran on — the housekeeping tick's loop; under
+        # the fabric, clients owned by it (or by no loop) are swept here
+        self._main_loop: Optional[asyncio.AbstractEventLoop] = None
+        # clients_connected gates maximum_clients: under the fabric the
+        # attach/detach paths run on many shard loops, and a bare += on
+        # the gauge could drift past the cap
+        self._conn_lock = threading.Lock()
+        # (timestamp, {loop: queued}) memo so one scrape's N per-shard
+        # backlog gauges share a single client-registry walk
+        self._shard_backlog_memo: Optional[tuple] = None
         # multi-core worker fabric (mqtt_tpu.cluster); None = single process
         self._cluster: Optional[Any] = None
         # set at the top of close(): CONNECTs arriving mid-drain are
@@ -1219,6 +1256,27 @@ class Server:
             # each other; close() releases this server's hold)
             self.telemetry.lock_plane.arm()
             self._lock_plane_armed = True
+        self._main_loop = asyncio.get_running_loop()
+        if self.options.loop_shards > 1:
+            # event-loop shard fabric (mqtt_tpu.shards / ROADMAP item
+            # 4): built before listener init so stream listeners bind
+            # raw fabric sockets instead of main-loop asyncio servers
+            from .listeners import StreamListener
+            from .shards import ShardFabric
+
+            self._fabric = ShardFabric(self.options.loop_shards, server=self)
+            reuseport = self.options.loop_shard_accept == "reuseport"
+            for lst in self.listeners.internal.values():
+                if isinstance(lst, StreamListener):
+                    lst.attach_fabric(self._fabric, reuseport=reuseport)
+            self._fabric.start()
+            if self.telemetry is not None:
+                self._fabric.register_metrics(self.telemetry.registry)
+            self.log.info(
+                "event-loop shard fabric started: shards=%d accept=%s",
+                self.options.loop_shards,
+                self.options.loop_shard_accept,
+            )
         for listener in list(self.listeners.internal.values()):
             await listener.init(self.log)
         self._event_loop_task = asyncio.get_running_loop().create_task(self._event_loop())
@@ -1548,11 +1606,43 @@ class Server:
         if ov is None:
             return
         ov.evaluate(force=True)
+        # under the shard fabric each shard sweeps ITS clients on its
+        # own loop (mqtt_tpu.shards LoopShard._tick) — transport-buffer
+        # reads and eviction disconnects stay loop-local, exactly the
+        # single-loop sweep's invariant; the main tick covers clients
+        # the main loop owns (and loop-less ones: tests, mocks)
+        try:
+            here: Optional[asyncio.AbstractEventLoop] = (
+                asyncio.get_running_loop()
+            )
+        except RuntimeError:
+            here = self._main_loop
+        self.sweep_clients_for_loop(here, include_unowned=True)
+
+    def sweep_clients_for_loop(
+        self,
+        loop: Optional[asyncio.AbstractEventLoop],
+        include_unowned: bool = False,
+    ) -> int:
+        """One slow-consumer eviction pass over the clients ``loop``
+        owns (every client when no fabric is attached — the single-loop
+        path unchanged). Returns the evictions performed; the shard
+        housekeeping tick feeds it into the per-shard counter."""
+        ov = self.overload
+        if ov is None:
+            return 0
         buf_limit = self.options.overload_client_buffer_limit_bytes
         now = time.monotonic()
+        evicted = 0
         for cl in self.clients.get_all().values():
             if cl.net.inline or cl.closed:
                 continue
+            if self._fabric is not None:
+                owner = cl.net.loop
+                if owner is not loop and not (
+                    owner is None and include_unowned
+                ):
+                    continue
             buffered = 0
             if cl.net.writer is not None:
                 try:
@@ -1580,6 +1670,7 @@ class Server:
                 )
             if over_since is not None and ov.evict_due(over_since):
                 ov.note_eviction()
+                evicted += 1
                 self.log.warning(
                     "evicting slow consumer under overload: client=%s "
                     "backlogged_for=%.1fs buffered=%dB queue_full=%s",
@@ -1592,6 +1683,34 @@ class Server:
                     self.disconnect_client(cl, ERR_QUOTA_EXCEEDED)
                 except Code:
                     pass
+                # deliberately a GRACEFUL close: a victim that resumes
+                # reading still sees its queued publishes + the 0x97
+                # DISCONNECT (the contract test_overload pins); one that
+                # never reads leaves an unflushable transport, which the
+                # BOUNDED close_all drain (listeners.Listeners) reaps at
+                # shutdown instead of wedging on it
+        return evicted
+
+    def shard_backlog(self, loop: Any) -> int:
+        """Queued outbound publishes across the clients one shard loop
+        owns (the per-shard face of the aggregate backlog gauge). One
+        scrape calls this once PER SHARD, so the walk over the client
+        registry is computed once and memoized briefly — N shard gauges
+        cost one pass, not N (the memo staleness is far below the
+        scrape interval)."""
+        now = time.monotonic()
+        cached = self._shard_backlog_memo
+        if cached is None or now - cached[0] > 0.5:
+            totals: dict = {}
+            for cl in self.clients.get_all().values():
+                if not cl.closed:
+                    owner = cl.net.loop
+                    totals[owner] = (
+                        totals.get(owner, 0) + cl.state.outbound.qsize()
+                    )
+            cached = (now, totals)
+            self._shard_backlog_memo = cached
+        return cached[1].get(loop, 0)
 
     def _resolve_tenant(self, cl: Client) -> None:
         """CONNECT-time tenant resolution (mqtt_tpu.tenancy): map the
@@ -1689,8 +1808,13 @@ class Server:
 
     async def establish_connection(self, listener: str, reader, writer) -> None:
         """Attach a newly accepted connection (server.go:398-401)."""
+        from .shards import SHARD_TASK_ATTR
+
         task = asyncio.current_task()
-        if task is not None:  # ClientsWg analog (listeners.go:43)
+        if task is not None and getattr(task, SHARD_TASK_ATTR, None) is None:
+            # ClientsWg analog (listeners.go:43). Shard-fabric tasks are
+            # tracked by their OWN shard (mqtt_tpu.shards) — the main
+            # loop must never gather a foreign loop's tasks
             self.listeners.client_tasks.add(task)
             task.add_done_callback(self.listeners.client_tasks.discard)
         cl = self.new_client(reader, writer, listener, "", False)
@@ -1699,6 +1823,15 @@ class Server:
     async def attach_client(self, cl: Client, listener: str) -> None:
         """Validate an incoming connection, run the CONNECT handshake, and
         read packets until disconnect (server.go:405-494)."""
+        # the loop OWNING this transport: every cross-shard write/close
+        # marshals onto it (mqtt_tpu.shards); single-loop brokers record
+        # the main loop and every check short-circuits loop-local
+        cl.net.loop = asyncio.get_running_loop()
+        cl._handler_task = asyncio.current_task()
+        if self._fabric is not None:
+            # per-shard read-side decode batching, default-on inside
+            # the fabric (ISSUE 15)
+            cl.scan_gate = self._fabric.gate_for(cl.net.loop)
         cl.start_write_loop()
         err: Optional[Exception] = None
         connected = False
@@ -1744,12 +1877,27 @@ class Server:
                     self.send_connack(cl, refusal, False, None)
                 raise refusal()
 
-            self.info.clients_connected += 1
+            with self._conn_lock:
+                self.info.clients_connected += 1
             connected = True
             if cl.tenant is not None and self._tenancy is not None:
                 self._tenancy.note_connect(cl.tenant)
 
             self.hooks.on_session_establish(cl, pk)
+
+            # cross-shard takeover quiesce (mqtt_tpu.shards): the
+            # session migration below clones/clears the EXISTING
+            # client's inflight + subscriptions, which is only safe
+            # once its owner loop has stopped serving it — disconnect
+            # it ON that loop and AWAIT completion before touching its
+            # state (the single-loop path needs none of this: the
+            # migration and the old client share one loop)
+            if self._fabric is not None:
+                existing = self.clients.get(cl.id)
+                if existing is not None and not self._client_loop_local(
+                    existing
+                ):
+                    await self._quiesce_takeover(existing)
 
             session_present = self.inherit_client_session(pk, cl)
             self.clients.add_client(cl)  # [MQTT-4.1.0-1]
@@ -1790,7 +1938,8 @@ class Server:
             err = e
         finally:
             if connected:
-                self.info.clients_connected -= 1
+                with self._conn_lock:
+                    self.info.clients_connected -= 1
                 if cl.tenant is not None and self._tenancy is not None:
                     self._tenancy.note_disconnect(cl.tenant)
             cl.stop(err)
@@ -1860,6 +2009,50 @@ class Server:
         if cl.properties.will.retain and caps.retain_available == 0:
             return ERR_RETAIN_NOT_SUPPORTED  # [MQTT-3.2.2-13]
         return code
+
+    async def _quiesce_takeover(self, existing: Client) -> None:
+        """Disconnect a to-be-taken-over client ON its owning shard's
+        loop and wait for it: after this, the old owner's loop can no
+        longer be mutating the session state the takeover migrates
+        (its read loop observes ``closed`` before processing anything
+        else). The drain also awaits the old ATTACH HANDLER itself, so
+        its disconnect epilogue (the expire branch, registry delete)
+        has fully run before the migration reads the registry — for a
+        persistent session that epilogue keeps the state (not taken
+        over yet, not expiring); for a clean session it discards it,
+        which is what a clean takeover does anyway. A dead/stopped
+        owner loop degrades to a direct stop — the client was not
+        being served."""
+        loop = existing.net.loop
+        if loop is None or not loop.is_running():
+            existing.stop(ERR_SESSION_TAKEN_OVER())
+            return
+
+        async def _disconnect_and_drain() -> None:
+            try:
+                self.disconnect_client(existing, ERR_SESSION_TAKEN_OVER)
+            except Code:
+                pass
+            task = existing._handler_task
+            if task is not None and task is not asyncio.current_task():
+                try:
+                    await asyncio.wait_for(asyncio.shield(task), timeout=4.0)
+                except Exception:  # brokerlint: ok=R4 bounded drain; a wedged old handler must not hold the CONNECT hostage
+                    pass
+
+        try:
+            cfut = asyncio.run_coroutine_threadsafe(
+                _disconnect_and_drain(), loop
+            )
+        except RuntimeError:
+            existing.stop(ERR_SESSION_TAKEN_OVER())
+            return
+        try:
+            await asyncio.wait_for(asyncio.wrap_future(cfut), timeout=5.0)
+        except asyncio.TimeoutError:
+            # a wedged owner loop must not hold the CONNECT hostage;
+            # the closed flag still fences its data plane
+            existing.stop(ERR_SESSION_TAKEN_OVER())
 
     def inherit_client_session(self, pk: Packet, cl: Client) -> bool:
         """Session takeover: disconnect the existing client with the same id
@@ -2551,7 +2744,6 @@ class Server:
         pre-encoded frame does not)."""
         try:
             tcl.state.outbound.put_nowait(data)
-            tcl.state.outbound_qty += 1
             tcl.state.outbound_full_since = None
             self._stamp_outbound(tcl)
             if count_delivery and self.telemetry is not None:
@@ -2739,7 +2931,7 @@ class Server:
                 continue
             # v5 target / identifiers / alias / size cap: full per-sub path
             try:
-                self.publish_to_client(tcl, sub, pk_source())
+                self._deliver_to_client(tcl, sub, pk_source())
             except Exception as e:
                 self.log.debug("failed publishing packet: error=%s client=%s", e, cid)
 
@@ -2882,7 +3074,9 @@ class Server:
                     cl = self.clients.get(id_)
                     if cl is not None:
                         try:
-                            self.publish_to_client(cl, subs, dpk, fast)
+                            delivered = self._deliver_to_client(
+                                cl, subs, dpk, fast, account=True
+                            )
                         except Exception as e:
                             self.log.debug(
                                 "failed publishing packet: error=%s client=%s",
@@ -2890,7 +3084,7 @@ class Server:
                                 id_,
                             )
                         else:
-                            if cl.tenant is not None:
+                            if delivered and cl.tenant is not None:
                                 cl.tenant.messages_out += 1
                                 cl.tenant.bytes_out += len(dpk.payload)
 
@@ -2909,7 +3103,7 @@ class Server:
             cl = self.clients.get(target)
             if cl is not None:
                 try:
-                    self.publish_to_client(cl, sub, out)
+                    self._deliver_to_client(cl, sub, out)
                 except Exception as e:
                     self.log.debug(
                         "failed publishing aggregate: error=%s client=%s",
@@ -3004,13 +3198,15 @@ class Server:
                                 sys_topic)
         for cl, sub in slow:
             try:
-                self.publish_to_client(cl, sub, dpk)
+                delivered = self._deliver_to_client(
+                    cl, sub, dpk, account=True
+                )
             except Exception as e:
                 self.log.debug(
                     "failed publishing packet: error=%s client=%s", e, cl.id
                 )
             else:
-                if cl.tenant is not None:
+                if delivered and cl.tenant is not None:
                     cl.tenant.messages_out += 1
                     cl.tenant.bytes_out += len(dpk.payload)
         if clock is not None:
@@ -3030,8 +3226,45 @@ class Server:
         sockets (idle transport + empty outbound queue, no TLS) flush
         through ONE GIL-released native call; everything else rides the
         bounded outbound queue with the existing backpressure, eviction
-        and drop accounting."""
+        and drop accounting.
+
+        Under the shard fabric the group is split BY OWNING SHARD
+        first: each remote shard receives its whole sub-group as one
+        marshaled call of this same method — the encode already
+        happened once on the publishing shard, and the remote shard
+        runs eligibility, QoS bookkeeping and its own ONE native flush
+        loop-locally (ISSUE 15: whole per-shard delivery batches into
+        the encode-once write path). ``call_soon_threadsafe`` preserves
+        per-publisher FIFO into each shard, so one publisher's
+        deliveries to one subscriber stay in order."""
         from .native import fan_flush
+
+        if self._fabric is not None:
+            try:
+                here: Optional[asyncio.AbstractEventLoop] = (
+                    asyncio.get_running_loop()
+                )
+            except RuntimeError:
+                here = None
+            local: list = []
+            remote: dict = {}
+            for cl, sub in group:
+                loop = cl.net.loop
+                if loop is None or loop is here:
+                    local.append((cl, sub))
+                else:
+                    remote.setdefault(loop, []).append((cl, sub))
+            for loop, rgroup in remote.items():
+                try:
+                    loop.call_soon_threadsafe(
+                        self._flush_variant,
+                        dpk, eff, retain, data, id_off, rgroup, sys_topic,
+                    )
+                except RuntimeError:
+                    continue  # shard gone; its clients are going away
+            if not local:
+                return
+            group = local
 
         count_delivery = not sys_topic
         topic = dpk.topic_name
@@ -3340,7 +3573,9 @@ class Server:
             out = dpk.copy(False)
             out.payload = data
             try:
-                self.publish_to_client(cl, subs, out)
+                delivered = self._deliver_to_client(
+                    cl, subs, out, account=True
+                )
             except Exception as e:
                 self.log.debug(
                     "failed publishing recrypted packet: error=%s "
@@ -3349,8 +3584,9 @@ class Server:
                     id_,
                 )
             else:
-                tenant.messages_out += 1
-                tenant.bytes_out += len(data)
+                if delivered:
+                    tenant.messages_out += 1
+                    tenant.bytes_out += len(data)
 
     def _fan_out_encrypted_batched(
         self, tenant, dpk: Packet, plaintext: bytes, items: list
@@ -3429,15 +3665,18 @@ class Server:
             out = dpk.copy(False)
             out.payload = data
             try:
-                self.publish_to_client(cl, sub, out)
+                delivered = self._deliver_to_client(
+                    cl, sub, out, account=True
+                )
             except Exception as e:
                 self.log.debug(
                     "failed publishing recrypted packet: error=%s "
                     "client=%s", e, cid,
                 )
             else:
-                tenant.messages_out += 1
-                tenant.bytes_out += len(data)
+                if delivered:
+                    tenant.messages_out += 1
+                    tenant.bytes_out += len(data)
 
         amp_tele = self.telemetry
         # the tenant-LOCAL topic (what the subscriber subscribed to):
@@ -3503,6 +3742,74 @@ class Server:
                         "client=%s", e, cl.id,
                     )
         return True
+
+    def _client_loop_local(self, cl: Client) -> bool:
+        """True when the calling thread may touch this client's
+        loop-affine state directly (its owning loop, or no loop)."""
+        loop = cl.net.loop
+        if loop is None:
+            return True
+        try:
+            return loop is asyncio.get_running_loop()
+        except RuntimeError:
+            return False
+
+    def _deliver_to_client(
+        self,
+        cl: Client,
+        sub: Subscription,
+        pk: Packet,
+        fast: Optional["_FrameCache"] = None,
+        account: bool = False,
+    ) -> bool:
+        """``publish_to_client`` with shard-loop affinity (mqtt_tpu.shards):
+        a delivery that mutates per-client loop-affine state (QoS>0
+        packet-id/inflight bookkeeping, outbound topic aliasing) for a
+        client ANOTHER shard owns is marshaled onto that shard's loop;
+        everything else — the shared-frame and plain QoS0 paths, whose
+        only cross-thread touch is the thread-safe outbound queue —
+        runs inline. No fabric = always inline = today's path.
+
+        Returns True when the delivery ran inline (exceptions propagate
+        and the caller does its own accounting); False when marshaled
+        (the owner-loop callback logs failures and, with ``account``,
+        performs the tenant accounting itself)."""
+        if self._fabric is None or self._client_loop_local(cl):
+            self.publish_to_client(cl, sub, pk, fast)
+            return True
+        eff = pk.fixed_header.qos
+        if eff > sub.qos:
+            eff = sub.qos
+        if eff == 0 and cl.properties.props.topic_alias_maximum == 0:
+            self.publish_to_client(cl, sub, pk, fast)
+            return True
+        loop = cl.net.loop
+        try:
+            loop.call_soon_threadsafe(  # type: ignore[union-attr]
+                self._deliver_remote, cl, sub, pk, fast, account
+            )
+        except RuntimeError:
+            pass  # owner shard gone; the client is going away with it
+        return False
+
+    def _deliver_remote(
+        self,
+        cl: Client,
+        sub: Subscription,
+        pk: Packet,
+        fast: Optional["_FrameCache"],
+        account: bool,
+    ) -> None:
+        """The owner-shard half of a marshaled delivery."""
+        try:
+            self.publish_to_client(cl, sub, pk, fast)
+        except Exception as e:
+            self.log.debug(
+                "failed publishing packet: error=%s client=%s", e, cl.id
+            )
+        else:
+            if account:
+                self._note_tenant_out(cl, pk)
 
     def publish_to_client(
         self,
@@ -3610,7 +3917,6 @@ class Server:
 
         try:
             cl.state.outbound.put_nowait(out)
-            cl.state.outbound_qty += 1
             cl.state.outbound_full_since = None
             self._stamp_outbound(cl)
         except asyncio.QueueFull:
@@ -3946,7 +4252,24 @@ class Server:
 
     def disconnect_client(self, cl: Client, code: Code) -> None:
         """Send DISCONNECT and close (server.go:1413-1437). Raises the code
-        for error-class disconnects (mirrors the reference's error return)."""
+        for error-class disconnects (mirrors the reference's error return).
+
+        Under the shard fabric a disconnect targeting a client ANOTHER
+        shard owns (cross-shard takeover, the main loop's eviction/drain
+        paths) is marshaled onto the owning loop — the DISCONNECT write
+        and the transport close are loop-affine. The marshaled form
+        cannot raise; its callers already treat the raise as advisory
+        (every call site catches Code)."""
+        if self._fabric is not None and not self._client_loop_local(cl):
+            loop = cl.net.loop
+            if loop is not None and loop.is_running():
+                try:
+                    loop.call_soon_threadsafe(
+                        self._disconnect_client_remote, cl, code
+                    )
+                    return
+                except RuntimeError:
+                    pass  # owner loop gone; close directly below
         out = Packet(
             fixed_header=FixedHeader(type=pkts.DISCONNECT),
             reason_code=code.code,
@@ -3962,6 +4285,13 @@ class Server:
             cl.stop(code)
             if code.code >= ERR_UNSPECIFIED_ERROR.code:
                 raise code()
+
+    def _disconnect_client_remote(self, cl: Client, code: Code) -> None:
+        """The owner-shard half of a marshaled disconnect."""
+        try:
+            self.disconnect_client(cl, code)
+        except Code:
+            pass
 
     # -- $SYS / housekeeping -----------------------------------------------
 
@@ -4163,6 +4493,13 @@ class Server:
         self.done.set()
         self.log.info("gracefully stopping server")
         await self.listeners.close_all(self._close_listener_clients)
+        if self._fabric is not None:
+            # after the listeners: the drain disconnects were marshaled
+            # onto the shard loops, which must still be alive to run
+            # them; stop() then drains the establish tasks and joins
+            # the shard threads (mqtt_tpu.shards)
+            await self._fabric.stop()
+            self._fabric = None
         # stage first (parked publishes resolve via the host walk), then
         # the matcher; shutdown LWT publishes and clean-session
         # unsubscribes must still flow through the live delta overlay

@@ -47,6 +47,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
@@ -123,8 +124,18 @@ class MatchStage:
         self.max_pending = max(1, max_pending)
         self.admission_fallbacks = 0
         self.peak_pending = 0
-        # parked publishes: (topic, future, stage clock or None)
+        # parked publishes: (topic, future, stage clock or None).
+        # Guarded by _plock: under the event-loop shard fabric
+        # (mqtt_tpu.shards) submit() runs on every shard's loop while
+        # the collector drains on the stage's own loop — the park list
+        # is the one cross-thread hand-off point. Futures are created
+        # on the SUBMITTING loop and resolved back onto it
+        # (call_soon_threadsafe when it is not the stage loop), so each
+        # publisher awaits a loop-local future exactly as before.
         self._pending: list[tuple] = []
+        self._plock = threading.Lock()
+        # the loop the collector/drainer run on (start()'s loop)
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._wake: Optional[asyncio.Event] = None
         self._queue: Optional[asyncio.Queue] = None
         self._tasks: list[asyncio.Task] = []
@@ -203,6 +214,7 @@ class MatchStage:
     def start(self) -> None:
         """Create the collector/drainer tasks on the running loop."""
         loop = asyncio.get_running_loop()
+        self._loop = loop
         self._wake = asyncio.Event()
         self._executor = ThreadPoolExecutor(
             max_workers=max(2, self.max_inflight),
@@ -231,8 +243,9 @@ class MatchStage:
         for t in self._tasks:
             t.cancel()
         await asyncio.gather(*self._tasks, return_exceptions=True)
-        self._fallback_all(self._pending, klass="stop")
-        self._pending = []
+        with self._plock:
+            parked, self._pending = self._pending, []
+        self._fallback_all(parked, klass="stop")
         queue = self._queue
         if queue is not None:
             while not queue.empty():
@@ -271,21 +284,39 @@ class MatchStage:
         (2x the latency budget), the publish resolves immediately via
         the host walk — the degraded-but-bounded mode — instead of
         growing the backlog."""
-        fut = asyncio.get_running_loop().create_future()
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
         wake = self._wake
         if self._stopping or wake is None:
             fut.set_result(self.host_fallback(topic))
             return fut
-        if len(self._pending) >= self.max_pending or self._past_deadline():
+        with self._plock:
+            if len(self._pending) >= self.max_pending or self._past_deadline():
+                admitted = False
+            else:
+                admitted = True
+                self._pending.append((topic, fut, clock, feats, rjob))
+                if len(self._pending) > self.peak_pending:
+                    self.peak_pending = len(self._pending)
+        if not admitted:
             self.admission_fallbacks += 1
             if self.telemetry is not None:
                 self.telemetry.note_fallback("admission")
             fut.set_result(self.host_fallback(topic))
             return fut
-        self._pending.append((topic, fut, clock, feats, rjob))
-        if len(self._pending) > self.peak_pending:
-            self.peak_pending = len(self._pending)
-        wake.set()
+        # the wake Event is loop-affine: shard-loop submitters marshal
+        # the set() onto the stage's loop (mqtt_tpu.shards). A never-
+        # started stage (_loop None: unit harnesses that drive the
+        # collector by hand) keeps the direct set.
+        if self._loop is None or loop is self._loop:
+            wake.set()
+        else:
+            try:
+                self._loop.call_soon_threadsafe(wake.set)
+            except RuntimeError:
+                # stage loop gone mid-shutdown: serve the host walk now
+                if not fut.done():
+                    fut.set_result(self.host_fallback(topic))
         return fut
 
     def _past_deadline(self) -> bool:
@@ -352,11 +383,13 @@ class MatchStage:
                 if w > 0:
                     await asyncio.sleep(w)
                 cap = self._batch_cap  # the drainer may have adapted it
-            batch, self._pending = (
-                self._pending[:cap],
-                self._pending[cap:],
-            )
-            if self._pending:
+            with self._plock:
+                batch, self._pending = (
+                    self._pending[:cap],
+                    self._pending[cap:],
+                )
+                leftovers = bool(self._pending)
+            if leftovers:
                 wake.set()  # leftovers start the next window now
             # a caller future cancelled mid-window (client disconnected
             # during accumulation) is dead weight: drop it here so the
@@ -538,8 +571,28 @@ class MatchStage:
                         ck.stamp_until("d2h", d2h[1])
                     else:
                         ck.stamp("device_batch")
-                if not fut.done():
-                    fut.set_result(subs)
+                self._resolve(fut, subs)
+
+    def _resolve(self, fut: "asyncio.Future", value) -> None:
+        """Complete one caller future ON ITS OWN LOOP: a future parked
+        by a shard-loop submitter (mqtt_tpu.shards) must not have
+        set_result called from the stage's loop — done-callbacks would
+        be scheduled cross-thread. Stage-loop futures resolve inline
+        (the single-loop path, unchanged)."""
+        loop = fut.get_loop()
+        if self._loop is None or loop is self._loop:
+            if not fut.done():
+                fut.set_result(value)
+            return
+
+        def _set() -> None:
+            if not fut.done():
+                fut.set_result(value)
+
+        try:
+            loop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # submitter's loop closed; nobody is awaiting
 
     def _fallback_all(self, items, klass: str = "stop") -> None:
         """Resolve parked items via the host walk. ``items`` yield
@@ -552,8 +605,9 @@ class MatchStage:
                 continue
             n += 1
             try:
-                fut.set_result(self.host_fallback(topic))
+                self._resolve(fut, self.host_fallback(topic))
             except Exception as e:  # pragma: no cover - host walk is total
-                fut.set_exception(e)
+                if not fut.done():
+                    fut.set_exception(e)
         if n and self.telemetry is not None:
             self.telemetry.note_fallback(klass, n)

@@ -35,7 +35,7 @@ from .packets import (
 )
 
 
-def _connect_bytes(client_id: str, version: int = 4) -> bytes:
+def _connect_bytes(client_id: str, version: int = 4, keepalive: int = 120) -> bytes:
     return encode_packet(
         Packet(
             fixed_header=FixedHeader(type=CONNECT),
@@ -43,7 +43,7 @@ def _connect_bytes(client_id: str, version: int = 4) -> bytes:
             connect=ConnectParams(
                 protocol_name=b"MQTT",
                 clean=True,
-                keepalive=120,
+                keepalive=keepalive,
                 client_identifier=client_id,
             ),
         )
@@ -273,6 +273,34 @@ async def run_stress(
         "aggregate_msgs_per_sec": round(n_clients * n_msgs / wall),
         "wall_s": round(wall, 2),
     }
+
+
+async def ramp_idle(
+    host: str,
+    port: int,
+    n: int,
+    client_prefix: str = "idle",
+    batch: int = 200,
+) -> list:
+    """Attach ``n`` mostly-idle device connections (CONNECT, then
+    silence; keepalive 0 so the broker never reaps them) — the
+    connection-scale axis of bench cfg 8 and exp/conn_smoke.py
+    (ISSUE 15). Returns the writers; close them to drop the
+    population."""
+    writers: list = []
+
+    async def one(i: int) -> None:
+        r, w = await asyncio.open_connection(host, port)
+        w.write(_connect_bytes(f"{client_prefix}-{i}", keepalive=0))
+        await w.drain()
+        await asyncio.wait_for(r.readexactly(4), 30)  # CONNACK
+        writers.append(w)
+
+    for base in range(0, n, batch):
+        await asyncio.gather(
+            *(one(i) for i in range(base, min(base + batch, n)))
+        )
+    return writers
 
 
 async def run_flatness(
@@ -1045,6 +1073,17 @@ def broker_main(
             # subprocess config-8 legs A/B cleanly too
             opt_kw["matcher_lazy_views"] = False
             opt_kw["fanout_batch"] = False
+        shards = int(os.environ.get("MQTT_TPU_LOOP_SHARDS", "0") or 0)
+        if os.environ.get("BENCH_SHARDS") == "1":
+            # bench A/B knob (ISSUE 15): BENCH_SHARDS=1 forces the
+            # single-loop front-end whatever MQTT_TPU_LOOP_SHARDS says,
+            # so the cfg-8 connections matrix A/Bs the fabric cleanly
+            shards = 1
+        if shards > 1:
+            opt_kw["loop_shards"] = shards
+            accept = os.environ.get("MQTT_TPU_LOOP_SHARD_ACCEPT", "")
+            if accept:
+                opt_kw["loop_shard_accept"] = accept
         srv = Server(Options(device_matcher=device_matcher, **opt_kw))
         srv.add_hook(AllowHook())
         clustered = wid_env is not None

@@ -78,6 +78,7 @@ LOCK_NAMES = (
     "overload_governor",
     "overload_peer_pressure",
     "matcher_breaker",
+    "shard_fabric",
 )
 
 

@@ -1,0 +1,102 @@
+"""Unit tests for the bench-history trend gate (exp/bench_trend.py),
+grown in ISSUE 15 with the per-config scalar gate that watches cfg 8's
+``receive_flatness_ratio`` beside the headline."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from exp.bench_trend import (  # noqa: E402
+    CONFIG_SCALARS,
+    check_config_scalar,
+    check_trend,
+    load_history,
+    usable_rounds,
+)
+
+
+def entry(value, flatness=None, round_tag="r", metric="m"):
+    e = {"round": round_tag, "metric": metric, "value": value, "configs": {}}
+    if flatness is not None:
+        e["configs"]["8_publish_storm"] = {"receive_flatness_ratio": flatness}
+    return e
+
+
+class TestHeadlineTrend:
+    def test_regression_fails(self):
+        entries = [entry(100), entry(110), entry(100), entry(60)]
+        ok, msg = check_trend(entries)
+        assert not ok and "REGRESSION" in msg
+
+    def test_within_threshold_passes(self):
+        entries = [entry(100), entry(110), entry(100), entry(90)]
+        ok, _ = check_trend(entries)
+        assert ok
+
+    def test_too_few_rounds_pass(self):
+        ok, msg = check_trend([entry(100)])
+        assert ok and "nothing to gate" in msg
+
+
+class TestConfigScalarGate:
+    def test_flatness_regression_fails(self):
+        entries = [
+            entry(100, flatness=0.5),
+            entry(100, flatness=0.6),
+            entry(100, flatness=0.55),
+            entry(100, flatness=0.2),  # > 25% below the 0.55 median
+        ]
+        ok, msg = check_config_scalar(entries, "8_publish_storm", "receive_flatness_ratio")
+        assert not ok and "REGRESSION" in msg
+
+    def test_flatness_within_threshold_passes(self):
+        entries = [
+            entry(100, flatness=0.5),
+            entry(100, flatness=0.6),
+            entry(100, flatness=0.5),
+        ]
+        ok, _ = check_config_scalar(entries, "8_publish_storm", "receive_flatness_ratio")
+        assert ok
+
+    def test_rounds_without_the_scalar_are_skipped(self):
+        entries = [
+            entry(100),  # pre-ISSUE-15 round: no flatness scalar
+            entry(100, flatness=0.5),
+            entry(100, flatness=0.52),
+        ]
+        ok, msg = check_config_scalar(entries, "8_publish_storm", "receive_flatness_ratio")
+        assert ok
+
+    def test_newest_round_without_scalar_passes_with_notice(self):
+        entries = [
+            entry(100, flatness=0.5),
+            entry(100, flatness=0.6),
+            entry(100),  # newest skipped cfg 8: must not be judged
+        ]
+        ok, msg = check_config_scalar(entries, "8_publish_storm", "receive_flatness_ratio")
+        assert ok and "did not measure" in msg
+
+    def test_too_few_usable_rounds_pass(self):
+        ok, msg = check_config_scalar(
+            [entry(100, flatness=0.5)], "8_publish_storm",
+            "receive_flatness_ratio",
+        )
+        assert ok and "nothing to gate" in msg
+
+    def test_flatness_is_a_registered_scalar(self):
+        assert ("8_publish_storm", "receive_flatness_ratio") in CONFIG_SCALARS
+
+
+class TestLedgerHoist:
+    def test_history_config_block_keeps_top_level_scalars(self):
+        from bench import _history_config_block
+
+        block = _history_config_block(
+            {
+                "receive_flatness_ratio": 0.42,
+                "receive_flatness": {"nested": "dropped"},
+                "cells": [1, 2, 3],
+            }
+        )
+        assert block == {"receive_flatness_ratio": 0.42}

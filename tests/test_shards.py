@@ -1,0 +1,544 @@
+"""Event-loop shard fabric tests (ISSUE 15 / ROADMAP item 4).
+
+Covers the fabric's cross-shard contracts end to end over real TCP:
+delivery parity with the single-loop oracle (QoS0 shared frames and
+QoS1 marshaled bookkeeping), least-loaded dispatch spread, cross-shard
+session takeover through the clients registry, the per-shard
+slow-consumer eviction sweep vs the single-loop sweep's semantics, the
+thread-safe OutboundQueue, and the staging pipeline's cross-loop
+submit/resolve seam.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import socket
+import threading
+import time
+
+import pytest
+
+from mqtt_tpu.clients import OutboundQueue
+from mqtt_tpu.hooks.auth.allow_all import AllowHook
+from mqtt_tpu.listeners import Config as LConfig
+from mqtt_tpu.listeners.tcp import TCP
+from mqtt_tpu.packets import DISCONNECT, PUBACK, PUBLISH, Subscription
+from mqtt_tpu.server import Options, Server
+from mqtt_tpu.staging import MatchStage
+from mqtt_tpu.topics import Subscribers
+from tests.test_server import (
+    CONNACK,
+    SUBACK,
+    connect_packet,
+    pub_packet,
+    read_wire_packet,
+    run,
+    sub_packet,
+)
+
+TIMEOUT = 10
+
+
+class FabricHarness:
+    """One broker on a real TCP listener + raw socket clients."""
+
+    def __init__(self, shards: int = 3, **opt_kw):
+        opt_kw.setdefault("loop_shards", shards)
+        self.server = Server(Options(**opt_kw))
+        self.server.add_hook(AllowHook())
+        self.server.add_listener(
+            TCP(LConfig(type="tcp", id="fab", address="127.0.0.1:0"))
+        )
+        self.port = 0
+
+    async def start(self):
+        await self.server.serve()
+        addr = self.server.listeners.get("fab").address()
+        self.port = int(addr.rsplit(":", 1)[1])
+        return self
+
+    async def connect(self, client_id, version=4, clean=True, expect_code=0):
+        reader, writer = await asyncio.open_connection("127.0.0.1", self.port)
+        writer.write(connect_packet(client_id, version, clean=clean))
+        await writer.drain()
+        ack = await asyncio.wait_for(read_wire_packet(reader, version), TIMEOUT)
+        assert ack.fixed_header.type == CONNACK
+        assert ack.reason_code == expect_code
+        return reader, writer, ack
+
+    async def subscribe(self, reader, writer, pid, filters, version=4):
+        writer.write(sub_packet(pid, filters, version=version))
+        await writer.drain()
+        ack = await asyncio.wait_for(read_wire_packet(reader, version), TIMEOUT)
+        assert ack.fixed_header.type == SUBACK
+
+    def shard_of(self, client_id):
+        cl = self.server.clients.get(client_id)
+        assert cl is not None
+        fabric = self.server._fabric
+        if fabric is None:
+            return None
+        return fabric.shard_of(cl.net.loop)
+
+    async def stop(self):
+        await self.server.close()
+
+
+async def collect_publishes(reader, want, version=4, timeout=TIMEOUT):
+    """Read until ``want`` PUBLISH packets arrive; returns them."""
+    got = []
+    deadline = time.monotonic() + timeout
+    while len(got) < want:
+        budget = deadline - time.monotonic()
+        assert budget > 0, f"timed out with {len(got)}/{want} publishes"
+        pk = await asyncio.wait_for(read_wire_packet(reader, version), budget)
+        if pk.fixed_header.type == PUBLISH:
+            got.append(pk)
+    return got
+
+
+# -- unit: the thread-safe outbound queue -----------------------------------
+
+
+class TestOutboundQueue:
+    def test_fifo_and_bounds(self):
+        async def scenario():
+            q = OutboundQueue(maxsize=3)
+            for i in range(3):
+                q.put_nowait(i)
+            assert q.full() and q.qsize() == 3 and not q.empty()
+            with pytest.raises(asyncio.QueueFull):
+                q.put_nowait(99)
+            assert [await q.get() for _ in range(3)] == [0, 1, 2]
+            assert q.empty() and not q.full()
+
+        run(scenario())
+
+    def test_get_waits_for_put(self):
+        async def scenario():
+            q = OutboundQueue(maxsize=8)
+            getter = asyncio.get_running_loop().create_task(q.get())
+            await asyncio.sleep(0.01)
+            assert not getter.done()
+            q.put_nowait("x")
+            assert await asyncio.wait_for(getter, TIMEOUT) == "x"
+
+        run(scenario())
+
+    def test_cross_thread_put_wakes_consumer(self):
+        """A producer on a foreign thread (no loop at all) must wake a
+        parked consumer through call_soon_threadsafe."""
+
+        async def scenario():
+            q = OutboundQueue(maxsize=8)
+            getter = asyncio.get_running_loop().create_task(q.get())
+            await asyncio.sleep(0.01)
+            t = threading.Thread(target=q.put_nowait, args=("cross",))
+            t.start()
+            assert await asyncio.wait_for(getter, TIMEOUT) == "cross"
+            t.join(TIMEOUT)
+
+        run(scenario())
+
+    def test_cancelled_get_clears_waiter(self):
+        async def scenario():
+            q = OutboundQueue(maxsize=8)
+            getter = asyncio.get_running_loop().create_task(q.get())
+            await asyncio.sleep(0.01)
+            getter.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await getter
+            # a later put must not wedge on the dead waiter, and a new
+            # consumer still gets the item
+            q.put_nowait("alive")
+            assert await asyncio.wait_for(q.get(), TIMEOUT) == "alive"
+
+        run(scenario())
+
+
+# -- fabric: dispatch + delivery parity -------------------------------------
+
+
+SCENARIO_FILTERS = {
+    "subA": "t/#",
+    "subB": "t/+/x",
+    "subC": "t/1/x",
+}
+SCENARIO_TOPICS = ["t/1/x", "t/2/x", "t/0", "t/1/y"]
+EXPECTED = {
+    # host-trie oracle, computed by hand from the filters above
+    "subA": {"t/1/x", "t/2/x", "t/0", "t/1/y"},
+    "subB": {"t/1/x", "t/2/x"},
+    "subC": {"t/1/x"},
+}
+
+
+async def _delivery_scenario(shards: int) -> dict:
+    h = await FabricHarness(shards=shards).start()
+    try:
+        subs = {}
+        for pid, (cid, filt) in enumerate(SCENARIO_FILTERS.items(), start=1):
+            r, w, _ = await h.connect(cid)
+            await h.subscribe(r, w, pid, [Subscription(filter=filt, qos=0)])
+            subs[cid] = (r, w)
+        pub_r, pub_w, _ = await h.connect("pub")
+        for topic in SCENARIO_TOPICS:
+            pub_w.write(pub_packet(topic, topic.encode()))
+        await pub_w.drain()
+        got = {}
+        for cid in SCENARIO_FILTERS:
+            pks = await collect_publishes(subs[cid][0], len(EXPECTED[cid]))
+            got[cid] = {pk.topic_name for pk in pks}
+            for pk in pks:
+                assert bytes(pk.payload) == pk.topic_name.encode()
+        return got
+    finally:
+        await h.stop()
+
+
+class TestFabricDelivery:
+    def test_delivery_matches_single_loop_oracle(self):
+        """The same pub/sub scenario delivers identically with the
+        fabric on (3 shards) and off (the single-loop oracle)."""
+
+        fabric = run(_delivery_scenario(3))
+        single = run(_delivery_scenario(1))
+        assert fabric == single == EXPECTED
+
+    def test_least_loaded_spread(self):
+        async def scenario():
+            h = await FabricHarness(shards=3).start()
+            try:
+                conns = [await h.connect(f"idle{i}") for i in range(9)]
+                spread = h.server._fabric.spread()
+                assert sum(spread.values()) == 9
+                assert max(spread.values()) - min(spread.values()) <= 1
+                assert h.server._fabric.dispatched == 9
+                # every client's read loop runs on ITS shard's loop
+                for i in range(9):
+                    cl = h.server.clients.get(f"idle{i}")
+                    assert h.server._fabric.owns(cl.net.loop)
+                for _r, w, _a in conns:
+                    w.close()
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+    def test_per_shard_scan_gate_default_on(self):
+        async def scenario():
+            h = await FabricHarness(shards=2).start()
+            try:
+                r, w, _ = await h.connect("gated")
+                cl = h.server.clients.get("gated")
+                shard = h.shard_of("gated")
+                assert cl.scan_gate is not None
+                assert cl.scan_gate is shard.scan_gate
+                # distinct per shard
+                gates = {s.scan_gate for s in h.server._fabric.shards}
+                assert len(gates) == 2
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+    def test_shard_metrics_exposed(self):
+        async def scenario():
+            h = await FabricHarness(shards=2).start()
+            try:
+                r, w, _ = await h.connect("m1")
+                text = h.server.telemetry.registry.exposition()
+                for family in (
+                    "mqtt_tpu_shard_connections",
+                    "mqtt_tpu_shard_accepted_total",
+                    "mqtt_tpu_shard_evictions_total",
+                    "mqtt_tpu_shard_scan_batches_total",
+                    "mqtt_tpu_shard_scan_buffers_total",
+                    "mqtt_tpu_shard_backlog_messages",
+                    "mqtt_tpu_shard_dispatch_total",
+                ):
+                    assert family in text, family
+                assert 'shard="0"' in text and 'shard="1"' in text
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+
+class TestCrossShardQoS1:
+    def test_qos1_delivery_across_shards(self):
+        """Publisher and subscriber on DIFFERENT shards: the QoS1
+        bookkeeping (packet id, inflight) is marshaled to the owner
+        loop and the ack cycle completes."""
+
+        async def scenario():
+            h = await FabricHarness(shards=2).start()
+            try:
+                sub_r, sub_w, _ = await h.connect("q1sub")
+                pub_r, pub_w, _ = await h.connect("q1pub")
+                assert h.shard_of("q1sub") is not h.shard_of("q1pub")
+                await h.subscribe(
+                    sub_r, sub_w, 1, [Subscription(filter="q/#", qos=1)]
+                )
+                pub_w.write(pub_packet("q/a", b"hello", qos=1, pid=7))
+                await pub_w.drain()
+                # publisher's inbound ack
+                ack = await asyncio.wait_for(
+                    read_wire_packet(pub_r, 4), TIMEOUT
+                )
+                assert ack.fixed_header.type == PUBACK
+                assert ack.packet_id == 7
+                # subscriber's delivery, marshaled cross-shard
+                pk = (await collect_publishes(sub_r, 1))[0]
+                assert pk.topic_name == "q/a"
+                assert pk.fixed_header.qos == 1
+                assert pk.packet_id > 0
+                scl = h.server.clients.get("q1sub")
+                assert len(scl.state.inflight) == 1
+                from tests.test_server import encode_packet
+                from mqtt_tpu.packets import FixedHeader, Packet
+
+                sub_w.write(
+                    encode_packet(
+                        Packet(
+                            fixed_header=FixedHeader(type=PUBACK),
+                            protocol_version=4,
+                            packet_id=pk.packet_id,
+                        )
+                    )
+                )
+                await sub_w.drain()
+                deadline = time.monotonic() + TIMEOUT
+                while len(scl.state.inflight) and time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                assert len(scl.state.inflight) == 0
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+
+class TestCrossShardTakeover:
+    def test_same_id_reconnects_on_another_shard(self):
+        """The registry-routed takeover (ISSUE 15): a persistent session
+        reconnecting onto a DIFFERENT shard inherits its subscriptions,
+        and the old connection is closed from the new client's shard."""
+
+        async def scenario():
+            h = await FabricHarness(shards=2).start()
+            try:
+                # steer placement: filler -> shard 0, dup#1 -> shard 1,
+                # dup#2 -> shard 0 (tie breaks to the lowest index)
+                f_r, f_w, _ = await h.connect("filler")
+                r1, w1, _ = await h.connect("dup", version=4, clean=False)
+                shard1 = h.shard_of("dup")
+                await h.subscribe(
+                    r1, w1, 1, [Subscription(filter="take/#", qos=0)]
+                )
+                r2, w2, ack2 = await h.connect("dup", version=4, clean=False)
+                shard2 = h.shard_of("dup")
+                assert shard1 is not shard2, "takeover landed on one shard"
+                assert ack2.session_present  # [MQTT-3.2.2-3]
+                # the OLD connection dies (cross-shard marshaled stop)
+                with pytest.raises(
+                    (asyncio.IncompleteReadError, ConnectionError)
+                ):
+                    while True:
+                        pk = await asyncio.wait_for(
+                            read_wire_packet(r1, 4), TIMEOUT
+                        )
+                        if pk.fixed_header.type == DISCONNECT:
+                            raise ConnectionResetError("takeover disconnect")
+                # the inherited subscription delivers WITHOUT resubscribe
+                p_r, p_w, _ = await h.connect("tpub")
+                p_w.write(pub_packet("take/x", b"inherited"))
+                await p_w.drain()
+                pk = (await collect_publishes(r2, 1))[0]
+                assert pk.topic_name == "take/x"
+                assert bytes(pk.payload) == b"inherited"
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+
+class TestPerShardEviction:
+    @pytest.mark.parametrize("shards", [1, 3])
+    def test_slow_consumer_evicted(self, shards):
+        """Per-shard eviction-sweep semantics vs the single-loop oracle:
+        the same stalled consumer under forced SHED is evicted by the
+        sweep on either front-end, and with the fabric the sweep RUNS on
+        the owning shard's loop."""
+
+        async def scenario():
+            h = await FabricHarness(
+                shards=shards,
+                overload_eval_interval_ms=20.0,
+                overload_eviction_grace_ms=100.0,
+                overload_min_dwell_ms=50.0,
+                overload_client_buffer_limit_bytes=4096,
+            ).start()
+            try:
+                gov = h.server.overload
+                slow_r, slow_w, _ = await h.connect("stall")
+                # shrink the victim's buffers so the backlog shows fast
+                scl = h.server.clients.get("stall")
+                await h.subscribe(
+                    slow_r, slow_w, 1, [Subscription(filter="e/#", qos=0)]
+                )
+                slow_w.transport.pause_reading()  # a truly stalled reader
+
+                pub_r, pub_w, _ = await h.connect("epub")
+                payload = b"x" * 32768
+                for _ in range(60):
+                    pub_w.write(pub_packet("e/x", payload))
+                await pub_w.drain()
+                await asyncio.sleep(0.3)
+
+                def sweep():
+                    """Run the sweep where the victim's loop lives."""
+                    fabric = h.server._fabric
+                    if fabric is None:
+                        h.server.sweep_overload()
+                        return
+                    gov.evaluate(force=True)
+                    cl = h.server.clients.get("stall")
+                    shard = fabric.shard_of(cl.net.loop)
+
+                    async def _s():
+                        return h.server.sweep_clients_for_loop(shard.loop)
+
+                    shard.evictions += asyncio.run_coroutine_threadsafe(
+                        _s(), shard.loop
+                    ).result(TIMEOUT)
+
+                sweep()  # observes the over-limit backlog
+                assert scl.state.backlog_over_since is not None
+                pressure = [2.0]
+                gov.add_source("test", lambda: pressure[0])
+                sweep()
+                assert gov.state == "shed"
+                assert gov.evictions == 0  # grace not elapsed
+                await asyncio.sleep(0.15)
+                sweep()
+                assert gov.evictions == 1
+                deadline = time.monotonic() + TIMEOUT
+                while not scl.closed and time.monotonic() < deadline:
+                    await asyncio.sleep(0.02)
+                assert scl.closed
+                if h.server._fabric is not None:
+                    assert sum(
+                        s.evictions for s in h.server._fabric.shards
+                    ) >= 1
+            finally:
+                await h.stop()
+
+        run(scenario())
+
+
+# -- staging: cross-loop submit/resolve -------------------------------------
+
+
+class _FakeMatcher:
+    def __init__(self):
+        self.batches = []
+
+    def match_topics_async(self, topics, profile=None):
+        self.batches.append(list(topics))
+
+        def resolve():
+            return [Subscribers() for _ in topics]
+
+        return resolve
+
+
+class TestStagingCrossLoop:
+    def test_submit_from_foreign_loop_resolves_there(self):
+        """A shard-loop publisher submits into a stage whose collector
+        runs on another loop: the future must park AND resolve on the
+        submitter's loop (mqtt_tpu.shards contract)."""
+
+        async def scenario():
+            stage = MatchStage(
+                _FakeMatcher(), lambda t: Subscribers(), window_s=0.001
+            )
+            stage.start()
+            results = {}
+            loop2 = asyncio.new_event_loop()
+            t = threading.Thread(target=loop2.run_forever, daemon=True)
+            t.start()
+
+            async def submit_there():
+                fut = stage.submit("from/shard")
+                assert fut.get_loop() is loop2
+                results["value"] = await asyncio.wait_for(fut, TIMEOUT)
+                results["loop"] = asyncio.get_running_loop()
+
+            cfut = asyncio.run_coroutine_threadsafe(submit_there(), loop2)
+            deadline = time.monotonic() + TIMEOUT
+            while not cfut.done() and time.monotonic() < deadline:
+                await asyncio.sleep(0.01)
+            cfut.result(0)
+            assert isinstance(results["value"], Subscribers)
+            assert results["loop"] is loop2
+            await stage.stop()
+            loop2.call_soon_threadsafe(loop2.stop)
+            t.join(TIMEOUT)
+            loop2.close()
+
+        run(scenario())
+
+    def test_stop_resolves_foreign_parked_futures(self):
+        async def scenario():
+            stage = MatchStage(
+                _FakeMatcher(), lambda t: Subscribers(), window_s=0.001
+            )
+            # armed but never started: parked entries stay parked
+            stage._wake = asyncio.Event()
+            loop2 = asyncio.new_event_loop()
+            t = threading.Thread(target=loop2.run_forever, daemon=True)
+            t.start()
+            holder = {}
+
+            async def park():
+                holder["fut"] = stage.submit("parked/topic")
+                await asyncio.sleep(0)
+
+            asyncio.run_coroutine_threadsafe(park(), loop2).result(TIMEOUT)
+            await stage.stop()
+
+            async def check():
+                return await asyncio.wait_for(holder["fut"], TIMEOUT)
+
+            got = asyncio.run_coroutine_threadsafe(check(), loop2).result(
+                TIMEOUT
+            )
+            assert isinstance(got, Subscribers)
+            loop2.call_soon_threadsafe(loop2.stop)
+            t.join(TIMEOUT)
+            loop2.close()
+
+        run(scenario())
+
+
+class TestConfigKnobs:
+    def test_options_normalization(self):
+        o = Options(loop_shards=-3, loop_shard_accept="bogus")
+        o.ensure_defaults()
+        assert o.loop_shards == 1
+        assert o.loop_shard_accept == "handoff"
+        o2 = Options(loop_shards=4, loop_shard_accept="REUSEPORT")
+        o2.ensure_defaults()
+        assert o2.loop_shards == 4
+        assert o2.loop_shard_accept == "reuseport"
+
+    def test_config_file_passthrough(self):
+        from mqtt_tpu.config import from_bytes
+
+        opts = from_bytes(
+            b'{"options": {"loop_shards": 3, "loop_shard_accept": '
+            b'"reuseport", "scan_coalesce": true}}'
+        )
+        assert opts.loop_shards == 3
+        assert opts.loop_shard_accept == "reuseport"
+        assert opts.scan_coalesce is True

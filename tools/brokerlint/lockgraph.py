@@ -89,6 +89,9 @@ LOCK_ORDER = (
     "flight_ring",
     "trace_ring",
     "metrics_registry",
+    # the shard router's dispatch counter lock (mqtt_tpu.shards): a pure
+    # leaf — nothing is ever acquired under it
+    "shard_fabric",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "threading.Lock", "threading.RLock"}
