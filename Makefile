@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke bench-trend scrape-cluster
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -99,3 +99,13 @@ pipeline-smoke:
 # conn-smoke.json (uploaded as a CI artifact)
 conn-smoke:
 	env JAX_PLATFORMS=cpu $(PY) exp/conn_smoke.py
+
+# crash-recovery smoke (exp/recovery_smoke.py): seed a broker subprocess
+# with persistent sessions + retained state over the log-structured
+# store, kill -9 it, restart on the same directory, assert the recovery
+# budget, the healthz recovering->ready flip, exact restored counts, and
+# the post-restart delivery oracle (session resume, live routing,
+# retained redelivery through the device matcher with zero oracle
+# mismatches); writes recovery-smoke.json (uploaded as a CI artifact)
+recovery-smoke:
+	env JAX_PLATFORMS=cpu $(PY) exp/recovery_smoke.py

@@ -1108,6 +1108,144 @@ def _recrypt_broker_ab(fast: bool) -> dict:
     return asyncio.run(main())
 
 
+def run_cfg11(fast: bool, rng) -> dict:
+    """Config 11 (ISSUE 16): the durable session plane. Two legs:
+
+    1. recovery-time vs key count over the log-structured store, A/B
+       between pure log replay and snapshot+tail (the checkpoint is the
+       whole point: replay cost must scale with the tail, not history);
+    2. retained wildcard-scan throughput, device kernel
+       (ops/retained.RetainedMatchEngine) vs the host trie walk
+       (TopicsIndex.messages), with a full parity check first.
+    """
+    import shutil
+    import tempfile
+
+    from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+    from mqtt_tpu.ops.retained import RetainedMatchEngine
+    from mqtt_tpu.packets import PUBLISH, FixedHeader, Packet
+    from mqtt_tpu.topics import TopicsIndex
+
+    # -- leg 1: recovery-time sweep --------------------------------------
+    scales = [
+        int(s)
+        for s in os.environ.get(
+            "BENCH_DURABLE_KEYS",
+            "2000,10000" if fast else "10000,100000,1000000",
+        ).split(",")
+        if s.strip()
+    ]
+    tail_every = 20  # after the checkpoint, 5% of keys get a tail update
+    recovery = []
+    for n in scales:
+        row: dict = {"keys": n}
+        for label, snap in (("log", False), ("snapshot", True)):
+            d = tempfile.mkdtemp(prefix="bench-logkv-")
+            try:
+                s = LogKVStore()
+                s.init(LogKVOptions(path=d, gc_interval=0.0))
+                # session-plane shaped records (the restart workload is
+                # dominated by SUB_ rows: one per persisted subscription)
+                for i in range(n):
+                    s._set(f"SUB_cl{i}:bench/c{i}/#", b'{"qos":1}')
+                if snap:
+                    s.snapshot()
+                    for i in range(0, n, tail_every):
+                        s._set(f"SUB_cl{i}:bench/c{i}/#", b'{"qos":2}')
+                s.stop()
+                t0 = time.perf_counter()
+                s2 = LogKVStore()
+                s2.init(LogKVOptions(path=d, gc_interval=0.0))
+                dt = time.perf_counter() - t0
+                st = s2.durable_stats()
+                s2.stop()
+                if st["keys"] != n:
+                    raise AssertionError(
+                        f"cfg11 recovery lost keys: {st['keys']} != {n}"
+                    )
+                row[f"recovery_s_{label}"] = round(dt, 4)
+                row[f"replayed_keys_{label}"] = st["replayed_keys"]
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
+        row["snapshot_speedup"] = round(
+            row["recovery_s_log"] / max(row["recovery_s_snapshot"], 1e-9), 2
+        )
+        recovery.append(row)
+        log(f"cfg11 recovery {row}")
+    top = recovery[-1]
+
+    # -- leg 2: retained matching, device kernel vs host walk ------------
+    n_ret = 2_000 if fast else 50_000
+    idx = TopicsIndex()
+    for i in range(n_ret):
+        idx.retain_message(
+            Packet(
+                fixed_header=FixedHeader(type=PUBLISH, retain=True),
+                topic_name=(
+                    f"region{i % 40}/device{(i // 40) % 50}"
+                    f"/metric{i // 2000}"
+                ),
+                payload=b"r",
+            )
+        )
+    # wildcard shapes only: the engine declines exact filters by design
+    # (a host dict hit beats any kernel), so they would bench the
+    # fallback path, not the kernel
+    filters = []
+    for k in range(64):
+        filters.append(
+            [
+                f"region{k % 40}/device{k % 50}/+",
+                f"region{k % 40}/+/metric{k % 25}",
+                f"region{k % 40}/#",
+                f"+/device{k % 50}/metric{k % 25}",
+            ][k % 4]
+        )
+    eng = RetainedMatchEngine(idx, max_levels=8, oracle_sample=0)
+    eng.reseed()
+    mismatched = 0
+    for f in filters:  # parity first: the speed of a wrong scan is noise
+        dev = eng.match(f)
+        host = {pk.topic_name for pk in idx.messages(f)}
+        if dev is None or set(dev) != host:
+            mismatched += 1
+    rounds = 4 if fast else 20
+
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for f in filters:
+            eng.match(f)
+    dev_dt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        for f in filters:
+            idx.messages(f)
+    host_dt = time.perf_counter() - t0
+    scans = rounds * len(filters)
+
+    out = {
+        # top-level scalars are what the history ledger keeps (and what
+        # exp/bench_trend.py gates): replay throughput at the largest
+        # scale + the device scan rate, both higher-is-better
+        "recovery_keys_per_sec": round(
+            top["keys"] / max(top["recovery_s_snapshot"], 1e-9)
+        ),
+        "recovery_s_log": top["recovery_s_log"],
+        "recovery_s_snapshot": top["recovery_s_snapshot"],
+        "snapshot_speedup": top["snapshot_speedup"],
+        "max_keys": top["keys"],
+        "retained_corpus": n_ret,
+        "retained_device_scans_per_sec": round(scans / max(dev_dt, 1e-9)),
+        "retained_host_scans_per_sec": round(scans / max(host_dt, 1e-9)),
+        "retained_device_vs_host": round(host_dt / max(dev_dt, 1e-9), 3),
+        "retained_parity_mismatches": mismatched,
+        "recovery": recovery,
+    }
+    if mismatched:
+        log(f"cfg11 RETAINED PARITY MISMATCHES: {mismatched}")
+    return out
+
+
 def run_materializer_bench(fast: bool) -> dict:
     """Config 7: the host result materializer in isolation — NO device, no
     jax. Synthetic snapshot tables and packed range rows shaped like cfg2's
@@ -1799,7 +1937,7 @@ def main() -> None:
     which = {
         int(c)
         for c in os.environ.get(
-            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10"
+            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11"
         ).split(",")
         if c.strip()
     }
@@ -1966,6 +2104,16 @@ def main() -> None:
         except Exception as e:  # never take the whole artifact down
             configs["10_recrypt_matrix"] = {"skipped": f"error: {e}"}
         log(f"cfg10 {configs['10_recrypt_matrix']} ({time.perf_counter()-t0:.0f}s)")
+    if 11 in which:
+        # durable recovery sweep + retained device-vs-host scan rates:
+        # the store leg is pure host I/O; the retained kernel runs on
+        # any jax backend and the config is skipped without one
+        t0 = time.perf_counter()
+        try:
+            configs["11_durable_recovery"] = run_cfg11(fast, rng)
+        except Exception as e:  # never take the whole artifact down
+            configs["11_durable_recovery"] = {"skipped": f"error: {e}"}
+        log(f"cfg11 {configs['11_durable_recovery']} ({time.perf_counter()-t0:.0f}s)")
     if not device_ok and device_wanted:
         # the broker bench bought the tunnel a few minutes: one more chance
         device_ok, probe_err = probe_device(2)

@@ -162,6 +162,10 @@ def check_config_scalar(
 # per-config scalars gated beside the headline: (config, key)
 CONFIG_SCALARS = (
     ("8_publish_storm", "receive_flatness_ratio"),
+    # durable session plane (ISSUE 16): snapshot+tail replay throughput
+    # at the largest swept scale, and the device retained scan rate
+    ("11_durable_recovery", "recovery_keys_per_sec"),
+    ("11_durable_recovery", "retained_device_scans_per_sec"),
 )
 
 

@@ -1,0 +1,461 @@
+#!/usr/bin/env python
+"""CI crash-recovery gate for the durable session plane (ISSUE 16):
+seed a broker subprocess with persistent sessions + retained state
+backed by the log-structured store, ``kill -9`` it, restart a broker
+on the same store directory, and assert
+
+- the restore finishes inside the recovery budget (wall-clock),
+- ``/healthz`` reports ``recovering`` (not ready) mid-restore and
+  answers 200 once the maps are served,
+- every seeded subscription and retained message survives the kill
+  (``durable/restored_*`` match the seed exactly),
+- the delivery oracle holds: reconnecting persisted clients resume
+  their session (CONNACK session-present), live publishes route
+  through the restored trie, and fresh subscribers receive the
+  pre-crash retained payloads bit-identically, and
+- the device-resident retained matcher served those retained scans
+  with ZERO differential-oracle mismatches and zero error fallbacks.
+
+The seed leg runs in a child process so the SIGKILL is real: nothing
+gets a chance to flush, and recovery starts from whatever the store's
+fsync discipline put on disk (the child seeds with ``sync=True`` so
+the expected post-crash state is exact). The block is written to
+``--out`` and uploaded as a CI artifact.
+
+Usage: python exp/recovery_smoke.py [--sessions 400] [--retained 200]
+           [--budget 10.0] [--out recovery-smoke.json]
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ORACLE_SAMPLE = 20  # persisted sessions re-driven end-to-end after restart
+
+
+def _connect(cid: str, clean: bool) -> bytes:
+    from mqtt_tpu.packets import CONNECT, ConnectParams, FixedHeader, Packet
+    from mqtt_tpu.packets import encode_packet
+
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=CONNECT),
+            protocol_version=4,
+            connect=ConnectParams(
+                protocol_name=b"MQTT",
+                clean=clean,
+                keepalive=0,
+                client_identifier=cid,
+            ),
+        )
+    )
+
+
+def _subscribe(pid: int, flt: str) -> bytes:
+    from mqtt_tpu.packets import SUBSCRIBE, FixedHeader, Packet, Subscription
+    from mqtt_tpu.packets import encode_packet
+
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
+            protocol_version=4,
+            packet_id=pid,
+            filters=[Subscription(filter=flt)],
+        )
+    )
+
+
+def _publish(topic: str, payload: bytes, retain: bool = False) -> bytes:
+    from mqtt_tpu.packets import PUBLISH, FixedHeader, Packet, encode_packet
+
+    return encode_packet(
+        Packet(
+            fixed_header=FixedHeader(type=PUBLISH, retain=retain),
+            protocol_version=4,
+            topic_name=topic,
+            payload=payload,
+        )
+    )
+
+
+async def _read_frame(reader, timeout: float = 10.0):
+    """One MQTT frame -> (packet type, body bytes)."""
+
+    async def _inner():
+        b1 = await reader.readexactly(1)
+        mul, rl = 1, 0
+        while True:
+            b = (await reader.readexactly(1))[0]
+            rl += (b & 0x7F) * mul
+            if not b & 0x80:
+                break
+            mul *= 128
+        body = await reader.readexactly(rl) if rl else b""
+        return b1[0] >> 4, body
+
+    return await asyncio.wait_for(_inner(), timeout)
+
+
+async def _pub_frame(reader, timeout: float = 10.0):
+    """Skip non-PUBLISH frames (SUBACK ordering is unspecified) and
+    return (topic, payload) of the first QoS0 PUBLISH."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ptype, body = await _read_frame(reader, deadline - time.monotonic())
+        if ptype != 3:
+            continue
+        tlen = int.from_bytes(body[:2], "big")
+        return body[2 : 2 + tlen].decode(), body[2 + tlen :]
+    raise asyncio.TimeoutError
+
+
+async def child(store: str, sessions: int, retained: int) -> int:
+    """Seed leg: boot a broker over the store, create the persistent
+    population through the real wire path, then wait to be SIGKILLed."""
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+    from mqtt_tpu.listeners import Config as LConfig
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+
+    srv = Server(Options())
+    srv.add_hook(AllowHook())
+    # sync=True: every append is fsynced, so the kill -9 must lose
+    # NOTHING -- the restart leg can assert exact counts
+    srv.add_hook(LogKVStore(), LogKVOptions(path=store, sync=True))
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    await srv.serve()
+    host, port_s = srv.listeners.get("t").address().rsplit(":", 1)
+    port = int(port_s)
+
+    # persistent sessions: v4 clean=False CONNECT + one wildcard
+    # SUBSCRIBE each, then an abrupt socket close -- the session and
+    # its subscription must survive in the log
+    for i in range(sessions):
+        r, w = await asyncio.open_connection(host, port)
+        w.write(_connect(f"rec-{i}", clean=False))
+        await w.drain()
+        await r.readexactly(4)
+        w.write(_subscribe(1, f"rec/c{i}/#"))
+        await w.drain()
+        await r.readexactly(5)
+        w.close()
+
+    # retained state: one transient publisher, one retained QoS0
+    # message per session topic
+    r, w = await asyncio.open_connection(host, port)
+    w.write(_connect("rec-seed-pub", clean=True))
+    await w.drain()
+    await r.readexactly(4)
+    for i in range(retained):
+        w.write(_publish(f"rec/c{i}/state", f"v{i}".encode(), retain=True))
+    await w.drain()
+
+    # QoS0 publishes race the broker's async read loop: wait until the
+    # broker itself holds (and has therefore persisted) every one.
+    # Count only the seeded namespace -- the broker's own $SYS retained
+    # rows live in the same store and would satisfy the bound early.
+    def _seeded() -> int:
+        return sum(
+            1 for t in srv.topics.retained.get_all() if t.startswith("rec/")
+        )
+
+    deadline = time.monotonic() + 60
+    while _seeded() < retained:
+        if time.monotonic() > deadline:
+            print(f"CHILD-FAIL retained={_seeded()}", flush=True)
+            return 1
+        await asyncio.sleep(0.05)
+
+    print(f"SEEDED {sessions} {_seeded()}", flush=True)
+    await asyncio.sleep(3600)  # the parent kill -9s us here
+    return 0
+
+
+def _seed_and_kill(store: str, sessions: int, retained: int) -> None:
+    """Run the seed leg in a subprocess and SIGKILL it once seeded."""
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            os.path.abspath(__file__),
+            "--child",
+            "--store",
+            store,
+            "--sessions",
+            str(sessions),
+            "--retained",
+            str(retained),
+        ],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    try:
+        assert proc.stdout is not None
+        line = ""
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line and proc.poll() is not None:
+                raise RuntimeError("seed child exited before SEEDED")
+            if line.startswith("SEEDED") or line.startswith("CHILD-FAIL"):
+                break
+        if not line.startswith("SEEDED"):
+            raise RuntimeError(f"seed child never seeded: {line!r}")
+        print(f"# seed child (pid {proc.pid}): {line.strip()}", file=sys.stderr)
+    finally:
+        # the point of the gate: no shutdown path runs, nothing flushes
+        try:
+            os.kill(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        proc.wait()
+        if proc.stdout is not None:
+            proc.stdout.close()
+
+
+async def restart(store: str, sessions: int, retained: int,
+                  budget_s: float, out_path: str) -> int:
+    """Restart leg: recover the store, gate the budget/healthz flip,
+    then re-drive a session sample through the delivery oracle."""
+    from exp.scrapelib import http_get
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.server import Options, Server
+
+    opts = Options(
+        retained_matcher=True,
+        retained_oracle_sample=1,  # oracle-check EVERY device retained scan
+        durable_restore_batch=64,
+    )
+    opts.hooks = [(LogKVStore(), LogKVOptions(path=store))]
+    srv = Server(opts)
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    srv.add_listener(
+        HTTPStats(
+            LConfig(type="sysinfo", id="s", address="127.0.0.1:0"),
+            srv.info,
+            telemetry=srv.telemetry,
+            health=srv.health_report,
+        )
+    )
+
+    # sample readiness DURING the restore (read_store blocks the loop,
+    # so an HTTP poll cannot race it deterministically): wrap the last
+    # restore stage and snapshot health_report right before it runs
+    mid: dict = {}
+    orig_load_retained = srv.load_retained
+
+    def _spy(v):
+        ok, detail = srv.health_report()
+        mid["ready"] = ok
+        mid["not_ready"] = list(detail.get("not_ready", []))
+        return orig_load_retained(v)
+
+    srv.load_retained = _spy  # type: ignore[method-assign]
+
+    t0 = time.monotonic()
+    await srv.serve()
+    serve_s = time.monotonic() - t0
+    failures: list[str] = []
+    try:
+        host, port_s = srv.listeners.get("t").address().rsplit(":", 1)
+        port = int(port_s)
+        http_addr = srv.listeners.get("s").address()
+        dur = srv._durable
+
+        # -- recovery budget + restored-count gates ----------------------
+        if dur["recovery_seconds"] > budget_s:
+            failures.append(
+                f"recovery took {dur['recovery_seconds']:.3f}s "
+                f"(budget {budget_s}s)"
+            )
+        if dur["restored_subscriptions"] != sessions:
+            failures.append(
+                f"restored_subscriptions={dur['restored_subscriptions']} "
+                f"!= seeded {sessions}"
+            )
+        if dur["restored_retained"] != retained:
+            failures.append(
+                f"restored_retained={dur['restored_retained']} "
+                f"!= seeded {retained}"
+            )
+        if dur["recovering"]:
+            failures.append("still recovering after serve()")
+
+        # -- healthz: 503 mid-restore, 200 once serving ------------------
+        if mid.get("ready", True) or "recovering" not in mid.get(
+            "not_ready", []
+        ):
+            failures.append(f"mid-restore health was not 'recovering': {mid}")
+        head, _body = await http_get(http_addr, "/healthz", timeout=15.0)
+        healthz_ok = b"200" in head.split(b"\r\n", 1)[0]
+        if not healthz_ok:
+            failures.append(f"/healthz after restore -> {head!r}")
+
+        # -- delivery oracle over a session sample -----------------------
+        step = max(1, sessions // ORACLE_SAMPLE)
+        sample = list(range(0, sessions, step))[:ORACLE_SAMPLE]
+        session_present = live_ok = retained_ok = 0
+        for i in sample:
+            # resume the persisted session: CONNACK must flag it present
+            r, w = await asyncio.open_connection(host, port)
+            w.write(_connect(f"rec-{i}", clean=False))
+            await w.drain()
+            ack = await asyncio.wait_for(r.readexactly(4), 10.0)
+            if ack[2] & 0x01:
+                session_present += 1
+            # the restored subscription must route a live publish
+            pr, pw = await asyncio.open_connection(host, port)
+            pw.write(_connect(f"rec-orc-pub-{i}", clean=True))
+            await pw.drain()
+            await asyncio.wait_for(pr.readexactly(4), 10.0)
+            pw.write(_publish(f"rec/c{i}/live", b"after-crash"))
+            await pw.drain()
+            try:
+                topic, payload = await _pub_frame(r, 10.0)
+                if topic == f"rec/c{i}/live" and payload == b"after-crash":
+                    live_ok += 1
+            except asyncio.TimeoutError:
+                pass
+            # a fresh subscriber must get the pre-crash retained payload
+            # (served through the device-resident retained matcher)
+            sr, sw = await asyncio.open_connection(host, port)
+            sw.write(_connect(f"rec-orc-sub-{i}", clean=True))
+            await sw.drain()
+            await asyncio.wait_for(sr.readexactly(4), 10.0)
+            sw.write(_subscribe(1, f"rec/c{i}/#"))
+            await sw.drain()
+            # always wait out the SUBACK so the retained scan has run
+            # before the socket closes (and before we read the engine
+            # counters); only the first `retained` sessions seeded a
+            # retained message, so a PUBLISH is due only for those
+            got_suback = False
+            got_pub = None
+            try:
+                while not got_suback or (i < retained and got_pub is None):
+                    ptype, body = await _read_frame(sr, 10.0)
+                    if ptype == 9:
+                        got_suback = True
+                    elif ptype == 3:
+                        tlen = int.from_bytes(body[:2], "big")
+                        got_pub = (
+                            body[2 : 2 + tlen].decode(),
+                            body[2 + tlen :],
+                        )
+            except asyncio.TimeoutError:
+                pass
+            if got_pub == (f"rec/c{i}/state", f"v{i}".encode()):
+                retained_ok += 1
+            for wr in (w, pw, sw):
+                wr.close()
+        if session_present != len(sample):
+            failures.append(
+                f"session-present on reconnect: {session_present}/{len(sample)}"
+            )
+        if live_ok != len(sample):
+            failures.append(
+                f"live deliveries through restored trie: "
+                f"{live_ok}/{len(sample)}"
+            )
+        want_retained = sum(1 for i in sample if i < retained)
+        if retained_ok != want_retained:
+            failures.append(
+                f"retained redeliveries: {retained_ok}/{want_retained}"
+            )
+
+        # -- device retained matcher: oracle-clean, no error fallbacks ---
+        eng = srv._retained_engine
+        eng_stats = eng.stats() if eng is not None else {}
+        if eng is None:
+            failures.append("retained matcher engine not constructed")
+        else:
+            if eng.oracle_mismatches:
+                failures.append(
+                    f"{eng.oracle_mismatches} retained oracle mismatches"
+                )
+            if eng.fallbacks.get("error", 0):
+                failures.append(
+                    f"{eng.fallbacks['error']} retained kernel error fallbacks"
+                )
+            if eng.device_matches < len(sample):
+                failures.append(
+                    f"device served only {eng.device_matches} retained "
+                    f"scans for {len(sample)} subscribes"
+                )
+
+        block = {
+            "sessions": sessions,
+            "retained": retained,
+            "budget_seconds": budget_s,
+            "recovery_seconds": round(dur["recovery_seconds"], 4),
+            "serve_seconds": round(serve_s, 4),
+            "replayed_keys": dur["replayed_keys"],
+            "restored_subscriptions": dur["restored_subscriptions"],
+            "restored_retained": dur["restored_retained"],
+            "restore_batches": dur["restore_batches"],
+            "healthz_mid_restore": mid,
+            "healthz_ready_ok": healthz_ok,
+            "oracle_sample": len(sample),
+            "session_present": session_present,
+            "live_deliveries": live_ok,
+            "retained_redeliveries": retained_ok,
+            "retained_redeliveries_expected": want_retained,
+            "retained_engine": eng_stats,
+        }
+        with open(out_path, "w") as f:
+            json.dump(block, f, indent=2)
+        print(f"# recovery block -> {out_path}: {json.dumps(block)}",
+              file=sys.stderr)
+
+        if failures:
+            for msg in failures:
+                print(f"FAIL: {msg}", file=sys.stderr)
+            return 1
+        print(
+            f"OK: killed -9 with {sessions} sessions + {retained} retained, "
+            f"recovered in {dur['recovery_seconds']:.3f}s "
+            f"(budget {budget_s}s), healthz 503->200, "
+            f"{len(sample)}/{len(sample)} sessions resumed with exact "
+            "delivery, retained oracle clean",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        await srv.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sessions", type=int, default=400)
+    ap.add_argument("--retained", type=int, default=200)
+    ap.add_argument("--budget", type=float, default=10.0)
+    ap.add_argument("--out", default="recovery-smoke.json")
+    ap.add_argument("--store", default="")
+    ap.add_argument("--child", action="store_true", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+
+    if args.child:
+        return asyncio.run(child(args.store, args.sessions, args.retained))
+
+    store = args.store or tempfile.mkdtemp(prefix="recovery-smoke-")
+    _seed_and_kill(store, args.sessions, args.retained)
+    return asyncio.run(
+        restart(store, args.sessions, args.retained, args.budget, args.out)
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -91,6 +91,13 @@ def _hooks_from(d: dict) -> list[tuple[Any, Any]]:
                     sync=cfg.get("sync", False),
                     gc_interval=cfg.get("gc_interval", 300.0),
                     gc_discard_ratio=cfg.get("gc_discard_ratio", 0.5),
+                    max_segment_bytes=cfg.get(
+                        "max_segment_bytes", 64 * 1024 * 1024
+                    ),
+                    max_segment_age_s=cfg.get("max_segment_age_s", 0.0),
+                    snapshot_interval_s=cfg.get("snapshot_interval_s", 0.0),
+                    durability_fsync=cfg.get("durability_fsync", ""),
+                    fsync_interval_ms=cfg.get("fsync_interval_ms", 50.0),
                 ),
             )
         )
@@ -259,6 +266,12 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "slo_burn_threshold",
         "cluster_metrics",
         "cluster_metrics_max_age_s",
+        # durable session plane + tenant count quotas (ISSUE 16)
+        "tenant_max_retained",
+        "tenant_max_subscriptions",
+        "retained_matcher",
+        "retained_oracle_sample",
+        "durable_restore_batch",
     ):
         if k in top:
             setattr(opts, k, top[k])

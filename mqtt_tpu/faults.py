@@ -42,6 +42,16 @@ behave like that hardware — reproducibly, from one seed:
   both replay the same plans against the overload governor
   (mqtt_tpu.overload).
 
+- Durable-store crash plans — :class:`StorageCrashPlan` kills a
+  :class:`~mqtt_tpu.hooks.storage.logkv.LogKVStore` at a seeded append
+  index or named crash point (rotation / snapshot / compaction), with a
+  torn-write mode that leaves a seeded PREFIX of the record on disk;
+  :func:`lose_unsynced` models power-loss page-cache loss by truncating
+  the active segment to its fsync watermark; :func:`tear_tail` /
+  :func:`dup_last_segment` mutate segment files directly. The
+  replay-convergence matrix (tests/test_durable.py) drives every point
+  and asserts the reopened map is bit-identical to the durable state.
+
 Only test/ops tooling imports this module; nothing on the hot path
 references it.
 """
@@ -195,6 +205,129 @@ class FaultyMatcher:
 
     def match_topics(self, topics: list[str]):
         return self.match_topics_async(topics)()
+
+
+# -- durable-store crash plans ----------------------------------------------
+
+STORAGE_CRASH_POINTS = (
+    "rotate",
+    "snapshot.begin",
+    "snapshot.rename",
+    "snapshot.prune",
+    "compact.rewrite",
+    "compact.prune",
+)
+
+
+@dataclass
+class StorageCrashPlan:
+    """A deterministic kill schedule for the log-structured store.
+
+    Attach to ``LogKVStore.crash_plan``; the store consults it at every
+    append (``append_record``) and at the named maintenance points
+    (``reach``). The plan raises
+    :class:`~mqtt_tpu.hooks.storage.logkv.SimulatedCrash` at its chosen
+    kill point — the test then abandons the store (no ``stop()``, the
+    kill -9 shape) and asserts a fresh open replays to the expected map.
+
+    ``crash_at_op`` kills at the Nth append since attach; with ``torn``
+    set, a seeded prefix of the record reaches the file first (the
+    torn-write shape replay's CRC/EOF checks exist for). ``crash_point``
+    kills at the ``point_hits``-th arrival at a named point instead —
+    e.g. between a compaction's rewrite and its prune, where old and new
+    segments overlap on disk.
+    """
+
+    seed: int = 0
+    crash_at_op: int = -1
+    torn: bool = False
+    crash_point: str = ""
+    point_hits: int = 1
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+        self.appends_seen = 0
+        self.points_seen: dict[str, int] = {}
+        if self.crash_point and self.crash_point not in STORAGE_CRASH_POINTS:
+            raise ValueError(f"unknown crash point: {self.crash_point}")
+
+    def append_record(self, store, rec: bytes) -> None:
+        from .hooks.storage.logkv import SimulatedCrash
+
+        i = self.appends_seen
+        self.appends_seen += 1
+        if i != self.crash_at_op:
+            return
+        if self.torn and len(rec) > 1:
+            # the torn write: a seeded strict prefix hits the platter
+            cut = 1 + self._rng.randrange(len(rec) - 1)
+            store._file.write(rec[:cut])
+            store._file.flush()
+        raise SimulatedCrash(f"injected kill at append {i} (torn={self.torn})")
+
+    def reach(self, point: str, store) -> None:
+        from .hooks.storage.logkv import SimulatedCrash
+
+        n = self.points_seen.get(point, 0) + 1
+        self.points_seen[point] = n
+        if point == self.crash_point and n == self.point_hits:
+            raise SimulatedCrash(f"injected kill at {point} (hit {n})")
+
+
+def lose_unsynced(store) -> int:
+    """Power-loss page-cache loss: truncate the ACTIVE segment back to
+    its last-fsync watermark (``synced_bytes``), as a kernel that never
+    flushed would. Returns the number of bytes lost. Under the
+    ``always`` policy this loses nothing; under ``batch`` at most one
+    flush interval; under ``off`` the whole active segment."""
+    import os
+
+    path = store._active_path
+    try:
+        store._file.close()
+    except (OSError, ValueError, AttributeError):
+        pass
+    size = os.path.getsize(path)
+    keep = min(store.synced_bytes, size)
+    os.truncate(path, keep)
+    return size - keep
+
+
+def tear_tail(dir_path: str, nbytes: int = 0, seed: int = 0) -> str:
+    """Tear the newest segment's tail: drop ``nbytes`` from its end (a
+    seeded 1..18 — inside the last record's frame — when 0). Returns the
+    torn segment's filename."""
+    import os
+
+    from .hooks.storage.logkv import _segments
+
+    name = _segments(dir_path)[-1]
+    p = os.path.join(dir_path, name)
+    size = os.path.getsize(p)
+    if nbytes <= 0:
+        nbytes = 1 + random.Random(seed).randrange(18)
+    os.truncate(p, max(0, size - nbytes))
+    return name
+
+
+def dup_last_segment(dir_path: str) -> str:
+    """Duplicate the NEWEST segment at the next sequence number — the
+    crash shape where a rotation/copy completed but the original was
+    never retired. Replaying the same record suffix twice is convergent
+    (records carry absolute values); duplicating an OLDER segment would
+    not be, which is why only this shape occurs in practice. Returns the
+    duplicate's filename."""
+    import os
+
+    from .hooks.storage.logkv import _seg_seq, _segments
+
+    name = _segments(dir_path)[-1]
+    dup = f"seg{_seg_seq(name) + 1:06d}.log"
+    with open(os.path.join(dir_path, name), "rb") as src:
+        data = src.read()
+    with open(os.path.join(dir_path, dup), "wb") as dst:
+        dst.write(data)
+    return dup
 
 
 # -- publish storms ----------------------------------------------------------

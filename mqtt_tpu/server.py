@@ -381,6 +381,31 @@ class Options:
     tenant_users: Optional[dict] = None
     # tenant for unmapped clients; "" keeps them in the global namespace
     tenant_default: str = ""
+    # per-tenant durable COUNT caps (ISSUE 16 / MQT-TZ quota residual):
+    # the default maximum number of retained topics / stored
+    # subscriptions a tenant may hold; a tenant dict may override with
+    # its own `max_retained` / `max_subscriptions`. 0 = unlimited.
+    # Enforced structurally in the namespaced stores (refused with v5
+    # 0x97 Quota exceeded and counted per tenant) so a runaway tenant
+    # cannot grow durable memory past its cap. Global (untenanted)
+    # clients are uncapped.
+    tenant_max_retained: int = 0
+    tenant_max_subscriptions: int = 0
+    # device-resident retained matching (mqtt_tpu.ops.retained): serve
+    # wildcard-SUBSCRIBE retained fan-out from the flat CSR kernel run
+    # in reverse, with the host retained walk as 1-in-N differential
+    # oracle behind a CircuitBreaker (host wins mismatches; an open
+    # breaker degrades all retained matching to the host walk). Off by
+    # default: the host walk is exact and retained fan-out is off the
+    # publish hot path.
+    retained_matcher: bool = False
+    # 1-in-N oracle cadence for the retained kernel (0 disables the
+    # sampled oracle; breaker probes still verify fully)
+    retained_oracle_sample: int = 16
+    # restart re-registration batch size: persisted subscriptions and
+    # retained messages re-enter the trie through the bulk-insert path
+    # in chunks of this many (staging.bulk_register / bulk_retain)
+    durable_restore_batch: int = 4096
     # MQT-TZ re-encryption stage (mqtt_tpu.tenancy.RecryptEngine +
     # ops/recrypt): publishes in a tenant's `encrypted` namespaces are
     # decrypted once with the publisher's key and re-encrypted per
@@ -613,6 +638,17 @@ class Options:
             self.recrypt_oracle_sample = 64
         if self.recrypt_device_min_blocks < 1:
             self.recrypt_device_min_blocks = 4
+        # durable-plane knobs are config-reachable: negative caps mean
+        # "unlimited", a negative oracle sample means "default", and the
+        # restore batch needs >= 1 or bulk chunking never drains
+        if self.tenant_max_retained < 0:
+            self.tenant_max_retained = 0
+        if self.tenant_max_subscriptions < 0:
+            self.tenant_max_subscriptions = 0
+        if self.retained_oracle_sample < 0:
+            self.retained_oracle_sample = 16
+        if self.durable_restore_batch < 1:
+            self.durable_restore_batch = 4096
         # telemetry knobs are config-reachable: a negative sample rate
         # means "default", a zero one disables stage sampling outright
         if self.telemetry_sample < 0:
@@ -993,6 +1029,29 @@ class Server:
                         else None
                     ),
                 )
+        # device-resident retained matching (ISSUE 16, mqtt_tpu.ops.
+        # retained): wildcard-SUBSCRIBE fan-out over the retained corpus
+        # served by the flat kernel run in reverse, host walk as 1-in-N
+        # oracle behind its own breaker. Opt-in; None = host walk only.
+        self._retained_engine: Optional[Any] = None
+        if opts.retained_matcher:
+            from .ops.retained import RetainedMatchEngine
+
+            self._retained_engine = RetainedMatchEngine(
+                self.topics,
+                oracle_sample=opts.retained_oracle_sample,
+            )
+        # durable session plane recovery state (read_store / healthz /
+        # $SYS/broker/durable): `recovering` holds /healthz at 503 until
+        # the restored maps are actually served
+        self._durable: dict = {
+            "recovering": False,
+            "recovery_seconds": 0.0,
+            "replayed_keys": 0,
+            "restored_subscriptions": 0,
+            "restored_retained": 0,
+            "restore_batches": 0,
+        }
         if opts.device_matcher:
             from .ops.delta import DeltaMatcher
 
@@ -1100,6 +1159,10 @@ class Server:
                     )
 
                 rbreaker.on_trip = _recrypt_trip_dump
+            # durable session plane + retained-match engine observability
+            # (ISSUE 16): recovery progress, log-store internals, and the
+            # device-vs-host retained oracle all surface on /metrics
+            self._register_durable_metrics()
         if opts.inline_client:
             self.inline_client = self.new_client(None, None, LOCAL_LISTENER, INLINE_CLIENT_ID, True)
             self.clients.add_client(self.inline_client)
@@ -1283,6 +1346,21 @@ class Server:
         await self.listeners.serve_all(self.establish_connection)
         self.publish_sys_topics()
         self.hooks.on_started()
+        if self._durable["recovering"]:
+            # the restored maps are now actually served: flip healthz
+            # from 503 `recovering` to ready and leave the recovery
+            # numbers behind as retained $SYS/broker/durable/# rows
+            self._durable["recovering"] = False
+            self.publish_durable_sys()
+            self.log.info(
+                "durable restore complete: seconds=%.3f replayed_keys=%d "
+                "subscriptions=%d retained=%d batches=%d",
+                self._durable["recovery_seconds"],
+                self._durable["replayed_keys"],
+                self._durable["restored_subscriptions"],
+                self._durable["restored_retained"],
+                self._durable["restore_batches"],
+            )
         self.log.info("mqtt_tpu server started")
 
     async def _event_loop(self) -> None:
@@ -1440,6 +1518,129 @@ class Server:
                 ),
             )
 
+    def _durable_store_stats(self) -> dict:
+        """Merge ``durable_stats()`` across storage hooks that expose one
+        (duck-typed — the LogKV store does; third-party hooks may too)."""
+        out: dict = {}
+        for hook in self.hooks.get_all():
+            fn = getattr(hook, "durable_stats", None)
+            if not callable(fn):
+                continue
+            try:
+                stats = fn()
+            except Exception:  # pragma: no cover  # brokerlint: ok=R4 observability merge must not take the broker down with a hook
+                continue
+            for k, v in stats.items():
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    out[k] = out.get(k, 0) + v
+                else:
+                    out.setdefault(k, v)
+        return out
+
+    def _register_durable_metrics(self) -> None:
+        """Recovery + durable-store + retained-engine families (ISSUE 16).
+        All callback-backed: scrape reads the live counters; zeros when
+        no durable hook / engine is configured."""
+        r = self.telemetry.registry
+        r.gauge(
+            "mqtt_tpu_durable_recovery_seconds",
+            "Wall seconds the last restart spent restoring persisted "
+            "state (store replay + bulk re-registration)",
+            fn=lambda: self._durable["recovery_seconds"],
+        )
+        r.counter(
+            "mqtt_tpu_durable_replayed_keys_total",
+            "Keys replayed from durable-store segments/snapshots at the "
+            "last restart (sum across storage hooks)",
+            fn=lambda: self._durable["replayed_keys"],
+        )
+        r.gauge(
+            "mqtt_tpu_durable_recovering",
+            "1 while restored state is still being re-registered "
+            "(healthz holds 503), else 0",
+            fn=lambda: 1 if self._durable["recovering"] else 0,
+        )
+        r.counter(
+            "mqtt_tpu_durable_restore_batches_total",
+            "Bulk re-registration batches used by the last restore "
+            "(subscriptions + retained, staging.bulk_*)",
+            fn=lambda: self._durable["restore_batches"],
+        )
+        r.gauge(
+            "mqtt_tpu_durable_segments",
+            "Live log segments across durable storage hooks",
+            fn=lambda: self._durable_store_stats().get("segments", 0),
+        )
+        r.gauge(
+            "mqtt_tpu_durable_snapshot_age_seconds",
+            "Seconds since the newest durable snapshot (-1 when none)",
+            fn=lambda: self._durable_store_stats().get(
+                "snapshot_age_seconds", -1.0
+            ),
+        )
+        r.counter(
+            "mqtt_tpu_durable_replay_corruptions_total",
+            "Corrupt records hit during segment replay (CRC/frame "
+            "failures — each truncates one segment's tail)",
+            fn=lambda: self._durable_store_stats().get("replay_corruptions", 0),
+        )
+        eng = self._retained_engine
+        r.counter(
+            "mqtt_tpu_retained_device_matches_total",
+            "Retained-topic SUBSCRIBE matches answered by the device "
+            "kernel (mqtt_tpu.ops.retained)",
+            fn=lambda: 0 if eng is None else eng.device_matches,
+        )
+        r.counter(
+            "mqtt_tpu_retained_oracle_checks_total",
+            "Differential host-walk oracle comparisons run by the "
+            "retained-match engine",
+            fn=lambda: 0 if eng is None else eng.oracle_checks,
+        )
+        r.counter(
+            "mqtt_tpu_retained_oracle_mismatches_total",
+            "Oracle comparisons where device and host disagreed (host "
+            "won; breaker counted a failure)",
+            fn=lambda: 0 if eng is None else eng.oracle_mismatches,
+        )
+        r.counter(
+            "mqtt_tpu_retained_host_fallbacks_total",
+            "Retained matches served by the host walk while the engine "
+            "was active (depth/filter/overflow/error/breaker classes)",
+            fn=lambda: 0 if eng is None else sum(eng.fallbacks.values()),
+        )
+
+    def publish_durable_sys(self) -> None:
+        """Publish the recovery progress tree as retained
+        ``$SYS/broker/durable/#`` rows (ISSUE 16): serve() calls this
+        once the restored maps are actually served, and the periodic
+        $SYS tick republishes via publish_sys_topics."""
+        d = self._durable
+        store = self._durable_store_stats()
+        rows = {
+            "recovering": "1" if d["recovering"] else "0",
+            "recovery_seconds": "%.6f" % d["recovery_seconds"],
+            "replayed_keys": str(d["replayed_keys"]),
+            "restored_subscriptions": str(d["restored_subscriptions"]),
+            "restored_retained": str(d["restored_retained"]),
+            "restore_batches": str(d["restore_batches"]),
+        }
+        for k in ("segments", "snapshot_seq", "replay_corruptions", "snapshot_invalid"):
+            if k in store:
+                rows[k] = str(store[k])
+        pk = Packet(
+            fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
+            created=int(time.time()),  # brokerlint: ok=R3 $SYS stamps are wall-clock (operator-correlatable)
+        )
+        for name, payload in rows.items():
+            pk.topic_name = SYS_PREFIX + "/broker/durable/" + name
+            pk.payload = payload.encode()
+            retained = pk.copy(False)
+            self.topics.retain_message(retained)
+            if self._retained_engine is not None:
+                self._retained_engine.note_retained(retained.topic_name, True)
+            self.publish_to_subscribers(pk)
+
     def _publish_slo_transition(self, name: str, payload: dict) -> None:
         """Publish one objective's breach/recovery as a retained
         ``$SYS/broker/slo/<name>`` message (mqtt_tpu.slo calls this on
@@ -1452,6 +1653,8 @@ class Server:
         pk.topic_name = SYS_PREFIX + "/broker/slo/" + name
         pk.payload = json.dumps(payload).encode()
         self.topics.retain_message(pk.copy(False))
+        if self._retained_engine is not None:
+            self._retained_engine.note_retained(pk.topic_name, True)
         self.publish_to_subscribers(pk)
 
     def health_report(self) -> tuple[bool, dict]:
@@ -1469,6 +1672,18 @@ class Server:
         detail: dict = {}
         if self._draining or self.done.is_set():
             not_ready.append("draining")
+        if self._durable["recovering"]:
+            # restored state is still re-registering: a load balancer
+            # must not route sessions at a half-restored map
+            not_ready.append("recovering")
+        if self._durable["replayed_keys"] or self._durable["restore_batches"]:
+            detail["durable"] = {
+                "recovering": self._durable["recovering"],
+                "recovery_seconds": round(
+                    self._durable["recovery_seconds"], 3
+                ),
+                "replayed_keys": self._durable["replayed_keys"],
+            }
         gov = self.overload
         if gov is not None:
             from .overload import SHED
@@ -1496,6 +1711,12 @@ class Server:
                 detail["matcher_breaker"] = {"state": state}
                 if state != "closed":
                     degraded.append("matcher_breaker_" + state)
+        if self._retained_engine is not None:
+            state = str(self._retained_engine.breaker.state)
+            detail["retained_breaker"] = {"state": state}
+            if state != "closed":
+                # retained matching degrades to the host walk — serve on
+                degraded.append("retained_breaker_" + state)
         c = self._cluster
         if c is not None:
             from .cluster import PEER_PARTITIONED
@@ -2503,6 +2724,25 @@ class Server:
             cl.tenant.messages_in += 1
             cl.tenant.bytes_in += len(pk.payload)
 
+        if pk.fixed_header.retain and self._retained_quota_refused(cl, pk):
+            # tenant retained COUNT cap (ISSUE 16): refuse the whole
+            # publish — accepting the fan-out while silently dropping
+            # retention would leave the publisher believing the topic is
+            # retained. Same graceful posture as overload: QoS0 drops
+            # (counted), QoS1/2 ack 0x97 Quota Exceeded.
+            self.info.messages_dropped += 1
+            if cl.tenant is not None:
+                cl.tenant.messages_dropped += 1
+            if pk.fixed_header.qos == 0:
+                return
+            ack_type = pkts.PUBREC if pk.fixed_header.qos == 2 else pkts.PUBACK
+            cl.write_packet(
+                self.build_ack(
+                    pk.packet_id, ack_type, 0, pk.properties, ERR_QUOTA_EXCEEDED
+                )
+            )
+            return
+
         if pk.fixed_header.retain:  # [MQTT-3.3.1-5]
             self.retain_message(cl, pk)
 
@@ -2626,14 +2866,61 @@ class Server:
             self._finish_publish_clock(pk)
         self.hooks.on_published(cl, pk)
 
+    def _retained_quota_refused(self, cl: Client, pk: Packet) -> bool:
+        """Tenant retained COUNT cap (ISSUE 16): True refuses the publish
+        with 0x97 before any state grows. Growth only — clearing (empty
+        payload) and overwriting an existing retained topic always pass,
+        so a capped tenant can still update or free slots. The topic is
+        already namespace-scoped here (process_publish scopes first)."""
+        t = cl.tenant
+        if t is None or not pk.payload:
+            return False
+        cap = t.max_retained or self.options.tenant_max_retained
+        if cap <= 0 or t.retained_count < cap:
+            return False
+        if self.topics.retained.get(pk.topic_name) is not None:
+            return False  # overwrite, not growth
+        t.retained_refused += 1
+        return True
+
+    def _subscribe_quota_refused(self, cl: Client, sub: Subscription) -> bool:
+        """Tenant subscription COUNT cap (ISSUE 16): True refuses the
+        filter with 0x97 before any rule or trie registration. Growth
+        only — replacing an existing subscription always passes. Sees
+        the LOCAL filter (scoping happens in the grant branch); shared
+        ($SHARE) filters are uncapped."""
+        t = cl.tenant
+        if t is None or is_shared_filter(sub.filter):
+            return False
+        cap = t.max_subscriptions or self.options.tenant_max_subscriptions
+        if cap <= 0 or t.subscriptions_count < cap:
+            return False
+        scoped = ns_scope_filter(t.name, sub.filter)
+        if cl.state.subscriptions.get(scoped) is not None:
+            return False  # replacement, not growth
+        t.subscriptions_refused += 1
+        return True
+
     def retain_message(self, cl: Client, pk: Packet) -> None:
         """(server.go:972-981)"""
         if self.options.capabilities.retain_available == 0 or pk.ignore:
             return
         out = pk.copy(False)
+        existed = self.topics.retained.get(out.topic_name) is not None
         r = self.topics.retain_message(out)
         self.hooks.on_retain_message(cl, pk, r)
         self.info.retained = len(self.topics.retained)
+        if self._tenancy is not None and out.topic_name[:1] == NS_CHAR:
+            t = self._tenancy.tenant_of_topic(out.topic_name)
+            if t is not None:
+                # durable COUNT quota bookkeeping (ISSUE 16): growth
+                # only on a NEW retained topic, shrink on a real clear
+                if r == 1 and not existed:
+                    t.retained_count += 1
+                elif r == -1 and t.retained_count > 0:
+                    t.retained_count -= 1
+        if self._retained_engine is not None:
+            self._retained_engine.note_retained(out.topic_name, r == 1)
 
     def publish_to_subscribers(self, pk: Packet) -> None:
         """Match subscribers and fan out (server.go:984-1021).
@@ -3942,7 +4229,24 @@ class Server:
         # value-copy: the reference ranges over Subscription values, so the
         # trie-stored subscription never carries fwd_retained_flag
         sub = replace(sub, fwd_retained_flag=True)
-        for pkv in self.topics.messages(sub.filter):  # [MQTT-3.8.4-4]
+        # device-resident retained matching (ISSUE 16): the flat publish
+        # kernel run in reverse answers wildcard filters against the
+        # retained corpus; None (non-wildcard, $SHARE, fallback class,
+        # open breaker) = host trie walk, the differential oracle
+        retained_msgs: list = []
+        if self._retained_engine is not None:
+            names = self._retained_engine.match(sub.filter)
+            if names is not None:
+                retained_msgs = [
+                    m
+                    for m in (self.topics.retained.get(n) for n in names)
+                    if m is not None
+                ]
+            else:
+                retained_msgs = self.topics.messages(sub.filter)
+        else:
+            retained_msgs = self.topics.messages(sub.filter)
+        for pkv in retained_msgs:  # [MQTT-3.8.4-4]
             # MQTT+ predicates apply to retained payloads too: the
             # sub.filter here is already the BASE filter, so the walk is
             # unchanged and only the delivery gate consults the rules
@@ -4113,6 +4417,11 @@ class Server:
                 reason_codes[i] = ERR_NOT_AUTHORIZED.code
                 if caps.compatibilities.obscure_not_authorized:
                     reason_codes[i] = ERR_UNSPECIFIED_ERROR.code
+            elif self._subscribe_quota_refused(cl, sub):
+                # tenant subscription COUNT cap (ISSUE 16): 0x97 before
+                # any rule/trie registration (the v3 clamp below turns
+                # it into 0x80 for pre-v5 clients)
+                reason_codes[i] = ERR_QUOTA_EXCEEDED.code
             else:
                 if cl.tenant is not None:
                     # tenant namespace (mqtt_tpu.tenancy): validation,
@@ -4137,6 +4446,8 @@ class Server:
                 is_new = self.topics.subscribe(cl.id, sub)  # [MQTT-3.8.4-3]
                 if is_new:
                     self.info.subscriptions += 1
+                    if cl.tenant is not None and sub.filter[:1] == NS_CHAR:
+                        cl.tenant.subscriptions_count += 1
                 cl.state.subscriptions.add(sub.filter, sub)  # [MQTT-3.2.2-10]
                 # granted qos caps at server max [MQTT-3.2.2-9] without
                 # mutating the trie-stored subscription (the reference caps a
@@ -4190,6 +4501,12 @@ class Server:
                     self._predicates.release(old.predicates)
             if self.topics.unsubscribe(sub.filter, cl.id):
                 self.info.subscriptions -= 1
+                if (
+                    cl.tenant is not None
+                    and sub.filter[:1] == NS_CHAR
+                    and cl.tenant.subscriptions_count > 0
+                ):
+                    cl.tenant.subscriptions_count -= 1
                 reason_codes[i] = CODE_SUCCESS.code
             else:
                 reason_codes[i] = pkts.CODE_NO_SUBSCRIPTION_EXISTED.code
@@ -4219,6 +4536,12 @@ class Server:
                 self._predicates.release(sub.predicates)
             if self.topics.unsubscribe(k, cl.id):
                 self.info.subscriptions -= 1
+                if self._tenancy is not None and k[:1] == NS_CHAR:
+                    # restored clients may not carry cl.tenant — resolve
+                    # the owner off the scoped filter itself
+                    t = self._tenancy.tenant_of_topic(k)
+                    if t is not None and t.subscriptions_count > 0:
+                        t.subscriptions_count -= 1
         self.hooks.on_unsubscribed(
             cl,
             Packet(
@@ -4483,7 +4806,18 @@ class Server:
             pk.topic_name = topic
             pk.payload = payload.encode()
             self.topics.retain_message(pk.copy(False))
+            if self._retained_engine is not None:
+                self._retained_engine.note_retained(topic, True)
             self.publish_to_subscribers(pk)
+        if (
+            self._durable["recovering"]
+            or self._durable["replayed_keys"]
+            or self._durable["restore_batches"]
+        ):
+            # keep the recovery tree fresh on the $SYS cadence (only
+            # once a durable restore has actually happened — brokers
+            # with no storage hook never grow the subtree)
+            self.publish_durable_sys()
         self.hooks.on_sys_info_tick(info)
 
     async def close(self) -> None:
@@ -4573,27 +4907,40 @@ class Server:
     # -- persistence restore (server.go:1554-1692) -------------------------
 
     def read_store(self) -> None:
-        if self.hooks.provides(STORED_CLIENTS):
-            clients = self.hooks.stored_clients()
-            self.load_clients(clients)
-            self.log.debug("loaded clients from store: len=%d", len(clients))
-        if self.hooks.provides(STORED_SUBSCRIPTIONS):
-            subs = self.hooks.stored_subscriptions()
-            self.load_subscriptions(subs)
-            self.log.debug("loaded subscriptions from store: len=%d", len(subs))
-        if self.hooks.provides(STORED_INFLIGHT_MESSAGES):
-            inflight = self.hooks.stored_inflight_messages()
-            self.load_inflight(inflight)
-            self.log.debug("loaded inflights from store: len=%d", len(inflight))
-        if self.hooks.provides(STORED_RETAINED_MESSAGES):
-            retained = self.hooks.stored_retained_messages()
-            self.load_retained(retained)
-            self.log.debug("loaded retained messages from store: len=%d", len(retained))
-        if self.hooks.provides(STORED_SYS_INFO):
-            sys_info = self.hooks.stored_sys_info()
-            if sys_info is not None:
-                self.load_server_info(sys_info.info)
-                self.log.debug("loaded $SYS info from store")
+        # durable recovery window (ISSUE 16): healthz answers 503
+        # `recovering` from the first restored byte until serve() has
+        # the maps actually being served (after hooks.on_started()).
+        # Restore failures propagate — serving a silently-partial
+        # session map would be worse than refusing to start.
+        self._durable["recovering"] = True
+        t0 = time.perf_counter()
+        try:
+            if self.hooks.provides(STORED_CLIENTS):
+                clients = self.hooks.stored_clients()
+                self.load_clients(clients)
+                self.log.debug("loaded clients from store: len=%d", len(clients))
+            if self.hooks.provides(STORED_SUBSCRIPTIONS):
+                subs = self.hooks.stored_subscriptions()
+                self.load_subscriptions(subs)
+                self.log.debug("loaded subscriptions from store: len=%d", len(subs))
+            if self.hooks.provides(STORED_INFLIGHT_MESSAGES):
+                inflight = self.hooks.stored_inflight_messages()
+                self.load_inflight(inflight)
+                self.log.debug("loaded inflights from store: len=%d", len(inflight))
+            if self.hooks.provides(STORED_RETAINED_MESSAGES):
+                retained = self.hooks.stored_retained_messages()
+                self.load_retained(retained)
+                self.log.debug("loaded retained messages from store: len=%d", len(retained))
+            if self.hooks.provides(STORED_SYS_INFO):
+                sys_info = self.hooks.stored_sys_info()
+                if sys_info is not None:
+                    self.load_server_info(sys_info.info)
+                    self.log.debug("loaded $SYS info from store")
+        finally:
+            self._durable["recovery_seconds"] = time.perf_counter() - t0
+            self._durable["replayed_keys"] = int(
+                self._durable_store_stats().get("replayed_keys", 0)
+            )
 
     def load_server_info(self, v: Info) -> None:
         if self.options.capabilities.compatibilities.restore_sys_info_on_restart:
@@ -4613,6 +4960,7 @@ class Server:
         self.info.subscriptions = v.subscriptions
 
     def load_subscriptions(self, v: list) -> None:
+        entries: list[tuple[str, Subscription]] = []
         for sub in v:
             predicates = tuple(getattr(sub, "predicates", ()) or ())
             if predicates and self._predicates is not None:
@@ -4634,10 +4982,28 @@ class Server:
                 identifier=sub.identifier,
                 predicates=predicates,
             )
-            if self.topics.subscribe(sub.client, sb):
-                cl = self.clients.get(sub.client)
-                if cl is not None:
-                    cl.state.subscriptions.add(sub.filter, sb)
+            entries.append((sub.client, sb))
+        # batched re-registration (ISSUE 16): a million-session restart
+        # must not pay a trie lock round-trip per subscription — chunks
+        # flow through the trie's bulk-insert path
+        from .staging import bulk_register
+
+        new, batches = bulk_register(
+            self.topics, entries, batch=self.options.durable_restore_batch
+        )
+        self._durable["restored_subscriptions"] += new
+        self._durable["restore_batches"] += batches
+        for client, sb in entries:
+            cl = self.clients.get(client)
+            if cl is not None:
+                cl.state.subscriptions.add(sb.filter, sb)
+            if self._tenancy is not None and sb.filter[:1] == NS_CHAR:
+                t = self._tenancy.tenant_of_topic(sb.filter)
+                if t is not None:
+                    # seed the durable COUNT quota from restored state:
+                    # a tenant over cap after restart keeps its
+                    # subscriptions but cannot grow further
+                    t.subscriptions_count += 1
 
     def load_clients(self, v: list) -> None:
         for c in v:
@@ -4687,8 +5053,24 @@ class Server:
                 cl.state.inflight.set(msg.to_packet())
 
     def load_retained(self, v: list) -> None:
-        for msg in v:
-            self.topics.retain_message(msg.to_packet())
+        from .staging import bulk_retain
+
+        packets = [msg.to_packet() for msg in v]
+        retained, batches = bulk_retain(
+            self.topics, packets, batch=self.options.durable_restore_batch
+        )
+        self._durable["restored_retained"] += retained
+        self._durable["restore_batches"] += batches
+        self.info.retained = len(self.topics.retained)
+        if self._tenancy is not None:
+            for pk in packets:
+                if pk.payload and pk.topic_name[:1] == NS_CHAR:
+                    t = self._tenancy.tenant_of_topic(pk.topic_name)
+                    if t is not None:
+                        t.retained_count += 1
+        if self._retained_engine is not None:
+            # one corpus rebuild beats a million note_retained calls
+            self._retained_engine.reseed()
 
     # -- expiry loops (server.go:1696-1758) --------------------------------
 
@@ -4717,6 +5099,12 @@ class Server:
             if expired or enforced:
                 self.topics.retained.delete(filter_)
                 self.hooks.on_retained_expired(filter_)
+                if self._tenancy is not None and filter_[:1] == NS_CHAR:
+                    t = self._tenancy.tenant_of_topic(filter_)
+                    if t is not None and t.retained_count > 0:
+                        t.retained_count -= 1
+                if self._retained_engine is not None:
+                    self._retained_engine.note_retained(filter_, False)
 
     def clear_expired_inflights(self, now: int) -> None:
         for client in self.clients.get_all().values():

@@ -611,3 +611,50 @@ class MatchStage:
                     fut.set_exception(e)
         if n and self.telemetry is not None:
             self.telemetry.note_fallback(klass, n)
+
+
+# -- restart re-registration (the durable session plane's bulk path) ---------
+
+
+def bulk_register(topics, entries, batch: int = 4096) -> tuple[int, int]:
+    """Re-register persisted subscriptions through the trie's bulk-insert
+    path in fixed-size batches — the restart leg of the durable session
+    plane (ISSUE 16). ``entries`` yield ``(client_id, Subscription)``;
+    each chunk of ``batch`` pays ONE trie lock acquisition via
+    ``TopicsIndex.subscribe_bulk`` instead of a per-subscription
+    ``subscribe`` round-trip, which is the difference between a bounded
+    and an unbounded restart at a million sessions. Returns
+    ``(new_subscriptions, batches)`` so recovery metrics can prove the
+    path was actually batched."""
+    added = 0
+    batches = 0
+    chunk: list = []
+    for entry in entries:
+        chunk.append(entry)
+        if len(chunk) >= batch:
+            added += topics.subscribe_bulk(chunk)
+            batches += 1
+            chunk = []
+    if chunk:
+        added += topics.subscribe_bulk(chunk)
+        batches += 1
+    return added, batches
+
+
+def bulk_retain(topics, packets, batch: int = 4096) -> tuple[int, int]:
+    """Re-seat persisted retained messages in fixed-size batches via
+    ``TopicsIndex.retain_bulk`` (one lock acquisition per chunk).
+    Returns ``(retained, batches)``."""
+    retained = 0
+    batches = 0
+    chunk: list = []
+    for pk in packets:
+        chunk.append(pk)
+        if len(chunk) >= batch:
+            retained += topics.retain_bulk(chunk)
+            batches += 1
+            chunk = []
+    if chunk:
+        retained += topics.retain_bulk(chunk)
+        batches += 1
+    return retained, batches

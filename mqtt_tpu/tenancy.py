@@ -100,6 +100,12 @@ class Tenant:
         "bytes_in",
         "bytes_out",
         "recrypt_fanouts",
+        "max_retained",
+        "max_subscriptions",
+        "retained_count",
+        "subscriptions_count",
+        "retained_refused",
+        "subscriptions_refused",
     )
 
     def __init__(
@@ -107,6 +113,8 @@ class Tenant:
         name: str,
         quota_class: str = "",
         encrypted: tuple = (),
+        max_retained: int = 0,
+        max_subscriptions: int = 0,
     ) -> None:
         self.name = name
         self.quota_class = quota_class
@@ -121,6 +129,17 @@ class Tenant:
         self.bytes_in = 0
         self.bytes_out = 0
         self.recrypt_fanouts = 0
+        # durable COUNT caps (ISSUE 16, the MQT-TZ quota residual): how
+        # many retained topics / stored subscriptions this tenant may
+        # hold; 0 = unlimited (or the Options-level default cap). Counts
+        # are maintained structurally at every grow/shrink site in the
+        # namespaced stores; refusals answer v5 0x97 Quota exceeded.
+        self.max_retained = max_retained
+        self.max_subscriptions = max_subscriptions
+        self.retained_count = 0
+        self.subscriptions_count = 0
+        self.retained_refused = 0
+        self.subscriptions_refused = 0
 
     def is_encrypted(self, local_topic: str) -> bool:
         """Does a tenant-local topic live in an encrypted namespace?"""
@@ -140,6 +159,10 @@ class Tenant:
             "bytes/in": self.bytes_in,
             "bytes/out": self.bytes_out,
             "recrypt_fanouts": self.recrypt_fanouts,
+            "retained/count": self.retained_count,
+            "retained/refused": self.retained_refused,
+            "subscriptions/count": self.subscriptions_count,
+            "subscriptions/refused": self.subscriptions_refused,
         }
 
 
@@ -207,6 +230,19 @@ class TenantPlane:
                 quota_class=str(cfg.get("quota_class", "") or ""),
                 encrypted=tuple(cfg.get("encrypted", ()) or ()),
             )
+            # per-tenant count-cap overrides (fall back to the
+            # Options-level tenant_max_* defaults when absent)
+            try:
+                t.max_retained = int(cfg.get("max_retained", t.max_retained))
+                t.max_subscriptions = int(
+                    cfg.get("max_subscriptions", t.max_subscriptions)
+                )
+            except (TypeError, ValueError):
+                _log.warning(
+                    "tenant %r max_retained/max_subscriptions is not an "
+                    "integer; cap ignored",
+                    t.name,
+                )
             for ident, hexkey in (cfg.get("keys") or {}).items():
                 try:
                     key = bytes.fromhex(str(hexkey))
@@ -301,6 +337,11 @@ class TenantPlane:
             ("mqtt_tpu_tenant_bytes_in_total", "bytes_in"),
             ("mqtt_tpu_tenant_bytes_out_total", "bytes_out"),
             ("mqtt_tpu_tenant_connects_total", "connects"),
+            ("mqtt_tpu_tenant_retained_refused_total", "retained_refused"),
+            (
+                "mqtt_tpu_tenant_subscriptions_refused_total",
+                "subscriptions_refused",
+            ),
         ):
             r.counter(
                 name,
@@ -312,6 +353,20 @@ class TenantPlane:
             "mqtt_tpu_tenant_connected",
             "Live connections per tenant",
             fn=lambda t=tenant: t.connected,
+            tenant=tenant.name,
+        )
+        r.gauge(
+            "mqtt_tpu_tenant_retained_count",
+            "Retained topics currently held per tenant (count-capped by "
+            "max_retained / tenant_max_retained)",
+            fn=lambda t=tenant: t.retained_count,
+            tenant=tenant.name,
+        )
+        r.gauge(
+            "mqtt_tpu_tenant_subscriptions_count",
+            "Stored subscriptions currently held per tenant (count-capped "
+            "by max_subscriptions / tenant_max_subscriptions)",
+            fn=lambda t=tenant: t.subscriptions_count,
             tenant=tenant.name,
         )
 

@@ -500,6 +500,36 @@ class TopicsIndex:
             )
             return not existed
 
+    def subscribe_bulk(self, entries: list[tuple[str, Subscription]]) -> int:
+        """Batched :meth:`subscribe`: one lock acquisition inserts a whole
+        batch of ``(client, subscription)`` pairs — the restart
+        re-registration path (ISSUE 16), where a million persisted
+        subscriptions must not pay a lock round-trip (and an observer
+        wake) each. Returns how many were NEW. Per-entry semantics are
+        identical to :meth:`subscribe`: the version bumps and the delta
+        observers fire for every entry, so device-matcher overlays see
+        the same mutation stream either way."""
+        added = 0
+        with self._lock:
+            for client, subscription in entries:
+                self.version += 1
+                prefix, _ = isolate_particle(subscription.filter, 0)
+                if prefix.upper() == SHARE_PREFIX:
+                    group, _ = isolate_particle(subscription.filter, 1)
+                    n = self._set(subscription.filter, 2)
+                    existed = n.shared.get(group, client) is not None
+                    n.shared.add(group, client, subscription)
+                else:
+                    n = self._set(subscription.filter, 0)
+                    existed = n.subscriptions.get(client) is not None
+                    n.subscriptions.add(client, subscription)
+                self._notify(
+                    Mutation(subscription.filter, "sub", "add", client, subscription)
+                )
+                if not existed:
+                    added += 1
+        return added
+
     def unsubscribe(self, filter: str, client: str) -> bool:
         """Remove a client's subscription; returns True if it existed
         (topics.go:423-448)."""
@@ -581,6 +611,25 @@ class TopicsIndex:
             self.retained.delete(pk.topic_name)  # [MQTT-3.3.1-6] [MQTT-3.3.1-7]
             self._trim(n)
             return out
+
+    def retain_bulk(self, packets: list[Packet]) -> int:
+        """Batched :meth:`retain_message` for restart restore: one lock
+        acquisition re-seats a whole batch of retained messages. Returns
+        how many were retained (clears count like the scalar path but are
+        not summed). Per-packet semantics match :meth:`retain_message`."""
+        retained = 0
+        with self._lock:
+            for pk in packets:
+                n = self._set(pk.topic_name, 0)
+                if pk.payload:
+                    n.retain_path = pk.topic_name
+                    self.retained.add(pk.topic_name, pk)
+                    retained += 1
+                else:
+                    n.retain_path = ""
+                    self.retained.delete(pk.topic_name)
+                    self._trim(n)
+        return retained
 
     def _set(self, topic: str, d: int) -> _Particle:
         """Create (or find) the particle at a topic address (topics.go:479)."""

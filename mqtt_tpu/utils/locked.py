@@ -72,6 +72,7 @@ LOCK_NAMES = (
     "topics_trie",
     "cluster_remote_trie",
     "retained",
+    "durable_store",
     "metrics_registry",
     "flight_ring",
     "trace_ring",

@@ -381,8 +381,20 @@ class TestPerShardEviction:
             try:
                 gov = h.server.overload
                 slow_r, slow_w, _ = await h.connect("stall")
-                # shrink the victim's buffers so the backlog shows fast
+                # shrink the victim's buffers so the backlog shows fast:
+                # clamp BOTH kernel socket buffers, or a host with large
+                # tcp autotuning limits (tcp_rmem max can be tens of MB)
+                # absorbs the whole flood in the kernel and the asyncio
+                # write buffer — what the sweep measures — never grows
                 scl = h.server.clients.get("stall")
+                import socket as _socket
+
+                slow_w.transport.get_extra_info("socket").setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_RCVBUF, 4096
+                )
+                scl.net.writer.transport.get_extra_info("socket").setsockopt(
+                    _socket.SOL_SOCKET, _socket.SO_SNDBUF, 4096
+                )
                 await h.subscribe(
                     slow_r, slow_w, 1, [Subscription(filter="e/#", qos=0)]
                 )

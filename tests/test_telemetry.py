@@ -434,9 +434,16 @@ async def _http(host, port, path, method="GET"):
     reader, writer = await asyncio.open_connection(host, int(port))
     writer.write(f"{method} {path} HTTP/1.1\r\nHost: x\r\n\r\n".encode())
     await writer.drain()
-    data = await asyncio.wait_for(reader.read(262144), 3)
+    # the listener answers Connection: close — read to EOF, not one
+    # recv (a grown /metrics body spans several TCP segments)
+    chunks = []
+    while True:
+        chunk = await asyncio.wait_for(reader.read(65536), 3)
+        if not chunk:
+            break
+        chunks.append(chunk)
     writer.close()
-    return data
+    return b"".join(chunks)
 
 
 class TestHttpSurfaces:

@@ -84,6 +84,12 @@ LOCK_ORDER = (
     "topics_trie",
     "cluster_remote_trie",
     "retained",
+    # the durable session plane (hooks/storage/logkv.py): storage-hook
+    # events fire while trie/retained work completes, so the store lock
+    # nests inside them and above the observability leaves; its append
+    # path takes nothing further (the maintenance serializer beside it
+    # is anonymous and ordered before it by construction)
+    "durable_store",
     # observability rings/registries last: leaf locks that must never
     # call back out into the planes above
     "flight_ring",
