@@ -56,9 +56,12 @@ test: verify
 # mesh peer kill under live traffic (tests/test_resilience.py), the
 # sustained publish-storm overload drill (tests/test_overload.py), the
 # partition-storm mesh drill against a flapping 2-worker broker
-# (tests/test_cluster.py + stress.py --partition), and the seeded
-# thread-schedule sweeps (tests/test_race.py: the switch-interval
-# fuzz plus the 200-schedule graph-guided preemption fuzzer)
+# (tests/test_cluster.py + stress.py --partition), the multi-worker
+# mesh drills (tests/test_mesh_drill.py: the 32-worker partition
+# storm, the shaped-TCP two-machine WAN predicate drill, and the
+# root-kill failover leg), and the seeded thread-schedule sweeps
+# (tests/test_race.py: the switch-interval fuzz plus the 200-schedule
+# graph-guided preemption fuzzer)
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_resilience.py \
 	  tests/test_overload.py tests/test_cluster.py tests/test_race.py \

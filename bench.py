@@ -1246,6 +1246,102 @@ def run_cfg11(fast: bool, rng) -> dict:
     return out
 
 
+def run_cfg12(fast: bool, rng) -> dict:
+    """Config 12 (ISSUE 17): the mesh predicate push-down gate in
+    isolation — no sockets, no jax. One tree-mode Cluster gets a
+    hand-installed edge summary whose subtree holds ONLY a predicated
+    subscriber (``pp/#$GT{v:50}``): the exact shape where push-down
+    earns its keep, because the plain bloom misses and every forward
+    hinges on evaluating the interned rule against the payload. Three
+    legs over ``_route_edges``:
+
+    1. failing payloads — the edge must be SKIPPED every time (the
+       filtered ratio is asserted at 1.0: a silent degradation to
+       pass-through is a correctness bug, not a slow round);
+    2. passing payloads — the edge must forward every time;
+    3. a bloom-miss topic — the PR 9 topic gate, for scale.
+    """
+    import shutil
+    import tempfile
+
+    from mqtt_tpu.cluster import Cluster, _EdgeSummary
+    from mqtt_tpu.mesh_topology import BloomBits, CountedBloom
+    from mqtt_tpu.predicates import predicate_digest
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.topics import summary_base
+
+    d = tempfile.mkdtemp(prefix="bench-mesh-pushdown-")
+    try:
+        srv = Server(
+            Options(telemetry=False, profile=False, cluster_topology="tree")
+        )
+        cl = Cluster(srv, 0, 2, d)
+        ep = cl.topo.epoch
+        sfx = "$GT{v:50}"
+        interest = CountedBloom()
+        interest.add(summary_base("pp/#" + sfx))
+        cl._edge_summaries[1] = _EdgeSummary(
+            interest.bits(),
+            1,
+            (ep.num, ep.boot, ep.proposer),
+            plain=BloomBits.empty(),
+            digests=((predicate_digest(sfx), sfx),),
+        )
+
+        n = 20_000 if fast else 200_000
+        # a pool of distinct payloads so the JSON parse inside the gate
+        # is paid on every call, like live traffic — not one hot string
+        fails = [
+            json.dumps({"v": rng.randint(0, 50), "seq": i}).encode()
+            for i in range(256)
+        ]
+        passes = [
+            json.dumps({"v": rng.randint(51, 500), "seq": i}).encode()
+            for i in range(256)
+        ]
+        route = cl._route_edges
+
+        base_filtered = cl.summary_predicate_filtered_forwards
+        t0 = time.perf_counter()
+        for i in range(n):
+            route("pp/x", None, payload=fails[i & 255])
+        fail_dt = time.perf_counter() - t0
+        filtered = cl.summary_predicate_filtered_forwards - base_filtered
+
+        forwarded = 0
+        t0 = time.perf_counter()
+        for i in range(n):
+            forwarded += len(route("pp/x", None, payload=passes[i & 255]))
+        pass_dt = time.perf_counter() - t0
+
+        base_bloom = cl.summary_filtered_forwards
+        t0 = time.perf_counter()
+        for i in range(n):
+            route("zz/x", None, payload=passes[i & 255])
+        bloom_dt = time.perf_counter() - t0
+        bloom_filtered = cl.summary_filtered_forwards - base_bloom
+
+        ratio = filtered / max(n, 1)
+        if ratio != 1.0 or forwarded != n or bloom_filtered != n:
+            # a gate that stops filtering (or worse, stops forwarding)
+            # must fail the round loudly, not post a smaller number
+            raise AssertionError(
+                f"cfg12 gate broke: filtered={filtered}/{n} "
+                f"forwarded={forwarded}/{n} bloom={bloom_filtered}/{n}"
+            )
+        out = {
+            "pushdown_filter_evals_per_sec": round(n / max(fail_dt, 1e-9)),
+            "pushdown_forward_evals_per_sec": round(n / max(pass_dt, 1e-9)),
+            "bloom_gate_evals_per_sec": round(n / max(bloom_dt, 1e-9)),
+            "pushdown_filtered_ratio": ratio,
+            "evals": n,
+        }
+        log(f"cfg12 pushdown {out}")
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def run_materializer_bench(fast: bool) -> dict:
     """Config 7: the host result materializer in isolation — NO device, no
     jax. Synthetic snapshot tables and packed range rows shaped like cfg2's
@@ -1937,7 +2033,7 @@ def main() -> None:
     which = {
         int(c)
         for c in os.environ.get(
-            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11"
+            "BENCH_CONFIGS", "1,2,3,4,5,6,7,8,9,10,11,12"
         ).split(",")
         if c.strip()
     }
@@ -2114,6 +2210,16 @@ def main() -> None:
         except Exception as e:  # never take the whole artifact down
             configs["11_durable_recovery"] = {"skipped": f"error: {e}"}
         log(f"cfg11 {configs['11_durable_recovery']} ({time.perf_counter()-t0:.0f}s)")
+    if 12 in which:
+        # mesh predicate push-down gate (ISSUE 17): pure host, no
+        # sockets — the per-edge filter/forward decision rate and the
+        # asserted filtered ratio
+        t0 = time.perf_counter()
+        try:
+            configs["12_mesh_pushdown"] = run_cfg12(fast, rng)
+        except Exception as e:  # never take the whole artifact down
+            configs["12_mesh_pushdown"] = {"skipped": f"error: {e}"}
+        log(f"cfg12 {configs['12_mesh_pushdown']} ({time.perf_counter()-t0:.0f}s)")
     if not device_ok and device_wanted:
         # the broker bench bought the tunnel a few minutes: one more chance
         device_ok, probe_err = probe_device(2)

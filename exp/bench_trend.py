@@ -166,6 +166,11 @@ CONFIG_SCALARS = (
     # at the largest swept scale, and the device retained scan rate
     ("11_durable_recovery", "recovery_keys_per_sec"),
     ("11_durable_recovery", "retained_device_scans_per_sec"),
+    # mesh predicate push-down (ISSUE 17): the per-edge filter decision
+    # rate — the filtered RATIO is asserted inside cfg12 itself (a
+    # silent pass-through degradation errors the config, which this
+    # gate's >0 usability rule would otherwise skip)
+    ("12_mesh_pushdown", "pushdown_filter_evals_per_sec"),
 )
 
 

@@ -92,6 +92,8 @@ import logging
 import math
 import os
 import random
+import socket
+import ssl
 import struct
 import time
 from typing import Any, Callable, Iterable, Optional
@@ -110,6 +112,7 @@ from .mesh_topology import (
 )
 from .packets import PUBLISH, FixedHeader, Packet
 from .packets import Subscription
+from .predicates import compile_suffix, eval_rule_host, predicate_digest
 from .topics import (
     NS_CHAR,
     SHARE_PREFIX,
@@ -185,6 +188,31 @@ PEER_UP = "up"
 PEER_SUSPECT = "suspect"
 PEER_PARTITIONED = "partitioned"
 _HEALTH_CODES = {PEER_UP: 0, PEER_SUSPECT: 1, PEER_PARTITIONED: 2}
+
+
+class _EdgeSummary:
+    """One tree edge's received interest summary: the all-interest bloom
+    the PR 9 gate probes, plus the predicate push-down planes (ISSUE 17)
+    — the PLAIN (un-predicated) interest bloom and the interned
+    predicate digest list. ``plain``/``digests`` are None when the
+    sender predates push-down (or overflowed its digest cap): the gate
+    degrades to the PR 9 topic-only behavior, conservative as ever."""
+
+    __slots__ = ("bits", "gen", "ep_key", "plain", "digests")
+
+    def __init__(
+        self,
+        bits: "BloomBits",
+        gen: int,
+        ep_key: tuple,
+        plain: Optional["BloomBits"] = None,
+        digests: Optional[tuple] = None,
+    ) -> None:
+        self.bits = bits
+        self.gen = gen
+        self.ep_key = ep_key
+        self.plain = plain
+        self.digests = digests  # ((digest, suffix), ...) or None
 
 
 class _PeerHealth:
@@ -273,7 +301,45 @@ class Cluster:
         # fault-injection seam (mqtt_tpu.faults): when set, inbound
         # frames it returns False for are dropped before dispatch
         self._rx_filter: Optional[Callable[[int, int, bytes], bool]] = None
+        # link-shaping seam (mqtt_tpu.faults.shape_cluster_links): an
+        # ASYNC hook awaited on every inbound frame BEFORE the rx filter
+        # — it models the wire itself (latency/jitter/loss/bandwidth),
+        # so it runs where the bytes arrive; returning False drops the
+        # frame (control loss — the protocol re-sends those anyway)
+        self._rx_shaper: Optional[Any] = None
         opts = getattr(server, "options", None)
+        # real transport (ISSUE 17): peers ride unix sockets on one box
+        # (the default, bit-identical to PR 5) or TCP across machines —
+        # optionally TLS with CA-verified peer certs BOTH directions
+        # (a worker cert is an authorization to join the mesh, so the
+        # server side requires one too). Worker ``i`` listens on
+        # ``cluster_base_port + i`` unless cluster_peer_addrs pins an
+        # explicit host:port per worker (multi-machine deployments).
+        self.transport = str(
+            getattr(opts, "cluster_transport", "unix") or "unix"
+        ).lower()
+        self.host = str(getattr(opts, "cluster_host", "127.0.0.1") or "127.0.0.1")
+        self.base_port = int(getattr(opts, "cluster_base_port", 0) or 0)
+        self.peer_addrs: dict[int, tuple[str, int]] = {}
+        for w, addr in dict(
+            getattr(opts, "cluster_peer_addrs", None) or {}
+        ).items():
+            try:
+                host, _, port = str(addr).rpartition(":")
+                self.peer_addrs[int(w)] = (host or "127.0.0.1", int(port))
+            except (ValueError, TypeError):
+                pass  # a malformed entry falls back to base_port + worker
+        self.tls_cert = str(getattr(opts, "cluster_tls_cert", "") or "")
+        self.tls_key = str(getattr(opts, "cluster_tls_key", "") or "")
+        self.tls_ca = str(getattr(opts, "cluster_tls_ca", "") or "")
+        # WAN-tuned link timers: the connect timeout bounds a dial stuck
+        # in a blackholed SYN (WAN RTTs make the OS default minutes);
+        # keepalive_s > 0 arms SO_KEEPALIVE with that idle/interval so a
+        # silently dead path is torn down between ping ticks
+        self.connect_timeout_s = float(
+            getattr(opts, "cluster_connect_timeout_s", 5.0) or 5.0
+        )
+        self.keepalive_s = float(getattr(opts, "cluster_keepalive_s", 0.0) or 0.0)
         self.suspect_pings = getattr(opts, "cluster_peer_health_suspect_pings", 2)
         self.partition_pings = getattr(
             opts, "cluster_peer_health_partition_pings", 5
@@ -306,10 +372,37 @@ class Cluster:
         self.topo: Optional[Topology] = None
         self._local_interest = CountedBloom(summary_bits)
         self._summary_filters: set[str] = set()  # summary keys currently counted
-        # peer -> (received bits, sender gen, sender (num, boot, proposer))
-        self._edge_summaries: dict[
-            int, tuple[BloomBits, int, tuple[int, int, int]]
-        ] = {}
+        # predicate push-down (ISSUE 17): the all-interest bloom above
+        # answers "could any filter match this topic"; these answer the
+        # sharper "could any subscriber actually TAKE it". Plain (un-
+        # predicated) interest keeps its own counted bloom, predicated
+        # interest rides as interned suffix digests — a forwarder
+        # evaluates each digest's compiled rule against the publish
+        # payload (the same host interpreter the destination runs, so a
+        # local FAIL is a guaranteed destination FAIL: false negatives
+        # impossible, exactly the blooms' contract).
+        self._local_plain = CountedBloom(summary_bits)
+        self._local_digests: dict[str, int] = {}  # suffix -> live-filter refs
+        # filter -> (has_plain, suffixes): the last probed push-down
+        # split per live filter, so churn diffs instead of re-folding
+        self._filter_pred: dict[str, tuple] = {}
+        self._digest_gen = 0  # bumped when the digest SET changes
+        # suffix -> compiled spec, or None = always-pass (aggregation
+        # windows and anything that fails to compile stay conservative)
+        self._digest_specs: dict[str, Optional[Any]] = {}
+        self.summary_digest_cap = int(
+            getattr(opts, "cluster_summary_digests", 64) or 0
+        )
+        self.summary_predicate_filtered_forwards = 0
+        # root-failure fast path (ISSUE 17): the pre-agreed successor
+        # (mesh_topology.compute_successor) promotes the moment the root
+        # goes SUSPECT instead of waiting out the PARTITIONED threshold
+        # — no full re-election blackout on the happy path
+        self.root_failovers = 0
+        self.root_failover_last_s = 0.0
+        self._root_failover_hist: Optional[Any] = None
+        # peer -> _EdgeSummary (received bits + push-down planes)
+        self._edge_summaries: dict[int, _EdgeSummary] = {}
         # peer -> (gen, full epoch key) last successfully sent
         self._summary_sent: dict[
             int, tuple[int, tuple[int, int, int]]
@@ -494,6 +587,25 @@ class Cluster:
                     "stale or not yet received",
                     fn=lambda: self.summary_passthrough_forwards,
                 )
+                r.counter(
+                    "mqtt_tpu_cluster_summary_predicate_filtered_total",
+                    "Tree edges skipped by predicate push-down: every "
+                    "remote subscriber behind them was predicated and "
+                    "every digest's rule FAILED on this payload",
+                    fn=lambda: self.summary_predicate_filtered_forwards,
+                )
+                r.counter(
+                    "mqtt_tpu_cluster_root_failovers_total",
+                    "Root-death fast-path promotions taken by THIS "
+                    "worker as the pre-agreed successor",
+                    fn=lambda: self.root_failovers,
+                )
+                self._root_failover_hist = r.histogram(
+                    "mqtt_tpu_cluster_root_failover_seconds",
+                    "Root-failure promotion window: suspect transition "
+                    "on the dead root to the new epoch flooded (the "
+                    "no-blackout bound the drill asserts)",
+                )
 
     @property
     def peer_count(self) -> int:
@@ -510,18 +622,100 @@ class Cluster:
     def _sock_path(self, worker: int) -> str:
         return os.path.join(self.sock_dir, f"mqtt-tpu-w{worker}.sock")
 
+    def _peer_addr(self, worker: int) -> tuple[str, int]:
+        """TCP transport: where ``worker`` listens. Cross-machine
+        deployments pin workers to hosts via ``cluster_peer_addrs``;
+        unpinned workers default to ``cluster_host`` and a deterministic
+        per-worker port (``cluster_base_port + worker``)."""
+        pinned = self.peer_addrs.get(worker)
+        if pinned is not None:
+            return pinned
+        return (self.host, self.base_port + worker)
+
+    def _tls_context(self, server: bool) -> Optional[ssl.SSLContext]:
+        """Mutual-TLS context for peer links, or None when TLS is off
+        (no cert configured). Both directions verify: the accepting side
+        demands a client cert and the dialing side verifies the server
+        cert against ``cluster_tls_ca`` — a mesh peer is authenticated
+        by its certificate, not its address. Hostname checking is off on
+        purpose: peer identity is the CA-signed cert itself, and drill
+        harnesses address every "machine" as 127.0.0.1."""
+        if not self.tls_cert:
+            return None
+        ctx = ssl.SSLContext(
+            ssl.PROTOCOL_TLS_SERVER if server else ssl.PROTOCOL_TLS_CLIENT
+        )
+        ctx.load_cert_chain(self.tls_cert, self.tls_key or None)
+        if self.tls_ca:
+            ctx.load_verify_locations(self.tls_ca)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        if not server:
+            ctx.check_hostname = False
+        return ctx
+
+    def _tune_socket(self, writer: asyncio.StreamWriter) -> None:
+        """WAN keepalive tuning on a peer link (both accept and dial
+        sides): with ``cluster_keepalive_s`` set, the kernel probes an
+        idle link so a silently-dead TCP path (machine vanished, NAT
+        state expired) surfaces as a socket error instead of hanging
+        until the application-level ping clock partitions it."""
+        if self.keepalive_s <= 0:
+            return
+        sock = writer.get_extra_info("socket")
+        if sock is None:
+            return
+        try:
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            idle = max(1, int(self.keepalive_s))
+            if hasattr(socket, "TCP_KEEPIDLE"):
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPIDLE, idle)
+            if hasattr(socket, "TCP_KEEPINTVL"):
+                sock.setsockopt(
+                    socket.IPPROTO_TCP, socket.TCP_KEEPINTVL, idle
+                )
+            if hasattr(socket, "TCP_KEEPCNT"):
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_KEEPCNT, 3)
+        except OSError:
+            pass  # tuning is advisory; an odd socket type keeps working
+
+    async def _connect(self, peer: int):
+        """One transport-aware connection attempt toward ``peer``. TCP
+        dials honor ``cluster_connect_timeout_s`` — a WAN SYN that
+        blackholes must fail onto the backoff ladder, not hang the dial
+        task forever — and apply the keepalive tuning on success."""
+        if self.transport == "tcp":
+            host, port = self._peer_addr(peer)
+            reader, writer = await asyncio.wait_for(
+                asyncio.open_connection(
+                    host, port, ssl=self._tls_context(server=False)
+                ),
+                timeout=self.connect_timeout_s,
+            )
+            self._tune_socket(writer)
+            return reader, writer
+        return await asyncio.open_unix_connection(self._sock_path(peer))
+
     async def start(self) -> None:
         loop = asyncio.get_running_loop()
         self._loop = loop  # _on_mutation may fire from embedder threads
         self._presence_wake = asyncio.Event()
-        path = self._sock_path(self.worker_id)
-        try:
-            os.unlink(path)
-        except FileNotFoundError:
-            pass
-        self._unix_server = await asyncio.start_unix_server(
-            self._on_peer_connect, path
-        )
+        if self.transport == "tcp":
+            host, port = self._peer_addr(self.worker_id)
+            self._unix_server = await asyncio.start_server(
+                self._on_peer_connect,
+                host,
+                port,
+                ssl=self._tls_context(server=True),
+            )
+        else:
+            path = self._sock_path(self.worker_id)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            self._unix_server = await asyncio.start_unix_server(
+                self._on_peer_connect, path
+            )
         # connect to lower-numbered peers (they accept from us); retries
         # cover start-order races. Tree mode dials only the current tree
         # NEIGHBORS (plus slow re-join probes toward excluded members) —
@@ -551,10 +745,11 @@ class Cluster:
             w.close()
         if self._unix_server is not None:
             self._unix_server.close()
-        try:
-            os.unlink(self._sock_path(self.worker_id))
-        except OSError:
-            pass
+        if self.transport != "tcp":
+            try:
+                os.unlink(self._sock_path(self.worker_id))
+            except OSError:
+                pass
 
     # re-dial backoff bounds: fast first retries for start-order races,
     # exponential growth (+jitter, mqtt_tpu.resilience.Backoff) so N
@@ -606,7 +801,6 @@ class Cluster:
         map converges."""
         from .resilience import Backoff
 
-        path = self._sock_path(peer)
         backoff = Backoff(
             initial=self.DIAL_BACKOFF_S,
             maximum=self.DIAL_BACKOFF_MAX_S,
@@ -617,8 +811,8 @@ class Cluster:
         while self._dial_wanted(peer):
             probe = self.topo is not None and not self.topo.in_view(peer)
             try:
-                reader, writer = await asyncio.open_unix_connection(path)
-            except OSError:
+                reader, writer = await self._connect(peer)
+            except (OSError, asyncio.TimeoutError, ssl.SSLError):
                 # an excluded member gets the gentle probe cadence: the
                 # fast first-retry ladder is for start-order races, not
                 # for hammering a socket that has been dead for minutes
@@ -673,6 +867,7 @@ class Cluster:
             await asyncio.sleep(backoff.next())  # link dropped: re-dial
 
     async def _on_peer_connect(self, reader, writer) -> None:
+        self._tune_socket(writer)  # no-op for unix links / keepalive off
         try:
             mtype, payload = await self._recv(reader)
         except (asyncio.IncompleteReadError, ConnectionError):
@@ -939,6 +1134,11 @@ class Cluster:
         body: dict = {"e": [ep.num, ep.boot, ep.proposer]}
         if not digest:
             body["m"] = encode_members(self.topo.members())
+            # the pre-agreed root successor is DERIVED (second-lowest live
+            # id, mesh_topology.compute_successor) — carried only so
+            # operators and the drill harness can observe the agreement;
+            # receivers recompute it from the member view and ignore "sc"
+            body["sc"] = self.topo.successor()
         payload = json.dumps(body).encode()
         targets = list(only) if only is not None else list(self.topo.neighbors())
         for p in targets:
@@ -1017,7 +1217,13 @@ class Cluster:
             self._reroute_parked(parked)
         self._sync_dial_tasks()
 
-    def _tree_update_interest(self, filter: str, populated: bool) -> None:
+    def _tree_update_interest(
+        self,
+        filter: str,
+        populated: bool,
+        has_plain: bool = True,
+        suffixes: frozenset = frozenset(),
+    ) -> None:
         """Fold one filter's populated state into the local counted
         bloom, idempotently: the ``_summary_filters`` set guarantees one
         add per live filter and one counted-bloom DELETE per withdrawal
@@ -1025,15 +1231,56 @@ class Cluster:
         $SHARE groups and predicate bases summarize as the BASE filter
         publishes actually match (topics.summary_base). The set keys on
         the ORIGINAL filter — `$SHARE/g/a/b` and `a/b` share a base, and
-        the counted bloom (not the set) owns that refcount."""
+        the counted bloom (not the set) owns that refcount.
+
+        ``has_plain``/``suffixes`` are the filter's push-down split from
+        ``_probe_interest`` (the trie stores predicate suffixes on the
+        Subscription records, not in the filter text): an unpredicated
+        subscriber puts the base in the PLAIN bloom, every predicated
+        one refcounts its suffix into the interned digest set. The
+        defaults are the conservative PR 9 posture — everything plain —
+        so a caller without split knowledge can only cost forwards."""
         base = summary_base(filter)
         if populated:
             if filter not in self._summary_filters:
                 self._summary_filters.add(filter)
                 self._local_interest.add(base)
+            else:
+                prev = self._filter_pred.get(filter, (True, frozenset()))
+                if prev == (has_plain, suffixes):
+                    return
+                pplain, psfx = prev
+                if pplain:
+                    self._local_plain.discard(base)
+                for s in psfx:
+                    self._digest_unref(s)
+            if has_plain:
+                self._local_plain.add(base)
+            for s in suffixes:
+                self._digest_ref(s)
+            self._filter_pred[filter] = (has_plain, suffixes)
         elif filter in self._summary_filters:
             self._summary_filters.discard(filter)
             self._local_interest.discard(base)
+            pplain, psfx = self._filter_pred.pop(filter, (True, frozenset()))
+            if pplain:
+                self._local_plain.discard(base)
+            for s in psfx:
+                self._digest_unref(s)
+
+    def _digest_ref(self, sfx: str) -> None:
+        refs = self._local_digests.get(sfx, 0)
+        self._local_digests[sfx] = refs + 1
+        if refs == 0:
+            self._digest_gen += 1  # set membership changed: re-advertise
+
+    def _digest_unref(self, sfx: str) -> None:
+        refs = self._local_digests.get(sfx, 0)
+        if refs <= 1:
+            if self._local_digests.pop(sfx, None) is not None:
+                self._digest_gen += 1
+        else:
+            self._local_digests[sfx] = refs - 1
 
     def _edge_summary_for(
         self, peer: int, local: Optional[BloomBits] = None
@@ -1044,10 +1291,53 @@ class Cluster:
         tree interested'. ``local`` lets a sweep over every edge pay the
         O(n_bits) counted-bloom export once, not once per edge."""
         bits = self._local_interest.bits() if local is None else local
-        for other, (obits, _gen, _ep) in self._edge_summaries.items():
+        for other, es in self._edge_summaries.items():
             if other != peer:
-                bits = bits.union(obits)
+                bits = bits.union(es.bits)
         return bits
+
+    def _edge_pushdown_for(
+        self, peer: int, plain: Optional[BloomBits] = None
+    ) -> tuple[BloomBits, Optional[tuple]]:
+        """The push-down planes advertised ON one edge: the aggregate
+        PLAIN bloom and the aggregate digest tuple (None = unknown,
+        receiver must stay conservative). An other-edge summary without
+        push-down info folds its WHOLE bloom into the plain plane — its
+        subtree's predicated interest then reads as plain, which only
+        costs forwards, never deliveries. The digest set is capped
+        (summary_digest_cap): past it the list stops enumerating the
+        predicates soundly, so it degrades to None."""
+        pbits = self._local_plain.bits() if plain is None else plain
+        digests: Optional[dict[int, str]] = {
+            predicate_digest(sfx): sfx for sfx in self._local_digests
+        }
+        for other, es in self._edge_summaries.items():
+            if other == peer:
+                continue
+            if es.plain is None:
+                # pre-push-down sender: every subscriber behind the edge
+                # counts as plain — the receiver forwards on any bloom
+                # match, exactly the PR 9 behavior for that subtree
+                pbits = pbits.union(es.bits)
+                continue
+            pbits = pbits.union(es.plain)
+            if es.digests is None:
+                # the edge has a plain split but could not ENUMERATE its
+                # predicates (downstream cap overflow): our list would
+                # be incomplete, so the whole digest plane degrades to
+                # unknown — plain still filters, predicates pass through
+                digests = None
+            elif digests is not None:
+                for d, sfx in es.digests:
+                    digests[int(d)] = str(sfx)
+        if digests is not None and (
+            self.summary_digest_cap <= 0
+            or len(digests) > self.summary_digest_cap
+        ):
+            digests = None
+        return pbits, (
+            tuple(sorted(digests.items())) if digests is not None else None
+        )
 
     def _send_summary(
         self,
@@ -1055,6 +1345,7 @@ class Cluster:
         writer,
         force: bool = False,
         local: Optional[BloomBits] = None,
+        plain: Optional[BloomBits] = None,
     ) -> None:
         """Push this edge's aggregate when anything feeding it moved
         since the last send (local generation, epoch) — or always, on
@@ -1072,27 +1363,44 @@ class Cluster:
         # received generations into the freshness key — EXCLUDING this
         # edge's own (its summary is not part of what we send it; folding
         # it in would make every receipt trigger a send back, and two
-        # neighbors would ping-pong summaries forever)
-        gen = self._local_interest.generation + sum(
-            g
-            for other, (_b, g, _e) in self._edge_summaries.items()
-            if other != peer
+        # neighbors would ping-pong summaries forever). The plain bloom
+        # and digest set ride the same summary, so their generations
+        # fold in too.
+        gen = (
+            self._local_interest.generation
+            + self._local_plain.generation
+            + self._digest_gen
+            + sum(
+                es.gen
+                for other, es in self._edge_summaries.items()
+                if other != peer
+            )
         )
         if not force and self._summary_sent.get(peer) == (gen, ep_key):
             return
         bits = self._edge_summary_for(peer, local)
-        head = json.dumps(
-            {
-                "e": ep.num,
-                "eb": ep.boot,
-                "ep": ep.proposer,
-                "g": gen,
-                "all": bits.match_all,
-            }
-        ).encode()
+        pbits, digests = self._edge_pushdown_for(peer, plain)
+        head_d = {
+            "e": ep.num,
+            "eb": ep.boot,
+            "ep": ep.proposer,
+            "g": gen,
+            "all": bits.match_all,
+            # push-down planes (ISSUE 17): nb splits the body into the
+            # all-interest and plain blooms; pd enumerates the interned
+            # predicate digests (null = unknown, stay conservative).
+            # Pre-push-down receivers ignore all three — their oversized
+            # BloomBits degrades to match-all on union, conservative.
+            "nb": len(bits.data),
+            "pall": pbits.match_all,
+            "pd": [[d, sfx] for d, sfx in digests]
+            if digests is not None
+            else None,
+        }
+        head = json.dumps(head_d).encode()
         try:
             if self._send_nowait(
-                peer, writer, _T_SUMMARY, head + b"\x00" + bits.data
+                peer, writer, _T_SUMMARY, head + b"\x00" + bits.data + pbits.data
             ):
                 self._summary_sent[peer] = (gen, ep_key)
         except (ConnectionError, RuntimeError):
@@ -1104,18 +1412,32 @@ class Cluster:
         if self.topo is None:
             return
         local = self._local_interest.bits()  # one export for the sweep
+        plain = self._local_plain.bits()
         for peer in self.topo.neighbors():
             w = self._writers.get(peer)
             if w is not None:
-                self._send_summary(peer, w, local=local)
+                self._send_summary(peer, w, local=local, plain=plain)
 
     def _on_summary(self, peer: int, payload: bytes) -> None:
         try:
             sep = payload.index(b"\x00")
             head = json.loads(payload[:sep])
-            bits = BloomBits(
-                bytes(payload[sep + 1 :]), bool(head.get("all", False))
-            )
+            body = payload[sep + 1 :]
+            nb = head.get("nb")
+            plain: Optional[BloomBits] = None
+            if nb is not None and 0 < int(nb) * 2 <= len(body):
+                nb = int(nb)
+                plain = BloomBits(
+                    bytes(body[nb : 2 * nb]), bool(head.get("pall", False))
+                )
+                body = body[:nb]
+            bits = BloomBits(bytes(body), bool(head.get("all", False)))
+            pd = head.get("pd")
+            digests: Optional[tuple] = None
+            if isinstance(pd, list):
+                digests = tuple(
+                    (int(d), str(sfx)) for d, sfx in pd
+                )
             gen = int(head.get("g", 0))
             # a head missing the boot/proposer fields stores a key no
             # live epoch can equal: conservative pass-through, not trust
@@ -1127,7 +1449,9 @@ class Cluster:
         except (ValueError, TypeError):
             return  # malformed summary: keep the stale one (conservative)
         first = peer not in self._edge_summaries
-        self._edge_summaries[peer] = (bits, gen, ep_key)
+        self._edge_summaries[peer] = _EdgeSummary(
+            bits, gen, ep_key, plain, digests
+        )
         tele = getattr(self.server, "telemetry", None)
         if first and tele is not None:
             tele.registry.gauge(
@@ -1135,7 +1459,7 @@ class Cluster:
                 "Fill ratio of the interest summary last received on a "
                 "tree edge (1.0 ≈ saturated, everything forwards)",
                 fn=lambda p=peer: (
-                    self._edge_summaries[p][0].fill_ratio()
+                    self._edge_summaries[p].bits.fill_ratio()
                     if p in self._edge_summaries
                     else 0.0
                 ),
@@ -1146,7 +1470,11 @@ class Cluster:
         self._send_summaries()
 
     def _route_edges(
-        self, topic: str, exclude: Optional[int], always: bool = False
+        self,
+        topic: str,
+        exclude: Optional[int],
+        always: bool = False,
+        payload: Optional[bytes] = None,
     ) -> list[int]:
         """The tree edges a publish on ``topic`` travels: every current
         neighbor except the arrival edge, gated by that edge's received
@@ -1154,7 +1482,16 @@ class Cluster:
         different epoch (the subtree behind the edge may have changed
         shape), passes conservatively — correctness never hangs on
         summary freshness, only efficiency does. ``always`` bypasses the
-        gate (retained replication reaches every worker)."""
+        gate (retained replication reaches every worker).
+
+        ``payload`` arms the predicate push-down (ISSUE 17): when the
+        edge's bloom matches but only PREDICATED subscribers could be
+        behind it (the plain bloom misses) and the summary enumerates
+        their digests, each digest's rule is evaluated here with the
+        same host interpreter the destination runs — every rule failing
+        means the destination would deliver to no one, so the edge is
+        skipped and counted. Any gap (no payload, no plain split, no
+        digest list, an unparseable rule) forwards conservatively."""
         if self.topo is None:
             return []
         out = []
@@ -1167,14 +1504,70 @@ class Cluster:
                 out.append(p)
                 continue
             stored = self._edge_summaries.get(p)
-            if stored is None or stored[2] != ep_key:
+            if stored is None or stored.ep_key != ep_key:
                 self.summary_passthrough_forwards += 1
                 out.append(p)
-            elif stored[0].might_match(topic):
-                out.append(p)
+            elif stored.bits.might_match(topic):
+                if (
+                    payload is None
+                    or stored.plain is None
+                    or stored.plain.might_match(topic)
+                    or stored.digests is None
+                    or self._digests_pass(stored.digests, payload)
+                ):
+                    out.append(p)
+                else:
+                    self.summary_predicate_filtered_forwards += 1
             else:
                 self.summary_filtered_forwards += 1
         return out
+
+    def _digests_pass(self, digests: tuple, payload: bytes) -> bool:
+        """Could ANY of the edge's interned predicates PASS this
+        payload? Mirrors the destination's own evaluation
+        (predicates.eval_rule_host — float32-coerced, skip-to-pass), so
+        False here guarantees the destination would deliver nothing:
+        push-down never loses a delivery a direct forward would have
+        made. Aggregation rules and anything uncompilable count as PASS
+        (their verdict depends on destination state we cannot see)."""
+        if not digests:
+            return False
+        doc: Any = None
+        for _digest, sfx in digests:
+            spec = self._digest_spec(sfx)
+            if spec is None:
+                return True  # unknowable: conservative
+            try:
+                if doc is None:
+                    try:
+                        doc = json.loads(payload)
+                    except (ValueError, UnicodeDecodeError):
+                        doc = False  # parsed, not JSON (non-None marker)
+                if eval_rule_host(spec, payload, doc):
+                    return True
+            except Exception:
+                return True  # evaluation trouble: conservative
+        return False
+
+    def _digest_spec(self, sfx: str):
+        """The compiled spec for one received suffix, cached; None =
+        always-pass (aggregation windows carry destination state, and a
+        suffix that fails to compile proves nothing)."""
+        try:
+            return self._digest_specs[sfx]
+        except KeyError:
+            pass
+        spec = None
+        try:
+            compiled = compile_suffix(sfx)
+            if not compiled.window:  # aggregation rules stay conservative
+                spec = compiled
+        except (ValueError, TypeError):
+            spec = None
+        if len(self._digest_specs) > 4096:  # bounded memory beats perfection
+            self._digest_specs.clear()
+        self._digest_specs[sfx] = spec
+        return spec
 
     @staticmethod
     def _frame_topic(frame: bytes) -> str:
@@ -1189,6 +1582,38 @@ class Cluster:
             return frame[off + 2 : off + 2 + tl].decode("utf-8", "replace")
         except (IndexError, ValueError):
             return ""
+
+    @staticmethod
+    def _frame_payload(frame: bytes, v5: bool = False) -> Optional[bytes]:
+        """The application payload of a raw PUBLISH frame — the predicate
+        push-down gate's evaluation input. ``v5`` skips the properties
+        block (tree _T_PACKET bodies are always encoded v5; the QoS0
+        passthrough frames are v4). None on any parse trouble — the
+        caller must treat that as forward-conservatively, never filter."""
+        from .server import publish_frame_body_offset
+
+        try:
+            off = publish_frame_body_offset(frame)
+            tl = (frame[off] << 8) | frame[off + 1]
+            i = off + 2 + tl
+            if (frame[0] >> 1) & 0x3:
+                i += 2  # packet id rides QoS>0 frames only
+            if v5:
+                mult = 1
+                plen = 0
+                while True:  # properties length varint
+                    b = frame[i]
+                    i += 1
+                    plen += (b & 0x7F) * mult
+                    if not (b & 0x80):
+                        break
+                    mult *= 128
+                i += plen
+            if i > len(frame):
+                return None
+            return bytes(frame[i:])
+        except (IndexError, ValueError):
+            return None
 
     def _route_stamp(self) -> dict:
         """A fresh route header for an ORIGINATING publish: the full
@@ -1266,7 +1691,9 @@ class Cluster:
         _T_RFRAME per summary-matching edge, all carrying the same
         (origin, boot, seq) stamp — each receiver is a distinct worker
         and sees it once; re-forwarding fans it down the tree."""
-        edges = self._route_edges(topic, None)
+        edges = self._route_edges(
+            topic, None, payload=self._frame_payload(frame)
+        )
         if not edges:
             return
         ob = origin.encode()
@@ -1324,7 +1751,7 @@ class Cluster:
         re-route it."""
         topic = pk.topic_name
         retain = bool(pk.fixed_header.retain)
-        edges = self._route_edges(topic, None, retain)
+        edges = self._route_edges(topic, None, retain, payload=pk.payload)
         if not edges:
             return
         c = pk.copy(False)
@@ -1427,7 +1854,12 @@ class Cluster:
         retain = bool(head.get("retain"))
         qos = int(head.get("qos", 0) or 0)
         tier_qos = 1 if retain else qos
-        for p in self._route_edges(topic, peer, retain or not topic):
+        for p in self._route_edges(
+            topic,
+            peer,
+            retain or not topic,
+            payload=self._frame_payload(frame, v5=True),
+        ):
             w = self._writers.get(p)
             ph = self._health.get(p)
             if tier_qos > 0 and (
@@ -1471,7 +1903,9 @@ class Cluster:
             # agreement) is what makes forwarding loop-safe
             self.stale_epoch_frames += 1
         topic = self._frame_topic(frame)
-        for p in self._route_edges(topic, peer, not topic):
+        for p in self._route_edges(
+            topic, peer, not topic, payload=self._frame_payload(frame)
+        ):
             w = self._writers.get(p)
             if w is None:
                 self._count_drop(p, partition=True)
@@ -1692,6 +2126,46 @@ class Cluster:
                         peer,
                         ph.outstanding,
                     )
+                    self._maybe_promote_root(peer)
+
+    def _maybe_promote_root(self, peer: int) -> None:
+        """Root-failure fast path (ISSUE 17): when the peer that just
+        went SUSPECT is the tree ROOT and *this* worker is the
+        pre-agreed successor (second-lowest live id — which is always
+        the root's direct heap child, so it observes the death
+        first-hand on its own ping clock), promote IMMEDIATELY: drop
+        the root from the view and flood the new epoch. Every other
+        worker adopts the strictly-greater epoch on arrival — no
+        ``partition_pings`` wait, no full scoped re-election blackout.
+
+        False suspicion converges safely: a live root that receives an
+        epoch excluding itself re-proposes (``propose_self`` in
+        ``_on_epoch``) and rejoins under a strictly-greater epoch — at
+        no point are there two roots within one adopted epoch, because
+        the root is DERIVED from the member view (lowest id)."""
+        topo = self.topo
+        if topo is None or self._stopping:
+            return
+        if peer != topo.root() or self.worker_id != topo.successor():
+            return
+        t0 = time.perf_counter()
+        if topo.propose_remove(peer) is None:
+            return  # lost a race with another membership event: give up
+        self.root_failovers += 1
+        self._reconcile_links()
+        self._announce_epoch()
+        dt = time.perf_counter() - t0
+        self.root_failover_last_s = dt
+        if self._root_failover_hist is not None:
+            self._root_failover_hist.observe(dt)
+        _log.warning(
+            "root %d suspected dead: successor %d promoted, epoch %s "
+            "flooded in %.6fs",
+            peer,
+            self.worker_id,
+            topo.epoch,
+            dt,
+        )
 
     def _on_pong(self, peer: int, payload: bytes) -> None:
         ph = self._health_for(peer)
@@ -2010,6 +2484,39 @@ class Cluster:
                 continue
         return True, False  # persistent tear: err on the forwarding side
 
+    def _probe_interest(self, f: str) -> tuple[bool, bool, frozenset]:
+        """(has_subscribers, has_plain, predicate_suffixes) for one
+        filter on the live trie — the push-down split (ISSUE 17). The
+        filter TEXT is always the base (the trie splits MQTT+ suffixes
+        off at SUBSCRIBE time); the suffixes live on the Subscription
+        records at the node, so only a node walk can recover them. A
+        subscriber without predicates makes the base PLAIN (always
+        forward on bloom match); every predicated one contributes its
+        suffix to the interned digest set. A persistent lock tear reads
+        as plain — forwards, never a miss."""
+        share_rooted = f.split("/", 1)[0].upper() == SHARE_PREFIX
+        for _ in range(8):
+            try:
+                node = self.server.topics._seek(f, 2 if share_rooted else 0)
+                if node is None:
+                    return False, False, frozenset()
+                plain = False
+                sfx = set()
+                subs: list = list(node.subscriptions.internal.values())
+                subs.extend(node.inline_subscriptions.internal.values())
+                for group in node.shared.internal.values():
+                    subs.extend(group.values())
+                for sub in subs:
+                    preds = getattr(sub, "predicates", ()) or ()
+                    if preds:
+                        sfx.update(preds)
+                    else:
+                        plain = True
+                return bool(subs), plain, frozenset(sfx)
+            except (RuntimeError, KeyError):
+                continue
+        return True, True, frozenset()  # persistent tear: read as plain
+
     async def _presence_loop(self) -> None:
         while True:
             await self._presence_wake.wait()
@@ -2023,8 +2530,10 @@ class Cluster:
                 # right away (tests and subscribers shouldn't wait a
                 # whole gossip tick for routability)
                 for f in pending:
-                    populated, _inline_only = self._probe_populated(f)
-                    self._tree_update_interest(f, populated)
+                    populated, has_plain, suffixes = self._probe_interest(f)
+                    self._tree_update_interest(
+                        f, populated, has_plain, suffixes
+                    )
                 self._send_summaries()
                 await asyncio.sleep(0)
                 continue
@@ -2412,6 +2921,9 @@ class Cluster:
         ph = self._health_for(peer)
         if ph.state == PEER_UP:
             ph.state = PEER_SUSPECT
+            # a dead ROOT socket is the fast-failover trigger too: the
+            # successor must not wait for the ping clock to re-notice
+            self._maybe_promote_root(peer)
 
     async def _read_loop(self, peer: int, reader, writer) -> None:
         self._live_read_loops[peer] = self._live_read_loops.get(peer, 0) + 1
@@ -2427,94 +2939,113 @@ class Cluster:
             except (asyncio.IncompleteReadError, ConnectionError):
                 self._on_link_down(peer, writer)
                 return
+            shaper = self._rx_shaper
+            if shaper is not None and not await shaper(peer, mtype, payload):
+                # link shaping (mqtt_tpu.faults): the frame was lost, or
+                # the shaper took ownership and will dispatch it LATE —
+                # either way the read loop moves on immediately, so a
+                # shaped propagation delay is latency, never occupancy
+                continue
             rx_filter = self._rx_filter
             if rx_filter is not None and not rx_filter(peer, mtype, payload):
                 continue  # fault injection (mqtt_tpu.faults): frame lost
-            try:
-                if mtype == _T_PRESENCE:
-                    d = json.loads(payload)
-                    if self._presence_stale(peer, d):
-                        continue  # pre-sync / dead-incarnation: discard
-                    self._apply_presence(
-                        peer, d["filter"], d["populated"], d.get("inline", False)
-                    )
-                elif mtype == _T_FRAME:
-                    (olen,) = struct.unpack(">H", payload[:2])
-                    origin = payload[2 : 2 + olen].decode()
-                    self._deliver_frame(payload[2 + olen :], origin)
-                elif mtype == _T_TFRAME:
-                    # a traced passthrough frame: same delivery as
-                    # _T_FRAME plus the remote-fanout span joining the
-                    # origin's trace (mqtt_tpu.tracing)
-                    (olen,) = struct.unpack(">H", payload[:2])
-                    origin = payload[2 : 2 + olen].decode()
-                    off = 2 + olen
-                    (tlen,) = struct.unpack(">H", payload[off : off + 2])
-                    tr = json.loads(payload[off + 2 : off + 2 + tlen])
-                    t0 = time.perf_counter()
-                    self._deliver_frame(
-                        payload[off + 2 + tlen :],
-                        origin,
-                        el=tr.get("el") if isinstance(tr, dict) else None,
-                        tid=tr.get("tid") if isinstance(tr, dict) else None,
-                    )
+            self._rx_dispatch(peer, mtype, payload, writer)
+
+    def _rx_dispatch(
+        self, peer: int, mtype: int, payload: bytes, writer=None
+    ) -> None:
+        """Apply one inbound peer frame (the read loop's dispatch table,
+        also the re-entry point for shaper-delayed frames — which pass
+        no writer: a pong for a late ping rides the canonical link, or
+        is skipped when the link died; pings are re-sent every tick)."""
+        if writer is None:
+            writer = self._writers.get(peer)
+        try:
+            if mtype == _T_PRESENCE:
+                d = json.loads(payload)
+                if self._presence_stale(peer, d):
+                    return  # pre-sync / dead-incarnation: discard
+                self._apply_presence(
+                    peer, d["filter"], d["populated"], d.get("inline", False)
+                )
+            elif mtype == _T_FRAME:
+                (olen,) = struct.unpack(">H", payload[:2])
+                origin = payload[2 : 2 + olen].decode()
+                self._deliver_frame(payload[2 + olen :], origin)
+            elif mtype == _T_TFRAME:
+                # a traced passthrough frame: same delivery as
+                # _T_FRAME plus the remote-fanout span joining the
+                # origin's trace (mqtt_tpu.tracing)
+                (olen,) = struct.unpack(">H", payload[:2])
+                origin = payload[2 : 2 + olen].decode()
+                off = 2 + olen
+                (tlen,) = struct.unpack(">H", payload[off : off + 2])
+                tr = json.loads(payload[off + 2 : off + 2 + tlen])
+                t0 = time.perf_counter()
+                self._deliver_frame(
+                    payload[off + 2 + tlen :],
+                    origin,
+                    el=tr.get("el") if isinstance(tr, dict) else None,
+                    tid=tr.get("tid") if isinstance(tr, dict) else None,
+                )
+                self._remote_span(
+                    "remote_fanout", tr, t0, {"from_peer": peer}
+                )
+            elif mtype == _T_PACKET:
+                sep = payload.index(b"\x00")
+                head = json.loads(payload[:sep])
+                frame = payload[sep + 1 :]
+                rt = head.get("rt")
+                if self.topo is not None and isinstance(rt, dict):
+                    # tree-routed: route the suppression verdict —
+                    # a DUP skips everything, a re-routed park copy
+                    # under a newer epoch re-forwards but must not
+                    # deliver twice, a new frame does both
+                    verdict = self._note_route(rt)
+                    if verdict == ROUTE_DUP:
+                        return
+                    self._reforward_packet(peer, head, rt, payload, frame)
+                    if verdict == ROUTE_REFORWARD:
+                        return
+                t0 = time.perf_counter()
+                self._deliver_packet(head, frame)
+                tr = head.get("trace")
+                if tr:
                     self._remote_span(
                         "remote_fanout", tr, t0, {"from_peer": peer}
                     )
-                elif mtype == _T_PACKET:
-                    sep = payload.index(b"\x00")
-                    head = json.loads(payload[:sep])
-                    frame = payload[sep + 1 :]
-                    rt = head.get("rt")
-                    if self.topo is not None and isinstance(rt, dict):
-                        # tree-routed: route the suppression verdict —
-                        # a DUP skips everything, a re-routed park copy
-                        # under a newer epoch re-forwards but must not
-                        # deliver twice, a new frame does both
-                        verdict = self._note_route(rt)
-                        if verdict == ROUTE_DUP:
-                            continue
-                        self._reforward_packet(peer, head, rt, payload, frame)
-                        if verdict == ROUTE_REFORWARD:
-                            continue
-                    t0 = time.perf_counter()
-                    self._deliver_packet(head, frame)
-                    tr = head.get("trace")
-                    if tr:
-                        self._remote_span(
-                            "remote_fanout", tr, t0, {"from_peer": peer}
-                        )
-                elif mtype == _T_RFRAME:
-                    self._on_rframe(peer, payload)
-                elif mtype == _T_EPOCH:
-                    self._on_epoch(peer, payload)
-                elif mtype == _T_SUMMARY:
-                    self._on_summary(peer, payload)
-                elif mtype == _T_PING:
-                    # echo verbatim; the sender computes the RTT. The raw
-                    # write bypasses _send_nowait, so count the pong's
-                    # control bytes here (the catalog row and the drill's
-                    # O(degree) rate are defined over ping AND pong)
+            elif mtype == _T_RFRAME:
+                self._on_rframe(peer, payload)
+            elif mtype == _T_EPOCH:
+                self._on_epoch(peer, payload)
+            elif mtype == _T_SUMMARY:
+                self._on_summary(peer, payload)
+            elif mtype == _T_PING:
+                # echo verbatim; the sender computes the RTT. The raw
+                # write bypasses _send_nowait, so count the pong's
+                # control bytes here (the catalog row and the drill's
+                # O(degree) rate are defined over ping AND pong)
+                if writer is not None:
                     writer.write(
                         struct.pack(">IB", len(payload) + 1, _T_PONG) + payload
                     )
                     self.control_bytes += len(payload) + 5
-                elif mtype == _T_PONG:
-                    self._on_pong(peer, payload)
-                elif mtype == _T_GOSSIP:
-                    self._on_gossip(peer, payload)
-                elif mtype == _T_METRICS:
-                    self._on_metrics(peer, payload)
-                elif mtype == _T_SYNC:
-                    d = json.loads(payload)
-                    self._apply_sync(peer, int(d["gen"]), d.get("boot"))
-                    # tree mode: the sync's boot nonce is membership
-                    # evidence too — a moved nonce is a restarted
-                    # incarnation and forces a re-election (its stale
-                    # tree must never be resurrected)
-                    self._member_contact(peer, int(d.get("boot") or 0))
-            except Exception:
-                _log.exception("cluster delivery failed (peer %d)", peer)
+            elif mtype == _T_PONG:
+                self._on_pong(peer, payload)
+            elif mtype == _T_GOSSIP:
+                self._on_gossip(peer, payload)
+            elif mtype == _T_METRICS:
+                self._on_metrics(peer, payload)
+            elif mtype == _T_SYNC:
+                d = json.loads(payload)
+                self._apply_sync(peer, int(d["gen"]), d.get("boot"))
+                # tree mode: the sync's boot nonce is membership
+                # evidence too — a moved nonce is a restarted
+                # incarnation and forces a re-election (its stale
+                # tree must never be resurrected)
+                self._member_contact(peer, int(d.get("boot") or 0))
+        except Exception:
+            _log.exception("cluster delivery failed (peer %d)", peer)
 
     def _deliver_frame(
         self,
@@ -2650,10 +3181,15 @@ def worker_env(
     sock_dir: str,
     topology: str = "",
     degree: int = 0,
+    transport: str = "",
+    base_port: int = 0,
+    host: str = "",
 ) -> dict:
     """Environment for a spawned worker process (read by __main__/stress).
     ``topology``/``degree`` select the spanning-tree fabric mesh-wide —
-    every worker must agree, so the launcher owns the choice."""
+    every worker must agree, so the launcher owns the choice. The same
+    goes for ``transport``/``base_port``/``host`` (ISSUE 17): a TCP mesh
+    only forms when every worker derives the same peer address map."""
     env = {
         "MQTT_TPU_WORKER": str(worker_id),
         "MQTT_TPU_WORKERS": str(n_workers),
@@ -2663,6 +3199,12 @@ def worker_env(
         env["MQTT_TPU_CLUSTER_TOPOLOGY"] = topology
     if degree:
         env["MQTT_TPU_CLUSTER_DEGREE"] = str(degree)
+    if transport:
+        env["MQTT_TPU_CLUSTER_TRANSPORT"] = transport
+    if base_port:
+        env["MQTT_TPU_CLUSTER_BASE_PORT"] = str(base_port)
+    if host:
+        env["MQTT_TPU_CLUSTER_HOST"] = host
     return env
 
 
@@ -2678,14 +3220,36 @@ def maybe_attach_from_env(server) -> Optional[Cluster]:
     wid = os.environ.get("MQTT_TPU_WORKER")
     if wid is None:
         return None
+    opts = getattr(server, "options", None)
     topo = os.environ.get("MQTT_TPU_CLUSTER_TOPOLOGY")
-    if topo:
-        opts = getattr(server, "options", None)
-        if opts is not None:
-            opts.cluster_topology = topo
-            degree = os.environ.get("MQTT_TPU_CLUSTER_DEGREE")
-            if degree:
-                opts.cluster_tree_degree = int(degree)
+    if topo and opts is not None:
+        opts.cluster_topology = topo
+        degree = os.environ.get("MQTT_TPU_CLUSTER_DEGREE")
+        if degree:
+            opts.cluster_tree_degree = int(degree)
+    if opts is not None:
+        # transport selection (ISSUE 17) rides env for spawned workers,
+        # same contract as topology: every worker must agree
+        for env_key, opt_key, conv in (
+            ("MQTT_TPU_CLUSTER_TRANSPORT", "cluster_transport", str),
+            ("MQTT_TPU_CLUSTER_HOST", "cluster_host", str),
+            ("MQTT_TPU_CLUSTER_BASE_PORT", "cluster_base_port", int),
+            ("MQTT_TPU_CLUSTER_TLS_CERT", "cluster_tls_cert", str),
+            ("MQTT_TPU_CLUSTER_TLS_KEY", "cluster_tls_key", str),
+            ("MQTT_TPU_CLUSTER_TLS_CA", "cluster_tls_ca", str),
+            (
+                "MQTT_TPU_CLUSTER_CONNECT_TIMEOUT_S",
+                "cluster_connect_timeout_s",
+                float,
+            ),
+            ("MQTT_TPU_CLUSTER_KEEPALIVE_S", "cluster_keepalive_s", float),
+        ):
+            raw = os.environ.get(env_key)
+            if raw:
+                try:
+                    setattr(opts, opt_key, conv(raw))
+                except ValueError:
+                    pass  # a malformed override keeps the default
     sock_dir = os.environ.get("MQTT_TPU_CLUSTER_DIR")
     if not sock_dir:
         raise RuntimeError(

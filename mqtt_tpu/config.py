@@ -272,6 +272,18 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "retained_matcher",
         "retained_oracle_sample",
         "durable_restore_batch",
+        # cross-machine mesh (ISSUE 17): TCP/TLS peer transport, WAN
+        # dial/keepalive tuning, predicate push-down digest cap
+        "cluster_transport",
+        "cluster_host",
+        "cluster_base_port",
+        "cluster_peer_addrs",
+        "cluster_tls_cert",
+        "cluster_tls_key",
+        "cluster_tls_ca",
+        "cluster_connect_timeout_s",
+        "cluster_keepalive_s",
+        "cluster_summary_digests",
     ):
         if k in top:
             setattr(opts, k, top[k])

@@ -32,7 +32,11 @@ behave like that hardware — reproducibly, from one seed:
   run a seeded, bounded link-flap storm over whatever link set the
   fabric holds (all-pairs or spanning tree); :func:`partition_peers`
   cuts a whole peer SET at once (the subtree-partition shape the tree's
-  scoped re-election exists for).
+  scoped re-election exists for); :class:`LinkShape` /
+  :func:`shape_cluster_links` impose a seeded WAN profile (latency,
+  jitter, loss, bandwidth) on chosen inbound edges — netem semantics
+  with no netem, so cross-machine conditions reproduce in tests on one
+  box.
 
 - :class:`StormPlan` — a seeded publish-storm schedule (publisher ->
   topic/payload/qos sequence, deterministic from the seed) plus
@@ -61,6 +65,7 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
@@ -585,6 +590,138 @@ def partition_peers(cluster, peers) -> Callable[[], None]:
     def release() -> None:
         if cluster._rx_filter is drop_from_cut:
             cluster._rx_filter = prev
+
+    return release
+
+
+@dataclass
+class LinkShape:
+    """A seeded WAN profile for one direction of a peer link (ISSUE 17):
+    propagation delay + uniform jitter, segment loss probability, and a
+    serialization bandwidth — everything netem would shape, reproducible
+    on one box from one seed with no root and no qdiscs.
+
+    The shaper models a TCP path, not a raw lossy wire: frames arrive IN
+    ORDER (each link's delivery horizon only moves forward, so a slow
+    frame head-of-line-blocks everything behind it exactly as a single
+    TCP stream would), and a "lost" DATA frame is delivered late — one
+    retransmit penalty (``retransmit_s``, defaulting to
+    ``max(0.2, 2 * delay_s)``, the RTO shape) — because TCP retransmits;
+    only idempotent CONTROL frames (pings, gossip, epoch digests — all
+    re-sent every tick by design) are actually dropped, which is what
+    keeps loss observable without ever violating the mesh's reliable-
+    stream assumptions.
+
+    Crucially, propagation delay is LATENCY, not OCCUPANCY: delayed
+    frames are handed to a per-link drainer task and the read loop moves
+    on, so a 25ms-delay link still carries arbitrarily many frames in
+    flight (sleeping inline would cap a shaped link at 1/delay frames/s
+    and melt the mesh's ping clock under drill load — a WAN does not do
+    that). Only ``rate_bytes_s`` consumes link time per byte."""
+
+    seed: int = 0
+    delay_s: float = 0.0  # one-way propagation delay (RTT/2 per direction)
+    jitter_s: float = 0.0  # uniform [0, jitter_s) added per frame
+    loss: float = 0.0  # per-frame loss probability
+    rate_bytes_s: float = 0.0  # serialization bandwidth (0 = unlimited)
+    retransmit_s: float = 0.0  # data-frame loss penalty (0 = RTO default)
+
+
+def shape_cluster_links(
+    cluster, shape: LinkShape, peers=None
+) -> Callable[[], None]:
+    """Install ``shape`` on ``cluster``'s INBOUND links from ``peers``
+    (every peer when None) — the cross-"machine" half of a drill splits
+    the worker set into groups and shapes only inter-group edges. Frames
+    from unshaped peers chain to any previously installed shaper, so
+    per-edge profiles stack. Per-(receiver, sender) rng streams derive
+    from (seed, worker, peer): the same storm replays exactly from its
+    seed, whatever the interleaving. Returns release()."""
+    import asyncio
+
+    from .cluster import _CONTROL_TYPES
+
+    sel = None if peers is None else frozenset(peers)
+    rngs: dict[int, random.Random] = {}
+    clocks: dict[int, float] = {}  # per-link serialization horizon
+    queues: dict[int, deque] = {}  # per-link (deliver_at, mtype, payload)
+    wakeups: dict[int, asyncio.Event] = {}
+    drainers: dict[int, asyncio.Task] = {}
+    horizons: dict[int, float] = {}  # per-link in-order delivery horizon
+    prev = cluster._rx_shaper
+
+    async def _drain(p: int) -> None:
+        """Deliver peer ``p``'s delayed frames in order at their
+        scheduled times — off the read loop, so delay never throttles
+        the link. The arrival-time rx filter still applies (a frame in
+        flight when a partition lands is swallowed by the cut)."""
+        q = queues[p]
+        ev = wakeups[p]
+        while not getattr(cluster, "_stopping", False):
+            if not q:
+                ev.clear()
+                try:
+                    # the timeout is an exit poll (cluster stopped with
+                    # the link idle), not a delivery cadence
+                    await asyncio.wait_for(ev.wait(), timeout=1.0)
+                except asyncio.TimeoutError:
+                    pass
+                continue
+            at, mtype, payload = q[0]
+            lag = at - time.monotonic()
+            if lag > 0:
+                await asyncio.sleep(lag)
+            q.popleft()
+            rx = cluster._rx_filter
+            if rx is None or rx(p, mtype, payload):
+                cluster._rx_dispatch(p, mtype, payload)
+
+    async def shaped(p: int, mtype: int, payload: bytes) -> bool:
+        if sel is not None and p not in sel:
+            return prev is None or await prev(p, mtype, payload)
+        rng = rngs.get(p)
+        if rng is None:
+            rng = rngs[p] = random.Random(
+                (shape.seed << 24) ^ (cluster.worker_id << 12) ^ p
+            )
+        delay = shape.delay_s
+        if shape.jitter_s > 0:
+            delay += shape.jitter_s * rng.random()
+        if shape.rate_bytes_s > 0:
+            now = time.monotonic()
+            horizon = max(clocks.get(p, 0.0), now)
+            horizon += (len(payload) + 5) / shape.rate_bytes_s
+            clocks[p] = horizon
+            delay += horizon - now
+        if shape.loss > 0 and rng.random() < shape.loss:
+            if mtype in _CONTROL_TYPES:
+                return False  # idempotent, re-sent next tick: really lost
+            # data frames ride a reliable stream: late, never lost
+            delay += shape.retransmit_s or max(0.2, 2 * shape.delay_s)
+        if delay <= 0:
+            return True
+        # in-order: the link's horizon only moves forward, so jitter (or
+        # a retransmit penalty) delays everything BEHIND it too, exactly
+        # like head-of-line blocking on one TCP stream
+        at = max(horizons.get(p, 0.0), time.monotonic() + delay)
+        horizons[p] = at
+        if p not in queues:
+            queues[p] = deque()
+            wakeups[p] = asyncio.Event()
+            drainers[p] = asyncio.get_running_loop().create_task(_drain(p))
+        queues[p].append((at, mtype, payload))
+        wakeups[p].set()
+        return False  # the drainer owns this frame now
+
+    cluster._rx_shaper = shaped
+
+    def release() -> None:
+        if cluster._rx_shaper is shaped:
+            cluster._rx_shaper = prev
+        for t in drainers.values():
+            t.cancel()
+        drainers.clear()
+        queues.clear()
 
     return release
 

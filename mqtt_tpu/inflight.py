@@ -6,10 +6,10 @@ Behavioral parity with reference ``inflight.go:16-156``.
 
 from __future__ import annotations
 
-import threading
 from typing import Optional
 
 from .packets import Packet
+from .utils.locked import InstrumentedLock
 
 
 class Inflight:
@@ -17,7 +17,7 @@ class Inflight:
     used for v5 flow control (inflight.go:16-23)."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = InstrumentedLock("inflight", rlock=True)
         self.internal: dict[int, Packet] = {}
         self.receive_quota = 0  # remaining inbound qos quota
         self.send_quota = 0  # remaining outbound qos quota
@@ -30,6 +30,18 @@ class Inflight:
             existed = m.packet_id in self.internal
             self.internal[m.packet_id] = m
             return not existed
+
+    def set_bulk(self, packets: list) -> int:
+        """Batched :meth:`set` for durable-session restore
+        (staging.bulk_inflight): one lock acquisition per chunk instead
+        of one per packet. Returns how many ids were new."""
+        with self._lock:
+            new = 0
+            for m in packets:
+                if m.packet_id not in self.internal:
+                    new += 1
+                self.internal[m.packet_id] = m
+            return new
 
     def get(self, id_: int) -> Optional[Packet]:
         with self._lock:

@@ -51,10 +51,11 @@ exactness).
 
 from __future__ import annotations
 
-import threading
 import zlib
 from dataclasses import dataclass
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .utils.locked import InstrumentedLock
 
 #: default branching factor: per-worker link count stays <= degree + 1
 #: (children + parent), the O(degree) bound the 32-worker drill asserts
@@ -84,6 +85,21 @@ def compute_parents(
     for i, w in enumerate(order):
         parents[w] = None if i == 0 else order[(i - 1) // degree]
     return parents
+
+
+def compute_successor(members: Iterable[int]) -> Optional[int]:
+    """The pre-agreed root successor for ``members``: the second-lowest
+    live id (the lowest IS the root), or None when the view is too small
+    to need one. Deterministic from the same sorted view as
+    :func:`compute_parents`, so every worker that holds the member list
+    already agrees on the successor without any extra exchange — the
+    epoch announcement carries it only so operators (and older peers)
+    can see the agreement, never to establish it. The successor is
+    always the root's direct child (heap slot 1 parents on slot 0), so
+    its own ping loop detects the root's death first-hand and can
+    promote without waiting out a full scoped re-election."""
+    order = sorted(set(members))
+    return order[1] if len(order) >= 2 else None
 
 
 def tree_children(parents: Dict[int, Optional[int]], worker: int) -> Tuple[int, ...]:
@@ -157,7 +173,7 @@ class Topology:
         self.worker_id = worker_id
         self.degree = max(1, int(degree))
         self.boot_id = boot_id
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("mesh_topology")
         # worker -> boot nonce (0 = not yet learned); every worker boots
         # with the same static view, so epoch 0's tree needs no exchange
         self._view: Dict[int, int] = {int(w): 0 for w in members}
@@ -215,6 +231,14 @@ class Topology:
     def root(self) -> int:
         with self._lock:
             return min(self._view)
+
+    def successor(self) -> Optional[int]:
+        """The pre-agreed root successor under the CURRENT view (see
+        :func:`compute_successor`) — the worker that promotes on the
+        root-failure fast path instead of waiting out a full scoped
+        re-election."""
+        with self._lock:
+            return compute_successor(self._view)
 
     # -- protocol (cluster loop) -------------------------------------------
 
@@ -361,7 +385,7 @@ class CountedBloom:
         self._counts = bytearray(2 * n_bits)  # u16 little-endian per slot
         self.match_all = 0  # wildcard-rooted filters (no usable prefix)
         self.generation = 0  # bumped on every mutation (refresh trigger)
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("interest_bloom")
 
     def _bump(self, slot: int, delta: int) -> None:
         off = 2 * slot
@@ -487,7 +511,7 @@ class DuplicateSuppressor:
         self.max_origins = max_origins
         # (origin, boot) -> [highest seq, {seq: last epoch key or None}]
         self._origins: Dict[Tuple[int, int], List] = {}
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("dup_suppressor")
 
     def seen(self, origin: int, boot: int, seq: int) -> bool:
         """Record (origin, boot, seq); True when it was already seen

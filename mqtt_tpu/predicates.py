@@ -55,7 +55,7 @@ from __future__ import annotations
 import json
 import logging
 import math
-import threading
+import zlib
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
 
@@ -67,6 +67,7 @@ from .topics import (
     Subscribers,
     split_predicate_suffix,
 )
+from .utils.locked import InstrumentedLock
 
 _log = logging.getLogger("mqtt_tpu.predicates")
 
@@ -112,6 +113,17 @@ class PredicateSpec:
     @property
     def is_agg(self) -> bool:
         return self.op in _AGG_CODES
+
+
+def predicate_digest(suffix: str) -> int:
+    """The 32-bit interning digest of one predicate suffix — the key the
+    mesh edge summaries carry (mqtt_tpu.cluster predicate push-down) and
+    receivers cache compiled specs under. CRC32 over the literal suffix
+    text: deterministic across processes (two workers must agree on the
+    digest of the same interned rule), and a collision only merges two
+    rules' cache slots — the suffix itself always travels beside the
+    digest, so evaluation never trusts the digest alone."""
+    return zlib.crc32(suffix.encode("utf-8", "surrogatepass"))
 
 
 def compile_suffix(suffix: str) -> PredicateSpec:
@@ -354,7 +366,7 @@ class PredicateEngine:
         # single window's round trip would only add link latency —
         # the host reduction serves it in microseconds
         self.device_agg_min_batch = 4
-        self._lock = threading.Lock()
+        self._lock = InstrumentedLock("predicate_rules")
         self._rules: dict[str, CompiledRule] = {}
         self._fields: dict[str, int] = {}  # field name -> feature slot
         self._contains: dict[bytes, int] = {}  # substring -> bitmask bit

@@ -350,6 +350,29 @@ class Options:
     cluster_summary_bits: int = 4096
     # (origin, boot) duplicate-suppression window in sequence numbers
     cluster_dup_window: int = 8192
+    # cross-machine mesh transport (ISSUE 17): "unix" keeps the on-box
+    # socket-dir fabric; "tcp" listens on cluster_base_port + worker_id
+    # (per-worker pins via cluster_peer_addrs: {worker: "host:port"}).
+    # Mesh-wide: every worker must run the same transport.
+    cluster_transport: str = "unix"
+    cluster_host: str = "127.0.0.1"
+    cluster_base_port: int = 0
+    cluster_peer_addrs: Optional[dict] = None
+    # mutual-TLS on TCP peer links: cert/key identify this worker, and a
+    # configured CA makes BOTH directions verify (the accepting side
+    # demands a client cert too). Empty cert = plaintext TCP.
+    cluster_tls_cert: str = ""
+    cluster_tls_key: str = ""
+    cluster_tls_ca: str = ""
+    # WAN dial/keepalive tuning: a blackholed SYN fails onto the backoff
+    # ladder after this many seconds; keepalive > 0 arms kernel TCP
+    # keepalive probes at that idle interval on every peer link
+    cluster_connect_timeout_s: float = 5.0
+    cluster_keepalive_s: float = 0.0
+    # predicate push-down (ISSUE 17): max interned predicate digests
+    # carried per edge summary — past the cap the digest plane degrades
+    # to conservative pass-through (0 disables push-down entirely)
+    cluster_summary_digests: int = 64
     # MQTT+ payload-predicate subscriptions (mqtt_tpu.predicates): parse
     # `$GT{...}`-style suffixes off SUBSCRIBE filters, filter fan-out by
     # payload, evaluate the compiled rule table on device inside the
@@ -626,6 +649,21 @@ class Options:
             self.cluster_summary_bits = 4096
         if self.cluster_dup_window < 1:
             self.cluster_dup_window = 8192
+        # transport knobs are config-reachable: an unknown transport
+        # falls back to the on-box unix fabric (never a refused boot),
+        # ports clamp into range, and the WAN timers stay sane
+        if str(self.cluster_transport).lower() not in ("unix", "tcp"):
+            self.cluster_transport = "unix"
+        else:
+            self.cluster_transport = str(self.cluster_transport).lower()
+        if not 0 <= self.cluster_base_port <= 65535:
+            self.cluster_base_port = 0
+        if self.cluster_connect_timeout_s <= 0:
+            self.cluster_connect_timeout_s = 5.0
+        if self.cluster_keepalive_s < 0:
+            self.cluster_keepalive_s = 0.0
+        if self.cluster_summary_digests < 0:
+            self.cluster_summary_digests = 64
         # predicate knobs are config-reachable: a zero/negative rule cap
         # would refuse every predicate, a negative sample means "default"
         if self.predicate_max_rules <= 0:
@@ -1050,6 +1088,7 @@ class Server:
             "replayed_keys": 0,
             "restored_subscriptions": 0,
             "restored_retained": 0,
+            "restored_inflight": 0,
             "restore_batches": 0,
         }
         if opts.device_matcher:
@@ -1354,11 +1393,12 @@ class Server:
             self.publish_durable_sys()
             self.log.info(
                 "durable restore complete: seconds=%.3f replayed_keys=%d "
-                "subscriptions=%d retained=%d batches=%d",
+                "subscriptions=%d retained=%d inflight=%d batches=%d",
                 self._durable["recovery_seconds"],
                 self._durable["replayed_keys"],
                 self._durable["restored_subscriptions"],
                 self._durable["restored_retained"],
+                self._durable["restored_inflight"],
                 self._durable["restore_batches"],
             )
         self.log.info("mqtt_tpu server started")
@@ -1623,6 +1663,7 @@ class Server:
             "replayed_keys": str(d["replayed_keys"]),
             "restored_subscriptions": str(d["restored_subscriptions"]),
             "restored_retained": str(d["restored_retained"]),
+            "restored_inflight": str(d["restored_inflight"]),
             "restore_batches": str(d["restore_batches"]),
         }
         for k in ("segments", "snapshot_seq", "replay_corruptions", "snapshot_invalid"):
@@ -4798,6 +4839,23 @@ class Server:
                 topics[
                     SYS_PREFIX + "/broker/cluster/tree/summary_passthrough"
                 ] = str(c.summary_passthrough_forwards)
+                # predicate push-down + root-failover gauges (ISSUE 17):
+                # the WAN drill asserts both from the outside
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/predicate_filtered"
+                ] = str(c.summary_predicate_filtered_forwards)
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/root_failovers"
+                ] = str(c.root_failovers)
+                topics[
+                    SYS_PREFIX + "/broker/cluster/tree/root_failover_last_s"
+                ] = "%.6f" % c.root_failover_last_s
+                topics[SYS_PREFIX + "/broker/cluster/tree/root"] = str(
+                    t.root()
+                )
+                topics[SYS_PREFIX + "/broker/cluster/tree/successor"] = str(
+                    t.successor()
+                )
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
             created=now,
@@ -5047,10 +5105,17 @@ class Server:
                 self.clients.add_client(cl)
 
     def load_inflight(self, v: list) -> None:
-        for msg in v:
-            cl = self.clients.get(msg.client)
-            if cl is not None:
-                cl.state.inflight.set(msg.to_packet())
+        # batched restore (ISSUE 17 satellite): the unacked QoS1/QoS2
+        # window rides the same chunked bulk path as subscriptions and
+        # retained — one inflight-lock acquisition per chunk, and the
+        # restore counters prove it was batched
+        from .staging import bulk_inflight
+
+        restored, batches = bulk_inflight(
+            self.clients, v, batch=self.options.durable_restore_batch
+        )
+        self._durable["restored_inflight"] += restored
+        self._durable["restore_batches"] += batches
 
     def load_retained(self, v: list) -> None:
         from .staging import bulk_retain

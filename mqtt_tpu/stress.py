@@ -615,12 +615,15 @@ def _puback_bytes(pid: int) -> bytes:
 
 class _DrillSubscriber:
     """One per-worker drill subscriber: pinned to the worker's private
-    port, subscribed ``drill/#`` QoS1, counting every delivered payload
-    (the duplicate/loss ledger) and PUBACKing QoS1 deliveries so
-    inflight windows never wedge the read."""
+    port, subscribed ``drill/#`` QoS1 (plus, with ``predicate`` set, the
+    MQTT+ filter ``drill-pred/#$GT{v:50}`` — the push-down drill's
+    predicated interest), counting every delivered payload (the
+    duplicate/loss ledger) and PUBACKing QoS1 deliveries so inflight
+    windows never wedge the read."""
 
-    def __init__(self, worker: int) -> None:
+    def __init__(self, worker: int, predicate: bool = False) -> None:
         self.worker = worker
+        self.predicate = predicate
         self.counts: dict = {}
         self.reader = None
         self.writer = None
@@ -631,13 +634,18 @@ class _DrillSubscriber:
         self.writer.write(_connect_bytes(f"drill-sub-{self.worker}", version=4))
         await self.writer.drain()
         assert await _read_packet_type(self.reader) == CONNACK
+        filters = [Subscription(filter="drill/#", qos=1)]
+        if self.predicate:
+            filters.append(
+                Subscription(filter="drill-pred/#$GT{v:50}", qos=1)
+            )
         self.writer.write(
             encode_packet(
                 Packet(
                     fixed_header=FixedHeader(type=SUBSCRIBE, qos=1),
                     protocol_version=4,
                     packet_id=1,
-                    filters=[Subscription(filter="drill/#", qos=1)],
+                    filters=filters,
                 )
             )
         )
@@ -671,7 +679,9 @@ class _DrillSubscriber:
                     self.writer.write(_puback_bytes(pid))
                 else:
                     payload = rest
-                if topic.startswith(b"drill/"):
+                if topic.startswith(b"drill/") or topic.startswith(
+                    b"drill-pred/"
+                ):
                     key = bytes(payload)
                     self.counts[key] = self.counts.get(key, 0) + 1
             del buf[:consumed]
@@ -688,7 +698,14 @@ class _DrillSubscriber:
 
 
 async def _drill_publish(
-    host: str, port: int, pub_id: int, tag: str, msgs: int, qos: int = 1
+    host: str,
+    port: int,
+    pub_id: int,
+    tag: str,
+    msgs: int,
+    qos: int = 1,
+    payloads: Optional[list] = None,
+    topic: str = "",
 ) -> list:
     """Publish ``msgs`` uniquely-tagged QoS1 payloads from one drill
     publisher (pinned to whatever worker owns ``port``); returns the
@@ -718,14 +735,20 @@ async def _drill_publish(
                 pass
 
         ack_task = asyncio.get_running_loop().create_task(drain_acks())
+        if payloads is not None:
+            msgs = len(payloads)
         for i in range(msgs):
-            payload = f"{tag}:{pub_id}:{i}".encode()
+            payload = (
+                payloads[i]
+                if payloads is not None
+                else f"{tag}:{pub_id}:{i}".encode()
+            )
             writer.write(
                 encode_packet(
                     Packet(
                         fixed_header=FixedHeader(type=PUBLISH, qos=qos),
                         protocol_version=4,
-                        topic_name=f"drill/{tag}/{pub_id}",
+                        topic_name=topic or f"drill/{tag}/{pub_id}",
                         packet_id=(i % 65535) + 1 if qos else 0,
                         payload=payload,
                     )
@@ -764,6 +787,7 @@ async def run_mesh_drill(
     settle_s: float = 3.0,
     verify_timeout_s: float = 30.0,
     scrape: bool = True,
+    pred_msgs: int = 0,
 ) -> dict:
     """The N-worker mesh acceptance drill (``--mesh-drill``), run
     against a broker started with ``--workers N`` (+ ``--topology tree
@@ -789,8 +813,19 @@ async def run_mesh_drill(
     and even QoS1 forwards (counted drops — the documented best-effort
     posture), but a payload arriving TWICE at one subscriber is a
     routing loop or a replayed park escaping the suppression window,
-    and fails the drill."""
-    subs = [_DrillSubscriber(w) for w in range(workers)]
+    and fails the drill.
+
+    With ``pred_msgs > 0`` a PREDICATE leg follows the verify batch:
+    every subscriber also holds ``drill-pred/#$GT{v:50}`` and the
+    verify publishers blast JSON payloads alternating above/below the
+    threshold to ``drill-pred/...`` topics (a base no plain ``drill/#``
+    interest covers, so the only cross-edge interest is the interned
+    predicate digest). PASSING payloads must converge everywhere
+    exactly once; a FAILING payload delivered ANYWHERE is a push-down
+    or engine soundness bug (``pred_leaks``), and the scrape's
+    ``tree/predicate_filtered`` sum proves edges actually cut the
+    failing traffic instead of shipping it to die at the destination."""
+    subs = [_DrillSubscriber(w, predicate=pred_msgs > 0) for w in range(workers)]
     for s in subs:
         await s.start(host, _drill_port(port, workers, s.worker))
 
@@ -830,6 +865,34 @@ async def run_mesh_drill(
         if all(want <= set(s.counts) for s in subs):
             break
         await asyncio.sleep(0.1)
+
+    pred_pass: list = []
+    pred_fail: list = []
+    if pred_msgs > 0:
+        pred_tasks = []
+        for p in range(verify_publishers):
+            payloads = []
+            for i in range(pred_msgs):
+                # alternate around the $GT{v:50} threshold: odd i PASS,
+                # even i FAIL (and must never be delivered anywhere)
+                v = 90.0 + i if i % 2 else 10.0
+                payload = json.dumps({"v": v, "tag": f"c:{p}:{i}"}).encode()
+                payloads.append(payload)
+                (pred_pass if v > 50 else pred_fail).append(payload)
+            pred_tasks.append(
+                _drill_publish(
+                    host, _drill_port(port, workers, (p * step + 1) % workers),
+                    p, "c", pred_msgs,
+                    payloads=payloads, topic=f"drill-pred/c/{p}",
+                )
+            )
+        await asyncio.gather(*pred_tasks)
+        pwant = set(pred_pass)
+        deadline = time.perf_counter() + verify_timeout_s
+        while time.perf_counter() < deadline:
+            if all(pwant <= set(s.counts) for s in subs):
+                break
+            await asyncio.sleep(0.1)
 
     report: dict = {
         "workers": workers,
@@ -873,6 +936,20 @@ async def run_mesh_drill(
             or any(n > 1 for k, n in s.counts.items() if k in want)
         },
     }
+    if pred_msgs > 0:
+        pwant = set(pred_pass)
+        report["pred_sent"] = len(pred_pass) + len(pred_fail)
+        report["pred_complete"] = all(pwant <= set(s.counts) for s in subs)
+        report["pred_missing"] = {
+            s.worker: len(pwant - set(s.counts)) for s in subs
+            if pwant - set(s.counts)
+        }
+        # a below-threshold payload delivered to ANY subscriber: the
+        # predicate plane (edge push-down or destination engine) passed
+        # traffic it proved could not match — soundness, not loss
+        report["pred_leaks"] = sum(
+            s.counts.get(k, 0) for s in subs for k in pred_fail
+        )
     for s in subs:
         await s.stop()
     if scrape:
@@ -895,6 +972,19 @@ async def run_mesh_drill(
             and "control_bytes" in c1.get(w, {})
         }
         report["cluster_sys"] = c1
+        # mesh-wide predicate push-down effect: publishes an edge's
+        # interned digests proved could not match any remote subscriber
+        # and therefore never crossed the link (cross-edge bytes saved)
+        report["predicate_filtered_total"] = sum(
+            int(g.get("tree/predicate_filtered", 0))
+            for g in c1.values()
+            if isinstance(g, dict)
+        )
+        report["root_failovers_total"] = sum(
+            int(g.get("tree/root_failovers", 0))
+            for g in c1.values()
+            if isinstance(g, dict)
+        )
     return report
 
 
@@ -1021,6 +1111,9 @@ def broker_main(
     flap_workers: int = 1,
     topology: str = "",
     degree: int = 0,
+    transport: str = "",
+    cluster_base_port: int = 0,
+    kill_root_after_s: float = 0.0,
 ) -> None:
     """Run a bench broker on ``address`` until stdin closes (the bench
     driver's subprocess entry; prints READY once serving).
@@ -1029,11 +1122,21 @@ def broker_main(
     this process becomes the launcher, spawning one worker process per
     core slot, each binding ``address`` with SO_REUSEPORT plus a private
     per-worker port (base+1+i) for deterministic testing, all joined by
-    the unix-socket forwarding mesh. ``topology``/``degree`` select the
+    the forwarding mesh. ``topology``/``degree`` select the
     spanning-tree fabric mesh-wide (ISSUE 9); ``flap_for_s`` bounds the
     link-flap storm so a drill gets a guaranteed heal phase, and
     ``flap_workers`` spreads the flapping across the first K workers (a
-    partition STORM, not one noisy neighbor)."""
+    partition STORM, not one noisy neighbor).
+
+    Cross-machine mode (ISSUE 17): ``transport="tcp"`` joins the mesh
+    over TCP peer links on ``cluster_base_port + worker``; env
+    ``MQTT_TPU_MACHINE_SPLIT=K`` declares workers ``< K`` one "machine"
+    and the rest another, and ``MQTT_TPU_LINK_SHAPE`` (a LinkShape json)
+    imposes a seeded WAN profile on every INTER-group inbound edge —
+    intra-group links stay clean, exactly as two LAN-joined process
+    groups over a shaped WAN would behave. ``kill_root_after_s`` SIGKILLs
+    worker 0 (the deterministic tree root) that long after the mesh
+    reports READY — the root-failover fast-path drill leg."""
     import os
     import sys
 
@@ -1044,7 +1147,9 @@ def broker_main(
         _cluster_launcher(
             address, device_matcher, workers, flap_peer_s,
             flap_for_s=flap_for_s, flap_workers=flap_workers,
-            topology=topology, degree=degree,
+            topology=topology, degree=degree, transport=transport,
+            cluster_base_port=cluster_base_port,
+            kill_root_after_s=kill_root_after_s,
         )
         return
 
@@ -1103,6 +1208,35 @@ def broker_main(
         await srv.serve()
         if cluster is not None:
             await cluster.start()
+        shape_env = os.environ.get("MQTT_TPU_LINK_SHAPE", "")
+        if cluster is not None and shape_env:
+            # WAN link shaping (ISSUE 17): this worker shapes its INBOUND
+            # edges from the other "machine" group (MQTT_TPU_MACHINE_SPLIT
+            # = first group's size; no split = every edge shaped). Both
+            # endpoints of an inter-group edge install the shaper, so the
+            # full RTT is delay_s per direction.
+            from .faults import LinkShape, shape_cluster_links
+
+            cfg = json.loads(shape_env)
+            split = int(os.environ.get("MQTT_TPU_MACHINE_SPLIT", "0") or 0)
+            peers = None
+            if split > 0:
+                me = cluster.worker_id < split
+                peers = [
+                    p for p in range(cluster.n_workers)
+                    if (p < split) != me
+                ]
+            shape_cluster_links(
+                cluster,
+                LinkShape(
+                    seed=int(cfg.get("seed", 0)),
+                    delay_s=float(cfg.get("delay_s", 0.0)),
+                    jitter_s=float(cfg.get("jitter_s", 0.0)),
+                    loss=float(cfg.get("loss", 0.0)),
+                    rate_bytes_s=float(cfg.get("rate_bytes_s", 0.0)),
+                ),
+                peers=peers,
+            )
         flap_task = None
         if cluster is not None and flap_peer_s > 0:
             # chaos self-injection (the --partition / --mesh-drill server
@@ -1168,15 +1302,22 @@ def _cluster_launcher(
     flap_workers: int = 1,
     topology: str = "",
     degree: int = 0,
+    transport: str = "",
+    cluster_base_port: int = 0,
+    kill_root_after_s: float = 0.0,
 ) -> None:
     """Spawn one worker subprocess per slot, relay READY when all workers
     serve, and shut them down when stdin closes. With
     ``MQTT_TPU_WORKER_LOG_DIR`` set, each worker's stderr streams to
-    ``worker-N.log`` in that directory — the drill's failure artifacts."""
+    ``worker-N.log`` in that directory — the drill's failure artifacts.
+    ``kill_root_after_s > 0`` SIGKILLs worker 0's process that long after
+    READY: the kill -9 root death the failover fast path exists for (the
+    mesh must promote the pre-agreed successor, worker 1)."""
     import os
     import subprocess
     import sys
     import tempfile
+    import threading
 
     from .cluster import worker_env
 
@@ -1189,7 +1330,12 @@ def _cluster_launcher(
     try:
         for i in range(workers):
             env = dict(os.environ)
-            env.update(worker_env(i, workers, sock_dir, topology, degree))
+            env.update(
+                worker_env(
+                    i, workers, sock_dir, topology, degree,
+                    transport=transport, base_port=cluster_base_port,
+                )
+            )
             cmd = [sys.executable, "-m", "mqtt_tpu.stress", "--serve",
                    "--broker", address]
             if device_matcher:
@@ -1212,6 +1358,10 @@ def _cluster_launcher(
             )
         for p in procs:
             assert p.stdout.readline().strip() == b"READY"
+        if kill_root_after_s > 0:
+            t = threading.Timer(kill_root_after_s, procs[0].kill)
+            t.daemon = True
+            t.start()
         print("READY", flush=True)
         sys.stdin.read()  # parent closes stdin to stop us
     finally:
@@ -1283,6 +1433,47 @@ def main() -> None:
         help="serve mode: spanning-tree branching factor (0 = default)",
     )
     p.add_argument(
+        "--transport", default="",
+        help="serve mode: cluster peer transport — 'tcp' joins workers "
+        "over TCP links (cross-machine mode, ISSUE 17), empty/'unix' "
+        "keeps the on-box socket-dir fabric",
+    )
+    p.add_argument(
+        "--cluster-base-port", type=int, default=0,
+        help="serve mode, --transport tcp: worker i listens for peers on "
+        "base+i (pick a range clear of the broker ports)",
+    )
+    p.add_argument(
+        "--machine-split", type=int, default=0,
+        help="serve mode: declare workers < K one 'machine' group and "
+        "the rest another; with MQTT_TPU_LINK_SHAPE set, only INTER-group "
+        "edges are shaped (exported to workers as MQTT_TPU_MACHINE_SPLIT)",
+    )
+    p.add_argument(
+        "--shape-rtt-ms", type=float, default=0.0,
+        help="serve mode: inter-group round-trip time in ms (half per "
+        "direction; builds MQTT_TPU_LINK_SHAPE for the workers)",
+    )
+    p.add_argument(
+        "--shape-jitter-ms", type=float, default=0.0,
+        help="serve mode: per-frame uniform jitter in ms on shaped edges",
+    )
+    p.add_argument(
+        "--shape-loss", type=float, default=0.0,
+        help="serve mode: per-frame loss probability on shaped edges "
+        "(TCP semantics: data frames arrive late, control frames drop)",
+    )
+    p.add_argument(
+        "--shape-rate-kbps", type=float, default=0.0,
+        help="serve mode: serialization bandwidth of shaped edges in "
+        "kilobytes/s (0 = unlimited)",
+    )
+    p.add_argument(
+        "--kill-root-after-s", type=float, default=0.0,
+        help="serve mode: SIGKILL worker 0 (the tree root) this long "
+        "after READY — the root-failover fast-path drill leg",
+    )
+    p.add_argument(
         "--mesh-drill", action="store_true",
         help="N-worker mesh acceptance drill: per-worker subscribers, a "
         "publish storm over the flapping mesh, then a post-heal verify "
@@ -1294,6 +1485,13 @@ def main() -> None:
         "--drill-workers", type=int, default=0,
         help="--mesh-drill: worker count of the broker under test "
         "(defaults to --workers)",
+    )
+    p.add_argument(
+        "--drill-pred-msgs", type=int, default=0,
+        help="--mesh-drill: add a predicate push-down leg — subscribers "
+        "also hold drill-pred/#$GT{v:50} and this many JSON payloads per "
+        "verify publisher alternate above/below the threshold; failing "
+        "payloads must be edge-filtered, never delivered (0 = off)",
     )
     p.add_argument(
         "--sys-port", type=int, default=0,
@@ -1309,6 +1507,21 @@ def main() -> None:
     args = p.parse_args()
     host, port = args.broker.rsplit(":", 1)
     if args.serve:
+        import os
+
+        if args.machine_split > 0:
+            os.environ["MQTT_TPU_MACHINE_SPLIT"] = str(args.machine_split)
+        if args.shape_rtt_ms or args.shape_jitter_ms or args.shape_loss \
+                or args.shape_rate_kbps:
+            os.environ["MQTT_TPU_LINK_SHAPE"] = json.dumps(
+                {
+                    "seed": 4242,
+                    "delay_s": args.shape_rtt_ms / 2e3,
+                    "jitter_s": args.shape_jitter_ms / 1e3,
+                    "loss": args.shape_loss,
+                    "rate_bytes_s": args.shape_rate_kbps * 1e3,
+                }
+            )
         broker_main(
             args.broker,
             device_matcher=args.device_matcher,
@@ -1318,12 +1531,16 @@ def main() -> None:
             flap_workers=args.flap_workers,
             topology=args.topology,
             degree=args.degree,
+            transport=args.transport,
+            cluster_base_port=args.cluster_base_port,
+            kill_root_after_s=args.kill_root_after_s,
         )
         return
     if args.mesh_drill:
         out = asyncio.run(
             run_mesh_drill(
-                host, int(port), args.drill_workers or args.workers
+                host, int(port), args.drill_workers or args.workers,
+                pred_msgs=args.drill_pred_msgs,
             )
         )
         print(json.dumps(out))

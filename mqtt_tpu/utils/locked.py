@@ -71,7 +71,9 @@ LOCK_NAMES = (
     "recrypt_keys",
     "topics_trie",
     "cluster_remote_trie",
+    "predicate_rules",
     "retained",
+    "inflight",
     "durable_store",
     "metrics_registry",
     "flight_ring",
@@ -80,6 +82,9 @@ LOCK_NAMES = (
     "overload_peer_pressure",
     "matcher_breaker",
     "shard_fabric",
+    "mesh_topology",
+    "interest_bloom",
+    "dup_suppressor",
 )
 
 

@@ -670,6 +670,69 @@ class TestBatchedRestore:
         ok, detail = srv.health_report()
         assert ok and "recovering" not in detail["not_ready"]
 
+    def test_unacked_inflight_survives_kill9(self, tmp_path):
+        """The QoS1 unacked window rides the batched restore path: a
+        subscriber that never PUBACKs is killed along with the broker
+        (the store directory is frozen mid-flight, exactly what a
+        kill -9 leaves on disk), and the next life re-inflates the
+        window through ``staging.bulk_inflight`` — counted, batched,
+        and live in the session's inflight map."""
+        import shutil
+
+        path = str(tmp_path / "kv")
+        crash = str(tmp_path / "kv-crash-image")
+
+        async def first_life():
+            h = Harness(Options(inline_client=False))
+            store = LogKVStore()
+            h.server.add_hook(store, LogKVOptions(path=path, gc_interval=0))
+            r, w, _ = await h.connect("keeper", version=4, clean=False)
+            w.write(sub_packet(1, [Subscription(filter="dur/+", qos=1)]))
+            await w.drain()
+            await read_wire_packet(r)
+            rp, wp, _ = await h.connect("pusher", version=4)
+            wp.write(pub_packet("dur/q", b"unacked", qos=1, pid=9))
+            await wp.drain()
+            assert (await read_wire_packet(rp)).fixed_header.type == PUBACK
+            # the delivery reaches the wire (on_qos_publish persisted
+            # the window entry)... and is never acknowledged
+            fwd = await read_wire_packet(r)
+            assert fwd.fixed_header.type == PUBLISH
+            assert bytes(fwd.payload) == b"unacked"
+            store.sync()  # the fsync the group-commit loop would do
+            # kill -9: freeze the on-disk state at this instant; the
+            # clean teardown below never touches the crash image
+            shutil.copytree(path, crash)
+            await h.shutdown()
+            store.stop()
+
+        run(first_life())
+
+        async def second_life():
+            h = Harness(Options(inline_client=False))
+            h.server.add_hook(
+                LogKVStore(), LogKVOptions(path=crash, gc_interval=0)
+            )
+            h.server.read_store()
+            srv = h.server
+            assert srv._durable["restored_inflight"] == 1
+            assert srv._durable["restore_batches"] >= 1
+            cl = srv.clients.get("keeper")
+            assert cl is not None
+            # the window is LIVE: the restored packet is queued for
+            # resend under its original packet id
+            assert len(cl.state.inflight) == 1
+            pk = cl.state.inflight.get_all(False)[0]
+            assert bytes(pk.payload) == b"unacked"
+            srv.publish_durable_sys()
+            row = srv.topics.retained.get(
+                "$SYS/broker/durable/restored_inflight"
+            )
+            assert row is not None and int(row.payload) == 1
+            await h.shutdown()
+
+        run(second_life())
+
     def test_restart_restores_through_logkv(self, tmp_path):
         """End-to-end in-process restart: sessions + retained topics
         persisted through the LogKV store come back bit-identical, the

@@ -47,9 +47,9 @@ DEGREE = 4
 PING_S = "0.5"
 
 
-def _free_base_port(span: int = WORKERS + 2) -> int:
+def _free_base_port(span: int = WORKERS + 2, start: int = 29010) -> int:
     """A base port with the whole private-port window free."""
-    for base in range(29010, 60000, span + 7):
+    for base in range(start, 60000, span + 7):
         try:
             for off in (0, 1, span - 1):
                 with socket.socket() as s:
@@ -67,7 +67,8 @@ def _artifact_dir(tmp_path, leg: str) -> str:
     return d
 
 
-def _launch(base: int, workers: int, topology: str, log_dir: str, flap: bool):
+def _launch(base: int, workers: int, topology: str, log_dir: str, flap: bool,
+            extra: tuple = ()):
     env = dict(os.environ)
     env.update(
         {
@@ -102,6 +103,7 @@ def _launch(base: int, workers: int, topology: str, log_dir: str, flap: bool):
         # threshold: a partition storm with a guaranteed heal phase
         cmd += ["--flap-peer-s", "0.6", "--flap-for-s", "6",
                 "--flap-workers", "4"]
+    cmd += list(extra)
     proc = subprocess.Popen(
         cmd, stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env
     )
@@ -224,3 +226,129 @@ def test_32_worker_partition_storm_drill(tmp_path):
     # identical script, identical expected set, both anomaly-free:
     # every tree subscriber's verify multiset IS the oracle's
     assert tree["verify_sent"] == oracle["verify_sent"]
+
+
+# -- cross-machine WAN drill (ISSUE 17) ------------------------------------
+
+
+def test_wan_two_machine_predicate_drill(tmp_path):
+    """The same 32-worker tree split across two 16-worker "machine"
+    groups joined by REAL TCP peer links, with every inter-group edge
+    shaped to a 50ms-RTT 1%-loss WAN profile, the partition storm still
+    running on top — plus the predicate push-down leg: payloads failing
+    ``$GT{v:50}`` must be filtered at the cross-machine edges (counted),
+    passing payloads must still arrive everywhere exactly once."""
+    log_dir = _artifact_dir(tmp_path, "wan")
+    # one window holds both the broker ports and the peer-link ports
+    base = _free_base_port(span=2 * WORKERS + 12)
+    peer_base = base + WORKERS + 8
+    proc = _launch(
+        base, WORKERS, "tree", log_dir, flap=True,
+        extra=(
+            "--transport", "tcp", "--cluster-base-port", str(peer_base),
+            "--machine-split", str(WORKERS // 2),
+            "--shape-rtt-ms", "50", "--shape-loss", "0.01",
+        ),
+    )
+    try:
+        time.sleep(3.0)  # TCP dial + TLS-free handshake across 32 peers
+        report = asyncio.run(
+            run_mesh_drill(
+                "127.0.0.1", base, WORKERS,
+                settle_s=8.0,
+                # shaped RTT + loss-as-late-delivery on top of the CPU
+                # oversubscription: give replay generous headroom
+                verify_timeout_s=180.0,
+                pred_msgs=10,
+            )
+        )
+    finally:
+        _stop(proc)
+    with open(os.path.join(log_dir, "report.json"), "w") as f:
+        json.dump(report, f, indent=1)
+
+    assert report["healed"], report
+    assert report["route_converged"], report
+    assert report["verify_complete"], report["verify_missing"]
+    assert report["dup_deliveries"] == 0, report
+    assert report["verify_anomalies"] == {}, report["verify_anomalies"]
+    # predicate leg: every passing payload landed, no failing payload
+    # EVER reached a subscriber (edge filter + delivery gate soundness)
+    assert report["pred_complete"], report["pred_missing"]
+    assert report["pred_leaks"] == 0, report
+    # ...and the filtering happened AT THE EDGES, not just at delivery:
+    # the failing half of the batch never crossed toward remote workers
+    assert report["predicate_filtered_total"] > 0, report
+    # the WAN profile did not cost exactly-once or epoch convergence
+    scraped = [
+        w for w in range(WORKERS) if "tree/epoch" in report["cluster_sys"][w]
+    ]
+    assert len(scraped) >= WORKERS - 2
+    epochs = {_gauge(report, w, "tree/epoch") for w in scraped}
+    assert len(epochs) == 1, f"epoch split survived the heal: {epochs}"
+
+
+def test_root_kill_promotes_pre_agreed_successor(tmp_path):
+    """kill -9 the tree root mid-serve: the pre-agreed successor
+    (worker 1, the second-lowest id) must promote on the fast path —
+    observed from the outside via its $SYS rows: a root_failovers tick,
+    a sub-second failover latency gauge, and the surviving mesh agreeing
+    root=1 on one epoch."""
+    from mqtt_tpu.stress import _drill_port, _read_cluster_sys
+
+    workers = 8
+    log_dir = _artifact_dir(tmp_path, "rootkill")
+    base = _free_base_port(span=workers + 2)
+    proc = _launch(
+        base, workers, "tree", log_dir, flap=False,
+        extra=("--kill-root-after-s", "2.5"),
+    )
+    try:
+        async def await_failover() -> dict:
+            deadline = time.monotonic() + 90.0
+            last: dict = {}
+            while time.monotonic() < deadline:
+                try:
+                    last = await _read_cluster_sys(
+                        "127.0.0.1", _drill_port(base, workers, 1), wait_s=2.0
+                    )
+                except (OSError, AssertionError, asyncio.IncompleteReadError):
+                    last = {}
+                if int(last.get("tree/root_failovers", "0")) >= 1:
+                    return last
+                await asyncio.sleep(0.5)
+            raise AssertionError(f"no failover observed; last scrape: {last}")
+
+        promoted = asyncio.run(await_failover())
+        assert promoted["tree/root"] == "1"
+        # the fast path fired at SUSPECT and the promotion window itself
+        # (drop root + reconcile + flood the new epoch) completed inside
+        # 2 ping intervals (1.0s at the drill's 0.5s cadence) — the
+        # acceptance bound: no full re-election blackout on this path
+        assert 0.0 < float(promoted["tree/root_failover_last_s"]) < 1.0
+
+        async def await_convergence() -> None:
+            deadline = time.monotonic() + 60.0
+            while True:
+                rows = {}
+                for w in range(1, workers):
+                    try:
+                        rows[w] = await _read_cluster_sys(
+                            "127.0.0.1", _drill_port(base, workers, w),
+                            wait_s=2.0,
+                        )
+                    except (OSError, AssertionError, asyncio.IncompleteReadError):
+                        rows[w] = {}
+                roots = {r.get("tree/root") for r in rows.values()}
+                epochs = {r.get("tree/epoch") for r in rows.values()}
+                if roots == {"1"} and len(epochs) == 1 and None not in epochs:
+                    # the NEXT successor is pre-agreed too: worker 2
+                    assert {r.get("tree/successor") for r in rows.values()} == {"2"}
+                    return
+                if time.monotonic() > deadline:
+                    raise AssertionError(f"survivors split: {rows}")
+                await asyncio.sleep(1.0)
+
+        asyncio.run(await_convergence())
+    finally:
+        _stop(proc)

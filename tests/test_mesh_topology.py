@@ -32,6 +32,7 @@ from mqtt_tpu.mesh_topology import (
     Topology,
     TreeEpoch,
     compute_parents,
+    compute_successor,
     decode_members,
     encode_members,
     is_spanning_tree,
@@ -203,6 +204,115 @@ class TestTopology:
             assert set(t.neighbors()) == set(
                 tree_neighbors(t.parents(), 0)
             )
+
+
+class TestSuccessor:
+    """The pre-agreed root successor (ISSUE 17): derived, not elected —
+    every worker computing it from the same view must agree without any
+    extra exchange, and the root-failure fast path must degrade to the
+    ordinary scoped re-election when the successor itself dies."""
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_successor_is_second_lowest(self, seed):
+        r = random.Random(seed)
+        members = r.sample(range(200), r.randint(2, 40))
+        assert compute_successor(members) == sorted(members)[1]
+
+    def test_small_views_need_no_successor(self):
+        assert compute_successor([]) is None
+        assert compute_successor([7]) is None
+        assert compute_successor([7, 7]) is None  # duplicates collapse
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_agreement_across_shuffled_views(self, seed):
+        """Every worker holds the member list in ITS OWN order; the
+        successor must not depend on that order."""
+        r = random.Random(seed)
+        members = r.sample(range(200), r.randint(2, 40))
+        shuffled = list(members)
+        r.shuffle(shuffled)
+        assert compute_successor(shuffled) == compute_successor(members)
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_successor_is_roots_direct_child(self, seed):
+        """The fast path only works because the successor pings the root
+        first-hand: heap slot 1 always parents on slot 0, whatever the
+        degree."""
+        r = random.Random(seed)
+        members = r.sample(range(200), r.randint(2, 40))
+        parents = compute_parents(members, degree=r.randint(1, 4))
+        succ = compute_successor(members)
+        assert parents[succ] == min(members)
+
+    @pytest.mark.parametrize("seed", range(10))
+    def test_topology_successor_tracks_the_view(self, seed):
+        """Whatever protocol interleaving runs, Topology.successor() is
+        exactly compute_successor over the current member view."""
+        r = random.Random(seed)
+        t = Topology(0, range(8), degree=r.randint(1, 4), boot_id=3)
+        for _ in range(100):
+            op = r.randrange(3)
+            if op == 0:
+                t.propose_remove(r.randrange(8))
+            elif op == 1:
+                t.propose_add(r.randrange(12), boot=r.randrange(5))
+            else:
+                members = {
+                    w: r.randrange(5)
+                    for w in r.sample(range(12), r.randint(1, 8))
+                }
+                t.adopt(
+                    TreeEpoch(r.randint(0, 200), r.randrange(5), r.randrange(8)),
+                    members,
+                )
+            assert t.successor() == compute_successor(t.members())
+
+    @pytest.mark.parametrize("seed", range(20))
+    def test_successor_death_mid_promotion_converges(self, seed):
+        """The root dies; the successor promotes on the fast path but
+        dies before its epoch floods everywhere. The strict TreeEpoch
+        total order plus DERIVED roots (lowest id of the adopted view)
+        must still converge every survivor to ONE root — never two live
+        roots within one adopted epoch."""
+        r = random.Random(seed)
+        n = r.randint(4, 8)
+        tops = {w: Topology(w, range(n), boot_id=w + 1) for w in range(n)}
+        announcements = []
+
+        def flood(ep, members):
+            if ep is not None:
+                announcements.append((ep, dict(members)))
+
+        root, succ = 0, compute_successor(range(n))
+        del tops[root]
+        # fast path: the successor notices the dead root first-hand
+        flood(tops[succ].propose_remove(root), tops[succ].members())
+        # ...but its flood only reaches SOME survivors before it dies
+        for w, t in tops.items():
+            if w != succ and r.random() < 0.5:
+                t.adopt(*announcements[-1])
+        del tops[succ]
+        # ordinary scoped re-election takes over: survivors detect both
+        # dead edges in arbitrary order (some already count root gone)
+        for w in r.sample(list(tops), len(tops)):
+            flood(tops[w].propose_remove(root), tops[w].members())
+            flood(tops[w].propose_remove(succ), tops[w].members())
+        # gossip every announcement in random order until quiescent
+        for _ in range(20):
+            changed = False
+            for ep, members in r.sample(announcements, len(announcements)):
+                for t in tops.values():
+                    changed |= t.adopt(ep, members)
+            if not changed:
+                break
+        live = sorted(tops)
+        final = tops[live[0]].epoch
+        for t in tops.values():
+            assert t.epoch == final  # one total-order winner everywhere
+            assert t.root() == live[0]  # the lowest LIVE id, derived
+            assert t.successor() == live[1]
+            assert root not in t.members() and succ not in t.members()
+            assert is_spanning_tree(t.parents(), t.members())
 
 
 # -- interest summaries -------------------------------------------------------

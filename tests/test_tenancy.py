@@ -853,7 +853,10 @@ class TestReviewRegressions:
         c = Cluster(srv, 0, 3, str(tmp_path))
         c.topo = Topology(0, range(3), 2, boot_id=1)
         seen = []
-        c._route_edges = lambda topic, peer, always: (seen.append(topic), [])[1]
+        c._route_edges = lambda topic, peer, always, payload=None: (
+            seen.append(topic),
+            [],
+        )[1]
         c._epoch_current = lambda rt: True
         pk = Packet(
             fixed_header=FixedHeader(type=pkts.PUBLISH),

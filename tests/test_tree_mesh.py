@@ -127,7 +127,7 @@ class TreeMesh:
             lambda: all(
                 all(
                     p in c._edge_summaries
-                    and c._edge_summaries[p][2] == _epoch_key(c)
+                    and c._edge_summaries[p].ep_key == _epoch_key(c)
                     for p in c.topo.neighbors()
                 )
                 for c in self.clusters
@@ -348,8 +348,8 @@ class TestTreeRouting:
             # the base filter (not the suffixed form) reached the blooms
             origin = mesh.clusters[2]
             assert any(
-                bits.might_match("sensors/a/temp")
-                for bits, _g, _e in origin._edge_summaries.values()
+                es.bits.might_match("sensors/a/temp")
+                for es in origin._edge_summaries.values()
             )
             _rp, wp, _ = await mesh.harnesses[2].connect("pub2", version=4)
             wp.write(pub_packet("sensors/a/temp", b"20.0", qos=0, version=4))
@@ -799,3 +799,404 @@ class TestPerSignalGossip:
             await mesh.stop()
 
         run(scenario())
+
+
+# -- ISSUE 17: root-failure fast path ----------------------------------------
+
+
+class TestRootFailover:
+    def test_successor_promotes_without_full_re_election(self, tmp_path):
+        """Killing the ROOT takes the fast path: the pre-agreed
+        successor (second-lowest live id, announced with every epoch)
+        promotes at its own SUSPECT transition and floods the new epoch
+        — no PARTITIONED wait, no scoped-re-election blackout. With
+        partition_pings cranked out of reach, the fast path is the ONLY
+        way the mesh can converge, so convergence proves it fired."""
+
+        async def scenario():
+            mesh = TreeMesh(5, tmp_path, partition_pings=600)
+            await mesh.start()
+            c1 = mesh.clusters[1]
+            assert mesh.clusters[0].topo.root() == 0
+            assert c1.topo.successor() == 1  # the pre-agreed successor
+            r4, _w4 = await mesh.subscribe(4, "sub4", "ft/#")
+            await mesh.settle_summaries()
+
+            await mesh.clusters[0].stop()  # SIGKILL-shaped: root gone
+            survivors = mesh.clusters[1:]
+            await wait_for(
+                lambda: c1.root_failovers == 1,
+                timeout=30,
+                msg="successor promotion",
+            )
+            # the promotion window (propose -> epoch flooded) is bounded
+            # well inside the acceptance budget of 2 ping intervals
+            assert 0.0 < c1.root_failover_last_s < 2 * c1.PING_INTERVAL_S
+            await wait_for(
+                lambda: all(
+                    c.topo.root() == 1 and 0 not in c.topo.members()
+                    for c in survivors
+                )
+                and len({c.topo.epoch for c in survivors}) == 1,
+                timeout=30,
+                msg="one epoch under the promoted root",
+            )
+            # the NEXT successor is re-agreed from the shrunken view
+            assert c1.topo.successor() == 2
+            await wait_for(
+                lambda: all(
+                    all(p in c._writers for p in c.topo.neighbors())
+                    for c in survivors
+                ),
+                timeout=30,
+                msg="post-failover links",
+            )
+            # routing works under the promoted root's tree
+            _rp, wp, _ = await mesh.harnesses[2].connect("pub2", version=4)
+            wp.write(pub_packet("ft/x", b"post-failover", qos=0, version=4))
+            await wp.drain()
+            seen = await read_until_payload(r4, b"post-failover")
+            assert seen == [b"post-failover"]
+            await mesh.stop(skip=(0,))
+
+        run(scenario())
+
+    def test_non_successor_never_takes_the_fast_path(self, tmp_path):
+        """Only the agreed successor may promote: any other worker
+        observing the root SUSPECT must wait for the ordinary
+        re-election machinery (never two competing fast promotions)."""
+
+        async def scenario():
+            mesh = TreeMesh(3, tmp_path, partition_pings=600)
+            await mesh.start()
+            c2 = mesh.clusters[2]
+            before = c2.topo.epoch
+            c2._maybe_promote_root(0)  # root suspect, but 2 != successor
+            assert c2.root_failovers == 0
+            assert c2.topo.epoch == before
+            # and the successor ignores a non-root suspect the same way
+            c1 = mesh.clusters[1]
+            c1._maybe_promote_root(2)
+            assert c1.root_failovers == 0
+            await mesh.stop()
+
+        run(scenario())
+
+    def test_epoch_announcement_carries_the_successor(self, tmp_path):
+        """The non-digest epoch announcement advertises the pre-agreed
+        successor — observability for operators and the drill scrape;
+        receivers recompute it from the member view."""
+
+        async def scenario():
+            mesh = TreeMesh(3, tmp_path)
+            await mesh.start()
+            c0 = mesh.clusters[0]
+            sent = []
+            orig = c0._send_nowait
+            c0._send_nowait = (
+                lambda p, w, t, b: sent.append((p, t, b)) or orig(p, w, t, b)
+            )
+            try:
+                c0._announce_epoch()
+                from mqtt_tpu.cluster import _T_EPOCH
+
+                bodies = [
+                    json.loads(b.decode())
+                    for _p, t, b in sent
+                    if t == _T_EPOCH
+                ]
+                assert bodies and all(b.get("sc") == 1 for b in bodies)
+            finally:
+                c0._send_nowait = orig
+            await mesh.stop()
+
+        run(scenario())
+
+
+# -- ISSUE 17: predicate push-down over edge summaries ------------------------
+
+
+class TestPredicatePushdown:
+    def test_edge_filters_failing_payloads_and_passes_matching(self, tmp_path):
+        """A remote ``pp/#$GT{v:50}`` subscriber interns its predicate
+        digest into the edge summaries: a publish whose payload PROVABLY
+        fails the predicate is filtered at the ORIGIN edge (counted),
+        while a passing payload still forwards and delivers — false
+        negatives impossible, same contract as the blooms."""
+
+        async def scenario():
+            mesh = TreeMesh(5, tmp_path)
+            await mesh.start()
+            r4, _w4 = await mesh.subscribe(4, "pred4", "pp/#$GT{v:50}")
+            await mesh.settle_summaries()
+            origin = mesh.clusters[2]
+            before = origin.summary_predicate_filtered_forwards
+            _rp, wp, _ = await mesh.harnesses[2].connect("pub2", version=4)
+
+            # digest folds propagate transitively (4 -> 1 -> 0 -> 2), one
+            # presence round per hop: keep publishing provably-failing
+            # payloads until the origin's edge gate starts cutting them.
+            # every one of these either dies at the origin (counted) or
+            # is predicate-gated at worker 4 — NEVER delivered.
+            async def _edge_filtering():
+                wp.write(
+                    pub_packet("pp/x", b'{"v": 10}', qos=0, version=4)
+                )
+                await wp.drain()
+                return origin.summary_predicate_filtered_forwards > before
+
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                if await _edge_filtering():
+                    break
+                await asyncio.sleep(0.05)
+            assert origin.summary_predicate_filtered_forwards > before
+
+            wp.write(pub_packet("pp/x", b'{"v": 90}', qos=0, version=4))
+            await wp.drain()
+            seen = await read_until_payload(r4, b'{"v": 90}')
+            assert seen == [b'{"v": 90}']  # zero failing payloads leaked
+            await mesh.stop()
+
+        run(scenario())
+
+    def test_unknown_digest_is_conservative_passthrough(self, tmp_path):
+        """An edge whose summary advertises predicate interest but whose
+        digest plane is unknown (old sender / cap overflow) must forward
+        everything — stale knowledge can only cost bytes, never a
+        delivery."""
+
+        async def scenario():
+            mesh = TreeMesh(3, tmp_path)
+            await mesh.start()
+            r2, _w2 = await mesh.subscribe(2, "pd2", "pq/#$GT{v:50}")
+            await mesh.settle_summaries()
+            origin = mesh.clusters[1]
+            # poison the digest plane on every edge: unknown, not empty
+            for es in origin._edge_summaries.values():
+                es.digests = None
+            _rp, wp, _ = await mesh.harnesses[1].connect("pub1", version=4)
+            wp.write(pub_packet("pq/x", b'{"v": 90}', qos=0, version=4))
+            await wp.drain()
+            seen = await read_until_payload(r2, b'{"v": 90}')
+            assert seen == [b'{"v": 90}']
+            await mesh.stop()
+
+        run(scenario())
+
+
+# -- ISSUE 17: shaped links + rapid-flap exactly-once -------------------------
+
+
+class TestShapedLinks:
+    def test_rapid_flap_replays_each_parked_frame_once(self, tmp_path):
+        """A peer flapping UP -> SUSPECT -> UP repeatedly within one
+        park window replays each parked frame AT MOST ONCE across all
+        heals: frames parked before the first heal must not ride the
+        second heal's replay. Run over seeded shaped links (delay +
+        jitter) so the WAN-ish reordering pressure is part of the
+        regression, reproducibly."""
+
+        async def scenario():
+            from mqtt_tpu.faults import LinkShape, shape_cluster_links
+
+            mesh = TreeMesh(3, tmp_path, partition_pings=600)
+            await mesh.start()
+            shape = LinkShape(seed=7, delay_s=0.004, jitter_s=0.002)
+            releases = [
+                shape_cluster_links(c, shape) for c in mesh.clusters
+            ]
+            r2, _w2 = await mesh.subscribe(2, "sub2", "rf/#")
+            await mesh.settle_summaries()
+            origin = mesh.clusters[0]
+            # the shaper delays the post-subscribe summary push: wait for
+            # the INTEREST (not just a fresh epoch stamp) before cutting
+            # the link, or the partition swallows it and nothing parks
+            await wait_for(
+                lambda: 2 in origin._edge_summaries
+                and origin._edge_summaries[2].bits.might_match("rf/t"),
+                msg="interest propagated",
+            )
+            replayed0 = origin.replayed_forwards
+            _rp, wp, _ = await mesh.harnesses[0].connect("pub0", version=4)
+
+            # flap 1: park 5 under SUSPECT, heal, each replays once
+            cut = asymmetric_partition(origin, 2)
+            await wait_for(
+                lambda: origin._health_for(2).state == PEER_SUSPECT,
+                msg="suspect #1",
+            )
+            for i in range(5):
+                wp.write(
+                    pub_packet("rf/t", f"m{i}".encode(), qos=1, pid=20 + i,
+                               version=4)
+                )
+            await wp.drain()
+            await wait_for(
+                lambda: len(origin._health_for(2).park) == 5, msg="park #1"
+            )
+            cut()
+            await wait_for(
+                lambda: origin._health_for(2).state == PEER_UP,
+                msg="heal #1",
+            )
+            seen1 = await read_until_payload(r2, b"m4")
+            assert seen1 == [b"m0", b"m1", b"m2", b"m3", b"m4"]
+
+            # flap 2, same park window: ONLY the newly parked frames
+            # may replay — m0..m4 are spent
+            cut = asymmetric_partition(origin, 2)
+            await wait_for(
+                lambda: origin._health_for(2).state == PEER_SUSPECT,
+                msg="suspect #2",
+            )
+            for i in range(5, 8):
+                wp.write(
+                    pub_packet("rf/t", f"m{i}".encode(), qos=1, pid=20 + i,
+                               version=4)
+                )
+            await wp.drain()
+            await wait_for(
+                lambda: len(origin._health_for(2).park) == 3, msg="park #2"
+            )
+            cut()
+            await wait_for(
+                lambda: origin._health_for(2).state == PEER_UP,
+                msg="heal #2",
+            )
+            seen2 = await read_until_payload(r2, b"m7")
+            assert seen2 == [b"m5", b"m6", b"m7"]  # no m0..m4 re-replay
+            assert origin.replayed_forwards == replayed0 + 8
+            assert not origin._health_for(2).park
+            for rel in releases:
+                rel()
+            await mesh.stop()
+
+        run(scenario())
+
+    def test_link_shape_is_deterministic_per_seed(self):
+        """Two shapers built from the same LinkShape drop/delay the same
+        frames — the WAN schedule is part of the test's identity."""
+        import random
+
+        from mqtt_tpu.faults import LinkShape
+
+        shape = LinkShape(seed=11, loss=0.3)
+        rng_a = random.Random((shape.seed << 24) ^ (0 << 12) ^ 2)
+        rng_b = random.Random((shape.seed << 24) ^ (0 << 12) ^ 2)
+        assert [rng_a.random() for _ in range(64)] == [
+            rng_b.random() for _ in range(64)
+        ]
+        # distinct edges draw from distinct streams
+        rng_c = random.Random((shape.seed << 24) ^ (1 << 12) ^ 2)
+        assert [rng_a.random() for _ in range(8)] != [
+            rng_c.random() for _ in range(8)
+        ]
+
+
+# -- ISSUE 17: TCP / TLS peer transport ---------------------------------------
+
+
+def _free_ports(n):
+    import socket as _socket
+
+    socks = [_socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+class TestTcpTransport:
+    def test_tcp_mesh_routes_cross_worker(self, tmp_path):
+        """The same mesh over TCP peer links (pinned per-worker
+        addresses, keepalive armed): multi-hop publish/subscribe
+        delivers exactly once — bit-identical semantics to unix."""
+
+        async def scenario():
+            ports = _free_ports(3)
+            addrs = {i: f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+            mesh = TreeMesh(
+                3, tmp_path,
+                cluster_transport="tcp",
+                cluster_peer_addrs=addrs,
+                cluster_keepalive_s=30.0,
+                cluster_connect_timeout_s=2.0,
+            )
+            await mesh.start()
+            for c in mesh.clusters:
+                assert c.transport == "tcp"
+            r2, _w2 = await mesh.subscribe(2, "sub2", "tcp/#")
+            await mesh.settle_summaries()
+            _rp, wp, _ = await mesh.harnesses[1].connect("pub1", version=4)
+            wp.write(pub_packet("tcp/t", b"over-tcp", qos=1, pid=5, version=4))
+            await wp.drain()
+            seen = await read_until_payload(r2, b"over-tcp")
+            assert seen == [b"over-tcp"]
+            await mesh.stop()
+
+        run(scenario())
+
+    @pytest.mark.skipif(
+        __import__("shutil").which("openssl") is None,
+        reason="openssl binary unavailable: cannot mint a test cert",
+    )
+    def test_tls_mesh_routes_cross_worker(self, tmp_path):
+        """TLS peer links with CA verification BOTH directions: a
+        self-signed cert doubles as the CA, every worker presents it,
+        and routed delivery still works — the handshake is in the path,
+        not mocked."""
+        import subprocess
+
+        cert = tmp_path / "mesh-cert.pem"
+        key = tmp_path / "mesh-key.pem"
+        # no -addext: -x509 already stamps basicConstraints=CA:TRUE, and
+        # a DUPLICATE extension makes OpenSSL reject the chain
+        subprocess.run(
+            [
+                "openssl", "req", "-x509", "-newkey", "rsa:2048",
+                "-nodes", "-keyout", str(key), "-out", str(cert),
+                "-days", "1", "-subj", "/CN=mqtt-tpu-mesh",
+            ],
+            check=True, capture_output=True,
+        )
+
+        async def scenario():
+            ports = _free_ports(3)
+            addrs = {i: f"127.0.0.1:{p}" for i, p in enumerate(ports)}
+            mesh = TreeMesh(
+                3, tmp_path,
+                cluster_transport="tcp",
+                cluster_peer_addrs=addrs,
+                cluster_tls_cert=str(cert),
+                cluster_tls_key=str(key),
+                cluster_tls_ca=str(cert),
+            )
+            await mesh.start()
+            r2, _w2 = await mesh.subscribe(2, "sub2", "tls/#")
+            await mesh.settle_summaries()
+            _rp, wp, _ = await mesh.harnesses[1].connect("pub1", version=4)
+            wp.write(pub_packet("tls/t", b"over-tls", qos=0, version=4))
+            await wp.drain()
+            seen = await read_until_payload(r2, b"over-tls")
+            assert seen == [b"over-tls"]
+            await mesh.stop()
+
+        run(scenario())
+
+    def test_transport_env_round_trip(self, tmp_path):
+        from mqtt_tpu.cluster import worker_env
+
+        env = worker_env(
+            2, 4, str(tmp_path), topology="tree", degree=2,
+            transport="tcp", base_port=39000,
+        )
+        assert env["MQTT_TPU_CLUSTER_TRANSPORT"] == "tcp"
+        assert env["MQTT_TPU_CLUSTER_BASE_PORT"] == "39000"
+        # unix mode (the default) sets neither
+        env_u = worker_env(0, 2, str(tmp_path))
+        assert "MQTT_TPU_CLUSTER_TRANSPORT" not in env_u
+        assert "MQTT_TPU_CLUSTER_BASE_PORT" not in env_u

@@ -83,7 +83,15 @@ LOCK_ORDER = (
     # the "retained" stats name)
     "topics_trie",
     "cluster_remote_trie",
+    # the interned predicate registry (mqtt_tpu.predicates): SUBSCRIBE /
+    # UNSUBSCRIBE interning runs while the trie mutation completes, so
+    # the registry lock nests inside the tries and takes nothing further
+    "predicate_rules",
     "retained",
+    # per-client QoS windows (mqtt_tpu.inflight): delivery paths touch
+    # the window before the durable hooks persist it, so it sits above
+    # the store lock and below the registries that route to it
+    "inflight",
     # the durable session plane (hooks/storage/logkv.py): storage-hook
     # events fire while trie/retained work completes, so the store lock
     # nests inside them and above the observability leaves; its append
@@ -98,6 +106,13 @@ LOCK_ORDER = (
     # the shard router's dispatch counter lock (mqtt_tpu.shards): a pure
     # leaf — nothing is ever acquired under it
     "shard_fabric",
+    # the mesh topology plane (mqtt_tpu.mesh_topology): the cluster
+    # loop's adopt/propose and the forward path's neighbor reads — pure
+    # leaves (no topology method ever calls back out), ordered after
+    # everything that may consult the tree mid-operation
+    "mesh_topology",
+    "interest_bloom",
+    "dup_suppressor",
 )
 
 _LOCK_CTORS = {"Lock", "RLock", "threading.Lock", "threading.RLock"}
