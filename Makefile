@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster scrape-devices
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -87,6 +87,13 @@ bench-trend:
 # nonzero remote-path delivery-latency samples
 scrape-cluster:
 	env JAX_PLATFORMS=cpu $(PY) exp/scrape_cluster.py
+
+# device-observatory scrape gate (exp/scrape_devices.py): boot a broker
+# over an 8-way forced host mesh, drive a burst + an 8-way sharded
+# matcher, and validate GET /devices + the labeled mqtt_tpu_device_*
+# exposition families for all 8 devices (ISSUE 18)
+scrape-devices:
+	env JAX_PLATFORMS=cpu $(PY) exp/scrape_devices.py
 
 # staged-pipeline smoke (exp/pipeline_smoke.py): boot the broker with
 # compaction + the 3-deep pipeline on, 1k-publish burst vs wildcard

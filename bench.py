@@ -298,6 +298,14 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
     profiler = DeviceProfiler()
     if hasattr(matcher, "profiler"):
         matcher.profiler = profiler
+    # compile-ledger watermark (ISSUE 18, the PR 11 regression guard):
+    # the warmup above compiled every executable this loop needs, so any
+    # ledger growth across the steady-state window below IS a recompile
+    # — the silent one-recompile-per-step failure mode, now a scalar the
+    # bench-history ledger diffs round over round
+    from mqtt_tpu.ops.devicestats import LEDGER
+
+    ledger_t0 = LEDGER.total()
     hits = 0
     t_start = time.perf_counter()
     pending = matcher.match_topics_async(batches[0])
@@ -328,6 +336,7 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
             )
         pending = nxt
     e2e_dt = time.perf_counter() - t_start
+    steady_recompiles = LEDGER.total() - ledger_t0
     device_pipeline = profiler.bench_block()
     if hasattr(matcher, "profiler"):
         matcher.profiler = None  # the latency loops below stay unprofiled
@@ -541,6 +550,14 @@ def time_matcher(matcher, index, topic_gen, batch, iters, select_shared=False):
         }
     return {
         "e2e_matches_per_sec": round((iters * batch) / e2e_dt),
+        # recompiles observed during the steady-state pipelined loop
+        # (must be 0: fixed-size batches after warmup; nonzero means the
+        # PR 11 capacity-churn bug is back — attribution names the
+        # kernel/shape so the regression is diagnosable from the artifact)
+        "steady_state_recompiles": steady_recompiles,
+        "recompile_attribution": (
+            LEDGER.attribution(ledger_t0) if steady_recompiles else None
+        ),
         # kernel duty cycle / transfer-compute overlap / idle gaps over
         # the pipelined e2e loop (mqtt_tpu.tracing.DeviceProfiler) — the
         # ROADMAP item 1 gap, measured per round; carries the compaction
@@ -596,7 +613,105 @@ def run_cfg2(n_subs, batch, iters, rng):
     matcher.rebuild()
     log(f"cfg2 index build {time.perf_counter()-t0:.1f}s nodes={matcher.csr.num_nodes}")
     parity_check(matcher, index, topic_gen)
-    return time_matcher(matcher, index, topic_gen, batch, iters)
+    m = time_matcher(matcher, index, topic_gen, batch, iters)
+    # the device-observability plane's sampled-path cost (ISSUE 18
+    # acceptance: <= 2%), measured on the same warmed matcher by the
+    # PR 7/14 interleaved-A/B method
+    m["devicestats_overhead"] = _devicestats_overhead_block(
+        matcher, topic_gen, batch
+    )
+    return m
+
+
+def _devicestats_overhead_block(matcher, topic_gen, batch) -> dict:
+    """ISSUE 18 acceptance leg: what the compile watch + per-device
+    profiler windows cost on the hot dispatch path. Interleaved best-of-3
+    rounds (the PR 7/14 method — sequential arm-then-arm would measure
+    tunnel drift, not the plane) of the same pipelined loop with the
+    plane fully ON (KernelWatch signatures + per-device fold) vs OFF,
+    plus the deterministic micro-number: one signature probe per jitted
+    dispatch, the exact added steady-state work."""
+    from mqtt_tpu.ops import devicestats
+    from mqtt_tpu.tracing import DeviceProfiler
+
+    batches = [[topic_gen() for _ in range(batch)] for _ in range(2)]
+    matcher.match_topics(batches[0])  # warm both executables
+
+    def one_round(enabled: bool) -> float:
+        devicestats.set_watch_enabled(enabled)
+        if hasattr(matcher, "profiler"):
+            matcher.profiler = DeviceProfiler() if enabled else None
+        n_it = 6
+        t0 = time.perf_counter()
+        pend = matcher.match_topics_async(batches[0])
+        for i in range(1, n_it + 1):
+            nxt = (
+                matcher.match_topics_async(batches[i % 2])
+                if i < n_it
+                else None
+            )
+            pend()
+            pend = nxt
+        dt = time.perf_counter() - t0
+        if hasattr(matcher, "profiler"):
+            matcher.profiler = None
+        return n_it * batch / dt
+
+    on_rate = off_rate = 0.0
+    try:
+        for _rep in range(3):
+            on_rate = max(on_rate, one_round(True))
+            off_rate = max(off_rate, one_round(False))
+    finally:
+        devicestats.set_watch_enabled(True)
+
+    # deterministic micro: the signature probe a watched kernel pays per
+    # DISPATCH (not per message) in steady state — harness-noise-free,
+    # the number the <=2% bar is judged against on noisy links
+    import jax.numpy as jnp
+
+    probe_args = (
+        jnp.zeros((batch, 8), jnp.int32),
+        jnp.zeros((64,), jnp.int32),
+    )
+    n = 20_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        devicestats._sig_of(probe_args, {})
+    per_probe_ns = (time.perf_counter() - t0) / n * 1e9
+    # ... plus the per-device window fold one profiled batch pays
+    # (tracing._DevWindow): dispatch+resolve notes over a stamped record
+    from mqtt_tpu.tracing import BatchProfile
+
+    fold_prof = DeviceProfiler()
+    nf = 20_000
+    t0 = time.perf_counter()
+    tb = time.perf_counter()
+    for i in range(nf):
+        rec = BatchProfile()
+        rec.devices = (0,)
+        rec.d2h_bytes = 4096
+        fold_prof.note_dispatch(rec, tb, tb + 1e-4)
+        fold_prof.note_resolve(rec, tb + 2e-4, tb + 3e-4)
+    per_fold_ns = (time.perf_counter() - t0) / nf * 1e9
+    per_batch_ns = per_probe_ns + per_fold_ns
+    out = {
+        "enabled_matches_per_sec": round(on_rate),
+        "disabled_matches_per_sec": round(off_rate),
+        "overhead_pct": round(
+            (off_rate - on_rate) / max(1.0, off_rate) * 100, 2
+        ),
+        "sig_probe_ns_per_dispatch": round(per_probe_ns, 1),
+        "device_fold_ns_per_batch": round(per_fold_ns, 1),
+    }
+    if off_rate > 0:
+        # the plane's exact added work as a fraction of one batch's wall
+        # budget — harness-noise-free, the <=2% acceptance figure (the
+        # macro pct above inherits the loopback/tunnel jitter)
+        out["amortized_overhead_pct"] = round(
+            per_batch_ns / (1e9 * batch / off_rate) * 100, 4
+        )
+    return out
 
 
 def run_cfg3(n_subs, batch, iters, rng):
@@ -813,6 +928,49 @@ def run_cfg9(fast: bool, rng) -> dict:
     return out
 
 
+def _keystream_device_rate(fast: bool):
+    """The PR 12 residual (ISSUE 18 satellite): the device keystream's
+    raw sustained byte rate — resident inputs, pipelined dispatches, one
+    dependent sync — on a REAL accelerator. On CPU-jax the 'device' path
+    is the same host silicon the vectorized-host path uses, so the
+    number would be a fiction: the zero-headline rule applies and the
+    cell records an honest skip instead."""
+    try:
+        import jax
+        import jax.numpy as jnp
+    except ImportError:
+        return {"skipped": True, "skip_reason": "jax not importable"}
+    platform = getattr(jax.devices()[0], "platform", "cpu")
+    if platform == "cpu":
+        return {
+            "skipped": True,
+            "skip_reason": "CPU-jax backend: device keystream bytes/s "
+            "is only meaningful on a real accelerator",
+        }
+    from mqtt_tpu.ops.recrypt import BLOCK, ctr_counters, keystream
+    from mqtt_tpu.tenancy import KeyRegistry
+
+    reg = KeyRegistry()
+    for k in range(64):
+        reg.set_key("bt0", f"c{k}", bytes([k % 256]) * 16)
+    table = reg.table()
+    n_blocks = 1 << (12 if fast else 16)  # 64 KiB / 1 MiB of keystream
+    kidx = np.arange(n_blocks, dtype=np.int32) % 64
+    counters = ctr_counters(b"bnks" * 3, n_blocks)
+    args = (jnp.asarray(table), jnp.asarray(kidx), jnp.asarray(counters))
+    jax.block_until_ready(args)
+    np.asarray(keystream(*args))  # warm the executable
+    red = jax.jit(lambda o: o.sum())
+    iters = 8 if fast else 32
+    rates = []
+    for _w in range(3):
+        t0 = time.perf_counter()
+        outs = [keystream(*args) for _ in range(iters)]
+        np.asarray(red(outs[-1]))  # dependent D2H = true completion
+        rates.append(iters * n_blocks * BLOCK / (time.perf_counter() - t0))
+    return round(sorted(rates)[len(rates) // 2])
+
+
 def run_cfg10(fast: bool, rng) -> dict:
     """Tenants x keys x fan-out re-encryption matrix (ISSUE 12 /
     ROADMAP item 6): the MQT-TZ stage measured at the engine seam —
@@ -934,6 +1092,16 @@ def run_cfg10(fast: bool, rng) -> dict:
     out["device_batches"] = eng.device_batches
     out["oracle_mismatches"] = eng.oracle_mismatches
     out["kernel_worst_ratio_at_fanout100"] = round(worst_ratio_at_100, 3)
+    # real-accelerator keystream byte rate as a TOP-LEVEL scalar so the
+    # bench-history ledger keeps it and exp/bench_trend.py gates its
+    # trajectory (ISSUE 18 satellite; honest skip dict on CPU-jax)
+    try:
+        out["keystream_device_bytes_per_sec"] = _keystream_device_rate(fast)
+    except Exception as e:  # a dead link must not sink the whole matrix
+        out["keystream_device_bytes_per_sec"] = {
+            "skipped": True,
+            "skip_reason": f"error: {e}",
+        }
     # the acceptance leg: a REAL broker A/B at 100-subscriber fan-out.
     # QoS1 deliveries (the at-least-once class trust-sensitive
     # workloads run on) pay the per-subscriber copy+encode path either

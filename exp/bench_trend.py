@@ -171,6 +171,12 @@ CONFIG_SCALARS = (
     # silent pass-through degradation errors the config, which this
     # gate's >0 usability rule would otherwise skip)
     ("12_mesh_pushdown", "pushdown_filter_evals_per_sec"),
+    # device observability plane (ISSUE 18): real-accelerator keystream
+    # byte rate (a skip dict on CPU-jax rounds is ignored by the gate),
+    # and the steady-state recompile guard rides cfg 2's block — the
+    # scalar is asserted == 0 by tier-1 tests; the ledger keeps it for
+    # post-hoc attribution when a regression lands anyway
+    ("10_recrypt_matrix", "keystream_device_bytes_per_sec"),
 )
 
 

@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""CI device-observatory scrape gate (ISSUE 18): boot a broker with the
+device-stats plane over an 8-way forced host mesh, drive a publish
+burst plus an 8-way mesh-sharded matcher, fetch ``GET /devices`` and
+``GET /metrics`` from the stats listener, validate the labeled
+``mqtt_tpu_device_*`` families with the pure-Python exposition checker
+(mqtt_tpu.telemetry.check_exposition), and write the /devices snapshot
+to disk — the workflow uploads it as the per-run device baseline
+artifact.
+
+Usage: python exp/scrape_devices.py [--out devices-snapshot.json]
+Exits non-zero when the scrape fails to parse, any of the 8 per-device
+families is missing, or the compile ledger / skew gauge stayed inert.
+"""
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the gate's whole point is an 8-device mesh: force the host platform
+# to present 8 devices BEFORE jax initialises (import-order-sensitive,
+# same trick as tests/conftest.py)
+_FLAG = "--xla_force_host_platform_device_count=8"
+if _FLAG not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "") + " " + _FLAG
+    ).strip()
+
+
+async def main(out_path: str) -> int:
+    try:
+        import jax
+    except ImportError:
+        # a dev box without jax must not brick `make scrape-devices`;
+        # CI always installs jax so the gate never silently skips there
+        print("SKIP: jax not installed; device scrape needs a backend",
+              file=sys.stderr)
+        return 0
+
+    from mqtt_tpu.hooks.auth import AllowHook
+    from mqtt_tpu.listeners import Config as LConfig, HTTPStats
+    from mqtt_tpu.listeners.tcp import TCP
+    from mqtt_tpu.packets import Subscription
+    from mqtt_tpu.parallel.sharded import ShardedTpuMatcher, make_mesh
+    from mqtt_tpu.server import Options, Server
+    from mqtt_tpu.stress import _connect_bytes, _subscribe_bytes
+    from mqtt_tpu.telemetry import check_exposition
+    from mqtt_tpu.topics import TopicsIndex
+
+    if len(jax.devices()) < 8:
+        print(
+            f"FAIL: expected >=8 forced host devices, "
+            f"got {len(jax.devices())}",
+            file=sys.stderr,
+        )
+        return 1
+
+    opts = Options(
+        device_matcher=True,
+        matcher_opts={"max_levels": 4, "background": False},
+        telemetry_sample=1,  # sample everything: a 2s burst must register
+        device_stats=True,
+    )
+    srv = Server(opts)
+    srv.add_hook(AllowHook())
+    srv.add_listener(TCP(LConfig(type="tcp", id="t", address="127.0.0.1:0")))
+    srv.add_listener(
+        HTTPStats(
+            LConfig(type="sysinfo", id="s", address="127.0.0.1:0"),
+            srv.info,
+            telemetry=srv.telemetry,
+        )
+    )
+    await srv.serve()
+    try:
+        host, port = srv.listeners.get("t").address().rsplit(":", 1)
+
+        # one subscriber + a small publish burst: exercises the staged
+        # matcher so the compile ledger records the flat-kernel entries
+        sr, sw = await asyncio.open_connection(host, int(port))
+        sw.write(_connect_bytes("scrape-sub", version=4))
+        await sw.drain()
+        await sr.readexactly(4)
+        sw.write(_subscribe_bytes(1, "bench/#"))
+        await sw.drain()
+        await sr.readexactly(5)
+        if srv.matcher is not None:
+            srv.matcher.flush()
+
+        pr, pw = await asyncio.open_connection(host, int(port))
+        pw.write(_connect_bytes("scrape-pub", version=4))
+        await pw.drain()
+        await pr.readexactly(4)
+        for i in range(200):
+            topic = f"bench/{i % 10}".encode()
+            body = len(topic).to_bytes(2, "big") + topic + b"x" * 16
+            pw.write(bytes([0x30, len(body)]) + body)
+        await pw.drain()
+        deadline = asyncio.get_event_loop().time() + 20
+        got = 0
+        while got < 200 and asyncio.get_event_loop().time() < deadline:
+            try:
+                # generous first-read budget: the burst's first staged
+                # batch pays the match kernel jit compile
+                data = await asyncio.wait_for(sr.read(65536), 5.0)
+            except asyncio.TimeoutError:
+                break
+            if not data:
+                break
+            got += data.count(b"bench/")
+        print(f"# delivered ~{got}/200 publishes", file=sys.stderr)
+
+        # mesh-sharded leg: attach an 8-way sharded matcher to the
+        # plane (the server's staged matcher is single-device) so the
+        # tile/skew families and all 8 per-device duty windows populate
+        index = TopicsIndex()
+        for i in range(64):
+            index.subscribe(f"c{i}", Subscription(filter=f"mesh/{i % 8}/+"))
+        sharded = ShardedTpuMatcher(
+            index, mesh=make_mesh(jax.devices()[:8]), max_levels=4
+        )
+        if srv.profiler is not None:
+            sharded.profiler = srv.profiler
+        assert srv.device_stats is not None
+        srv.device_stats.attach_matcher(sharded)
+        for _ in range(3):
+            sharded.match_topics([f"mesh/{i % 8}/x" for i in range(64)])
+
+        srv.publish_sys_topics()
+        from scrapelib import http_get
+
+        addr = srv.listeners.get("s").address()
+        head, body = await http_get(addr, "/devices")
+        assert b"200" in head.split(b"\r\n", 1)[0], head
+        doc = json.loads(body)
+        if doc.get("n_devices") != 8 or len(doc.get("devices", [])) != 8:
+            print(f"FAIL: /devices n_devices={doc.get('n_devices')} != 8",
+                  file=sys.stderr)
+            return 1
+        if sorted(d["id"] for d in doc["devices"]) != list(range(8)):
+            print("FAIL: /devices ids are not 0..7", file=sys.stderr)
+            return 1
+        if doc["compiles"]["total"] < 1:
+            print("FAIL: compile ledger recorded no events", file=sys.stderr)
+            return 1
+        if doc["skew"]["ratio"] <= 0.0:
+            print("FAIL: skew gauge inert after sharded burst",
+                  file=sys.stderr)
+            return 1
+
+        head, mbody = await http_get(addr, "/metrics")
+        assert b"200" in head.split(b"\r\n", 1)[0], head
+        text = mbody.decode()
+        samples = check_exposition(text)
+        required = [
+            "mqtt_tpu_device_skew_ratio",
+            'mqtt_tpu_device_tile_hits_total{tile="0"}',
+            "mqtt_tpu_device_tile_fill_ratio_bucket",
+            "mqtt_tpu_matcher_recompiles_total",
+            "mqtt_tpu_matcher_compile_seconds_count",
+        ]
+        for did in range(8):
+            required.append(f'mqtt_tpu_device_hbm_ratio{{device="{did}"}}')
+            required.append(
+                f'mqtt_tpu_device_duty_cycle_ratio{{device="{did}"}}'
+            )
+        missing = [m for m in required if m not in text]
+        if missing:
+            print(f"FAIL: metrics missing {missing}", file=sys.stderr)
+            return 1
+
+        with open(out_path, "w") as f:
+            json.dump({"devices": doc, "metrics_samples": samples}, f,
+                      indent=2)
+        print(
+            f"OK: 8 devices exported, {samples} samples parsed, "
+            f"{doc['compiles']['total']} compile event(s); "
+            f"snapshot -> {out_path}",
+            file=sys.stderr,
+        )
+        return 0
+    finally:
+        await srv.close()
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default="devices-snapshot.json")
+    sys.exit(asyncio.run(main(ap.parse_args().out)))

@@ -264,6 +264,11 @@ def from_bytes(b: bytes) -> Optional[Options]:
         "slo",
         "slo_objectives",
         "slo_burn_threshold",
+        # per-device observability plane: HBM gauges, compile ledger,
+        # shard skew, /devices + $SYS devices tree (ISSUE 18,
+        # mqtt_tpu.ops.devicestats)
+        "device_stats",
+        "device_hbm_watermark",
         "cluster_metrics",
         "cluster_metrics_max_age_s",
         # durable session plane + tenant count quotas (ISSUE 16)

@@ -120,7 +120,9 @@ class HTTPStats(_HttpListener):
     load the body straight into Perfetto), and the host profiler's
     exports at ``GET /profile`` (mqtt_tpu.profiling) — collapsed
     flamegraph text by default, ``?format=trace`` for the
-    Perfetto-loadable flame chart.
+    Perfetto-loadable flame chart — and the per-device observability
+    snapshot at ``GET /devices`` (mqtt_tpu.ops.devicestats: HBM, duty
+    cycles, shard skew, compile ledger).
 
     Cluster-wide SLO observatory surfaces (ISSUE 14): ``GET
     /metrics/cluster`` renders the mesh-federated per-worker + folded
@@ -186,6 +188,14 @@ class HTTPStats(_HttpListener):
                 ),
             }
             body = json.dumps(out, indent=1).encode()
+            return "200 OK", body, "application/json", _NO_STORE
+        if path == "/devices":
+            plane = getattr(self.telemetry, "device_stats", None)
+            if plane is None:  # telemetry off, or the device plane disabled
+                return "404 Not Found", b"", "text/plain"
+            if method != "GET":
+                return self._method_not_allowed()
+            body = json.dumps(plane.snapshot(), indent=1).encode()
             return "200 OK", body, "application/json", _NO_STORE
         if path == "/profile":
             profiler = getattr(self.telemetry, "host_profiler", None)

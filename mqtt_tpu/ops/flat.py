@@ -961,10 +961,15 @@ def _jit_core():
 class _LazyJit:
     """Defer the jax.jit wrapping until first call (keeps `import
     mqtt_tpu.ops` light and CPU-only test processes fast). ``builder``
-    returns the jitted callable."""
+    returns the jitted callable. When ``kernel`` is named, the built
+    callable is wrapped in a devicestats.KernelWatch so every first
+    call per (shapes, dtypes, statics) signature lands in the
+    compile-event ledger — the single ``note_compile`` seam for the
+    flat/predicates/recrypt/retained kernel families (ISSUE 18)."""
 
-    def __init__(self, builder):
+    def __init__(self, builder, kernel=None):
         self._builder = builder
+        self._kernel = kernel
         self._fn = None
         self._lock = threading.Lock()
 
@@ -972,11 +977,16 @@ class _LazyJit:
         if self._fn is None:
             with self._lock:
                 if self._fn is None:
-                    self._fn = self._builder()
+                    built = self._builder()
+                    if self._kernel is not None:
+                        from .devicestats import KernelWatch
+
+                        built = KernelWatch(self._kernel, built)
+                    self._fn = built
         return self._fn(*args, **kwargs)
 
 
-flat_match = _LazyJit(_jit_core)
+flat_match = _LazyJit(_jit_core, kernel="flat_match")
 
 
 def pack_tokens(tok1, tok2, lengths, is_dollar) -> np.ndarray:
@@ -1175,7 +1185,7 @@ def _jit_compact():
     )(_compact_core)
 
 
-flat_match_compact = _LazyJit(_jit_compact)
+flat_match_compact = _LazyJit(_jit_compact, kernel="flat_match_compact")
 
 
 def _scatter_core(table, idx, rows):
@@ -1192,7 +1202,7 @@ def _jit_scatter():
     return jax.jit(_scatter_core, donate_argnums=())
 
 
-scatter_rows = _LazyJit(_jit_scatter)
+scatter_rows = _LazyJit(_jit_scatter, kernel="scatter_rows")
 
 
 def _jit_ranges():
@@ -1203,7 +1213,7 @@ def _jit_ranges():
     )
 
 
-flat_match_ranges = _LazyJit(_jit_ranges)
+flat_match_ranges = _LazyJit(_jit_ranges, kernel="flat_match_ranges")
 
 
 def _jit_packed():
@@ -1212,4 +1222,4 @@ def _jit_packed():
     return partial(jax.jit, static_argnames=("max_levels",))(_packed_core)
 
 
-flat_match_packed = _LazyJit(_jit_packed)
+flat_match_packed = _LazyJit(_jit_packed, kernel="flat_match_packed")

@@ -676,7 +676,12 @@ class TpuMatcher:
             pass
         if prof is not None:
             # device pipeline profiler: the issue leg (tokenize + H2D +
-            # async dispatch) ends here; the device window opens now
+            # async dispatch) ends here; the device window opens now.
+            # Stamp which chip ran the batch first so the per-device
+            # window replicas (ISSUE 18) attribute it correctly.
+            dev = getattr(out_dev, "device", None)
+            did = getattr(dev() if callable(dev) else dev, "id", None)
+            rec.devices = (did,) if did is not None else None
             prof.note_dispatch(rec, t_issue0, time.perf_counter())
         if route_to_host is None:
             pred = batch_pred = None

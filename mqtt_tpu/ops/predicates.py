@@ -85,7 +85,7 @@ def _jit_rules_eval():
     return jax.jit(rules_eval_core)
 
 
-rules_eval = _LazyJit(_jit_rules_eval)
+rules_eval = _LazyJit(_jit_rules_eval, kernel="rules_eval")
 
 
 def agg_reduce_core(vals, ops, counts):
@@ -115,7 +115,7 @@ def _jit_agg_reduce():
     return jax.jit(agg_reduce_core)
 
 
-agg_reduce = _LazyJit(_jit_agg_reduce)
+agg_reduce = _LazyJit(_jit_agg_reduce, kernel="agg_reduce")
 
 
 def agg_reduce_batch(pending: list) -> Optional[np.ndarray]:

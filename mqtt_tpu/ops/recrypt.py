@@ -257,7 +257,7 @@ def _jit_keystream():
     return jax.jit(keystream_core)
 
 
-keystream = _LazyJit(_jit_keystream)
+keystream = _LazyJit(_jit_keystream, kernel="keystream")
 
 
 def ctr_counters(nonce: bytes, n_blocks: int, start: int = 0) -> np.ndarray:

@@ -52,7 +52,7 @@ def shard_map(*args, disable_rep_check=False, **kwargs):
 
 from functools import partial
 
-from ..telemetry import Histogram
+from ..telemetry import FILL_BOUNDS, Histogram
 from ..topics import Mutation, Subscribers, TopicsIndex
 from ..ops.flat import (
     KIND_CLIENT,
@@ -65,6 +65,7 @@ from ..ops.flat import (
     build_flat_index,
     flat_match_core,
 )
+from ..ops.devicestats import KernelWatch
 from ..ops.hashing import tokenize_topics
 from ..ops.matcher import (
     MatcherStats,
@@ -239,8 +240,53 @@ class ShardedTpuMatcher:
         # merges them on demand (merged_shard_compile), the merge()-at-
         # scrape pattern the telemetry plane's Histogram documents
         self.shard_compile_hists = [Histogram() for _ in range(self.n_shards)]
+        # per-tile imbalance telemetry (ISSUE 18): cumulative hit counts
+        # and per-batch fill histograms, one per batch tile, folded from
+        # each resolved compact batch under _tile_lock (arithmetic only).
+        # device_skew_ratio() = max/mean over tile_hits — the live gauge
+        # the multi-chip frontier's "near-linear scaling" claim reads.
+        self._tile_lock = threading.Lock()
+        self._tile_hits = np.zeros(self.n_batch, dtype=np.int64)
+        self._tile_batches = 0
+        self.tile_fill_hists = [
+            Histogram(bounds=FILL_BOUNDS) for _ in range(self.n_batch)
+        ]
+        # mesh device ids, dispatch-stamped onto each BatchProfile so the
+        # profiler's per-device windows attribute sharded batches
+        self._device_ids = tuple(
+            int(getattr(d, "id", i))
+            for i, d in enumerate(self.mesh.devices.flat)
+        )
         if incremental:
             topics.add_observer(self._on_mutation)
+
+    def tile_hit_counts(self) -> np.ndarray:
+        """Cumulative per-batch-tile hit counts (a copy)."""
+        with self._tile_lock:
+            return self._tile_hits.copy()
+
+    def device_skew_ratio(self) -> float:
+        """max/mean per-tile cumulative hits: 1.0 = balanced mesh,
+        n_batch = one hot tile, 0.0 = no traffic yet."""
+        with self._tile_lock:
+            hits = self._tile_hits
+            mean = float(hits.mean()) if hits.size else 0.0
+            if mean <= 0.0:
+                return 0.0
+            return float(hits.max()) / mean
+
+    def _fold_tile_hits(self, tile_hits: np.ndarray, cap_local: int) -> None:
+        """Fold one resolved batch's per-tile hit counts into the skew
+        accounting (called from resolve closures, any thread)."""
+        n = min(len(tile_hits), self.n_batch)
+        with self._tile_lock:
+            self._tile_hits[:n] += tile_hits[:n].astype(np.int64)
+            self._tile_batches += 1
+            if cap_local > 0:
+                for t in range(n):
+                    self.tile_fill_hists[t].observe(
+                        float(tile_hits[t]) / cap_local
+                    )
 
     def close(self) -> None:
         """Detach from the trie's mutation stream."""
@@ -600,14 +646,17 @@ class ShardedTpuMatcher:
 
         shard_spec = P("subs")
         batch_spec = P("batch")
-        step = jax.jit(
-            shard_map(
-                step_fn,
-                mesh=mesh,
-                in_specs=(shard_spec,) * 4 + (batch_spec,) * 4,
-                out_specs=(P(None, "batch", None), P(None, "batch"), P(None, "batch")),
-                disable_rep_check=True,
-            )
+        step = KernelWatch(
+            "sharded_step",
+            jax.jit(
+                shard_map(
+                    step_fn,
+                    mesh=mesh,
+                    in_specs=(shard_spec,) * 4 + (batch_spec,) * 4,
+                    out_specs=(P(None, "batch", None), P(None, "batch"), P(None, "batch")),
+                    disable_rep_check=True,
+                )
+            ),
         )
         self._step = step
         return step
@@ -618,18 +667,24 @@ class ShardedTpuMatcher:
         step = self._compact_steps.get(cap_local)
         if step is None:
             fn = partial(_tile_compact_core, cap_local=cap_local)
-            step = jax.jit(
-                shard_map(
-                    fn,
-                    mesh=self.mesh,
-                    in_specs=(
-                        P(None, "batch", None),
-                        P(None, "batch"),
-                        P(None, "batch"),
-                    ),
-                    out_specs=P("batch", None),
-                    disable_rep_check=True,
-                )
+            # cap_local is baked into the traced fn, not a call arg: give
+            # the watch a per-capacity kernel label so a capacity-churn
+            # recompile (the PR 11 incident) attributes to its capacity
+            step = KernelWatch(
+                f"sharded_tile_compact_c{cap_local}",
+                jax.jit(
+                    shard_map(
+                        fn,
+                        mesh=self.mesh,
+                        in_specs=(
+                            P(None, "batch", None),
+                            P(None, "batch"),
+                            P(None, "batch"),
+                        ),
+                        out_specs=P("batch", None),
+                        disable_rep_check=True,
+                    )
+                ),
             )
             self._compact_steps[cap_local] = step
         return step
@@ -695,7 +750,10 @@ class ShardedTpuMatcher:
             except AttributeError:  # pragma: no cover - older jax arrays
                 pass
         if prof is not None:
-            # device pipeline profiler: the SPMD issue leg ends here
+            # device pipeline profiler: the SPMD issue leg ends here; every
+            # mesh device participated in the step, so the per-device
+            # windows (ISSUE 18) each get this batch's window
+            rec.devices = self._device_ids
             prof.note_dispatch(rec, t_issue0, time.perf_counter())
         # accept both route forms (ops/matcher.py): a plain predicate or
         # the delta overlay object exposing .affected
@@ -751,6 +809,10 @@ class ShardedTpuMatcher:
             n_hits = int(rows[:, 0].sum())
             batch_ovf = bool(rows[:, 1].any())
             self._observe_hits(n_hits, b)
+            # per-tile imbalance fold (ISSUE 18): every resolved batch —
+            # including the overflow fallback, whose tile counts are
+            # saturated-but-honest — feeds the skew gauge
+            self._fold_tile_hits(np.asarray(rows[:, 0]), cap_local)
             if batch_ovf:
                 # a tile outgrew its pair buffer: fall back to the full
                 # gathered transfer for THIS batch only (the device

@@ -525,6 +525,17 @@ class Options:
     # burn-rate level both windows must exceed to breach (1.0 = the
     # budget is being spent exactly as fast as allowed)
     slo_burn_threshold: float = 1.0
+    # per-device observability plane (ISSUE 18, mqtt_tpu.ops.
+    # devicestats): per-chip HBM gauges, the compile-event ledger, the
+    # shard-skew gauge, GET /devices, $SYS/broker/devices/#, and the
+    # devices_*.json trigger-dump sibling. Default on (requires
+    # telemetry + a device matcher to say anything interesting, but the
+    # plane itself is host-side and backend-agnostic).
+    device_stats: bool = True
+    # live/limit HBM occupancy at or above which /healthz reports the
+    # device plane degraded (never flips readiness); the "hbm ratio"
+    # SLO objective is the alerting twin of this knob
+    device_hbm_watermark: float = 0.9
     # mesh metric federation (mqtt_tpu.cluster _T_METRICS): per-worker
     # registry summaries ride the mesh at gossip cadence with
     # per-subtree fold; the tree root serves GET /metrics/cluster and
@@ -981,6 +992,9 @@ class Server:
         # delivery-latency SLI gate plus the burn-rate engine when
         # objectives are declared; evaluate() rides the housekeeping tick
         self.slo: Optional[Any] = None
+        # per-device observability plane (ISSUE 18); built further down
+        # once the matcher + device profiler exist to attach
+        self.device_stats: Optional[Any] = None
         if self.telemetry is not None:
             self.telemetry.delivery_sli = bool(opts.slo)
             if opts.slo and opts.slo_objectives:
@@ -1182,6 +1196,28 @@ class Server:
                         )
 
                     breaker.on_trip = _trip_dump
+            # per-device observability plane (ISSUE 18, ops/devicestats):
+            # HBM gauges + the compile-event ledger + the shard-skew
+            # gauge; adopts the device profiler's per-device windows and
+            # the sharded snapshot's tile-skew state when they exist
+            if opts.device_stats:
+                from .ops.devicestats import DeviceStatsPlane
+
+                plane = DeviceStatsPlane(
+                    registry=self.telemetry.registry,
+                    hbm_watermark=opts.device_hbm_watermark,
+                )
+                if self.profiler is not None:
+                    plane.attach_profiler(self.profiler)
+                for cand in (
+                    getattr(self.matcher, "_snap", None),
+                    self.matcher,
+                ):
+                    if cand is not None and hasattr(cand, "device_skew_ratio"):
+                        plane.attach_matcher(cand)
+                        break
+                self.telemetry.attach_device_stats(plane)
+                self.device_stats = plane
             if self._recrypt is not None:
                 rbreaker = self._recrypt.breaker
                 prev_rtrip = rbreaker.on_trip
@@ -1791,6 +1827,27 @@ class Server:
             if breached:
                 detail["slo"]["breached"] = breached
                 degraded.append("slo_breached")
+        plane = self.device_stats
+        if plane is not None:
+            # device plane (ISSUE 18): HBM past the watermark or a
+            # breached skew objective DEGRADE — the broker still
+            # serves, but the multi-chip frontier is unhealthy and the
+            # body says which chip-level instrument tripped. Readiness
+            # NEVER flips on device telemetry.
+            ratio = plane.hbm_ratio()
+            detail["devices"] = {
+                "hbm_ratio": round(ratio, 4),
+                "hbm_watermark": plane.hbm_watermark,
+                "skew_ratio": round(plane.skew_ratio(), 4),
+            }
+            if ratio >= plane.hbm_watermark and ratio > 0.0:
+                degraded.append("hbm_watermark")
+            if self.slo is not None and any(
+                st.get("breached")
+                and st.get("family") == "mqtt_tpu_device_skew_ratio"
+                for st in self.slo.state().values()
+            ):
+                degraded.append("device_skew")
         ok = not not_ready
         detail["ok"] = ok
         detail["not_ready"] = not_ready
@@ -4760,6 +4817,11 @@ class Server:
             # queue-wait, flight-recorder state
             for key, val in self.telemetry.sys_tree().items():
                 topics[SYS_PREFIX + "/broker/telemetry/" + key] = str(val)
+        if self.device_stats is not None:
+            # per-device observability (ISSUE 18, ops/devicestats): HBM,
+            # duty cycles, skew, and the compile ledger as retained rows
+            for key, val in self.device_stats.sys_tree().items():
+                topics[SYS_PREFIX + "/broker/devices/" + key] = str(val)
         if self._cluster is not None:
             # worker-mesh observability (mqtt_tpu.cluster)
             c = self._cluster

@@ -9,6 +9,8 @@ slo_objectives``)::
     p99 delivery{tenant=acme} < 20ms over 5m/1h
     shed ratio < 0.1% over 5m            # event-ratio objective
     messages_dropped/messages_received ratio < 0.5%
+    shard skew < 2.0 over 5m             # gauge objective (ISSUE 18)
+    hbm ratio < 0.9 over 5m              # device HBM watermark
 
 and the engine evaluates each as a MULTI-WINDOW BURN RATE (the SRE
 workbook shape): the burn rate is ``bad-event fraction / allowed
@@ -26,7 +28,14 @@ Sources are the registry's OWN metrics — no second bookkeeping path:
   bucket granularity with the threshold snapped DOWN one bucket so the
   gate alarms early, never late (telemetry.Histogram.count_le);
 - ratio objectives diff two counter families (numerator = bad events,
-  denominator = total events), summed across their children.
+  denominator = total events), summed across their children;
+- gauge objectives (ISSUE 18's device plane) sample a gauge family each
+  evaluation tick — the worst (max) child, so a per-device family
+  breaches on its hottest chip — counting a tick as "bad" when the
+  value exceeds the threshold. Events are TICKS: the burn windows then
+  measure the fraction of recent time the gauge spent past the line
+  against a ``GAUGE_BUDGET`` (10%) allowance, which plugs straight into
+  the multi-window machinery below.
 
 Each evaluation tick snapshots cumulative totals into a bounded ring;
 window deltas come from the ring, so restarts/counter resets clamp to
@@ -70,6 +79,17 @@ RATIO_SLIS = {
     "fallback": ("mqtt_tpu_stage_fallback_total", "mqtt_tpu_matcher_topics_total"),
 }
 
+# named gauge SLIs (ISSUE 18 device plane): phrase -> gauge family; the
+# engine samples the family's WORST (max) child each tick
+GAUGE_SLIS = {
+    "shard skew": "mqtt_tpu_device_skew_ratio",
+    "hbm ratio": "mqtt_tpu_device_hbm_ratio",
+}
+
+# allowed fraction of evaluation ticks a gauge may spend past its
+# threshold before the burn rate reads 1.0
+GAUGE_BUDGET = 0.1
+
 DEFAULT_FAST_S = 300.0  # 5m fast window
 SLOW_FACTOR = 12.0  # slow window = 12x fast (5m -> 1h) unless spelled out
 
@@ -87,6 +107,14 @@ _RATIO_RE = re.compile(
     r"\s*<\s*(?P<num>\d+(?:\.\d+)?)%"
     r"(?:\s+over\s+(?P<win>\S+))?$"
 )
+# tried LAST: a bare unitless comparison ("shard skew < 2.0 over 5m",
+# "hbm ratio < 0.9") — multi-word phrases resolve through GAUGE_SLIS,
+# single words fall back to a gauge family name
+_GAUGE_RE = re.compile(
+    r"^(?P<sli>[a-z_][a-z0-9_]*(?: [a-z0-9_]+)*)"
+    r"\s*<\s*(?P<num>\d+(?:\.\d+)?)"
+    r"(?:\s+over\s+(?P<win>\S+))?$"
+)
 
 
 class ObjectiveError(ValueError):
@@ -101,11 +129,12 @@ class Objective:
 
     name: str
     spec: str
-    kind: str  # "latency" | "ratio"
+    kind: str  # "latency" | "ratio" | "gauge"
     budget: float
     fast_s: float = DEFAULT_FAST_S
     slow_s: float = DEFAULT_FAST_S * SLOW_FACTOR
-    # latency objectives
+    # latency objectives; gauge objectives reuse both fields (family =
+    # the sampled gauge family, threshold_s = the UNITLESS threshold)
     family: str = ""
     threshold_s: float = 0.0
     labels: dict = field(default_factory=dict)
@@ -208,9 +237,32 @@ def parse_objective(spec: str, name: str = "") -> Objective:
             numerator=num,
             denominator=den,
         )
+    m = _GAUGE_RE.match(s)
+    if m is not None:
+        sli = m.group("sli")
+        family = GAUGE_SLIS.get(sli)
+        if family is None:
+            if " " in sli:
+                raise ObjectiveError(
+                    f"unknown gauge sli {sli!r} (known: {sorted(GAUGE_SLIS)})"
+                )
+            family = sli  # a single word names the gauge family itself
+        if not family.startswith("mqtt_tpu_"):
+            family = "mqtt_tpu_" + family
+        fast, slow = _parse_windows(m.group("win"))
+        return Objective(
+            name=name or _slug(s),
+            spec=s,
+            kind="gauge",
+            budget=GAUGE_BUDGET,
+            fast_s=fast,
+            slow_s=slow,
+            family=family,
+            threshold_s=float(m.group("num")),
+        )
     raise ObjectiveError(
         f"unparseable objective {spec!r} (grammar: 'p99 delivery < 50ms "
-        "over 5m' or 'shed ratio < 0.1%')"
+        "over 5m', 'shed ratio < 0.1%', or 'shard skew < 2.0 over 5m')"
     )
 
 
@@ -242,13 +294,18 @@ class _Track:
     __slots__ = (
         "obj", "ring", "breached", "burn_fast", "burn_slow",
         "budget_remaining", "breaches", "g_fast", "g_slow", "g_budget",
-        "g_breached",
+        "g_breached", "cum_total", "cum_bad", "last_value",
     )
 
     def __init__(self, obj: Objective) -> None:
         self.obj = obj
         # (monotonic, total_events, bad_events) cumulative snapshots
         self.ring: deque = deque()
+        # gauge objectives accumulate here: every evaluation tick is an
+        # event, a tick with the sampled value past the threshold is bad
+        self.cum_total = 0
+        self.cum_bad = 0
+        self.last_value = 0.0
         self.breached = False
         self.burn_fast = 0.0
         self.burn_slow = 0.0
@@ -319,10 +376,28 @@ class SLOEngine:
 
     # -- totals from the registry ------------------------------------------
 
-    def _totals(self, obj: Objective) -> tuple[float, float]:
+    def _totals(self, tr: _Track) -> tuple[float, float]:
         """Cumulative (total events, bad events) for one objective, read
-        from the registry's live children."""
+        from the registry's live children. Gauge objectives synthesize
+        events from evaluation ticks: this tick is one event, bad when
+        the family's worst (max) child value exceeds the threshold."""
+        obj = tr.obj
         r = self.telemetry.registry
+        if obj.kind == "gauge":
+            worst = 0.0
+            for _key, child in r.family_children(obj.family):
+                value = getattr(child, "value", None)
+                try:
+                    v = value() if callable(value) else value
+                except Exception:  # brokerlint: ok=R4 a failing gauge callback must degrade the objective sample, never the tick
+                    continue
+                if isinstance(v, (int, float)):
+                    worst = max(worst, float(v))
+            tr.last_value = worst
+            tr.cum_total += 1
+            if worst > obj.threshold_s:
+                tr.cum_bad += 1
+            return float(tr.cum_total), float(tr.cum_bad)
         if obj.kind == "latency":
             total = bad = 0.0
             want = obj.labels
@@ -377,7 +452,7 @@ class SLOEngine:
         now = self.clock() if now is None else now
         for tr in self._tracks:
             o = tr.obj
-            total, bad = self._totals(o)
+            total, bad = self._totals(tr)
             tr.ring.append((now, total, bad))
             horizon = now - o.slow_s - 2.0
             while len(tr.ring) > 2 and tr.ring[1][0] <= horizon:
@@ -432,7 +507,7 @@ class SLOEngine:
 
     def _objective_state(self, tr: _Track) -> dict:
         o = tr.obj
-        return {
+        out = {
             "objective": o.name,
             "spec": o.spec,
             "kind": o.kind,
@@ -445,6 +520,11 @@ class SLOEngine:
             "window_slow_s": o.slow_s,
             "breaches": tr.breaches,
         }
+        if o.kind == "gauge":
+            out["value"] = round(tr.last_value, 6)
+            out["threshold"] = o.threshold_s
+            out["family"] = o.family
+        return out
 
     def state(self) -> dict:
         """Objective name -> full state (GET /cluster/slo's local half

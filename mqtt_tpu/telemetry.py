@@ -729,6 +729,11 @@ class Telemetry:
         # the lock-contention plane (mqtt_tpu.utils.locked.LockPlane)
         # or None; attached via attach_lock_plane()
         self.lock_plane: Any = None
+        # the per-device observability plane (ops/devicestats.
+        # DeviceStatsPlane) or None; attached via attach_device_stats()
+        # — serves GET /devices, $SYS/broker/devices/#, and grows
+        # trigger dumps a ``devices_*.json`` sibling
+        self.device_stats: Any = None
         # cluster-wide SLO observatory (ISSUE 14): the delivery-latency
         # SLI gate (one bool test on the sampled path; Options.slo), the
         # SLO burn-rate engine (mqtt_tpu.slo.SLOEngine) and the mesh
@@ -929,6 +934,14 @@ class Telemetry:
         (mqtt_tpu.profiling.SamplingProfiler): GET /profile serves its
         exports and trigger dumps grow a ``profile_*.txt`` sibling."""
         self.host_profiler = profiler
+
+    def attach_device_stats(self, plane: Any) -> None:
+        """Attach the per-device observability plane
+        (mqtt_tpu.ops.devicestats.DeviceStatsPlane): GET /devices and
+        the $SYS devices tree serve its snapshot, and trigger dumps
+        write a ``devices_*.json`` sibling beside flight/traces/
+        profile."""
+        self.device_stats = plane
 
     def attach_lock_plane(self, plane: Any) -> None:
         """Attach the lock-contention plane
@@ -1154,18 +1167,36 @@ class Telemetry:
         synchronous dump."""
         after = (
             self._dump_siblings
-            if self.tracer is not None or self.host_profiler is not None
+            if self.tracer is not None
+            or self.host_profiler is not None
+            or self.device_stats is not None
             else None
         )
         self.recorder.dump_async(reason, extra, after=after)
 
     def _dump_siblings(self, dump_path: str, reason: str) -> None:
-        """Write the trace ring and the profiler's collapsed stacks
-        beside a just-written flight dump (recorder writer thread)."""
+        """Write the trace ring, the profiler's collapsed stacks, and
+        the device-plane snapshot beside a just-written flight dump
+        (recorder writer thread)."""
         if self.tracer is not None:
             self._dump_traces(dump_path, reason)
         if self.host_profiler is not None:
             self._dump_profile(dump_path, reason)
+        if self.device_stats is not None:
+            self._dump_devices(dump_path, reason)
+
+    def _dump_devices(self, dump_path: str, reason: str) -> None:
+        base = os.path.basename(dump_path)
+        stem = base[len("flight_"):] if base.startswith("flight_") else base
+        name = "devices_" + os.path.splitext(stem)[0] + ".json"
+        path = os.path.join(os.path.dirname(dump_path), name)
+        try:
+            with open(path, "w") as f:
+                json.dump(self.device_stats.snapshot(), f, indent=1)
+        except OSError:
+            _log.exception("device-plane dump failed (path=%s)", path)
+            return
+        _log.warning("device snapshot dumped to %s (reason=%s)", path, reason)
 
     def _dump_profile(self, dump_path: str, reason: str) -> None:
         base = os.path.basename(dump_path)

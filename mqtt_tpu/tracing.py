@@ -282,7 +282,7 @@ class BatchProfile:
 
     __slots__ = (
         "dispatch", "d2h", "d2h_bytes", "d2h_bytes_ranges",
-        "d2h_bytes_dense", "compact", "compact_overflow",
+        "d2h_bytes_dense", "compact", "compact_overflow", "devices",
     )
 
     def __init__(self) -> None:
@@ -304,6 +304,53 @@ class BatchProfile:
         # compact_overflow marks the per-batch padded-path fallback
         self.compact = False
         self.compact_overflow = False
+        # device ids this batch's window ran on, stamped by the matcher
+        # at dispatch (TpuMatcher: the output buffer's device; sharded:
+        # every mesh device). None = unstamped, folds as device 0.
+        self.devices: Optional[tuple] = None
+
+
+# D2H transfer sizes: single compact rows (~tens of bytes) up to the
+# dense padded geometries (tens of MB)
+BYTE_BOUNDS = (
+    256.0, 1024.0, 4096.0, 16384.0, 65536.0, 262144.0,
+    1048576.0, 4194304.0, 16777216.0, 67108864.0,
+)
+
+
+class _DevWindow:
+    """One device's replica of the profiler's busy/overlap/idle fold
+    (ISSUE 18): same arithmetic, keyed by device id, so a single-device
+    run's window 0 is bit-identical to the unlabeled aggregates (the
+    test parity oracle) and a sharded run gets one window per chip."""
+
+    __slots__ = (
+        "first_t", "last_t", "busy_until", "busy_s", "window_s",
+        "overlap_s", "batches", "d2h_bytes_total",
+        "issue_hist", "d2h_hist", "idle_hist", "bytes_hist",
+    )
+
+    def __init__(self) -> None:
+        self.first_t: Optional[float] = None
+        self.last_t = 0.0
+        self.busy_until = 0.0
+        self.busy_s = 0.0
+        self.window_s = 0.0
+        self.overlap_s = 0.0
+        self.batches = 0
+        self.d2h_bytes_total = 0
+        self.issue_hist = Histogram()
+        self.d2h_hist = Histogram()
+        self.idle_hist = Histogram()
+        self.bytes_hist = Histogram(bounds=BYTE_BOUNDS)
+
+    def duty_cycle(self) -> float:
+        if self.first_t is None or self.last_t <= self.first_t:
+            return 0.0
+        return self.busy_s / (self.last_t - self.first_t)
+
+    def overlap_ratio(self) -> float:
+        return self.overlap_s / self.window_s if self.window_s > 0 else 0.0
 
 
 class DeviceProfiler:
@@ -334,6 +381,10 @@ class DeviceProfiler:
 
     def __init__(self, registry: Any = None) -> None:
         self._lock = threading.Lock()
+        self._registry = registry
+        # per-device window replicas (ISSUE 18), keyed by device id;
+        # mutated under _lock, child registration happens outside it
+        self._dev: dict[int, _DevWindow] = {}
         self.batches = 0
         self._first_t: Optional[float] = None
         self._last_t = 0.0
@@ -393,11 +444,58 @@ class DeviceProfiler:
         holds the batch (staging drain loop, bench) reads it."""
         return BatchProfile()
 
+    def ensure_device(self, did: int) -> _DevWindow:
+        """The window replica for one device id, creating it (and its
+        ``device``-labeled metric children) on first sight. Idempotent;
+        registration runs outside the fold lock."""
+        with self._lock:
+            dw = self._dev.get(did)
+        if dw is not None:
+            return dw
+        dw = _DevWindow()
+        with self._lock:
+            have = self._dev.setdefault(did, dw)
+        if have is not dw:
+            return have  # lost the race: the winner registered children
+        reg = self._registry
+        if reg is not None:
+            dev = str(did)
+            reg.histogram(
+                "mqtt_tpu_device_issue_seconds",
+                fn=lambda d=dw: d.issue_hist, device=dev,
+            )
+            reg.histogram(
+                "mqtt_tpu_device_d2h_seconds",
+                fn=lambda d=dw: d.d2h_hist, device=dev,
+            )
+            reg.histogram(
+                "mqtt_tpu_device_idle_gap_seconds",
+                fn=lambda d=dw: d.idle_hist, device=dev,
+            )
+            reg.histogram(
+                "mqtt_tpu_device_d2h_bytes",
+                "Per-batch D2H result bytes attributed to each device "
+                "(even split across a sharded batch's mesh)",
+                bounds=BYTE_BOUNDS,
+                fn=lambda d=dw: d.bytes_hist, device=dev,
+            )
+            reg.gauge(
+                "mqtt_tpu_device_duty_cycle_ratio",
+                fn=lambda d=dw: d.duty_cycle(), device=dev,
+            )
+            reg.gauge(
+                "mqtt_tpu_device_overlap_ratio",
+                fn=lambda d=dw: d.overlap_ratio(), device=dev,
+            )
+        return dw
+
     def note_dispatch(self, rec: BatchProfile, t0: float, t1: float) -> None:
         """One batch issued: tokenize + device dispatch ran [t0, t1];
         the device window opens at t1."""
         rec.dispatch = (t0, t1)
         self.issue_hist.observe(t1 - t0)
+        for did in rec.devices or (0,):
+            self.ensure_device(did).issue_hist.observe(t1 - t0)
 
     def note_resolve(self, rec: BatchProfile, sync_start: float, sync_end: float) -> None:
         """One batch's blocking D2H sync ran [sync_start, sync_end];
@@ -411,6 +509,11 @@ class DeviceProfiler:
         if rec.dispatch is None:
             return  # never dispatched (shouldn't happen): histogram only
         t_disp = rec.dispatch[1]
+        devs = rec.devices or (0,)
+        windows = [self.ensure_device(d) for d in devs]
+        # transfer bytes attribute evenly across a sharded batch's mesh
+        # (each chip moved ~1/n of the result) — exact for one device
+        per_dev_bytes = getattr(rec, "d2h_bytes", 0) // len(devs)
         with self._lock:
             if getattr(rec, "d2h_bytes", 0):
                 self._bytes_batches += 1
@@ -436,6 +539,26 @@ class DeviceProfiler:
                 self._overlap_s += max(0.0, min(self._busy_until, end) - t_disp)
                 self._busy_s += max(0.0, end - self._busy_until)
             self._busy_until = max(self._busy_until, end)
+            # the same fold, replicated per participating device: a
+            # single-device run's window 0 tracks the aggregates exactly
+            for dw in windows:
+                dw.batches += 1
+                dw.d2h_hist.observe(sync_end - sync_start)
+                if per_dev_bytes:
+                    dw.bytes_hist.observe(per_dev_bytes)
+                    dw.d2h_bytes_total += per_dev_bytes
+                if dw.first_t is None:
+                    dw.first_t = t_disp
+                dw.last_t = max(dw.last_t, end)
+                dw.window_s += end - t_disp
+                if t_disp >= dw.busy_until:
+                    if dw.busy_until > 0.0:
+                        dw.idle_hist.observe(t_disp - dw.busy_until)
+                    dw.busy_s += end - t_disp
+                else:
+                    dw.overlap_s += max(0.0, min(dw.busy_until, end) - t_disp)
+                    dw.busy_s += max(0.0, end - dw.busy_until)
+                dw.busy_until = max(dw.busy_until, end)
 
     # -- aggregates ---------------------------------------------------------
 
@@ -448,6 +571,27 @@ class DeviceProfiler:
     def overlap_ratio(self) -> float:
         with self._lock:
             return self._overlap_s / self._window_s if self._window_s > 0 else 0.0
+
+    def device_snapshot(self) -> dict:
+        """Per-device window aggregates keyed by device id — what
+        DeviceStatsPlane.snapshot() merges into the /devices body."""
+        out: dict[int, dict] = {}
+        with self._lock:
+            for did, dw in sorted(self._dev.items()):
+                out[did] = {
+                    "duty_cycle": round(dw.duty_cycle(), 4),
+                    "overlap_ratio": round(dw.overlap_ratio(), 4),
+                    "batches": dw.batches,
+                    "d2h_bytes_total": dw.d2h_bytes_total,
+                    "issue_p99_ms": round(
+                        dw.issue_hist.percentile(0.99) * 1e3, 3
+                    ),
+                    "d2h_p99_ms": round(dw.d2h_hist.percentile(0.99) * 1e3, 3),
+                    "idle_gap_p99_ms": round(
+                        dw.idle_hist.percentile(0.99) * 1e3, 3
+                    ),
+                }
+        return out
 
     def bench_block(self) -> dict:
         """The BENCH-json device-pipeline block (configs 2 and 8): the

@@ -78,6 +78,7 @@ LOCK_NAMES = (
     "metrics_registry",
     "flight_ring",
     "trace_ring",
+    "device_stats",
     "overload_governor",
     "overload_peer_pressure",
     "matcher_breaker",

@@ -519,12 +519,16 @@ class TestSysTopics:
             assert "$SYS/broker/overload/state" in topics
             assert "$SYS/broker/telemetry/flight/ring_depth" in topics
             assert "$SYS/broker/predicates/rules" in topics
+            if h.server.device_stats is not None:
+                assert "$SYS/broker/devices/skew_ratio" in topics
             base = {
                 t
                 for t in topics
                 if not t.startswith("$SYS/broker/overload/")
                 and not t.startswith("$SYS/broker/telemetry/")
                 and not t.startswith("$SYS/broker/predicates/")
+                # device observatory rows scale with the device count
+                and not t.startswith("$SYS/broker/devices/")
             }
             assert len(base) == 20
             await h.shutdown()

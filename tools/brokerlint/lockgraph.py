@@ -99,9 +99,13 @@ LOCK_ORDER = (
     # is anonymous and ordered before it by construction)
     "durable_store",
     # observability rings/registries last: leaf locks that must never
-    # call back out into the planes above
+    # call back out into the planes above; the compile-event ledger's
+    # device_stats lock sits before the metrics registry (it never
+    # registers children while held, but a future edge in that
+    # direction is the legal one)
     "flight_ring",
     "trace_ring",
+    "device_stats",
     "metrics_registry",
     # the shard router's dispatch counter lock (mqtt_tpu.shards): a pure
     # leaf — nothing is ever acquired under it
