@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster scrape-devices
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph loopgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster scrape-devices
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -28,6 +28,12 @@ typecheck:
 # `dot -Tsvg exp/artifacts/lockgraph.dot` when graphviz is installed
 lockgraph:
 	$(PY) -m tools.brokerlint mqtt_tpu --lock-graph exp/artifacts
+
+# extract the loop-affinity model (brokerlint R10-R15: loop-owned kinds,
+# owner-attach sites, blessed marshal seams) and write
+# exp/artifacts/loopgraph.{dot,json}
+loopgraph:
+	$(PY) -m tools.brokerlint mqtt_tpu --loop-graph exp/artifacts
 
 # gcc -fanalyzer (+ cppcheck when installed) over the native C sources
 c-gate:

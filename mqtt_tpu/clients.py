@@ -33,6 +33,7 @@ from .packets import (
 )
 from .topics import OutboundTopicAliases, Subscriptions, TopicAliases
 from .utils import LockedMap
+from .utils.loopwitness import DEFAULT_LOOP_PLANE as _LOOP_PLANE
 from .utils.mempool import get_buffer, put_buffer
 
 DEFAULT_KEEPALIVE = 10  # default connection keepalive seconds (clients.go:25)
@@ -60,7 +61,7 @@ class OutboundQueue:
     enqueue/dequeue and keep identical semantics.
     """
 
-    __slots__ = ("maxsize", "_items", "_lock", "_waiter")
+    __slots__ = ("maxsize", "_items", "_lock", "_waiter", "_witness_loop")
 
     def __init__(self, maxsize: int = 0) -> None:
         self.maxsize = maxsize
@@ -69,6 +70,9 @@ class OutboundQueue:
         # the single consumer's parked (loop, future), or None; the
         # write loop is the only get() caller, so one slot suffices
         self._waiter: Optional[tuple] = None
+        # owning-loop identity stamped by the first witnessed get()
+        # (mqtt_tpu.utils.loopwitness); None while unobserved/disarmed
+        self._witness_loop: Optional[asyncio.AbstractEventLoop] = None
 
     def qsize(self) -> int:
         return len(self._items)
@@ -87,6 +91,14 @@ class OutboundQueue:
     def put_nowait(self, item: Any) -> None:
         """Enqueue from ANY thread; raises ``asyncio.QueueFull`` past
         the bound (the drop-on-slow-consumer contract is unchanged)."""
+        plane = _LOOP_PLANE
+        if plane.active:
+            w = plane.witness
+            if w is not None:
+                w.note_crossing(
+                    "outbound_queue", "put_local", "put_cross",
+                    self._witness_loop,
+                )
         wake = None
         with self._lock:
             if 0 < self.maxsize <= len(self._items):
@@ -110,6 +122,15 @@ class OutboundQueue:
 
     async def get(self) -> Any:
         """Dequeue (single consumer: the client's write loop)."""
+        plane = _LOOP_PLANE
+        if plane.active:
+            w = plane.witness
+            if w is not None:
+                if self._witness_loop is None:
+                    self._witness_loop = asyncio.get_running_loop()
+                w.check_owner(
+                    "outbound_queue", "get_owner", self._witness_loop
+                )
         while True:
             with self._lock:
                 if self._items:

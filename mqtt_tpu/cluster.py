@@ -123,6 +123,7 @@ from .topics import (
     ns_tenant,
     summary_base,
 )
+from .utils.loopwitness import DEFAULT_LOOP_PLANE as _LOOP_PLANE
 
 _log = logging.getLogger("mqtt_tpu.cluster")
 
@@ -710,6 +711,7 @@ class Cluster:
         else:
             path = self._sock_path(self.worker_id)
             try:
+                # brokerlint: ok=R11 one-time stale-socket unlink before bind; start() runs before any frame flows on this loop
                 os.unlink(path)
             except FileNotFoundError:
                 pass
@@ -747,6 +749,7 @@ class Cluster:
             self._unix_server.close()
         if self.transport != "tcp":
             try:
+                # brokerlint: ok=R11 teardown-path unlink after the server is closed; nothing on this loop still serves
                 os.unlink(self._sock_path(self.worker_id))
             except OSError:
                 pass
@@ -2368,7 +2371,15 @@ class Cluster:
             running = asyncio.get_running_loop()
         except RuntimeError:
             running = None
-        if loop is None or running is loop:
+        local = loop is None or running is loop
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                w.note(
+                    "cluster_writer",
+                    "dispatch_local" if local else "dispatch_cross",
+                )
+        if local:
             fn()
         else:
             try:

@@ -43,7 +43,7 @@ def _to_logger(level: str) -> logging.Logger:
     return logger
 
 
-def _capabilities_from(d: dict) -> Capabilities:
+def _capabilities_from(d: dict[str, Any]) -> Capabilities:
     caps = Capabilities()
     compat = d.pop("compatibilities", None)
     for k, v in d.items():
@@ -56,7 +56,7 @@ def _capabilities_from(d: dict) -> Capabilities:
     return caps
 
 
-def _hooks_from(d: dict) -> list[tuple[Any, Any]]:
+def _hooks_from(d: dict[str, Any]) -> list[tuple[Any, Any]]:
     """Instantiate built-in hooks from their config sections
     (config.go:71-145)."""
     hooks: list[tuple[Any, Any]] = []

@@ -67,7 +67,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Callable, Optional
+from typing import Any, Callable, Iterable, Optional
 
 from .packets import Subscription
 
@@ -134,7 +134,7 @@ class FaultyMatcher:
     so it interposes transparently under the degradation manager
     (``ResilientMatcher.inner``) or directly under the staging loop."""
 
-    def __init__(self, inner, plan: FaultPlan) -> None:
+    def __init__(self, inner: Any, plan: FaultPlan) -> None:
         self.inner = inner
         self.plan = plan
         self.dispatches = 0
@@ -144,7 +144,7 @@ class FaultyMatcher:
         # release it at teardown so abandoned guard threads retire
         self.release = threading.Event()
 
-    def __getattr__(self, name):
+    def __getattr__(self, name: str) -> Any:
         if name == "inner":
             raise AttributeError(name)
         return getattr(self.inner, name)
@@ -153,7 +153,9 @@ class FaultyMatcher:
         with self._lock:
             self.injected[kind] = self.injected.get(kind, 0) + 1
 
-    def match_topics_async(self, topics: list[str], profile=None):
+    def match_topics_async(
+        self, topics: list[str], profile: Optional[Any] = None
+    ) -> Callable[[], Any]:
         with self._lock:
             i = self.dispatches
             self.dispatches += 1
@@ -208,7 +210,7 @@ class FaultyMatcher:
 
         return corrupting
 
-    def match_topics(self, topics: list[str]):
+    def match_topics(self, topics: list[str]) -> Any:
         return self.match_topics_async(topics)()
 
 
@@ -256,7 +258,7 @@ class StorageCrashPlan:
         if self.crash_point and self.crash_point not in STORAGE_CRASH_POINTS:
             raise ValueError(f"unknown crash point: {self.crash_point}")
 
-    def append_record(self, store, rec: bytes) -> None:
+    def append_record(self, store: Any, rec: bytes) -> None:
         from .hooks.storage.logkv import SimulatedCrash
 
         i = self.appends_seen
@@ -270,7 +272,7 @@ class StorageCrashPlan:
             store._file.flush()
         raise SimulatedCrash(f"injected kill at append {i} (torn={self.torn})")
 
-    def reach(self, point: str, store) -> None:
+    def reach(self, point: str, store: Any) -> None:
         from .hooks.storage.logkv import SimulatedCrash
 
         n = self.points_seen.get(point, 0) + 1
@@ -279,7 +281,7 @@ class StorageCrashPlan:
             raise SimulatedCrash(f"injected kill at {point} (hit {n})")
 
 
-def lose_unsynced(store) -> int:
+def lose_unsynced(store: Any) -> int:
     """Power-loss page-cache loss: truncate the ACTIVE segment back to
     its last-fsync watermark (``synced_bytes``), as a kernel that never
     flushed would. Returns the number of bytes lost. Under the
@@ -375,7 +377,7 @@ class StormPlan:
 
 
 async def drive_storm(
-    writers,
+    writers: Iterable[Any],
     plan: StormPlan,
     burst: int = 16,
     pause_s: float = 0.0,
@@ -434,7 +436,7 @@ async def drive_storm(
 # -- worker-mesh faults ------------------------------------------------------
 
 
-def sever_peer_link(cluster, peer: int) -> bool:
+def sever_peer_link(cluster: Any, peer: int) -> bool:
     """Abort the live link to ``peer`` (connection-reset mid-traffic, as
     a crashed worker or yanked cable would). Returns False when no link
     is up. The surviving side must withdraw the peer's presence and the
@@ -446,7 +448,7 @@ def sever_peer_link(cluster, peer: int) -> bool:
     return True
 
 
-def asymmetric_partition(cluster, peer: int) -> Callable[[], None]:
+def asymmetric_partition(cluster: Any, peer: int) -> Callable[[], None]:
     """An ASYMMETRIC partition of one link: ``cluster`` silently loses
     everything ``peer`` sends it (pongs included) while its own writes
     keep succeeding — the lost-return-path failure a dead switch port or
@@ -457,7 +459,7 @@ def asymmetric_partition(cluster, peer: int) -> Callable[[], None]:
     return partition_peers(cluster, {peer})
 
 
-def lose_gossip(cluster, rate: float, seed: int = 0) -> Callable[[], None]:
+def lose_gossip(cluster: Any, rate: float, seed: int = 0) -> Callable[[], None]:
     """Seeded gossip loss: ``cluster`` drops each inbound pressure-gossip
     frame with probability ``rate`` (deterministic from the seed), while
     data/presence/ping traffic flows untouched — the degraded-telemetry
@@ -509,7 +511,7 @@ class FlapPlan:
     partition_hold_s: float = 2.0
 
 
-async def drive_link_flaps(cluster, plan: FlapPlan) -> int:
+async def drive_link_flaps(cluster: Any, plan: FlapPlan) -> int:
     """Run one worker's flap schedule to completion; returns the number
     of links actually disturbed. Draws are deterministic from the seed;
     which PEER each draw lands on depends on the live link set at that
@@ -570,7 +572,7 @@ async def _asyncio_sleep(s: float) -> None:
     await asyncio.sleep(s)
 
 
-def partition_peers(cluster, peers) -> Callable[[], None]:
+def partition_peers(cluster: Any, peers: Iterable[int]) -> Callable[[], None]:
     """Partition ``cluster`` from a SET of peers at once — the
     subtree-cut shape: every inbound frame from any of them is lost
     (pongs included) while writes keep succeeding, so the per-edge
@@ -628,7 +630,7 @@ class LinkShape:
 
 
 def shape_cluster_links(
-    cluster, shape: LinkShape, peers=None
+    cluster: Any, shape: LinkShape, peers: Optional[Iterable[int]] = None
 ) -> Callable[[], None]:
     """Install ``shape`` on ``cluster``'s INBOUND links from ``peers``
     (every peer when None) — the cross-"machine" half of a drill splits
@@ -726,7 +728,7 @@ def shape_cluster_links(
     return release
 
 
-def stall_peer_reads(cluster) -> Callable[[], None]:
+def stall_peer_reads(cluster: Any) -> Callable[[], None]:
     """Gate ``cluster``'s mesh reads shut: frames from every peer queue
     in the socket until the returned release() is called, so the peers'
     write buffers climb toward MAX_PEER_BUFFER (the backpressure-drop /

@@ -31,7 +31,7 @@ class Inflight:
             self.internal[m.packet_id] = m
             return not existed
 
-    def set_bulk(self, packets: list) -> int:
+    def set_bulk(self, packets: list[Packet]) -> int:
         """Batched :meth:`set` for durable-session restore
         (staging.bulk_inflight): one lock acquisition per chunk instead
         of one per packet. Returns how many ids were new."""

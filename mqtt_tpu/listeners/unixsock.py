@@ -38,7 +38,8 @@ class UnixSock(StreamListener):
     async def init(self, log: logging.Logger) -> None:
         self.log = log
         try:
-            os.unlink(self.config.address)  # remove stale socket (unixsock.go:58)
+            # brokerlint: ok=R11 one-time stale-socket removal during init, before the listener accepts (unixsock.go:58)
+            os.unlink(self.config.address)
         except FileNotFoundError:
             pass
         if self._fabric is not None:
@@ -51,6 +52,7 @@ class UnixSock(StreamListener):
     async def close(self, close_clients: Callable[[str], None]) -> None:
         await super().close(close_clients)
         try:
+            # brokerlint: ok=R11 teardown-path unlink after clients are closed; the listener no longer serves
             os.unlink(self.config.address)
         except OSError:
             pass

@@ -83,13 +83,16 @@ def _build(so: str) -> bool:
             continue
         # build to a temp file then atomically rename, so concurrent
         # processes never load a half-written library
+        # brokerlint: ok=R14 single-flight first-call build: the lock exists to serialize this compile; never on a frame path
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
         try:
             cmd = [cc, "-O3", "-shared", "-fPIC", *_extra_cflags(),
                    "-o", tmp, _SRC]
+            # brokerlint: ok=R14 the compile is the whole point of the lock (single-flight build)
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                # brokerlint: ok=R14 atomic publish of the built library, still under the single-flight build lock
                 os.replace(tmp, so)
                 return True
             _log.debug("native build with %s failed: %s", cc, r.stderr.decode())
@@ -97,6 +100,7 @@ def _build(so: str) -> bool:
             _log.debug("native build with %s failed: %s", cc, e)
         finally:
             if os.path.exists(tmp):
+                # brokerlint: ok=R14 temp-file cleanup on the single-flight build path
                 os.unlink(tmp)
     return False
 
@@ -201,13 +205,16 @@ def _build_accel(so: str) -> bool:
     for cc in (os.environ.get("CC"), "cc", "gcc", "clang"):
         if not cc:
             continue
+        # brokerlint: ok=R14 single-flight first-call build: the lock exists to serialize this compile; never on a frame path
         fd, tmp = tempfile.mkstemp(suffix=".so", dir=_HERE)
         os.close(fd)
         try:
             cmd = [cc, "-O3", "-shared", "-fPIC", *_extra_cflags(),
                    f"-I{include}", "-o", tmp, _ACCEL_SRC]
+            # brokerlint: ok=R14 the compile is the whole point of the lock (single-flight build)
             r = subprocess.run(cmd, capture_output=True, timeout=120)
             if r.returncode == 0:
+                # brokerlint: ok=R14 atomic publish of the built library, still under the single-flight build lock
                 os.replace(tmp, so)
                 return True
             _log.debug("accel build with %s failed: %s", cc, r.stderr.decode())
@@ -215,6 +222,7 @@ def _build_accel(so: str) -> bool:
             _log.debug("accel build with %s failed: %s", cc, e)
         finally:
             if os.path.exists(tmp):
+                # brokerlint: ok=R14 temp-file cleanup on the single-flight build path
                 os.unlink(tmp)
     return False
 

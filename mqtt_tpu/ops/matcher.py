@@ -702,7 +702,8 @@ class TpuMatcher:
 
             def resolve() -> list[Subscribers]:
                 t_sync0 = time.perf_counter() if prof is not None else 0.0
-                packed = np.asarray(out_dev)  # ONE D2H: [B, 2P+2]
+                # brokerlint: ok=R15 the blessed resolve seam: ONE batched D2H after copy_to_host_async, [B, 2P+2]
+                packed = np.asarray(out_dev)
                 if prof is not None:
                     # the blocking D2H sync just completed: close the
                     # device window (kernel + transfer) on this record
@@ -728,7 +729,8 @@ class TpuMatcher:
 
         def resolve_compact() -> list[Subscribers]:
             t_sync0 = time.perf_counter() if prof is not None else 0.0
-            out = np.asarray(out_dev)  # ONE D2H: [2 + 2B + 2K] ints
+            # brokerlint: ok=R15 the blessed resolve seam: ONE batched D2H after copy_to_host_async, [2 + 2B + 2K] ints
+            out = np.asarray(out_dev)
             bp = len(padded)
             n_hits = int(out[0])
             batch_ovf = bool(out[1])

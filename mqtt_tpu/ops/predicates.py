@@ -225,6 +225,7 @@ class DeviceRuleEvaluator:
             pass
 
         def resolve() -> np.ndarray:
+            # brokerlint: ok=R15 the blessed resolve seam: ONE batched D2H after copy_to_host_async
             return np.asarray(rows_dev)[:B]
 
         return resolve

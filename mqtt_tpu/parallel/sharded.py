@@ -764,8 +764,10 @@ class ShardedTpuMatcher:
         bytes_padded = self.n_shards * bp * self.out_slots * 4
 
         def resolve_full(t_sync0: float) -> list[Subscribers]:
-            out = np.asarray(out_dev)  # [S, B, K]
-            overflow = np.asarray(overflow_dev).any(axis=0) | len_overflow  # [B]
+            # brokerlint: ok=R15 the blessed resolve seam: one D2H per array after copy_to_host_async, [S, B, K]
+            out = np.asarray(out_dev)
+            # brokerlint: ok=R15 same resolve seam, the [B] overflow mask rides the batched readback
+            overflow = np.asarray(overflow_dev).any(axis=0) | len_overflow
             self.stats.d2h_bytes += int(out.nbytes)
             if prof is not None:
                 rec.d2h_bytes += int(out.nbytes)
@@ -802,6 +804,7 @@ class ShardedTpuMatcher:
             t_sync0 = time.perf_counter() if prof is not None else 0.0
             # [n_batch, 2 + 2*bl + 2*cap_local]: one compacted row per
             # batch tile (shard_map over the batch axis)
+            # brokerlint: ok=R15 the blessed resolve seam: ONE compacted-row D2H after copy_to_host_async
             rows = np.asarray(compact_dev)
             stats = self.stats
             stats.batches += 1

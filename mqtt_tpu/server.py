@@ -88,6 +88,7 @@ from .packets import (
 )
 from .system import Info
 from .utils.mempool import get_buffer, put_buffer
+from .utils.loopwitness import DEFAULT_LOOP_PLANE as _LOOP_PLANE
 from .utils.proc import rss_bytes
 from .topics import (
     NS_CHAR,
@@ -2677,7 +2678,12 @@ class Server:
         result = self.process_packet(cl, pk)
         if asyncio.iscoroutine(result):
             try:
-                asyncio.get_running_loop().create_task(result)
+                # found by brokerlint R13: the fan-out task was
+                # fire-and-forget, so asyncio's weak reference was the
+                # only thing keeping it alive mid-flight
+                task = asyncio.get_running_loop().create_task(result)
+                self.listeners.client_tasks.add(task)
+                task.add_done_callback(self.listeners.client_tasks.discard)
             except RuntimeError:
                 asyncio.run(result)
         self.info.packets_received += 1
@@ -4186,6 +4192,15 @@ class Server:
         account: bool,
     ) -> None:
         """The owner-shard half of a marshaled delivery."""
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                # call_soon_threadsafe landed us on the owner's loop;
+                # anything else is a marshal-routing bug
+                w.check_owner(
+                    "client_state", "deliver_marshal", cl.net.loop,
+                    detail=cl.id,
+                )
         try:
             self.publish_to_client(cl, sub, pk, fast)
         except Exception as e:
@@ -4213,6 +4228,20 @@ class Server:
         if sub.no_local and pk.origin == cl.id:
             return pk  # [MQTT-3.8.3-3]
 
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                eff = pk.fixed_header.qos
+                if eff > sub.qos:
+                    eff = sub.qos
+                if eff > 0 or cl.properties.props.topic_alias_maximum > 0:
+                    # this delivery mutates loop-affine per-client state
+                    # (packet ids / inflight / outbound aliases): the
+                    # _deliver_to_client contract marshals it here
+                    w.check_owner(
+                        "client_state", "owner_touch", cl.net.loop,
+                        detail=cl.id,
+                    )
         topic = pk.topic_name
         if topic[:1] == NS_CHAR:
             topic = ns_local(topic)

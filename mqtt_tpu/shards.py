@@ -50,6 +50,7 @@ import threading
 from typing import Any, Awaitable, Callable, Optional
 
 from .utils.locked import InstrumentedLock
+from .utils.loopwitness import DEFAULT_LOOP_PLANE as _LOOP_PLANE
 
 _log = logging.getLogger("mqtt_tpu.shards")
 
@@ -125,6 +126,12 @@ class LoopShard:
                 _log.exception("shard %d eviction sweep failed", self.index)
 
     def track(self, task: asyncio.Task) -> None:
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                # tracking mutates the shard-owned task set: legal only
+                # on this shard's loop (dispatch marshals _go here)
+                w.check_owner("shard_task", "tracked", self.loop)
         self.tasks.add(task)
         task.add_done_callback(self.tasks.discard)
 

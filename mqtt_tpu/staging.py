@@ -53,6 +53,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Optional
 
 from .topics import Subscribers
+from .utils.loopwitness import DEFAULT_LOOP_PLANE as _LOOP_PLANE
 
 _log = logging.getLogger("mqtt_tpu.staging")
 
@@ -286,6 +287,12 @@ class MatchStage:
         growing the backlog."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                w.note_crossing(
+                    "match_stage", "submit_local", "submit_cross", self._loop
+                )
         wake = self._wake
         if self._stopping or wake is None:
             fut.set_result(self.host_fallback(topic))
@@ -368,6 +375,11 @@ class MatchStage:
     async def _collect_loop(self) -> None:
         wake, queue = self._wake, self._queue
         assert wake is not None and queue is not None  # start() created us
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                # the collector IS the stage loop's drainer of _pending
+                w.check_owner("match_stage", "drain_owner", self._loop)
         while True:
             await wake.wait()
             wake.clear()
@@ -580,7 +592,15 @@ class MatchStage:
         be scheduled cross-thread. Stage-loop futures resolve inline
         (the single-loop path, unchanged)."""
         loop = fut.get_loop()
-        if self._loop is None or loop is self._loop:
+        local = self._loop is None or loop is self._loop
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                w.note(
+                    "match_stage",
+                    "resolve_local" if local else "resolve_marshal",
+                )
+        if local:
             if not fut.done():
                 fut.set_result(value)
             return
@@ -588,6 +608,35 @@ class MatchStage:
         def _set() -> None:
             if not fut.done():
                 fut.set_result(value)
+
+        try:
+            loop.call_soon_threadsafe(_set)
+        except RuntimeError:
+            pass  # submitter's loop closed; nobody is awaiting
+
+    def _reject(self, fut: "asyncio.Future", exc: BaseException) -> None:
+        """The exception leg of :meth:`_resolve`: fail a caller future
+        ON ITS OWN LOOP. Found by brokerlint R12 — the old inline
+        ``fut.set_exception`` from ``_fallback_all`` ran the waiter's
+        done-callbacks on the stage's thread when the future was parked
+        by a shard-loop submitter."""
+        loop = fut.get_loop()
+        local = self._loop is None or loop is self._loop
+        if _LOOP_PLANE.active:
+            w = _LOOP_PLANE.witness
+            if w is not None:
+                w.note(
+                    "match_stage",
+                    "resolve_local" if local else "resolve_marshal",
+                )
+        if local:
+            if not fut.done():
+                fut.set_exception(exc)
+            return
+
+        def _set() -> None:
+            if not fut.done():
+                fut.set_exception(exc)
 
         try:
             loop.call_soon_threadsafe(_set)
@@ -607,8 +656,7 @@ class MatchStage:
             try:
                 self._resolve(fut, self.host_fallback(topic))
             except Exception as e:  # pragma: no cover - host walk is total
-                if not fut.done():
-                    fut.set_exception(e)
+                self._reject(fut, e)
         if n and self.telemetry is not None:
             self.telemetry.note_fallback(klass, n)
 

@@ -650,7 +650,13 @@ class RecryptEngine:
         for job, rows in resolved:
             job.keystream = rows
 
-    def _maybe_oracle(self, table, kidx, counters, rows) -> None:
+    def _maybe_oracle(
+        self,
+        table: np.ndarray,
+        kidx: np.ndarray,
+        counters: np.ndarray,
+        rows: np.ndarray,
+    ) -> None:
         """The sampled differential: 1-in-N device dispatches re-derive
         the whole batch on the vectorized host path and compare
         bit-for-bit. AES is deterministic, so the tolerance is zero; a
@@ -679,7 +685,9 @@ class RecryptEngine:
 
     # -- apply (fan-out path) ----------------------------------------------
 
-    def _host_keystream_for(self, key_id: int, nonce: bytes, n_blocks: int):
+    def _host_keystream_for(
+        self, key_id: int, nonce: bytes, n_blocks: int
+    ) -> np.ndarray:
         from .ops.recrypt import ctr_counters, host_keystream
 
         table = self.keys.table()
@@ -692,7 +700,11 @@ class RecryptEngine:
         )
 
     def open_publish(
-        self, tenant: Tenant, idents: tuple, payload: bytes, job=None
+        self,
+        tenant: Tenant,
+        idents: tuple,
+        payload: bytes,
+        job: Optional[RecryptJob] = None,
     ) -> Optional[bytes]:
         """The publish's plaintext, from the staged job's attached
         keystream when the batch rode the device, else the host path.
@@ -711,7 +723,7 @@ class RecryptEngine:
 
     def seal_fanout_raw(
         self, tenant: Tenant, plaintext: bytes, targets: list
-    ):
+    ) -> tuple:
         """The batched keystream half of :meth:`seal_fanout`: ONE
         keystream generation for every keyed target (device when the
         batch is worth a dispatch and the breaker admits it; vectorized
@@ -812,7 +824,9 @@ class RecryptEngine:
 
     # -- client-side helpers (tests, embedders, bench) ---------------------
 
-    def seal_with_key(self, key: bytes, plaintext: bytes, nonce=None) -> bytes:
+    def seal_with_key(
+        self, key: bytes, plaintext: bytes, nonce: Optional[bytes] = None
+    ) -> bytes:
         """Encrypt ``plaintext`` under a raw key — what a publishing
         CLIENT does before the wire (and what tests use to fabricate
         encrypted publishes)."""
@@ -873,7 +887,7 @@ class RecryptEngine:
             "breaker_state": self.breaker.state,
         }
 
-    def _register_metrics(self, registry) -> None:
+    def _register_metrics(self, registry: Any) -> None:
         registry.gauge(
             "mqtt_tpu_recrypt_keys",
             "Registered per-(tenant, identity) AES keys",

@@ -31,3 +31,14 @@ jax.config.update("jax_platforms", "cpu")
 from mqtt_tpu.utils.locked import DEFAULT_PLANE  # noqa: E402
 
 DEFAULT_PLANE.arm_witness()
+
+# Loop-affinity witness (ISSUE 19): same contract as the lock witness —
+# recording (non-raising) for the whole session, so every instrumented
+# affinity seam any test traverses feeds the process-wide (kind, seam)
+# set. tests/test_zz_loopwitness.py asserts observed ⊆ the blessed
+# LOOP_AFFINITY table (tools/brokerlint/loopgraph.py) and that zero
+# guarded touches ran off their owning loop. Disarmed cost at every
+# touch point: one plane-flag read + branch (bench cfg 8).
+from mqtt_tpu.utils.loopwitness import DEFAULT_LOOP_PLANE  # noqa: E402
+
+DEFAULT_LOOP_PLANE.arm_witness()

@@ -1010,6 +1010,535 @@ def test_lock_graph_export_artifacts(tmp_path):
     assert all(e["sites"] for e in data["edges"])
 
 
+def test_loop_graph_export_artifacts(tmp_path):
+    """--loop-graph writes Graphviz + JSON artifacts beside the lock
+    graph; the JSON carries the blessed affinity table, the discovered
+    owner-attach sites, and the live (evidence-backed) seams."""
+    out = tmp_path / "artifacts"
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.brokerlint", "mqtt_tpu",
+         "--loop-graph", str(out)],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    dot = (out / "loopgraph.dot").read_text()
+    assert dot.startswith("digraph loopaffinity")
+    data = json.loads((out / "loopgraph.json").read_text())
+    from tools.brokerlint.loopgraph import LOOP_AFFINITY
+
+    assert data["affinity"] == [list(p) for p in LOOP_AFFINITY]
+    # the live tree must supply owner-attach evidence for the core kinds
+    assert {"outbound_queue", "match_stage", "shard_task"} <= set(
+        data["owners"]
+    )
+    for sites in data["owners"].values():
+        assert all(s["path"] and s["line"] > 0 for s in sites)
+    # every live seam is a blessed pair (the zz gate's static half)
+    seams = {tuple(p) for p in data["seams"]}
+    assert seams <= set(LOOP_AFFINITY)
+    assert ("outbound_queue", "put_local") in seams
+
+
+# -- R10: foreign-thread mutation of loop-affine objects ---------------------
+
+
+def test_r10_fires_on_thread_reachable_event_set(tmp_path):
+    # the generalized R2 shape: an asyncio.Event owned by a shard loop
+    # set() directly from a worker thread (the pre-fix delta-poller bug
+    # class) instead of via call_soon_threadsafe
+    fired, findings = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Poller:
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self._stopped.set()
+        """,
+        ["R10"],
+    )
+    assert fired == ["R10"]
+    assert "call_soon_threadsafe" in findings[0].msg
+
+
+def test_r10_quiet_on_threading_event(tmp_path):
+    # the delta.py/resilience.py real shape: the event IS a
+    # threading.Event, thread-safe by construction — foreign set() is
+    # the intended use
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Poller:
+            def __init__(self):
+                self._stopped = threading.Event()
+
+            def start(self):
+                self._t = threading.Thread(target=self._run, daemon=True)
+                self._t.start()
+
+            def _run(self):
+                self._stopped.set()
+        """,
+        ["R10"],
+    )
+    assert fired == []
+
+
+def test_r10_quiet_without_thread_entry(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        class Loop:
+            def stop(self):
+                self._stopped.set()
+        """,
+        ["R10"],
+    )
+    assert fired == []
+
+
+def test_r10_fires_on_writer_close_and_task_cancel_from_thread(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Reaper:
+            def start(self):
+                threading.Thread(target=self._reap).start()
+
+            def _reap(self):
+                self._writer.close()
+                self._tick_task.cancel()
+        """,
+        ["R10"],
+    )
+    assert fired == ["R10", "R10"]
+
+
+# -- R11: blocking calls in async bodies / loop callbacks --------------------
+
+
+def test_r11_fires_on_sleep_in_async_def(tmp_path):
+    fired, findings = lint_snippet(
+        tmp_path,
+        """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+        """,
+        ["R11"],
+    )
+    assert fired == ["R11"]
+    assert "stalls" in findings[0].msg
+
+
+def test_r11_fires_on_untimed_acquire_in_loop_callback(tmp_path):
+    # the sync body runs ON the loop because it is scheduled with
+    # call_soon — async-context rules apply to it too
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def submit(self, loop):
+                loop.call_soon_threadsafe(self._drain)
+
+            def _drain(self):
+                self._lock.acquire()
+        """,
+        ["R11"],
+    )
+    assert fired == ["R11"]
+
+
+def test_r11_fires_on_storage_append_in_async_def(tmp_path):
+    # storage hooks hit the durability path (fsync under
+    # durability_fsync=always): never inline on a loop
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        async def persist(self, rec):
+            self._store.append(rec)
+        """,
+        ["R11"],
+    )
+    assert fired == ["R11"]
+
+
+def test_r11_quiet_on_bounded_acquire_and_executor(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class Stage:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def submit(self, loop):
+                loop.call_soon_threadsafe(self._drain)
+
+            def _drain(self):
+                if self._lock.acquire(timeout=0.5):
+                    self._lock.release()
+
+        async def persist(loop, store, rec):
+            await loop.run_in_executor(None, store.append, rec)
+        """,
+        ["R11"],
+    )
+    assert fired == []
+
+
+# -- R12: future resolution loop discipline ----------------------------------
+
+
+def test_r12_fires_on_unguarded_set_exception(tmp_path):
+    # the staging._fallback_all defect this rule found live (PR 19):
+    # rejecting parked futures inline on the stage's thread runs their
+    # done-callbacks cross-loop
+    fired, findings = lint_snippet(
+        tmp_path,
+        """
+        class Stage:
+            def _fallback_all(self, exc):
+                for fut in self._pending:
+                    fut.set_exception(exc)
+        """,
+        ["R12"],
+    )
+    assert fired == ["R12"]
+    assert "marshal" in findings[0].msg
+
+
+def test_r12_quiet_under_loop_identity_guard(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        class Stage:
+            def _resolve(self, fut, val):
+                if fut.get_loop() is asyncio.get_running_loop():
+                    fut.set_result(val)
+                else:
+                    fut.get_loop().call_soon_threadsafe(fut.set_result, val)
+        """,
+        ["R12"],
+    )
+    assert fired == []
+
+
+def test_r12_quiet_when_resolver_is_itself_marshaled(tmp_path):
+    # the resolver body IS the marshal seam: it only ever runs on the
+    # target loop because every reference to it rides call_soon*
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        class Stage:
+            def _resolve(self, loop):
+                loop.call_soon_threadsafe(self._finish)
+
+            def _finish(self):
+                self.fut.set_result(1)
+        """,
+        ["R12"],
+    )
+    assert fired == []
+
+
+def test_r12_quiet_on_locally_created_future(tmp_path):
+    # same-scope create_future + resolve never crosses a loop
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        class Stage:
+            def park(self, loop):
+                fut = loop.create_future()
+                fut.set_result(1)
+                return fut
+        """,
+        ["R12"],
+    )
+    assert fired == []
+
+
+# -- R13: spawned tasks must be tracked --------------------------------------
+
+
+def test_r13_fires_on_fire_and_forget_create_task(tmp_path):
+    # the server.inject_packet defect this rule found live (PR 19): the
+    # bridged fan-out task held no reference and could be GC'd mid-flight
+    fired, findings = lint_snippet(
+        tmp_path,
+        """
+        async def inject(loop, coro):
+            loop.create_task(coro)
+        """,
+        ["R13"],
+    )
+    assert fired == ["R13"]
+    assert "weak reference" in findings[0].msg
+
+
+def test_r13_quiet_on_tracked_spawns(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import asyncio
+
+        async def spawn(shard, loop, coros):
+            task = loop.create_task(coros[0])
+            shard.track(loop.create_task(coros[1]))
+            acks = [asyncio.ensure_future(c) for c in coros]
+            return task, acks
+        """,
+        ["R13"],
+    )
+    assert fired == []
+
+
+# -- R14: await/blocking under a lock, one call level deep -------------------
+
+
+def test_r14_fires_on_blocking_call_in_lock_only_function(tmp_path):
+    fired, findings = lint_snippet(
+        tmp_path,
+        """
+        import threading, time
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._flush()
+
+            def _flush(self):
+                time.sleep(0.1)
+        """,
+        ["R14"],
+    )
+    assert fired == ["R14"]
+    assert "one call level deep" in findings[0].msg
+
+
+def test_r14_fires_on_await_in_lock_only_function(tmp_path):
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            async def outer(self):
+                with self._lock:
+                    await self._flush()
+
+            async def _flush(self):
+                await self.writer.drain()
+        """,
+        ["R14"],
+    )
+    assert fired == ["R14"]
+
+
+def test_r14_quiet_when_also_called_outside_locks(tmp_path):
+    # a lock-free call site means the function is NOT a lock-held scope
+    fired, _ = lint_snippet(
+        tmp_path,
+        """
+        import threading, time
+
+        class A:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self._flush()
+
+            def direct(self):
+                self._flush()
+
+            def _flush(self):
+                time.sleep(0.1)
+        """,
+        ["R14"],
+    )
+    assert fired == []
+
+
+# -- R15: implicit D2H syncs on the device hot path --------------------------
+
+
+def lint_ops_snippet(tmp_path, source, rel="mqtt_tpu/ops/x.py"):
+    """R15 gates on the file's repo-relative path, so its fixtures must
+    live under the scoped subtree of the lint root."""
+    mod = tmp_path / rel
+    mod.parent.mkdir(parents=True, exist_ok=True)
+    mod.write_text(textwrap.dedent(source))
+    new, _ = run([str(mod)], str(tmp_path), {"R15": FILE_RULES["R15"]}, {})
+    return [f.rule for f in new], new
+
+
+def test_r15_fires_on_implicit_d2h_syncs(tmp_path):
+    fired, findings = lint_ops_snippet(
+        tmp_path,
+        """
+        import jax
+        import numpy as np
+
+        def resolve(out_dev):
+            n = out_dev.sum().item()
+            host = np.asarray(out_dev)
+            got = jax.device_get(out_dev)
+            return n, host, got, float(out_dev[0])
+        """,
+    )
+    assert fired == ["R15"] * 4
+    assert any("blocking" in f.msg for f in findings)
+
+
+def test_r15_quiet_on_host_arrays_and_outside_scope(tmp_path):
+    # host-named arrays don't trip the device heuristic...
+    fired, _ = lint_ops_snippet(
+        tmp_path,
+        """
+        import numpy as np
+
+        def pack(ids):
+            return np.asarray(ids, dtype=np.int32)
+        """,
+    )
+    assert fired == []
+    # ...and the same D2H shapes OUTSIDE ops//sharded.py are not R15's
+    # business (hooks and tests read scalars freely)
+    fired, _ = lint_ops_snippet(
+        tmp_path,
+        """
+        def read(out_dev):
+            return out_dev.item()
+        """,
+        rel="mqtt_tpu/hooks/h.py",
+    )
+    assert fired == []
+
+
+def test_r15_pragma_round_trip(tmp_path):
+    # a reasoned pragma blesses the ONE batched resolve seam; the same
+    # pragma without a reason is itself a finding (the R3 contract,
+    # checked for a loop-rule id)
+    src = """
+        import numpy as np
+
+        def resolve(out_dev):
+            return np.asarray(out_dev)  # brokerlint: ok=R15 {reason}
+    """
+    fired, _ = lint_ops_snippet(
+        tmp_path, src.format(reason="the one batched D2H at the resolve seam")
+    )
+    assert fired == []
+    fired, _ = lint_ops_snippet(tmp_path, src.format(reason=""))
+    assert sorted(fired) == ["PRAGMA", "R15"]
+
+
+# -- lockgraph callback propagation (the PR 10 residual, closed) -------------
+
+
+def test_r9_propagates_through_registered_callback(tmp_path):
+    # a callback registered as an observer attribute and FIRED under a
+    # lock contributes its own acquisitions to the firing site's edge
+    # set: retained -> topics_trie here reverses the blessed order
+    findings = run_r9(
+        tmp_path,
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class Store:
+            def __init__(self):
+                self._fire_lock = InstrumentedLock("retained")
+                self._note_lock = InstrumentedLock("topics_trie")
+                self.on_change = self._note
+
+            def mutate(self):
+                with self._fire_lock:
+                    self.on_change()
+
+            def _note(self):
+                with self._note_lock:
+                    pass
+        """,
+    )
+    assert any(f.rule == "R9" and "reversed" in f.msg for f in findings)
+
+
+def test_r9_callback_propagation_quiet_on_blessed_order(tmp_path):
+    findings = run_r9(
+        tmp_path,
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class Store:
+            def __init__(self):
+                self._fire_lock = InstrumentedLock("topics_trie")
+                self._note_lock = InstrumentedLock("retained")
+                self.on_change = self._note
+
+            def mutate(self):
+                with self._fire_lock:
+                    self.on_change()
+
+            def _note(self):
+                with self._note_lock:
+                    pass
+        """,
+    )
+    assert findings == []
+
+
+def test_r9_propagates_through_callback_container(tmp_path):
+    # the container shape: registered via .append, fired by subscript
+    # over the observer-named container
+    findings = run_r9(
+        tmp_path,
+        """
+        from mqtt_tpu.utils.locked import InstrumentedLock
+
+        class Bus:
+            def __init__(self):
+                self._fire_lock = InstrumentedLock("retained")
+                self._note_lock = InstrumentedLock("topics_trie")
+                self._observers = []
+                self._observers.append(self._note)
+
+            def mutate(self):
+                with self._fire_lock:
+                    self._observers[0]()
+
+            def _note(self):
+                with self._note_lock:
+                    pass
+        """,
+    )
+    assert any(f.rule == "R9" and "reversed" in f.msg for f in findings)
+
+
 # -- pragmas and baseline ---------------------------------------------------
 
 
@@ -1078,8 +1607,8 @@ def test_rule_catalog_is_complete():
 )
 def test_mypy_gate_on_typed_core_modules():
     """`mypy` (config: mypy.ini) must pass over the typed core modules
-    — the full scope now includes server.py and clients.py (ISSUE 10
-    closed the last PR 4 residual)."""
+    — the scope grew to faults.py, tenancy.py, inflight.py, config.py
+    and utils/loopwitness.py in ISSUE 19."""
     r = subprocess.run(
         [sys.executable, "-m", "mypy", "--config-file", "mypy.ini"],
         capture_output=True, text=True, timeout=300,

@@ -1,0 +1,206 @@
+"""LoopWitness/LoopPlane unit coverage (ISSUE 19): the arming matrix
+(disarmed / recording / mid-session escalation), seam selection, and
+violation semantics — plus the instrumented OutboundQueue touch points
+driven against a private plane so the session-wide witness
+(tests/conftest.py) stays undisturbed.
+
+The cross-validation against the static model lives in
+tests/test_zz_loopwitness.py; this file proves the witness machinery
+itself.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+import mqtt_tpu.clients as clients_mod
+from mqtt_tpu.clients import OutboundQueue
+from mqtt_tpu.utils.loopwitness import (
+    DEFAULT_LOOP_PLANE,
+    LoopAffinityViolation,
+    LoopPlane,
+    LoopWitness,
+    current_loop,
+)
+
+
+class TestLoopWitnessUnit:
+    def test_note_crossing_picks_seam_by_owner(self):
+        w = LoopWitness()
+
+        async def drive():
+            me = asyncio.get_running_loop()
+            # no affinity established yet -> the local seam
+            w.note_crossing("k", "local", "cross", None)
+            # on the owning loop -> local
+            w.note_crossing("k2", "local", "cross", me)
+            # owner is some OTHER loop -> cross
+            other = asyncio.new_event_loop()
+            try:
+                w.note_crossing("k3", "local", "cross", other)
+            finally:
+                other.close()
+
+        asyncio.run(drive())
+        assert ("k", "local") in w.edges
+        assert ("k2", "local") in w.edges
+        assert ("k3", "cross") in w.edges
+        # plain-thread context (no running loop) with an owner -> cross
+        owner = asyncio.new_event_loop()
+        try:
+            assert current_loop() is None
+            w.note_crossing("k4", "local", "cross", owner)
+        finally:
+            owner.close()
+        assert ("k4", "cross") in w.edges
+
+    def test_note_records_first_seen_evidence_once(self):
+        w = LoopWitness()
+        w.note("k", "s", detail="first")
+        w.note("k", "s", detail="second")
+        thread_name, detail = w.edges[("k", "s")]
+        assert thread_name == threading.current_thread().name
+        assert detail == "first"
+
+    def test_check_owner_collects_without_raising_when_recording(self):
+        w = LoopWitness()  # recording mode
+        owner = asyncio.new_event_loop()
+        try:
+            w.check_owner("k", "s", owner, detail="cid-1")
+        finally:
+            owner.close()
+        assert w.edges == {}  # a violation is not a legal seam traversal
+        assert len(w.violations) == 1
+        assert "off its owning loop" in w.violations[0]
+        assert "cid-1" in w.violations[0]
+
+    def test_check_owner_raises_when_armed_raising(self):
+        w = LoopWitness(raise_on_violation=True)
+        owner = asyncio.new_event_loop()
+        try:
+            with pytest.raises(LoopAffinityViolation):
+                w.check_owner("k", "s", owner)
+        finally:
+            owner.close()
+        assert len(w.violations) == 1  # collected AND raised
+
+    def test_check_owner_legal_on_owner_or_unattached(self):
+        w = LoopWitness(raise_on_violation=True)
+        w.check_owner("k", "s", None)  # not yet attached: trivially legal
+
+        async def drive():
+            w.check_owner("k2", "s2", asyncio.get_running_loop())
+
+        asyncio.run(drive())
+        assert ("k", "s") in w.edges and ("k2", "s2") in w.edges
+        assert w.violations == []
+
+
+class TestLoopPlaneArmingMatrix:
+    def test_disarmed_plane_is_inert(self):
+        plane = LoopPlane()
+        assert plane.active is False and plane.witness is None
+
+    def test_arm_is_idempotent_and_returns_same_witness(self):
+        plane = LoopPlane()
+        w1 = plane.arm_witness()
+        w2 = plane.arm_witness()
+        assert w1 is w2 and plane.active is True
+        assert w1.raise_on_violation is False
+
+    def test_mid_session_escalation_never_deescalates(self):
+        # the schedule fuzzer's contract: conftest arms a recording
+        # witness first; the fuzzer escalates IN PLACE to raising, and
+        # a later recording arm must not quietly drop the tripwire
+        plane = LoopPlane()
+        w = plane.arm_witness()
+        w.note("k", "s")
+        escalated = plane.arm_witness(raise_on_violation=True)
+        assert escalated is w  # same witness, evidence preserved
+        assert w.raise_on_violation is True
+        assert ("k", "s") in w.edges
+        again = plane.arm_witness(raise_on_violation=False)
+        assert again is w and w.raise_on_violation is True
+
+    def test_disarm_detaches_and_reset_clears_in_place(self):
+        plane = LoopPlane()
+        w = plane.arm_witness()
+        w.note("k", "s")
+        w.violations.append("x")
+        plane.reset()
+        assert plane.witness is w  # reset keeps the attachment
+        assert w.edges == {} and w.violations == []
+        plane.disarm_witness()
+        assert plane.witness is None and plane.active is False
+
+
+class TestInstrumentedTouchPoints:
+    """Drive the real OutboundQueue seams against a PRIVATE plane
+    swapped into mqtt_tpu.clients, covering all three arming states
+    without touching the session witness."""
+
+    @pytest.fixture
+    def plane(self, monkeypatch):
+        p = LoopPlane()
+        monkeypatch.setattr(clients_mod, "_LOOP_PLANE", p)
+        return p
+
+    def _put_get(self):
+        async def drive():
+            q = OutboundQueue(maxsize=4)
+            q.put_nowait(b"x")
+            assert await q.get() == b"x"
+            return q
+
+        return asyncio.run(drive())
+
+    def test_disarmed_records_nothing(self, plane):
+        self._put_get()
+        assert plane.witness is None  # never materialized a witness
+
+    def test_armed_records_queue_seams(self, plane):
+        w = plane.arm_witness()
+        self._put_get()
+        assert ("outbound_queue", "put_local") in w.edges
+        assert ("outbound_queue", "get_owner") in w.edges
+
+    def test_cross_thread_put_records_cross_seam(self, plane):
+        w = plane.arm_witness()
+
+        async def drive():
+            q = OutboundQueue(maxsize=4)
+            getter = asyncio.ensure_future(q.get())
+            await asyncio.sleep(0)  # park the consumer (stamps the owner)
+            t = threading.Thread(target=q.put_nowait, args=(b"y",))
+            t.start()
+            t.join()
+            assert await asyncio.wait_for(getter, 5) == b"y"
+
+        asyncio.run(drive())
+        assert ("outbound_queue", "put_cross") in w.edges
+        assert w.violations == []
+
+    def test_escalated_witness_trips_on_foreign_get(self, plane):
+        # stamp the queue's owner on one loop, then consume from a
+        # DIFFERENT loop: a real single-consumer contract breach
+        w = plane.arm_witness()
+        q = OutboundQueue(maxsize=4)
+
+        async def consume():
+            q.put_nowait(b"z")
+            await q.get()
+
+        asyncio.run(consume())  # stamps loop A as owner, then discards it
+        assert w.violations == []
+        plane.arm_witness(raise_on_violation=True)
+        with pytest.raises(LoopAffinityViolation):
+            asyncio.run(consume())  # a second, different loop
+        assert len(w.violations) == 1
+
+    def test_session_plane_is_armed_recording(self):
+        # tier-1 runs with the conftest-armed witness; this file must
+        # not have disturbed it (the private-plane fixture guarantees
+        # isolation, this asserts it)
+        assert DEFAULT_LOOP_PLANE.active is True
+        assert DEFAULT_LOOP_PLANE.witness is not None

@@ -13,7 +13,7 @@ import asyncio
 import pytest
 
 from mqtt_tpu import Options, Server
-from mqtt_tpu.packets import PUBLISH, SUBACK, Subscription
+from mqtt_tpu.packets import PUBLISH, SUBACK, FixedHeader, Packet, Subscription
 from mqtt_tpu.staging import MatchStage
 from mqtt_tpu.topics import SYS_PREFIX, Subscribers
 
@@ -248,6 +248,112 @@ class TestCancelledCallerFutures:
             assert futs[1].done() and futs[3].done()
             assert isinstance(futs[1].result(), Subscribers)
             assert isinstance(futs[3].result(), Subscribers)
+
+        run(scenario())
+
+
+class TestCrossLoopResolution:
+    def test_fallback_rejection_marshals_to_submitter_loop(self):
+        """Regression for the brokerlint R12 finding fixed in PR 19
+        (staging._reject): ``_fallback_all`` used to call
+        ``fut.set_exception`` INLINE on whatever thread ran the
+        fallback, scheduling the waiter's done-callbacks cross-thread.
+        The submitter loop runs in DEBUG mode here, so the old inline
+        shape trips asyncio's non-thread-safe-operation check and the
+        test fails loudly if the marshal seam regresses."""
+        import threading
+
+        class Boom(Exception):
+            pass
+
+        def exploding_host(topic):
+            raise Boom(topic)
+
+        loop_b = asyncio.new_event_loop()
+        loop_b.set_debug(True)
+        t = threading.Thread(
+            target=loop_b.run_forever, name="submitter-loop", daemon=True
+        )
+        t.start()
+        stage_loop = asyncio.new_event_loop()  # never running: just != loop_b
+        try:
+
+            async def park():
+                return asyncio.get_running_loop().create_future()
+
+            fut = asyncio.run_coroutine_threadsafe(park(), loop_b).result(5)
+            rej = MatchStage(None, exploding_host)
+            rej._loop = stage_loop
+            # the old code raises RuntimeError (non-thread-safe op) here
+            rej._fallback_all([("x/y", fut)])
+
+            async def reap():
+                try:
+                    await fut
+                except Boom:
+                    return threading.get_ident()
+                raise AssertionError("future resolved without the host error")
+
+            # the rejection completed ON the submitter's loop thread
+            assert (
+                asyncio.run_coroutine_threadsafe(reap(), loop_b).result(5)
+                == t.ident
+            )
+
+            # the success leg rides the same seam (_resolve's marshal)
+            fut2 = asyncio.run_coroutine_threadsafe(park(), loop_b).result(5)
+            ok = MatchStage(None, lambda t: Subscribers())
+            ok._loop = stage_loop
+            ok._fallback_all([("x/z", fut2)])
+
+            async def reap_ok():
+                return await fut2
+
+            assert isinstance(
+                asyncio.run_coroutine_threadsafe(reap_ok(), loop_b).result(5),
+                Subscribers,
+            )
+        finally:
+            loop_b.call_soon_threadsafe(loop_b.stop)
+            t.join(5)
+            loop_b.close()
+            stage_loop.close()
+
+    def test_inject_packet_tracks_fan_out_task(self):
+        """Regression for the brokerlint R13 finding fixed in PR 19
+        (server.inject_packet): the staged fan-out task was
+        fire-and-forget — asyncio's weak reference was the only thing
+        keeping it alive mid-flight. It must register in the tracked
+        listener task set and discard itself on completion."""
+
+        async def scenario():
+            h = Harness(staged_options())
+            await h.server.serve()
+            sub_r, sub_w, _ = await h.connect("inj-sub")
+            sub_w.write(sub_packet(1, [Subscription(filter="in/t", qos=0)]))
+            await sub_w.drain()
+            await read_wire_packet(sub_r)
+            h.server.matcher.flush()
+            cl = h.server.clients.get("inj-sub")
+            before = set(h.server.listeners.client_tasks)
+            h.server.inject_packet(
+                cl,
+                Packet(
+                    fixed_header=FixedHeader(type=PUBLISH),
+                    topic_name="in/t",
+                    payload=b"injected",
+                ),
+            )
+            spawned = set(h.server.listeners.client_tasks) - before
+            assert len(spawned) == 1, "staged fan-out task must be tracked"
+            pk = await read_wire_packet(sub_r)
+            assert bytes(pk.payload) == b"injected"
+            task = spawned.pop()
+            await task
+            await asyncio.sleep(0)  # let the done-callback run
+            assert task not in h.server.listeners.client_tasks
+            await h.server.close()
+            await h.shutdown()
 
         run(scenario())
 

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                     help="also write the extracted whole-program "
                          "lock-order graph (R9) to DIR/lockgraph.dot and "
                          "DIR/lockgraph.json")
+    ap.add_argument("--loop-graph", metavar="DIR", default=None,
+                    help="also write the extracted loop-affinity model "
+                         "(R10-R15) to DIR/loopgraph.dot and "
+                         "DIR/loopgraph.json")
     args = ap.parse_args(argv)
 
     if args.list_rules:
@@ -72,6 +76,27 @@ def main(argv=None) -> int:
             json.dump(graph.as_dict(), f, indent=1)
             f.write("\n")
         print(f"lock graph written: {dot} {gj}", file=sys.stderr)
+
+    if args.loop_graph is not None:
+        from .core import collect_files, load_ctx
+        from .loopgraph import extract_loop_graph
+
+        ctxs = []
+        for p in collect_files(paths, root):
+            try:
+                ctxs.append(load_ctx(p, root))
+            except SyntaxError:
+                continue  # already reported as a PARSE finding above
+        graph = extract_loop_graph(ctxs)
+        os.makedirs(args.loop_graph, exist_ok=True)
+        dot = os.path.join(args.loop_graph, "loopgraph.dot")
+        with open(dot, "w", encoding="utf-8") as f:
+            f.write(graph.to_dot())
+        gj = os.path.join(args.loop_graph, "loopgraph.json")
+        with open(gj, "w", encoding="utf-8") as f:
+            json.dump(graph.as_dict(), f, indent=1)
+            f.write("\n")
+        print(f"loop graph written: {dot} {gj}", file=sys.stderr)
 
     if args.write_baseline:
         save_baseline(args.baseline, new + baselined)

@@ -33,8 +33,15 @@ observes across the suite appears in this statically extracted graph,
 so an extraction gap here fails loudly instead of rotting silently.
 
 Known honest limits (the witness gate is the backstop for all of
-them): callbacks registered under one lock and fired under another are
-not followed; locals (``task = self._tasks[k]; task._lock``) resolve
+them): callbacks registered under one lock and fired under another ARE
+followed one level — the ISSUE 10 residual — but only for statically
+resolvable targets through the observer shapes (``obj.on_x =
+self.meth``/``= module_fn``, ``*_observers.append(fn)``) fired as
+``recv.on_x(...)`` or ``recv._observers[k](...)``; a lambda, a foreign
+bound method, or a fire through a loop variable (``for cb in
+self._observers: cb()``) stays invisible to the static pass (R5
+independently flags that fire shape under a held lock); locals
+(``task = self._tasks[k]; task._lock``) resolve
 to a per-site anonymous node unless the attribute name is unique
 project-wide; propagation is one call level deep; and cross-module
 NAME-based class resolution (base classes, annotated attribute types)
@@ -54,6 +61,8 @@ from .core import FileCtx, Finding
 from .rules import (
     _LOCK_NAME_RE,
     _LOCKED_SUFFIX,
+    _OBSERVER_ATTR_RE,
+    _OBSERVER_CONTAINER_RE,
     _dotted,
     _iter_scope,
     _terminal_name,
@@ -614,6 +623,95 @@ class _Extractor:
     def __init__(self, project: _Project) -> None:
         self.project = project
         self.graph = LockGraph()
+        # observer-attr / container name -> registered callback targets
+        # (defining ctx, fn node, owner class, owner rel); built once,
+        # consulted at fire sites so edges propagate one level through
+        # callbacks registered under one lock and fired under another
+        self.callbacks: dict[str, list[tuple]] = self._index_callbacks()
+
+    def _index_callbacks(self) -> dict[str, list[tuple]]:
+        """Project-wide registry of the observer/`on_transition`/
+        `rebuild_observer` shapes: ``obj.on_x = self.meth`` /
+        ``obj.on_x = module_fn`` (attr matching the R5 observer
+        convention) and ``container.append(fn_ref)`` /
+        ``.add``/``.register`` on ``*_observers``-style containers.
+        Only statically resolvable targets register (same-file module
+        functions, self-methods through the class index); a lambda or a
+        foreign object's bound method stays invisible — the LockWitness
+        gate remains the backstop for those."""
+        reg: dict[str, list[tuple]] = {}
+        for ctx in self.project.ctxs:
+            for top in ctx.tree.body:
+                cls = top.name if isinstance(top, ast.ClassDef) else None
+                for node in ast.walk(top):
+                    self._note_registration(ctx, node, cls, reg)
+        return reg
+
+    def _note_registration(
+        self, ctx: FileCtx, node: ast.AST, cls: Optional[str],
+        reg: dict[str, list[tuple]],
+    ) -> None:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Attribute)
+        ):
+            attr = node.targets[0].attr
+            if _OBSERVER_ATTR_RE.search(attr):
+                self._register_callback(ctx, cls, node.value, attr, reg)
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("append", "add", "register")
+            and node.args
+        ):
+            base = node.func.value
+            container = (
+                base.attr
+                if isinstance(base, ast.Attribute)
+                else base.id
+                if isinstance(base, ast.Name)
+                else None
+            )
+            if container and _OBSERVER_CONTAINER_RE.search(container):
+                self._register_callback(
+                    ctx, cls, node.args[0], container, reg
+                )
+
+    def _register_callback(
+        self, ctx: FileCtx, cls: Optional[str], value: ast.AST, key: str,
+        reg: dict[str, list[tuple]],
+    ) -> None:
+        p = self.project
+        if (
+            isinstance(value, ast.Attribute)
+            and isinstance(value.value, ast.Name)
+            and value.value.id == "self"
+            and cls is not None
+        ):
+            found = p.find_method(cls, value.attr, rel=ctx.rel)
+            if found is not None:
+                owner, fn = found
+                octx = self._ctx_for(owner.rel) or ctx
+                reg.setdefault(key, []).append(
+                    (octx, fn, owner.name, owner.rel)
+                )
+        elif isinstance(value, ast.Name):
+            fn = p.module_funcs.get(ctx.rel, {}).get(value.id)
+            if fn is not None:
+                reg.setdefault(key, []).append((ctx, fn, None, None))
+
+    def _callback_acquisitions(self, key: str) -> list[tuple[frozenset, str]]:
+        out: list[tuple[frozenset, str]] = []
+        seen: set[int] = set()
+        for octx, fn, owner_cls, rel in self.callbacks.get(key, ()):
+            if id(fn) in seen:
+                continue
+            seen.add(id(fn))
+            out.extend(
+                self._direct_acquisitions(octx, fn, owner_cls, None, rel=rel)
+            )
+        return out
 
     def run(self) -> LockGraph:
         p = self.project
@@ -818,6 +916,31 @@ class _Extractor:
     # -- one-level call propagation -----------------------------------------
 
     def _call_acquisitions(
+        self, ctx: FileCtx, call: ast.Call, cls: Optional[str]
+    ) -> list[tuple[frozenset, str]]:
+        out = self._resolved_call_acquisitions(ctx, call, cls)
+        if out:
+            return out
+        # unresolvable receiver: if the call SHAPE is an observer fire
+        # (`self.on_transition(...)`, `self._observers[k](...)`), charge
+        # the one-level acquisitions of every callback registered under
+        # that name project-wide — the "registered under one lock, fired
+        # under another" residual from ISSUE 10
+        f = call.func
+        key = None
+        if isinstance(f, ast.Attribute) and _OBSERVER_ATTR_RE.search(f.attr):
+            key = f.attr
+        elif (
+            isinstance(f, ast.Subscript)
+            and isinstance(f.value, ast.Attribute)
+            and _OBSERVER_CONTAINER_RE.search(f.value.attr)
+        ):
+            key = f.value.attr
+        if key is not None:
+            return self._callback_acquisitions(key)
+        return []
+
+    def _resolved_call_acquisitions(
         self, ctx: FileCtx, call: ast.Call, cls: Optional[str]
     ) -> list[tuple[frozenset, str]]:
         f = call.func

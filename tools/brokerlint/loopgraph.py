@@ -1,0 +1,812 @@
+"""Whole-program loop-affinity model + rules R10-R15 (ISSUE 19).
+
+PR 15's event-loop shard fabric made cross-loop state the broker's
+dominant concurrency hazard: per-client transport/QoS state is owned by
+ONE shard loop, the staging pipeline parks futures created on OTHER
+loops, and cluster writers marshal frames onto peer loops. Every recent
+real bug in this class (the OutboundQueue cross-thread wake, the
+takeover quiesce, futures parked on the submitter's loop) was found by
+hand. This module applies the lockgraph recipe (ISSUE 10) to loop
+affinity:
+
+- a small blessed affinity table, ``LOOP_AFFINITY`` (analogous to
+  ``LOCK_ORDER``): the catalog of loop-owned object KINDS and the
+  legal SEAMS through which foreign threads/loops may touch them. The
+  runtime witness (``mqtt_tpu/utils/loopwitness.py``) records every
+  (kind, seam) traversal it observes; the tier-1 gate
+  (tests/test_zz_loopwitness.py) asserts the witnessed set is a subset
+  of this table AND that each cross seam's home module really contains
+  an extracted marshal site — an unexplained runtime seam is a model
+  gap and fails loudly;
+- an extracted :class:`LoopGraph` over ``mqtt_tpu/``: which constructs
+  OWN a loop (``LoopShard``/``MatchStage``/``Cluster`` constructors,
+  ``connect_accepted_socket`` wrap sites, the ``net.loop`` attach
+  seam) and where the marshal seams are (every
+  ``call_soon_threadsafe``/``run_coroutine_threadsafe`` call site);
+- rules R10-R14 riding the normal pragma/baseline machinery, plus R15
+  (the device hot-path D2H rule, ROADMAP item 1's static complement to
+  the PR 18 compile ledger).
+
+Rule summary (see README "Static analysis" for the incident each
+encodes):
+
+- R10 foreign-thread mutations of loop-affine objects (futures beyond
+  R2's set: asyncio Events, tasks, stream writers/transports) must
+  route via ``call_soon_threadsafe``/``run_coroutine_threadsafe`` —
+  R2's one-loop model generalized to N shards;
+- R11 no blocking calls (``time.sleep``, fsync/file I/O, sync
+  ``socket.*`` ops, untimed ``lock.acquire()``, storage-hook appends)
+  inside ``async def`` bodies or functions scheduled as loop
+  callbacks;
+- R12 a Future must be resolved on its creation loop or through a
+  marshal seam: ``set_result``/``set_exception`` on a parked future
+  is legal only under a get_loop()/loop-identity guard, from a
+  callback that is itself marshaled, or on a future the same function
+  created;
+- R13 every spawned task holds a tracking binding or registers in a
+  tracked set (the PR 15 per-shard establish-task shape;
+  fire-and-forget tasks are GC'd mid-flight);
+- R14 ``await``/blocking calls inside functions whose every call site
+  sits under a held lock (the one-level R5 propagation applied to
+  R1's check — suspension points under locks are findings, not
+  folklore);
+- R15 no implicit device->host syncs (``.item()``,
+  ``jax.device_get``, ``np.asarray`` on ``*_dev``-named device
+  arrays, ``float()``/``bool()``/``int()`` over them) inside
+  ``mqtt_tpu/ops/`` and ``parallel/sharded.py`` outside blessed
+  resolve seams; every intentional D2H point carries a reasoned
+  pragma.
+
+Honest limits (the runtime witness is the backstop): ownership is
+inferred from the repo's own conventions (``*_dev`` device-array
+names, ``fut``/``waiter`` future names, the ``net.loop`` attach
+seam), so renamed state evades the static pass; R10's reachability is
+the same Thread-target BFS as R2 (dynamically dispatched thread
+entries need ``THREAD_ENTRY_EXTRA``); and R12's guard recognition is
+lexical, not data-flow.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from .core import FileCtx, Finding
+from .rules import (
+    _dotted,
+    _is_blocking_call,
+    _is_lock_expr,
+    _iter_scope,
+    _module_functions,
+    _terminal_name,
+    _thread_entries,
+    _called_names,
+    _funcs_called_only_under_locks,
+)
+
+# The blessed loop-affinity catalog: (kind, seam) pairs the runtime
+# witness may legally observe. ``*_local`` seams are owner-loop
+# touches; ``*_cross``/``*_marshal`` seams are foreign-context touches
+# that are legal ONLY because the object is thread-safe by design or
+# the touch rides a call_soon_threadsafe/run_coroutine_threadsafe
+# marshal. A witnessed (kind, seam) missing from this table is a model
+# gap (fix the table or the code, never the gate); a NEW kind is a
+# design decision made here, in review, like a new LOCK_ORDER entry.
+LOOP_AFFINITY = (
+    # clients.OutboundQueue: thread-safe bounded deque; any thread may
+    # enqueue, the single consumer (the client's write loop) dequeues
+    # on the owning shard's loop
+    ("outbound_queue", "put_local"),
+    ("outbound_queue", "put_cross"),
+    ("outbound_queue", "get_owner"),
+    # per-client loop-affine state (QoS packet ids, inflight, outbound
+    # aliases): mutated only on cl.net.loop; cross-shard deliveries
+    # marshal through server._deliver_to_client
+    ("client_state", "owner_touch"),
+    ("client_state", "deliver_marshal"),
+    # staging.MatchStage: _pending is lock-guarded; submitters wake the
+    # stage loop via call_soon_threadsafe, futures resolve on their
+    # creation loop through _resolve's marshal seam
+    ("match_stage", "submit_local"),
+    ("match_stage", "submit_cross"),
+    ("match_stage", "resolve_local"),
+    ("match_stage", "resolve_marshal"),
+    ("match_stage", "drain_owner"),
+    # cluster peer writers: frames marshal onto the cluster loop
+    ("cluster_writer", "dispatch_local"),
+    ("cluster_writer", "dispatch_cross"),
+    # shards.LoopShard: establish tasks register in the shard's tracked
+    # set (the R13 shape, witnessed)
+    ("shard_task", "tracked"),
+)
+
+# kind -> the module that must host its marshal seam: a *_cross/_marshal
+# seam for a kind whose home module has NO extracted
+# call_soon_threadsafe/run_coroutine_threadsafe site would mean the
+# witness observed a crossing the source cannot explain
+AFFINITY_HOME = {
+    "outbound_queue": "mqtt_tpu/clients.py",
+    "client_state": "mqtt_tpu/server.py",
+    "match_stage": "mqtt_tpu/staging.py",
+    "cluster_writer": "mqtt_tpu/cluster.py",
+    "shard_task": "mqtt_tpu/shards.py",
+}
+
+_MARSHAL_APIS = ("call_soon_threadsafe", "run_coroutine_threadsafe")
+
+# loop-owning construct signatures: (class ctor | call attr) -> kind
+_OWNER_CTORS = {
+    "LoopShard": "shard_task",
+    "MatchStage": "match_stage",
+    "Cluster": "cluster_writer",
+    "OutboundQueue": "outbound_queue",
+}
+_OWNER_ATTACH_RE = re.compile(r"^(net\.loop|_loop|loop)$")
+
+
+@dataclass(frozen=True)
+class SeamSite:
+    path: str
+    line: int
+    context: str
+    api: str  # which marshal API (or owner construct) anchors the site
+
+
+class LoopGraph:
+    """The extracted loop-affinity model: loop-owning construct sites,
+    marshal-seam sites per module, and the blessed-catalog join."""
+
+    def __init__(self) -> None:
+        # kind -> definition sites (ctor/attach seams)
+        self.owners: dict[str, list[SeamSite]] = {}
+        # module rel -> marshal call sites
+        self.marshals: dict[str, list[SeamSite]] = {}
+
+    def add_owner(self, kind: str, site: SeamSite) -> None:
+        sites = self.owners.setdefault(kind, [])
+        if site not in sites:
+            sites.append(site)
+
+    def add_marshal(self, rel: str, site: SeamSite) -> None:
+        sites = self.marshals.setdefault(rel, [])
+        if site not in sites:
+            sites.append(site)
+
+    def seams(self) -> set[tuple[str, str]]:
+        """The witness-comparable set: every blessed (kind, seam) whose
+        requirements the extracted model satisfies — local/owner seams
+        need the kind's owning construct extracted; cross/marshal seams
+        additionally need a marshal call site in the kind's home
+        module. A blessed pair whose evidence is missing is EXCLUDED,
+        so a witnessed traversal of it fails the gate until the source
+        really carries the seam."""
+        out: set[tuple[str, str]] = set()
+        for kind, seam in LOOP_AFFINITY:
+            if kind not in self.owners:
+                continue
+            if seam.endswith(("_cross", "_marshal")):
+                home = AFFINITY_HOME.get(kind)
+                if home is None or not self.marshals.get(home):
+                    continue
+            out.add((kind, seam))
+        return out
+
+    def as_dict(self) -> dict:
+        return {
+            "affinity": [list(p) for p in LOOP_AFFINITY],
+            "owners": {
+                kind: [
+                    {"path": s.path, "line": s.line, "context": s.context,
+                     "api": s.api}
+                    for s in sites
+                ]
+                for kind, sites in sorted(self.owners.items())
+            },
+            "marshals": {
+                rel: [
+                    {"line": s.line, "context": s.context, "api": s.api}
+                    for s in sites
+                ]
+                for rel, sites in sorted(self.marshals.items())
+            },
+            "seams": sorted(list(p) for p in self.seams()),
+        }
+
+    def to_dot(self) -> str:
+        """GraphViz rendering: one box per kind, one edge per blessed
+        seam; cross seams whose marshal evidence is missing are red."""
+        live = self.seams()
+        lines = [
+            "digraph loopaffinity {",
+            '  rankdir="LR";',
+            '  node [shape=box, fontname="monospace", fontsize=10];',
+        ]
+        kinds = sorted({k for k, _ in LOOP_AFFINITY})
+        for kind in kinds:
+            style = "" if kind in self.owners else ", style=dashed"
+            lines.append(f'  "{kind}" [label="{kind}"{style}];')
+        for kind, seam in LOOP_AFFINITY:
+            attrs = [f'label="{seam}"']
+            if (kind, seam) not in live:
+                attrs.append('color="red"')
+            src = "foreign" if seam.endswith(("_cross", "_marshal")) else kind
+            if src == "foreign":
+                lines.append(
+                    f'  "foreign ctx" -> "{kind}" [{", ".join(attrs)}];'
+                )
+            else:
+                lines.append(f'  "{kind}" -> "{kind}" [{", ".join(attrs)}];')
+        lines.append("}")
+        return "\n".join(lines) + "\n"
+
+
+def extract_loop_graph(ctxs: list[FileCtx]) -> LoopGraph:
+    """Extract (or reuse) the affinity model for this exact source set
+    (same single-slot memo discipline as ``extract_lock_graph``: one
+    CLI run extracts once for the rules and once for --loop-graph)."""
+    key = tuple(sorted((c.rel, hash(c.source)) for c in ctxs))
+    memo = getattr(extract_loop_graph, "_memo", None)
+    if memo is not None and memo[0] == key:
+        return memo[1]
+    graph = LoopGraph()
+    for ctx in ctxs:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Attribute)
+                ):
+                    # the attach seam: cl.net.loop = get_running_loop()
+                    tgt = node.targets[0]
+                    d = _dotted(tgt) or ""
+                    leaf = ".".join(d.split(".")[-2:]) if "." in d else d
+                    if _OWNER_ATTACH_RE.match(leaf) or _OWNER_ATTACH_RE.match(
+                        tgt.attr
+                    ):
+                        val = node.value
+                        vd = _dotted(val.func) if isinstance(val, ast.Call) else None
+                        if vd is not None and vd.endswith("get_running_loop"):
+                            graph.add_owner(
+                                "client_state"
+                                if "net" in d
+                                else "match_stage"
+                                if ctx.rel.endswith("staging.py")
+                                else "cluster_writer"
+                                if ctx.rel.endswith("cluster.py")
+                                else "shard_task",
+                                SeamSite(
+                                    ctx.rel, node.lineno,
+                                    ctx.context_line(node.lineno), "attach",
+                                ),
+                            )
+                continue
+            name = _terminal_name(node.func)
+            if name in _OWNER_CTORS:
+                graph.add_owner(
+                    _OWNER_CTORS[name],
+                    SeamSite(
+                        ctx.rel, node.lineno,
+                        ctx.context_line(node.lineno), name,
+                    ),
+                )
+            elif name == "connect_accepted_socket":
+                graph.add_owner(
+                    "client_state",
+                    SeamSite(
+                        ctx.rel, node.lineno,
+                        ctx.context_line(node.lineno), name,
+                    ),
+                )
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MARSHAL_APIS
+            ) or _dotted(node.func) in (
+                "asyncio.run_coroutine_threadsafe",
+            ):
+                api = (
+                    node.func.attr
+                    if isinstance(node.func, ast.Attribute)
+                    else "run_coroutine_threadsafe"
+                )
+                graph.add_marshal(
+                    ctx.rel,
+                    SeamSite(
+                        ctx.rel, node.lineno,
+                        ctx.context_line(node.lineno), api,
+                    ),
+                )
+    extract_loop_graph._memo = (key, graph)  # type: ignore[attr-defined]
+    return graph
+
+
+# -- R10: foreign-thread mutation of loop-affine objects ---------------------
+
+# receiver-name conventions marking loop-affine objects beyond R2's
+# future/loop set: asyncio Events, tasks, stream writers and transports
+_AFFINE_EVENT_RE = re.compile(r"(^|_)(event|wake|ready|done|stopped)$", re.I)
+_AFFINE_TASK_RE = re.compile(r"(^|_)(task|tick)s?$|_task$", re.I)
+_AFFINE_WRITER_RE = re.compile(r"(^|_)(writer|transport)$", re.I)
+
+
+def _threading_constructed(tree: ast.Module) -> set[str]:
+    """Terminal names assigned a ``threading.Event()`` (or bare
+    ``Event()``) anywhere in the file: those are thread-safe by
+    construction, so foreign-thread set()/clear() is the intended use,
+    not an affinity violation."""
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1):
+            continue
+        val = node.value
+        if not isinstance(val, ast.Call):
+            continue
+        d = _dotted(val.func)
+        if d in ("threading.Event", "Event") or (
+            _terminal_name(val.func) == "Event"
+        ):
+            name = _terminal_name(node.targets[0])
+            if name:
+                out.add(name)
+    return out
+
+
+def _affine_mutation(call: ast.Call, threading_safe: set[str]) -> Optional[str]:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = _terminal_name(fn.value) or ""
+    if recv in threading_safe:
+        return None
+    if fn.attr in ("set", "clear") and _AFFINE_EVENT_RE.search(recv):
+        return f"{recv}.{fn.attr}"
+    if fn.attr == "cancel" and _AFFINE_TASK_RE.search(recv):
+        return f"{recv}.cancel"
+    if fn.attr in ("write", "close", "drain") and _AFFINE_WRITER_RE.search(
+        recv
+    ):
+        return f"{recv}.{fn.attr}"
+    return None
+
+
+def check_r10(ctx: FileCtx) -> list[Finding]:
+    """Mutations of loop-affine objects from thread-reachable sync code
+    must route via call_soon_threadsafe/run_coroutine_threadsafe. R2
+    covers futures and the loop itself; R10 generalizes the one-loop
+    model to the N-shard fabric's object kinds: asyncio Events, tasks,
+    and stream writers/transports."""
+    funcs = _module_functions(ctx.tree)
+    entries = _thread_entries(ctx) & set(funcs)
+    if not entries:
+        return []
+    reachable: set[str] = set()
+    frontier = list(entries)
+    while frontier:
+        fn = frontier.pop()
+        if fn in reachable:
+            continue
+        reachable.add(fn)
+        for callee in _called_names(funcs[fn]):
+            if callee in funcs and callee not in reachable:
+                frontier.append(callee)
+    threading_safe = _threading_constructed(ctx.tree)
+    out = []
+    for fname in sorted(reachable):
+        node = funcs[fname]
+        if isinstance(node, ast.AsyncFunctionDef):
+            continue  # coroutines run on the loop, never as Thread targets
+        for sub in _iter_scope(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            what = _affine_mutation(sub, threading_safe)
+            if what is not None:
+                out.append(
+                    ctx.finding(
+                        "R10", sub,
+                        f"{what}() inside `{fname}`, reachable from a "
+                        "thread entry point: loop-affine objects (events, "
+                        "tasks, writers) owned by a shard loop must be "
+                        "touched via loop.call_soon_threadsafe/"
+                        "run_coroutine_threadsafe",
+                    )
+                )
+    return out
+
+
+# -- R11: blocking calls in async bodies / loop callbacks --------------------
+
+_STORE_RECV_RE = re.compile(r"(^|_)(store|storage|kv|logkv)$", re.I)
+_STORE_BLOCKING_ATTRS = {
+    "append", "put", "delete", "sync", "snapshot", "compact", "fsync",
+}
+_LOOP_CB_SCHEDULERS = {
+    "call_soon", "call_soon_threadsafe", "call_later", "call_at",
+}
+
+
+def _blocking_in_async(call: ast.Call) -> Optional[str]:
+    what = _is_blocking_call(call)
+    if what is not None:
+        return what
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        recv = fn.value
+        if fn.attr == "acquire" and _is_lock_expr(recv):
+            # untimed/blocking acquire stalls the whole loop; a
+            # non-blocking probe or timeout-bounded acquire passes
+            blocking_false = any(
+                (k.arg == "blocking" and isinstance(k.value, ast.Constant)
+                 and k.value.value is False)
+                for k in call.keywords
+            ) or (
+                call.args
+                and isinstance(call.args[0], ast.Constant)
+                and call.args[0].value is False
+            )
+            has_timeout = any(k.arg == "timeout" for k in call.keywords) or (
+                len(call.args) >= 2
+            )
+            if not blocking_false and not has_timeout:
+                return f"{_terminal_name(recv)}.acquire"
+        if fn.attr in _STORE_BLOCKING_ATTRS and _STORE_RECV_RE.search(
+            _terminal_name(recv) or ""
+        ):
+            # storage-hook appends hit the durability path (fsync under
+            # durability_fsync=always): never inline on a loop
+            return f"{_terminal_name(recv)}.{fn.attr}"
+    return None
+
+
+def _loop_callback_funcs(ctx: FileCtx) -> set[str]:
+    """Names of same-file functions passed BY REFERENCE to
+    call_soon/call_later/... — they execute as loop callbacks, so the
+    async-context blocking rules apply to their sync bodies too."""
+    out: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOOP_CB_SCHEDULERS
+        ):
+            continue
+        for arg in node.args[:1]:  # the callback is the first argument
+            name = _terminal_name(arg)
+            if name:
+                out.add(name)
+    return out
+
+
+def check_r11(ctx: FileCtx) -> list[Finding]:
+    """No blocking calls inside ``async def`` bodies or functions
+    scheduled as loop callbacks: one blocked coroutine stalls every
+    connection that loop owns (under the shard fabric, a whole shard's
+    worth)."""
+    out = []
+    cb_names = _loop_callback_funcs(ctx)
+    scopes: list[tuple[list, str]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.AsyncFunctionDef):
+            scopes.append((node.body, f"async {node.name}()"))
+        elif isinstance(node, ast.FunctionDef) and node.name in cb_names:
+            scopes.append((node.body, f"loop callback {node.name}()"))
+    flagged: set[int] = set()
+    for body, desc in scopes:
+        for node in _iter_scope(body):
+            if not isinstance(node, ast.Call) or id(node) in flagged:
+                continue
+            what = _blocking_in_async(node)
+            if what is not None:
+                flagged.add(id(node))
+                out.append(
+                    ctx.finding(
+                        "R11", node,
+                        f"blocking call {what}() inside {desc}: it stalls "
+                        "the owning event loop (every connection on that "
+                        "shard); run it in an executor or use the async "
+                        "variant",
+                    )
+                )
+    return out
+
+
+# -- R12: future resolution loop discipline ----------------------------------
+
+_FUT_NAME_RE = re.compile(r"(^|_)(fut|future|waiter)s?$|^f$", re.I)
+_LOOPISH_RE = re.compile(r"(^|_)loop$|^running$", re.I)
+
+
+def _has_loop_guard(fn_node: ast.AST) -> bool:
+    """True when the function carries the marshal-seam guard shape: a
+    ``.get_loop()`` call, or an ``is``/``is not`` comparison between
+    two loop-named operands (``loop is self._loop``,
+    ``loop is running``)."""
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get_loop"
+            ):
+                return True
+        elif isinstance(node, ast.Compare):
+            if not any(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops
+            ):
+                continue
+            operands = [node.left] + list(node.comparators)
+            loopish = sum(
+                1
+                for o in operands
+                if _LOOPISH_RE.search(_terminal_name(o) or "")
+            )
+            if loopish >= 2:
+                return True
+    return False
+
+
+def _callback_referenced_funcs(ctx: FileCtx) -> set[str]:
+    """Function/method names passed by reference (not called) anywhere
+    in this file to a loop scheduler — their bodies run on the target
+    loop, so resolving a future inside them IS the marshal seam."""
+    out: set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOOP_CB_SCHEDULERS
+        ):
+            continue
+        for arg in node.args:
+            name = _terminal_name(arg)
+            if name:
+                out.add(name)
+    return out
+
+
+def _creates_future_locally(fn_node: ast.AST) -> bool:
+    for node in _iter_scope(list(getattr(fn_node, "body", []))):
+        if isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "create_future"
+            ):
+                return True
+    return False
+
+
+def check_r12(ctx: FileCtx) -> list[Finding]:
+    """A Future must be resolved on its creation loop or through a
+    marshal seam (the staging submit/resolve contract, checked
+    statically): ``set_result``/``set_exception`` on a parked future
+    from the wrong loop schedules its done-callbacks cross-thread. A
+    resolution passes when its function (a) guards on loop identity
+    (``fut.get_loop()`` / ``loop is self._loop``), (b) is itself
+    marshaled (passed by reference to call_soon*/call_later), or (c)
+    resolves a future it created in the same scope."""
+    out = []
+    marshaled = _callback_referenced_funcs(ctx)
+    # nested defs: a closure defined inside a guarded/marshaling parent
+    # inherits the seam (_resolve's `_set` shape)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if (
+            node.name in marshaled
+            or _has_loop_guard(node)
+            or _creates_future_locally(node)
+        ):
+            continue
+        for sub in _iter_scope(node.body):
+            if not isinstance(sub, ast.Call):
+                continue
+            fn = sub.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in ("set_result", "set_exception")
+            ):
+                continue
+            recv = _terminal_name(fn.value) or ""
+            if not _FUT_NAME_RE.search(recv):
+                continue
+            out.append(
+                ctx.finding(
+                    "R12", sub,
+                    f"{recv}.{fn.attr}() in `{node.name}` without a loop "
+                    "guard: a future parked by another loop's submitter "
+                    "must resolve on ITS loop (compare fut.get_loop(), or "
+                    "marshal via call_soon_threadsafe) — the staging "
+                    "submit/resolve contract",
+                )
+            )
+    return out
+
+
+# -- R13: spawned tasks must be tracked --------------------------------------
+
+
+def check_r13(ctx: FileCtx) -> list[Finding]:
+    """Every spawned task holds a tracking binding or registers in a
+    tracked set: asyncio keeps only a WEAK reference to running tasks,
+    so a fire-and-forget ``create_task`` can be garbage-collected
+    mid-flight (the PR 15 per-shard establish-task shape exists for
+    exactly this)."""
+    out = []
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(ctx.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        spawned = None
+        if isinstance(fn, ast.Attribute) and fn.attr == "create_task":
+            spawned = "create_task"
+        elif _dotted(fn) in ("asyncio.ensure_future", "ensure_future"):
+            spawned = "ensure_future"
+        if spawned is None:
+            continue
+        parent = parents.get(node)
+        tracked = False
+        if isinstance(
+            parent, (ast.Assign, ast.AnnAssign, ast.NamedExpr, ast.Return,
+                     ast.Await),
+        ):
+            tracked = True
+        elif isinstance(parent, ast.Call):
+            # shard.track(loop.create_task(...)), tasks.append(...),
+            # gather(...), setattr(...) — any enclosing call holds a
+            # reference the spawner can account for
+            tracked = True
+        elif isinstance(
+            parent,
+            (ast.Tuple, ast.List, ast.Set, ast.Dict, ast.ListComp,
+             ast.SetComp, ast.DictComp, ast.GeneratorExp),
+        ):
+            # the container (or the comprehension's result) holds the
+            # reference; its own binding is the spawner's problem
+            tracked = True
+        if not tracked:
+            out.append(
+                ctx.finding(
+                    "R13", node,
+                    f"fire-and-forget {spawned}(): bind the task or "
+                    "register it in a tracked set (asyncio holds only a "
+                    "weak reference; an untracked task can be GC'd "
+                    "mid-flight and its failures vanish)",
+                )
+            )
+    return out
+
+
+# -- R14: await/blocking under a lock, one call level deep -------------------
+
+
+def check_r14(ctx: FileCtx) -> list[Finding]:
+    """The one-level propagation of R1: a function whose EVERY call
+    site sits under a held lock is itself a lock-held scope (the R5
+    machinery), so ``await`` or a blocking call inside it suspends the
+    loop while the lock pins every other holder — the same finding R1
+    raises for the lexical case."""
+    out = []
+    funcs = _module_functions(ctx.tree)
+    for name in sorted(_funcs_called_only_under_locks(ctx)):
+        node = funcs[name]
+        desc = f"{name}() [only ever called under a lock]"
+        for sub in _iter_scope(node.body):
+            if isinstance(sub, ast.Await):
+                out.append(
+                    ctx.finding(
+                        "R14", sub,
+                        f"`await` inside {desc}: the caller's lock is "
+                        "held across the suspension point (R1, one call "
+                        "level deep)",
+                    )
+                )
+            elif isinstance(sub, ast.Call):
+                what = _is_blocking_call(sub)
+                if what is not None:
+                    out.append(
+                        ctx.finding(
+                            "R14", sub,
+                            f"blocking call {what}() inside {desc}: the "
+                            "caller's lock is held across it (R1, one "
+                            "call level deep)",
+                        )
+                    )
+    return out
+
+
+# -- R15: implicit device->host syncs on the device hot path -----------------
+
+_R15_SCOPES = ("mqtt_tpu/ops/", "mqtt_tpu/parallel/sharded.py")
+_DEV_NAME_RE = re.compile(r"(_dev|_device)$|^dev_", re.I)
+_HOST_CASTS = {"float", "bool", "int"}
+
+
+def _is_dev_expr(node: ast.AST) -> bool:
+    """Heuristic: the repo names device-resident arrays ``*_dev`` (the
+    matcher/predicates/recrypt convention); a Subscript/Attribute/Call
+    chain rooted at one stays device-resident."""
+    while isinstance(node, (ast.Subscript, ast.Attribute, ast.Call)):
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        elif isinstance(node, ast.Attribute):
+            node = node.value
+        else:
+            node = node.func
+    name = _terminal_name(node)
+    return name is not None and bool(_DEV_NAME_RE.search(name))
+
+
+def check_r15(ctx: FileCtx) -> list[Finding]:
+    """No implicit device->host syncs inside the device hot path
+    (``mqtt_tpu/ops/``, ``parallel/sharded.py``): ``.item()``,
+    ``jax.device_get``, ``np.asarray`` over a device array, and host
+    casts (``float``/``bool``/``int``) over one each force a blocking
+    transfer that serializes the dispatch pipeline — the static
+    complement to the PR 18 compile ledger. Intentional resolve seams
+    (the ONE-D2H batched reads) carry reasoned pragmas."""
+    if not any(
+        ctx.rel.startswith(p) or ctx.rel == p.rstrip("/") for p in _R15_SCOPES
+    ):
+        return []
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "item" and not node.args:
+            out.append(
+                ctx.finding(
+                    "R15", node,
+                    ".item() is an implicit blocking device->host sync; "
+                    "batch the read through one np.asarray at a blessed "
+                    "resolve seam (reasoned pragma if intentional)",
+                )
+            )
+            continue
+        d = _dotted(fn)
+        if d in ("jax.device_get",):
+            out.append(
+                ctx.finding(
+                    "R15", node,
+                    "jax.device_get() blocks on the transfer; prefer "
+                    "copy_to_host_async + one np.asarray at the resolve "
+                    "seam",
+                )
+            )
+            continue
+        if (
+            d in ("np.asarray", "numpy.asarray")
+            and node.args
+            and _is_dev_expr(node.args[0])
+        ):
+            out.append(
+                ctx.finding(
+                    "R15", node,
+                    "np.asarray over a device array is a blocking D2H "
+                    "sync; blessed resolve seams carry a reasoned pragma "
+                    "naming the ONE transfer they batch",
+                )
+            )
+            continue
+        if (
+            isinstance(fn, ast.Name)
+            and fn.id in _HOST_CASTS
+            and len(node.args) == 1
+            and _is_dev_expr(node.args[0])
+        ):
+            out.append(
+                ctx.finding(
+                    "R15", node,
+                    f"{fn.id}() over a device array forces an implicit "
+                    "per-element D2H sync; resolve the batch once and "
+                    "cast on the host",
+                )
+            )
+    return out

@@ -740,6 +740,17 @@ def check_r8(ctx: FileCtx) -> list[Finding]:
     return out
 
 
+def _loop_rule(name: str):
+    # late import: loopgraph imports helpers from this module
+    def run(ctx: FileCtx) -> list[Finding]:
+        from . import loopgraph
+
+        return getattr(loopgraph, name)(ctx)
+
+    run.__name__ = name
+    return run
+
+
 FILE_RULES = {
     "R1": check_r1,
     "R2": check_r2,
@@ -748,6 +759,12 @@ FILE_RULES = {
     "R5": check_r5,
     "R7": check_r7,
     "R8": check_r8,
+    "R10": _loop_rule("check_r10"),
+    "R11": _loop_rule("check_r11"),
+    "R12": _loop_rule("check_r12"),
+    "R13": _loop_rule("check_r13"),
+    "R14": _loop_rule("check_r14"),
+    "R15": _loop_rule("check_r15"),
 }
 
 def _check_r9(ctxs: list[FileCtx], root: str) -> list[Finding]:
@@ -772,4 +789,11 @@ RULE_DOC = {
     "R7": "threads: explicit daemon= and a tracking binding",
     "R8": "no mutable default args / module-level mutable singletons",
     "R9": "lock-order graph: acyclic and consistent with LOCK_ORDER",
+    "R10": "loop-affine objects: foreign threads marshal via "
+           "call_soon_threadsafe (N-shard generalization of R2)",
+    "R11": "no blocking calls inside async bodies or loop callbacks",
+    "R12": "futures resolve on their creation loop or via a marshal seam",
+    "R13": "every spawned task is bound or registered in a tracked set",
+    "R14": "no await/blocking calls in functions only called under locks",
+    "R15": "no implicit device->host syncs on the device hot path",
 }
