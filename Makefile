@@ -6,7 +6,7 @@
 SHELL := /bin/bash
 PY ?= python
 
-.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph loopgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster scrape-devices
+.PHONY: verify chaos-smoke test lint typecheck c-gate san-gate stage-gate lockgraph loopgraph pipeline-smoke conn-smoke recovery-smoke bench-trend scrape-cluster scrape-devices scenario-smoke scenario-matrix
 
 # static analysis: the repo-specific concurrency/invariant lint pass
 # (tools/brokerlint, README "Static analysis"), the mypy gate over the
@@ -115,6 +115,19 @@ pipeline-smoke:
 # conn-smoke.json (uploaded as a CI artifact)
 conn-smoke:
 	env JAX_PLATFORMS=cpu $(PY) exp/conn_smoke.py
+
+# scenario lab (exp/scenario_lab.py + mqtt_tpu/scenarios.py, ISSUE 20):
+# seeded workload/fault scenarios judged by the delivery oracle AND the
+# SLO engine's burn-rate objectives. The smoke tier runs in the CI
+# verify job (artifact: exp/artifacts/scenario_lab.json); the full
+# matrix — QoS2 kill -9 exactly-once, will storm, 3-worker federation,
+# live tenant re-key — rides the nightly chaos leg and appends its
+# round to BENCH_HISTORY.jsonl for the bench-trend gate
+scenario-smoke:
+	env JAX_PLATFORMS=cpu $(PY) exp/scenario_lab.py --smoke
+
+scenario-matrix:
+	env JAX_PLATFORMS=cpu $(PY) exp/scenario_lab.py --all
 
 # crash-recovery smoke (exp/recovery_smoke.py): seed a broker subprocess
 # with persistent sessions + retained state over the log-structured

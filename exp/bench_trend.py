@@ -177,6 +177,14 @@ CONFIG_SCALARS = (
     # scalar is asserted == 0 by tier-1 tests; the ledger keeps it for
     # post-hoc attribution when a regression lands anyway
     ("10_recrypt_matrix", "keystream_device_bytes_per_sec"),
+    # scenario lab (ISSUE 20): exp/scenario_lab.py appends matrix
+    # rounds under its own headline metric; these per-scenario rates
+    # catch a slow scenario (throughput cliff) even while its oracle
+    # still passes. Pass/fail itself is enforced by the lab's exit
+    # code, not here — "passed" is a bit, not a trendable scalar.
+    ("scenario_payload_sweep", "deliveries_per_sec"),
+    ("scenario_qos2_fanout", "deliveries_per_sec"),
+    ("scenario_tenant_rekey", "deliveries_per_sec"),
 )
 
 

@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Scenario lab runner (ISSUE 20 tentpole CLI).
+
+Executes the declarative workload/fault scenarios in
+``mqtt_tpu/scenarios.py`` — each one a seeded fleet + traffic mix +
+fault script judged by a delivery oracle AND the SLO engine's
+burn-rate objectives — and writes the machine-readable verdicts the
+rest of the repo's gating already consumes:
+
+- a JSON artifact (``--out``, default ``exp/artifacts/scenario_lab.json``)
+  with the full per-scenario result docs (oracle counts, SLO objective
+  states, driver metrics, wall time, seed) for CI upload;
+- a ``BENCH_HISTORY.jsonl`` entry (via ``bench.append_history`` — the
+  ONE ledger schema) whose headline is the matrix's aggregate delivery
+  rate under its own metric name, so ``exp/bench_trend.py`` trends
+  scenario rounds against scenario rounds and bench rounds against
+  bench rounds without cross-contamination. Per-scenario scalar blocks
+  land under ``configs["scenario_<name>"]`` where the trend gate's
+  CONFIG_SCALARS rows watch them.
+
+History appends only for the canonical selections (``--smoke`` /
+``--all``): an ad-hoc named run or a ``--seed`` override is not a
+comparable round and must not enter the trend window.
+
+Usage:
+    python exp/scenario_lab.py --smoke            # CI verify-job gate
+    python exp/scenario_lab.py --all              # nightly full matrix
+    python exp/scenario_lab.py tenant_rekey       # one scenario, ad hoc
+    python exp/scenario_lab.py --all --seed 7     # reseeded (no ledger)
+Exit code is non-zero when any selected scenario fails its oracle or
+breaches an SLO objective.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from mqtt_tpu.scenarios import SCENARIOS, run_matrix, scenario_names  # noqa: E402
+
+
+def _config_block(res: dict) -> dict:
+    """The per-scenario scalar slice kept in the history ledger: oracle
+    counts, pass bit, wall time, throughput, plus every numeric the
+    driver reported (bench.py's ``_history_config_block`` drops
+    non-scalars on append, so richer values are safe to include)."""
+    oracle = res.get("oracle") or {}
+    wall = res.get("wall_s") or 0.0
+    delivered = oracle.get("delivered", 0)
+    block: dict = {
+        "passed": bool(res.get("passed")),
+        "expected": oracle.get("expected", 0),
+        "delivered": delivered,
+        "gaps": oracle.get("gaps", 0),
+        "duplicates": oracle.get("duplicates", 0),
+        "faults": oracle.get("faults", 0),
+        "wall_s": wall,
+        "deliveries_per_sec": (delivered / wall) if wall > 0 else 0,
+        "seed": res.get("seed"),
+    }
+    for k, v in (res.get("metrics") or {}).items():
+        if isinstance(v, (int, float, bool)) and k not in block:
+            block[k] = v
+    return block
+
+
+def _history_doc(results: list[dict], selection: str) -> dict:
+    """A bench-document-shaped dict for ``bench.append_history``: the
+    headline is the matrix aggregate delivery rate, named per selection
+    (smoke vs full matrices are different workloads — bench_trend's
+    same-metric rule keeps their trend lines separate)."""
+    delivered = sum((r.get("oracle") or {}).get("delivered", 0) for r in results)
+    wall = sum(r.get("wall_s") or 0.0 for r in results)
+    return {
+        "metric": f"scenario_deliveries_per_sec@{selection}",
+        "value": round(delivered / wall, 1) if wall > 0 else None,
+        "configs": {
+            f"scenario_{r['scenario']}": _config_block(r) for r in results
+        },
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument(
+        "names",
+        nargs="*",
+        help=f"scenario names to run (known: {', '.join(SCENARIOS)})",
+    )
+    ap.add_argument(
+        "--smoke",
+        action="store_true",
+        help="run only the smoke-tier scenarios (CI verify job)",
+    )
+    ap.add_argument(
+        "--all", action="store_true", help="run the full scenario matrix"
+    )
+    ap.add_argument(
+        "--seed",
+        type=int,
+        default=None,
+        help="override every spec's seed (disables the history append)",
+    )
+    ap.add_argument(
+        "--out",
+        default=os.path.join(_REPO, "exp", "artifacts", "scenario_lab.json"),
+        help="artifact path for the full result docs",
+    )
+    ap.add_argument(
+        "--no-history",
+        action="store_true",
+        help="skip the BENCH_HISTORY.jsonl append even for canonical runs",
+    )
+    ap.add_argument("--list", action="store_true", help="list scenarios")
+    args = ap.parse_args()
+
+    if args.list:
+        for name, spec in SCENARIOS.items():
+            tier = "smoke" if spec.smoke else "full "
+            print(f"{name:20s} [{tier}] seed={spec.seed}  {spec.title}")
+        return 0
+
+    if args.names:
+        unknown = [n for n in args.names if n not in SCENARIOS]
+        if unknown:
+            ap.error(f"unknown scenario(s): {', '.join(unknown)}")
+        names, selection = list(args.names), "custom"
+    elif args.all:
+        names, selection = scenario_names(), "full"
+    elif args.smoke:
+        names, selection = scenario_names(smoke_only=True), "smoke"
+    else:
+        ap.error("pick scenarios by name, or pass --smoke / --all")
+        return 2  # unreachable; keeps type-checkers honest
+
+    print(f"scenario-lab: running {len(names)} scenario(s): {', '.join(names)}")
+    results = run_matrix(names, seed=args.seed)
+
+    failed = [r["scenario"] for r in results if not r.get("passed")]
+    for r in results:
+        oracle = r.get("oracle") or {}
+        mark = "PASS" if r.get("passed") else "FAIL"
+        print(
+            f"scenario-lab: [{mark}] {r['scenario']:18s} "
+            f"delivered {oracle.get('delivered', 0)}/{oracle.get('expected', 0)} "
+            f"gaps={oracle.get('gaps', 0)} dups={oracle.get('duplicates', 0)} "
+            f"wall={r.get('wall_s', 0):.2f}s"
+        )
+        for msg in r.get("failures") or []:
+            print(f"scenario-lab:        - {msg}")
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    artifact = {
+        "selection": selection,
+        "seed_override": args.seed,
+        "passed": not failed,
+        "results": results,
+    }
+    with open(args.out, "w", encoding="utf-8") as f:
+        json.dump(artifact, f, indent=2, default=str)
+    print(f"scenario-lab: artifact written to {args.out}")
+
+    # failed rounds never enter the ledger: a red matrix's delivery
+    # rate is not a comparable baseline, and CI already fails on rc=1
+    canonical = (
+        selection in ("smoke", "full") and args.seed is None and not failed
+    )
+    if canonical and not args.no_history:
+        from bench import append_history
+
+        append_history(_history_doc(results, selection))
+
+    if failed:
+        print(f"scenario-lab: FAILED: {', '.join(failed)}")
+        return 1
+    print(f"scenario-lab: all {len(results)} scenario(s) passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -752,3 +752,28 @@ def stall_peer_reads(cluster: Any) -> Callable[[], None]:
         gate.set()
 
     return release
+
+
+def drop_fleet(writers: list, k: int, seed: int) -> list:
+    """Seeded mass disconnect (ISSUE 20): abruptly close ``k`` of the
+    fleet's client transports in one tick — no DISCONNECT packet, the
+    TCP-RST shape a power failure or network cut leaves behind, so every
+    victim's will fires (or delays) server-side. ``writers`` are the
+    CLIENT-side StreamWriters (or anything carrying ``.transport``);
+    returns the chosen indices, sorted, drawn from ``seed`` so the
+    will-storm and reconnect scenarios replay exactly.
+
+    The close is ``transport.abort()`` — never ``close()``, which would
+    flush and read as a graceful teardown."""
+    rng = random.Random(seed)
+    n = len(writers)
+    k = max(0, min(k, n))
+    victims = sorted(rng.sample(range(n), k))
+    for i in victims:
+        w = writers[i]
+        tr = getattr(w, "transport", None) or w
+        try:
+            tr.abort()
+        except (OSError, RuntimeError):  # already-dead victim: no-op
+            pass
+    return victims

@@ -46,6 +46,13 @@ OP_CONTAINS = 7
 OP_MEAN = 8
 OP_MAX = 9
 OP_MIN = 10
+# string equality: rides the host-computed bitmask exactly like CONTAINS
+# (the host interns (field, literal) pairs and sets the bit per publish)
+OP_EQS = 11
+# compound ops never appear in the device table: their CHILDREN compile
+# to ordinary rows and the boolean combine happens host-side per verdict
+OP_AND = 12
+OP_OR = 13
 
 
 def rules_eval_core(op, slot, thresh, cbit, feats, cmask):
@@ -73,7 +80,8 @@ def rules_eval_core(op, slot, thresh, cbit, feats, cmask):
     res = res | nanp
     cword = jnp.take(cmask, jnp.clip(cbit, 0, None) >> 5, axis=1)  # [B,R]
     cpass = ((cword >> (jnp.clip(cbit, 0, None) & 31).astype(jnp.uint32)) & 1) != 0
-    res = jnp.where(op[None, :] == OP_CONTAINS, cpass, res)
+    bitop = (op[None, :] == OP_CONTAINS) | (op[None, :] == OP_EQS)
+    res = jnp.where(bitop, cpass, res)
     bits = res.astype(jnp.uint32).reshape(B, R // 32, 32)
     weights = (jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32))[None, None, :]
     return (bits * weights).sum(axis=2).astype(jnp.uint32)

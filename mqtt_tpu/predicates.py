@@ -63,9 +63,11 @@ import numpy as np
 
 from .topics import (
     PREDICATE_AGG_OPS,
+    PREDICATE_COMPOUND_OPS,
     PREDICATE_NUMERIC_OPS,
     Subscribers,
     split_predicate_suffix,
+    split_predicate_tokens,
 )
 from .utils.locked import InstrumentedLock
 
@@ -84,6 +86,14 @@ OP_CONTAINS = 7
 OP_MEAN = 8
 OP_MAX = 9
 OP_MIN = 10
+# string equality ($EQS{field:literal}): device path rides the
+# host-computed bitmask exactly like CONTAINS — the host interns the
+# (field, literal) pair and sets the verdict bit once per publish
+OP_EQS = 11
+# compound ops ($AND{...}/$OR{...}): the CHILDREN compile to ordinary
+# device rows; the boolean combine happens host-side from the child bits
+OP_AND = 12
+OP_OR = 13
 
 _OP_CODES = {
     "GT": OP_GT,
@@ -96,8 +106,12 @@ _OP_CODES = {
     "MEAN": OP_MEAN,
     "MAX": OP_MAX,
     "MIN": OP_MIN,
+    "EQS": OP_EQS,
+    "AND": OP_AND,
+    "OR": OP_OR,
 }
 _AGG_CODES = {OP_MEAN, OP_MAX, OP_MIN}
+_COMPOUND_CODES = {OP_AND, OP_OR}
 
 
 @dataclass(frozen=True)
@@ -107,12 +121,17 @@ class PredicateSpec:
     op: int  # OP_* code
     field: str = ""  # JSON field name; "" = whole payload as the number
     value: float = 0.0  # comparison threshold (numeric ops)
-    text: bytes = b""  # substring (CONTAINS)
+    text: bytes = b""  # substring (CONTAINS) / literal utf-8 (EQS)
     window: int = 0  # sample count per emission (aggregation ops)
+    children: tuple = ()  # member specs (AND/OR compounds only)
 
     @property
     def is_agg(self) -> bool:
         return self.op in _AGG_CODES
+
+    @property
+    def is_compound(self) -> bool:
+        return self.op in _COMPOUND_CODES
 
 
 def predicate_digest(suffix: str) -> int:
@@ -136,10 +155,23 @@ def compile_suffix(suffix: str) -> PredicateSpec:
     code = _OP_CODES.get(op_name)
     if code is None:
         raise ValueError(f"unknown predicate op: {op_name!r}")
+    if op_name in PREDICATE_COMPOUND_OPS:
+        tokens = split_predicate_tokens(arg)
+        if not tokens:
+            raise ValueError(f"malformed compound predicate: {suffix!r}")
+        children = tuple(compile_suffix(t) for t in tokens)
+        return PredicateSpec(op=code, children=children)
     if code == OP_CONTAINS:
         if not arg:
             raise ValueError("empty $CONTAINS argument")
         return PredicateSpec(op=code, text=arg.encode("utf-8"))
+    if code == OP_EQS:
+        field_part, sep, literal = arg.partition(":")
+        if not sep:
+            raise ValueError(f"malformed $EQS argument: {arg!r}")
+        return PredicateSpec(
+            op=code, field=field_part, text=literal.encode("utf-8")
+        )
     field_part, _, num = arg.rpartition(":")
     if op_name in PREDICATE_AGG_OPS:
         window = int(num)
@@ -199,13 +231,59 @@ def payload_number(payload: bytes, field: str, doc: Any = None) -> float:
 _NOT_JSON = object()  # sentinel: payload parsed and found not-a-JSON-object
 
 
+def payload_string(payload: bytes, field: str, doc: Any = None) -> Optional[str]:
+    """Extract the STRING feature ``field`` from a JSON payload; None
+    when the payload has no such string (skip-to-pass upstream). Same
+    flat-key-wins dotted traversal as :func:`payload_number`."""
+    if doc is None:
+        try:
+            doc = json.loads(payload)
+        except (ValueError, UnicodeDecodeError):
+            doc = _NOT_JSON
+    if not isinstance(doc, dict):
+        return None
+    v = doc.get(field)
+    if v is None and "." in field and field not in doc:
+        v = doc
+        for seg in field.split("."):
+            if not isinstance(v, dict):
+                v = None
+                break
+            v = v.get(seg)
+    return v if isinstance(v, str) else None
+
+
+def eval_equals(payload: bytes, field: str, text: bytes, doc: Any = None) -> bool:
+    """The $EQS verdict — shared by the host interpreter AND the feature
+    extractor (the device gathers the host-computed bit, so both paths
+    are this function by construction). ``field=""`` compares the whole
+    payload bytes; a missing or non-string field skips to PASS."""
+    if field == "":
+        return payload == text
+    v = payload_string(payload, field, doc)
+    if v is None:
+        return True  # skip-to-pass: the predicate does not apply
+    return v.encode("utf-8") == text
+
+
 def eval_rule_host(spec: PredicateSpec, payload: bytes, doc: Any = None) -> bool:
     """The host predicate interpreter — the differential oracle for the
     device kernel and the degradation path when the breaker is open.
     Numeric comparisons coerce both sides to float32 so the verdict is
-    bit-identical to the device's."""
+    bit-identical to the device's. Compounds recurse over their member
+    specs (one JSON parse shared across every child)."""
+    if spec.children:
+        if doc is None and any(c.field for c in spec.children):
+            try:
+                doc = json.loads(payload)
+            except (ValueError, UnicodeDecodeError):
+                doc = _NOT_JSON
+        verdicts = (eval_rule_host(c, payload, doc) for c in spec.children)
+        return all(verdicts) if spec.op == OP_AND else any(verdicts)
     if spec.op == OP_CONTAINS:
         return spec.text in payload
+    if spec.op == OP_EQS:
+        return eval_equals(payload, spec.field, spec.text, doc)
     v = payload_number(payload, spec.field, doc)
     if math.isnan(v):
         return True  # skip-to-pass: the predicate does not apply
@@ -262,12 +340,13 @@ class CompiledRule:
     rebuild invalidates ``idx_gen`` BEFORE moving ``idx``)."""
 
     spec: PredicateSpec
-    slot: int = -1  # field slot in the feature vector (-1: CONTAINS)
-    cbit: int = -1  # contains bitmask bit (-1: numeric/agg)
+    slot: int = -1  # field slot in the feature vector (-1: CONTAINS/EQS)
+    cbit: int = -1  # verdict bitmask bit (-1: numeric/agg/compound)
     refs: int = 0  # live subscriptions referencing this rule
     idx: int = -1  # dense row in the device table (valid per idx_gen)
     idx_gen: int = -1  # table generation idx belongs to
     device: bool = True  # eligible for the device table at all
+    children: tuple = ()  # member suffixes (compounds; refcounted rules)
 
 
 class _AggWindow:
@@ -369,7 +448,12 @@ class PredicateEngine:
         self._lock = InstrumentedLock("predicate_rules")
         self._rules: dict[str, CompiledRule] = {}
         self._fields: dict[str, int] = {}  # field name -> feature slot
+        # the verdict bitmask is ONE shared bit space: CONTAINS interns
+        # substrings, EQS interns (field, literal) pairs — bits are
+        # allocated from the combined counter and stay monotonic until
+        # the whole rule set drains (same discipline as field slots)
         self._contains: dict[bytes, int] = {}  # substring -> bitmask bit
+        self._equals: dict[tuple[str, bytes], int] = {}  # (field, lit) -> bit
         self._gen = 0  # bumped on every registry mutation
         self._table_gen = -1  # generation the device table was built at
         # mqtt_tpu.ops.predicates.DeviceRuleEvaluator, built lazily on
@@ -432,28 +516,56 @@ class PredicateEngine:
     def register(self, suffix: str) -> CompiledRule:
         """Intern one predicate suffix (refcounted)."""
         with self._lock:
-            rule = self._rules.get(suffix)
-            if rule is not None:
-                rule.refs += 1
-                return rule
-            spec = compile_suffix(suffix)
-            rule = CompiledRule(spec=spec, refs=1)
-            if spec.op == OP_CONTAINS:
-                bit = self._contains.get(spec.text)
-                if bit is None:
-                    bit = self._contains[spec.text] = len(self._contains)
-                rule.cbit = bit
-            else:
-                slot = self._fields.get(spec.field)
-                if slot is None:
-                    slot = self._fields[spec.field] = len(self._fields)
-                rule.slot = slot
-            # aggregation is host-state; rules past the table cap stay
-            # host-interpreted (degraded, never refused)
-            rule.device = not spec.is_agg and len(self._rules) < self.max_rules
-            self._rules[suffix] = rule
-            self._gen += 1
+            return self._register_locked(suffix)
+
+    def _register_locked(self, suffix: str) -> CompiledRule:
+        rule = self._rules.get(suffix)
+        if rule is not None:
+            rule.refs += 1
             return rule
+        spec = compile_suffix(suffix)
+        rule = CompiledRule(spec=spec, refs=1)
+        if spec.children:
+            # compound: each member interns as its OWN (device-eligible)
+            # rule holding one parent reference; the compound row never
+            # enters the device table — _rule_passes combines the child
+            # bits host-side, so the members still evaluate on device
+            op_name, _, arg = suffix[1:-1].partition("{")
+            tokens = split_predicate_tokens(arg)
+            for t in tokens:
+                self._register_locked(t)
+            rule.children = tokens
+        elif spec.op == OP_CONTAINS:
+            bit = self._contains.get(spec.text)
+            if bit is None:
+                bit = self._contains[spec.text] = len(self._contains) + len(
+                    self._equals
+                )
+            rule.cbit = bit
+        elif spec.op == OP_EQS:
+            key = (spec.field, spec.text)
+            bit = self._equals.get(key)
+            if bit is None:
+                bit = self._equals[key] = len(self._contains) + len(
+                    self._equals
+                )
+            rule.cbit = bit
+        else:
+            slot = self._fields.get(spec.field)
+            if slot is None:
+                slot = self._fields[spec.field] = len(self._fields)
+            rule.slot = slot
+        # aggregation is host-state, compounds are host-combined; rules
+        # past the table cap stay host-interpreted (degraded, never
+        # refused)
+        rule.device = (
+            not spec.is_agg
+            and not spec.children
+            and len(self._rules) < self.max_rules
+        )
+        self._rules[suffix] = rule
+        self._gen += 1
+        return rule
 
     def release(self, predicates: tuple) -> None:
         """Drop one reference per suffix (unsubscribe / replace)."""
@@ -461,20 +573,27 @@ class PredicateEngine:
             return
         with self._lock:
             for suffix in predicates:
-                rule = self._rules.get(suffix)
-                if rule is None:
-                    continue
-                rule.refs -= 1
-                if rule.refs <= 0:
-                    del self._rules[suffix]
-                    self._gen += 1
-                    # field slots / contains bits are monotonic: vectors
-                    # stay index-stable across releases, and the widths
-                    # only reset when the whole rule set drains
+                self._release_locked(suffix)
             if not self._rules:
                 self._fields.clear()
                 self._contains.clear()
+                self._equals.clear()
                 self._agg.clear()
+
+    def _release_locked(self, suffix: str) -> None:
+        rule = self._rules.get(suffix)
+        if rule is None:
+            return
+        rule.refs -= 1
+        if rule.refs <= 0:
+            del self._rules[suffix]
+            self._gen += 1
+            # a dying compound drops its one reference on each member
+            for child in rule.children:
+                self._release_locked(child)
+            # field slots / verdict bits are monotonic: vectors stay
+            # index-stable across releases, and the widths only reset
+            # when the whole rule set drains
 
     # -- feature extraction ------------------------------------------------
 
@@ -488,20 +607,28 @@ class PredicateEngine:
         gen = self._gen
         fields = list(self._fields.items())
         contains = list(self._contains.items())
+        equals = list(self._equals.items())
         fvec = np.empty(max(1, len(fields)), dtype=np.float32)
-        if fields:
-            doc: Any = None
-            if any(name != "" for name, _ in fields):
-                try:
-                    doc = json.loads(payload)
-                except (ValueError, UnicodeDecodeError):
-                    doc = _NOT_JSON
-            for name, slot in fields:
-                if slot < fvec.shape[0]:
-                    fvec[slot] = np.float32(payload_number(payload, name, doc))
-        mask = np.zeros(max(1, (len(contains) + 31) // 32), dtype=np.uint32)
+        doc: Any = None
+        if any(name != "" for name, _ in fields) or any(
+            f != "" for (f, _t), _ in equals
+        ):
+            try:
+                doc = json.loads(payload)
+            except (ValueError, UnicodeDecodeError):
+                doc = _NOT_JSON
+        for name, slot in fields:
+            if slot < fvec.shape[0]:
+                fvec[slot] = np.float32(payload_number(payload, name, doc))
+        n_bits = len(contains) + len(equals)
+        mask = np.zeros(max(1, (n_bits + 31) // 32), dtype=np.uint32)
         for text, bit in contains:
-            if text in payload:
+            if text in payload and (bit >> 5) < mask.shape[0]:
+                mask[bit >> 5] |= np.uint32(1 << (bit & 31))
+        for (field, text), bit in equals:
+            if (bit >> 5) < mask.shape[0] and eval_equals(
+                payload, field, text, doc
+            ):
                 mask[bit >> 5] |= np.uint32(1 << (bit & 31))
         return PublishFeatures(payload, fvec, mask, gen)
 
@@ -538,7 +665,9 @@ class PredicateEngine:
             [r.slot for r in rules],
             [r.cbit for r in rules],
             n_slots=max(1, len(self._fields)),
-            n_cwords=max(1, (len(self._contains) + 31) // 32),
+            n_cwords=max(
+                1, (len(self._contains) + len(self._equals) + 31) // 32
+            ),
         )
         self._table_gen = gen
         for rule in rules:
@@ -668,6 +797,29 @@ class PredicateEngine:
         self, rule: CompiledRule, payload: bytes, feats, oracle: bool, memo: list
     ) -> bool:
         spec = rule.spec
+        if rule.children:
+            # compound: combine the member verdicts — each member is its
+            # own interned rule, so each rides the device pass-bit row
+            # when one is attached (the compound itself has no table row)
+            verdicts = []
+            for sfx, cspec in zip(rule.children, spec.children):
+                crule = self._rules.get(sfx)
+                if crule is not None:
+                    verdicts.append(
+                        self._rule_passes(crule, payload, feats, oracle, memo)
+                    )
+                else:
+                    # member released mid-flight (raced unsubscribe):
+                    # evaluate its spec directly, same verdict either way
+                    self.host_evals += 1
+                    verdicts.append(
+                        eval_rule_host(
+                            cspec,
+                            payload,
+                            self._doc(payload, memo) if cspec.field else None,
+                        )
+                    )
+            return all(verdicts) if spec.op == OP_AND else any(verdicts)
         # read idx BEFORE idx_gen: the rebuild path invalidates idx_gen
         # first, so a generation match here guarantees the idx we read
         # belongs to the row's table (see _rebuild_evaluator)
@@ -970,6 +1122,7 @@ class PredicateEngine:
             ),
             "fields": len(self._fields),
             "contains": len(self._contains),
+            "equals": len(self._equals),
             "device_evals": self.device_evals,
             "device_batches": self.device_batches,
             "device_decisions": self.device_decisions,

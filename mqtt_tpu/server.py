@@ -4430,6 +4430,131 @@ class Server:
         out.payload = data
         return out
 
+    # -- live tenant re-key (ISSUE 20, the MQT-TZ rotation residual) -------
+
+    def _publish_rekey_notice(
+        self, tenant: str, state: str, epoch: int, extra: Optional[dict] = None
+    ) -> None:
+        """The $SYS half of the epoch protocol: a retained
+        ``$SYS/broker/tenant/rekey`` message in the tenant's OWN
+        namespace (its clients subscribe there to learn the new epoch)
+        plus the global operator mirror, published on every state edge
+        (distributing -> active -> retired)."""
+        payload = {"tenant": tenant, "epoch": epoch, "state": state}
+        if extra:
+            payload.update(extra)
+        data = json.dumps(payload).encode()
+        now = int(time.time())  # brokerlint: ok=R3 $SYS rekey notice stamps are wall-clock (operator-correlatable)
+        for topic in (
+            ns_scope_topic(tenant, SYS_PREFIX + "/broker/tenant/rekey"),
+            SYS_PREFIX + f"/broker/tenants/{tenant}/rekey",
+        ):
+            pk = Packet(
+                fixed_header=FixedHeader(type=pkts.PUBLISH, retain=True),
+                topic_name=topic,
+                payload=data,
+                created=now,
+            )
+            self.topics.retain_message(pk.copy(False))
+            if self._retained_engine is not None:
+                self._retained_engine.note_retained(topic, True)
+            self.publish_to_subscribers(pk)
+
+    def rekey_tenant(
+        self, name: str, new_keys: dict, reseal_retained: bool = True
+    ) -> dict:
+        """Rotate a tenant's encryption keys LIVE (ISSUE 20): stage the
+        next epoch's keys (``ident -> raw 16-byte key``), announce the
+        distributing epoch on ``$SYS/broker/tenant/rekey``, re-seal the
+        tenant's retained encrypted payloads across the rotation in
+        batched device dispatches, then activate — new fan-out ticks
+        seal under the new generation while in-flight ticks drain on
+        their old-table snapshots. The OLD epoch stays decryptable
+        (epoch-tagged nonces) until :meth:`retire_tenant_epoch`.
+
+        Returns ``{"epoch", "old_epoch", "resealed"}``; raises
+        ValueError when tenancy/recrypt is off or the tenant is
+        unknown."""
+        if self._tenancy is None or self._recrypt is None:
+            raise ValueError("rekey requires tenancy + recrypt enabled")
+        t = self._tenancy.get(name)
+        if t is None:
+            raise ValueError(f"unknown tenant {name!r}")
+        renc = self._recrypt
+        keys = self._tenancy.keys
+        old_epoch = keys.current_epoch(name)
+        epoch = keys.stage_epoch(name, new_keys)
+        self._publish_rekey_notice(name, "distributing", epoch)
+        resealed = 0
+        if reseal_retained:
+            resealed = self._reseal_tenant_retained(t, epoch)
+        keys.activate_epoch(name)
+        renc.note_rekey(name)
+        self._publish_rekey_notice(
+            name, "active", epoch, {"resealed": resealed}
+        )
+        self.log.info(
+            "tenant %s re-keyed: epoch %d -> %d, %d retained re-sealed",
+            name, old_epoch, epoch, resealed,
+        )
+        return {"epoch": epoch, "old_epoch": old_epoch, "resealed": resealed}
+
+    def retire_tenant_epoch(self, name: str, epoch: int) -> int:
+        """Retire a drained epoch: tagged publishes under it now drop
+        (counted as stale), its round-key rows are scrubbed, and the
+        retirement is announced on the rekey $SYS topic. Returns how
+        many key rows were scrubbed."""
+        if self._tenancy is None:
+            raise ValueError("rekey requires tenancy enabled")
+        scrubbed = self._tenancy.keys.retire_epoch(name, epoch)
+        self._publish_rekey_notice(
+            name, "retired", epoch, {"scrubbed": scrubbed}
+        )
+        return scrubbed
+
+    def _reseal_tenant_retained(self, t, epoch: int) -> int:
+        """Re-seal every retained encrypted-namespace payload of one
+        tenant from its CURRENT generation to the staged ``epoch`` in
+        ONE batched keystream dispatch (decrypt + seal blocks share the
+        call — tenancy.RecryptEngine.reseal_batch). The rewritten
+        payloads ride retain_message, so durable persistence and the
+        retained-match engine see the new ciphertext."""
+        renc = self._recrypt
+        keys = self._tenancy.keys
+        prefix = NS_CHAR + t.name + "/"
+        victims: list = []
+        items: list = []
+        for topic, pkv in self.topics.retained.get_all().items():
+            if not topic.startswith(prefix) or not pkv.payload:
+                continue
+            local = ns_local(topic)
+            if local.startswith("$SYS") or not t.is_encrypted(local):
+                continue
+            idents = self._origin_idents(pkv)
+            old_kid = new_kid = -1
+            for ident in idents:
+                if not ident:
+                    continue
+                old_kid = keys.key_id(t.name, ident)
+                new_kid = keys.kid_for_epoch(t.name, ident, epoch)
+                if old_kid >= 0 and new_kid >= 0:
+                    break
+            victims.append((topic, pkv))
+            items.append((bytes(pkv.payload), old_kid, new_kid))
+        if not items:
+            return 0
+        resealed = renc.reseal_batch(t, items, epoch)
+        n = 0
+        for (topic, pkv), data in zip(victims, resealed):
+            if data is None:
+                continue  # keyless origin: the old ciphertext stands
+            out = pkv.copy(False)
+            out.payload = data
+            out.fixed_header.retain = True
+            self.retain_message(self.clients.get(out.origin), out)
+            n += 1
+        return n
+
     def build_ack(
         self, packet_id: int, pkt: int, qos: int, properties: Properties, reason: Code
     ) -> Packet:
@@ -4477,6 +4602,13 @@ class Server:
         ack = self.build_ack(pk.packet_id, pkts.PUBREL, 1, pk.properties, CODE_SUCCESS)
         cl.state.inflight.decrease_receive_quota()
         cl.state.inflight.set(ack)  # [MQTT-4.3.3-5]
+        # persist the PUBLISH -> PUBREL window transition (ISSUE 20):
+        # the durable record must flip with the in-memory window, or a
+        # crash-restore re-inflates the window as an unacked PUBLISH and
+        # re-delivers a message the receiver already PUBREC'd — the
+        # exactly-once violation the qos2_fanout scenario's kill -9 leg
+        # caught ([MQTT-4.3.3-6]: no PUBLISH re-send once PUBREC is in)
+        self.hooks.on_qos_publish(cl, ack, ack.created, 0)
         cl.write_packet(ack)
 
     def process_pubrel(self, cl: Client, pk: Packet) -> None:
@@ -4698,6 +4830,12 @@ class Server:
             raise CODE_DISCONNECT_WILL_MESSAGE()
 
         self.will_delayed.delete(cl.id)  # [MQTT-3.1.3-9] [MQTT-3.1.2-8]
+        # discard the will STRUCT too, not just a pending delayed entry
+        # [MQTT-3.14.4-3] (ISSUE 20 will fixes): the read loop usually
+        # returns cleanly after stop() and clears it, but a transport
+        # already racing its own teardown can surface the close as a
+        # ConnectionError first — and that path fires send_lwt
+        cl.properties.will = Will()
         cl.stop(CODE_DISCONNECT())  # [MQTT-3.14.4-2]
 
     def disconnect_client(self, cl: Client, code: Code) -> None:
@@ -5022,6 +5160,24 @@ class Server:
         """Issue (or delay) a client's will message (server.go:1515-1551)."""
         if cl.properties.will.flag == 0:
             return
+        if cl.is_taken_over:
+            # session takeover is not an ungraceful disconnect: the
+            # inheriting connection IS the client, so the old
+            # connection's will must not fire (ISSUE 20 will fixes —
+            # the read loop's teardown path lands here after
+            # disconnect_client(ERR_SESSION_TAKEN_OVER) aborts it)
+            cl.properties.will = Will()
+            return
+        if self.overload is not None and not self.overload.admit(cl):
+            # wills ride the same shed accounting as live publishes
+            # (ISSUE 20): a mass-disconnect will storm against a broker
+            # already in SHED must not bypass the governor — the will is
+            # dropped AND counted, exactly like an admitted-path shed
+            self.info.messages_dropped += 1
+            if cl.tenant is not None:
+                cl.tenant.messages_dropped += 1
+            cl.properties.will = Will()
+            return
         modified = self.hooks.on_will(cl, cl.properties.will)
         now = int(time.time())  # brokerlint: ok=R3 will-message created/expiry stamps are wall-clock
         pk = Packet(
@@ -5242,6 +5398,23 @@ class Server:
             ):
                 expire = client.properties.props.session_expiry_interval
             if disconnected + expire < dt:
+                # a pending delayed will fires when the session ends,
+                # even if its delay interval has not elapsed
+                # [MQTT-3.1.2-8] (ISSUE 20 will fixes): expiry must not
+                # orphan the entry — and its retain flag must still be
+                # honored after the session object is gone
+                pending = self.will_delayed.get(id_)
+                if pending is not None:
+                    self.will_delayed.delete(id_)
+                    if pending.fixed_header.retain:
+                        self.topics.retain_message(pending.copy(False))
+                        self.info.retained = len(self.topics.retained)
+                        if self._retained_engine is not None:
+                            self._retained_engine.note_retained(
+                                pending.topic_name, True
+                            )
+                    self.publish_to_subscribers(pending)
+                    self.hooks.on_will_sent(client, pending)
                 self.hooks.on_client_expired(client)
                 self.clients.delete(id_)  # [MQTT-4.1.0-2]
 
@@ -5273,11 +5446,35 @@ class Server:
     def send_delayed_lwt(self, dt: int) -> None:
         for id_, pk in self.will_delayed.get_all().items():
             if dt > pk.expiry:
-                self.publish_to_subscribers(pk)  # [MQTT-3.1.2-8]
                 cl = self.clients.get(id_)
-                if cl is not None:
-                    if pk.fixed_header.retain:
+                if (
+                    cl is not None
+                    and self.overload is not None
+                    and not self.overload.admit(cl)
+                ):
+                    # delayed wills obey the shed accounting too
+                    # (ISSUE 20): counted and dropped, never a governor
+                    # bypass
+                    self.info.messages_dropped += 1
+                    if cl.tenant is not None:
+                        cl.tenant.messages_dropped += 1
+                    cl.properties.will = Will()
+                    self.will_delayed.delete(id_)
+                    continue
+                self.publish_to_subscribers(pk)  # [MQTT-3.1.2-8]
+                if pk.fixed_header.retain:
+                    if cl is not None:
                         self.retain_message(cl, pk)
+                    else:
+                        # the retain flag holds even when the session
+                        # is already gone (ISSUE 20 will fixes)
+                        self.topics.retain_message(pk.copy(False))
+                        self.info.retained = len(self.topics.retained)
+                        if self._retained_engine is not None:
+                            self._retained_engine.note_retained(
+                                pk.topic_name, True
+                            )
+                if cl is not None:
                     cl.properties.will = Will()  # [MQTT-3.1.2-10]
                     self.hooks.on_will_sent(cl, pk)
                 self.will_delayed.delete(id_)

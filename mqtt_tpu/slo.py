@@ -77,6 +77,23 @@ LATENCY_SLIS = {
 RATIO_SLIS = {
     "shed": ("mqtt_tpu_messages_dropped_total", "mqtt_tpu_messages_received_total"),
     "fallback": ("mqtt_tpu_stage_fallback_total", "mqtt_tpu_matcher_topics_total"),
+    # scenario-lab oracles (mqtt_tpu.scenarios): the runner registers
+    # these counters around each drill so the gate is the SLO engine,
+    # not harness asserts
+    "scenario_gap": (
+        "mqtt_tpu_scenario_gaps_total",
+        "mqtt_tpu_scenario_expected_total",
+    ),
+    "scenario_dup": (
+        "mqtt_tpu_scenario_duplicates_total",
+        "mqtt_tpu_scenario_expected_total",
+    ),
+    # live tenant re-key: deliveries sealed with a retired epoch key /
+    # all sealed fan-outs (must hold at zero after retirement)
+    "rekey_stale": (
+        "mqtt_tpu_recrypt_epoch_stale_drops_total",
+        "mqtt_tpu_recrypt_fanouts_total",
+    ),
 }
 
 # named gauge SLIs (ISSUE 18 device plane): phrase -> gauge family; the

@@ -70,6 +70,31 @@ from .topics import (
 _log = logging.getLogger("mqtt_tpu.tenancy")
 
 
+# -- epoch-tagged nonces (live tenant re-key, ISSUE 20) --------------------
+#
+# CTR ciphertext carries no authentication, so during a key rotation the
+# broker cannot TELL which epoch's key sealed a payload. Rekey-aware
+# clients therefore stamp the epoch into the nonce they generate: byte 0
+# is a magic marker, bytes 1:3 the big-endian epoch number, bytes 3:12
+# the client's own uniqueness material. The tag is only ever consulted
+# for tenants that have staged an epoch (has_epochs) — tenants that
+# never rotate keep the full 12 opaque bytes and none of this runs.
+
+EPOCH_NONCE_MAGIC = 0xA7
+
+
+def epoch_tag_nonce(nonce: bytes, epoch: int) -> bytes:
+    """Stamp an epoch tag over a 12-byte nonce's first 3 bytes."""
+    return bytes((EPOCH_NONCE_MAGIC, (epoch >> 8) & 0xFF, epoch & 0xFF)) + nonce[3:]
+
+
+def nonce_epoch(nonce: bytes) -> Optional[int]:
+    """The epoch a tagged nonce names, or None for an untagged nonce."""
+    if len(nonce) >= 3 and nonce[0] == EPOCH_NONCE_MAGIC:
+        return (nonce[1] << 8) | nonce[2]
+    return None
+
+
 def scope_client_id(tenant: str, client_id: str) -> str:
     """The broker-registry identity of a tenant client: scoped like a
     topic, so two tenants using the same client id can never take over
@@ -374,7 +399,20 @@ class TenantPlane:
 class KeyRegistry:
     """Per-(tenant, identity) AES-128 keys, expanded once into a dense
     device-ready round-key table. Identity is a tenant-LOCAL client id
-    or username — whatever the operator keyed the config on."""
+    or username — whatever the operator keyed the config on.
+
+    Live re-key (ISSUE 20) layers EPOCHS on top without disturbing the
+    dense-id contract: ``stage_epoch`` registers a tenant's next key
+    generation as FRESH table rows (current lookups untouched — sealing
+    stays on the old keys while the new ones distribute),
+    ``activate_epoch`` atomically flips the tenant's current-id map to
+    the staged rows (old rows stay addressable by epoch for the
+    in-flight drain), and ``retire_epoch`` cuts the old generation off:
+    epoch-tagged lookups below the retirement floor answer -2 and the
+    retired round-key rows are scrubbed to zeros so not even a buggy
+    path can seal with the dead key bits. Fan-out ticks snapshot
+    ``table()`` before dispatch, so in-flight work keyed pre-rotation
+    drains on the old key material regardless."""
 
     def __init__(self) -> None:
         from .utils.locked import InstrumentedLock
@@ -383,6 +421,13 @@ class KeyRegistry:
         self._ids: dict[tuple[str, str], int] = {}
         self._round_keys: list[np.ndarray] = []  # [11, 16] per key id
         self._table: Optional[np.ndarray] = None  # stacked cache
+        # re-key epochs (ISSUE 20): tenant -> current epoch (absent = 0),
+        # (tenant, ident, epoch) -> kid, tenant -> staged-but-inactive
+        # epoch, tenant -> lowest still-live epoch (retirement floor)
+        self._epochs: dict[str, int] = {}
+        self._epoch_kids: dict[tuple[str, str, int], int] = {}
+        self._staged: dict[str, int] = {}
+        self._floor: dict[str, int] = {}
 
     def set_key(self, tenant: str, ident: str, key: bytes) -> int:
         """Register (or rotate) one identity's key; returns its dense id."""
@@ -397,8 +442,98 @@ class KeyRegistry:
                 self._round_keys.append(rk)
             else:
                 self._round_keys[kid] = rk
+            self._epoch_kids[(tenant, ident, self._epochs.get(tenant, 0))] = kid
             self._table = None  # rebuilt on next snapshot
             return kid
+
+    # -- re-key epochs (ISSUE 20) ------------------------------------------
+
+    def stage_epoch(self, tenant: str, keys: dict) -> int:
+        """Register a tenant's NEXT key generation (ident -> raw key)
+        as fresh table rows; current lookups keep resolving the old
+        generation until :meth:`activate_epoch`. Returns the staged
+        epoch number."""
+        from .ops.recrypt import expand_key
+
+        rks = {ident: expand_key(key) for ident, key in keys.items()}
+        with self._lock:
+            epoch = self._epochs.get(tenant, 0) + 1
+            for ident, rk in rks.items():
+                kid = len(self._round_keys)
+                self._round_keys.append(rk)
+                self._epoch_kids[(tenant, ident, epoch)] = kid
+            self._staged[tenant] = epoch
+            self._table = None
+            return epoch
+
+    def activate_epoch(self, tenant: str) -> int:
+        """Flip the tenant's current-id map to the staged generation
+        (sealing switches atomically); the old generation stays
+        addressable by epoch tag for the in-flight drain. Returns the
+        now-current epoch (no-op -1 when nothing is staged)."""
+        with self._lock:
+            epoch = self._staged.pop(tenant, -1)
+            if epoch < 0:
+                return -1
+            for (t, ident, ep), kid in self._epoch_kids.items():
+                if t == tenant and ep == epoch:
+                    self._ids[(tenant, ident)] = kid
+            self._epochs[tenant] = epoch
+            return epoch
+
+    def retire_epoch(self, tenant: str, epoch: int) -> int:
+        """Retire every generation of a tenant up to and including
+        ``epoch``: tagged lookups below the new floor answer -2
+        (stale), and the retired round-key rows are scrubbed to zeros.
+        Returns how many rows were scrubbed."""
+        scrubbed = 0
+        with self._lock:
+            floor = max(self._floor.get(tenant, 0), epoch + 1)
+            current = self._epochs.get(tenant, 0)
+            floor = min(floor, current)  # never retire the live epoch
+            self._floor[tenant] = floor
+            live = set(self._ids.values())
+            for (t, _ident, ep), kid in self._epoch_kids.items():
+                if t == tenant and ep < floor and kid not in live:
+                    if self._round_keys[kid].any():
+                        self._round_keys[kid] = np.zeros((11, 16), np.uint8)
+                        scrubbed += 1
+            if scrubbed:
+                self._table = None
+        return scrubbed
+
+    def current_epoch(self, tenant: str) -> int:
+        with self._lock:
+            return self._epochs.get(tenant, 0)
+
+    def staged_epoch(self, tenant: str) -> int:
+        """The staged-but-inactive epoch, or -1."""
+        with self._lock:
+            return self._staged.get(tenant, -1)
+
+    def has_epochs(self, tenant: str) -> bool:
+        """Has this tenant ever staged a re-key? (Gates all epoch-tag
+        nonce interpretation — tenants that never rotate keep the full
+        12 opaque nonce bytes.)"""
+        with self._lock:
+            return (
+                self._epochs.get(tenant, 0) > 0 or tenant in self._staged
+            )
+
+    def kid_for_epoch(self, tenant: str, ident: str, epoch: int) -> int:
+        """The dense key id of one identity AT one epoch: -1 = no such
+        key, -2 = that generation is retired (stale)."""
+        with self._lock:
+            if epoch < self._floor.get(tenant, 0):
+                return -2
+            kid = self._epoch_kids.get((tenant, ident, epoch))
+            if kid is not None:
+                return kid
+            # identities keyed before the first rotation live at epoch
+            # 0 in _ids only
+            if epoch == 0:
+                return self._ids.get((tenant, ident), -1)
+            return -1
 
     def key_id(self, tenant: str, ident: str) -> int:
         """The dense key id for an identity, or -1 (no key registered)."""
@@ -409,6 +544,15 @@ class KeyRegistry:
         """Batch lookup for a fan-out tick: one lock round trip for the
         whole target list. Each element of ``idents_list`` is a tuple of
         candidate identities; the first registered one wins (-1 = none)."""
+        return self.key_ids_with_epoch(tenant, idents_list)[0]
+
+    def key_ids_with_epoch(
+        self, tenant: str, idents_list: list
+    ) -> tuple[list, int]:
+        """:meth:`key_ids` plus the tenant's current epoch, resolved in
+        the SAME lock round trip — a fan-out tick racing an
+        ``activate_epoch`` must never stamp new-epoch nonce tags onto
+        old-generation key ids (or vice versa)."""
         with self._lock:
             ids = self._ids
             out = []
@@ -420,7 +564,7 @@ class KeyRegistry:
                         if kid >= 0:
                             break
                 out.append(kid)
-            return out
+            return out, self._epochs.get(tenant, 0)
 
     def table(self) -> Optional[np.ndarray]:
         """The stacked round-key table ``uint8 [T, 11, 16]`` (None when
@@ -504,7 +648,13 @@ class RecryptEngine:
         self.oracle_mismatches = 0
         self.no_key_drops = 0  # deliveries withheld: subscriber keyless
         self.malformed = 0  # publishes dropped: bad ciphertext framing
+        # re-key epoch counters (ISSUE 20, mqtt_tpu_recrypt_epoch_*)
+        self.rekeys = 0  # epoch rotations completed (activate)
+        self.resealed = 0  # retained payloads re-sealed across epochs
+        self.stale_epoch_drops = 0  # publishes under a RETIRED epoch key
         self._dispatch_seq = 0  # oracle sampling clock
+        self._registry = registry
+        self._epoch_metered: set[str] = set()
         if registry is not None:
             self._register_metrics(registry)
 
@@ -547,18 +697,32 @@ class RecryptEngine:
         client id, then username). A keyless publisher or malformed
         framing yields an errored job — the fan-out drops the publish
         (counted), never delivers ciphertext it cannot re-key."""
-        kid = -1
-        for ident in idents:
-            if ident:
-                kid = self.keys.key_id(tenant.name, ident)
-                if kid >= 0:
-                    break
-        if kid < 0:
-            self.no_key_drops += 1
-            return RecryptJob(-1, b"", 0, error="no_key")
         if len(payload) < self.nonce_bytes:
             self.malformed += 1
             return RecryptJob(-1, b"", 0, error="malformed")
+        # epoch-tagged nonce (ISSUE 20): for a tenant mid/post-rotation
+        # the tag names WHICH generation sealed this payload — old-epoch
+        # publishes keep decrypting through the drain, retired epochs
+        # drop (counted), untagged nonces resolve the current generation
+        epoch = None
+        if self.keys.has_epochs(tenant.name):
+            epoch = nonce_epoch(payload[: self.nonce_bytes])
+        kid = -1
+        for ident in idents:
+            if not ident:
+                continue
+            if epoch is None:
+                kid = self.keys.key_id(tenant.name, ident)
+            else:
+                kid = self.keys.kid_for_epoch(tenant.name, ident, epoch)
+                if kid == -2:
+                    self.stale_epoch_drops += 1
+                    return RecryptJob(-1, b"", 0, error="stale_epoch")
+            if kid >= 0:
+                break
+        if kid < 0:
+            self.no_key_drops += 1
+            return RecryptJob(-1, b"", 0, error="no_key")
         nonce = payload[: self.nonce_bytes]
         n_blocks = (len(payload) - self.nonce_bytes + 15) // 16
         return RecryptJob(kid, nonce, n_blocks)
@@ -739,7 +903,9 @@ class RecryptEngine:
         from .ops.recrypt import keystream_async
 
         n_blocks = (len(plaintext) + 15) // 16
-        kids = self.keys.key_ids(tenant.name, [t[1] for t in targets])
+        kids, epoch = self.keys.key_ids_with_epoch(
+            tenant.name, [t[1] for t in targets]
+        )
         keyed = [(t[0], kid) for t, kid in zip(targets, kids) if kid >= 0]
         dropped = len(targets) - len(keyed)
         if dropped:
@@ -750,6 +916,13 @@ class RecryptEngine:
         tenant.recrypt_fanouts += 1
         j = len(keyed)
         nonces = self._next_nonces(j)  # uint8 [J, 12]
+        if epoch > 0:
+            # post-rotation tenants get epoch-tagged subscriber nonces:
+            # a subscriber holding both generations through the drain
+            # picks its key off the tag instead of trial-decrypting
+            nonces[:, 0] = EPOCH_NONCE_MAGIC
+            nonces[:, 1] = (epoch >> 8) & 0xFF
+            nonces[:, 2] = epoch & 0xFF
         if n_blocks == 0:
             # zero-length plaintext: the wire payload is the bare nonce
             return keyed, nonces, None
@@ -822,6 +995,102 @@ class RecryptEngine:
             out[tkey] = nonces[i].tobytes() + ct[i].tobytes()
         return out
 
+    # -- re-key re-seal (ISSUE 20) -----------------------------------------
+
+    def reseal_batch(
+        self, tenant: Tenant, items: list, epoch: int
+    ) -> list:
+        """Re-seal a batch of stored ciphertexts across a key rotation
+        in ONE batched keystream dispatch: every item's decrypt blocks
+        (old generation) and seal blocks (new generation) land in the
+        SAME device call, then one XOR pass per item rewrites the
+        ciphertext — the MQT-TZ re-encryption shape applied to the
+        retained store. ``items`` yield ``(payload, old_kid, new_kid)``
+        (payload = ``nonce || ciphertext``); returns the new payloads
+        (epoch-tagged nonce || ciphertext), None per malformed item."""
+        from .ops.recrypt import ctr_counters, keystream_async
+
+        nb = self.nonce_bytes
+        spans = []  # (idx, ct, old_off, n_blocks)
+        out: list = [None] * len(items)
+        total = 0
+        for i, (payload, old_kid, new_kid) in enumerate(items):
+            if len(payload) < nb or old_kid < 0 or new_kid < 0:
+                continue
+            ct = payload[nb:]
+            n = (len(ct) + 15) // 16
+            spans.append((i, payload[:nb], ct, total, n))
+            total += n
+        if not spans:
+            return out
+        fresh = self._next_nonces(len(spans))
+        fresh[:, 0] = EPOCH_NONCE_MAGIC
+        fresh[:, 1] = (epoch >> 8) & 0xFF
+        fresh[:, 2] = epoch & 0xFF
+        # combined dispatch: [decrypt blocks | seal blocks]
+        kidx = np.empty(2 * total, dtype=np.int32)
+        counters = np.empty((2 * total, 16), dtype=np.uint8)
+        for s, (i, old_nonce, ct, off, n) in enumerate(spans):
+            _payload, old_kid, new_kid = items[i]
+            kidx[off : off + n] = old_kid
+            counters[off : off + n] = ctr_counters(old_nonce, n)
+            kidx[total + off : total + off + n] = new_kid
+            counters[total + off : total + off + n] = ctr_counters(
+                fresh[s].tobytes(), n
+            )
+        table = self.keys.table()
+        rows = None
+        if (
+            self._device_enabled
+            and 2 * total >= self.device_min_blocks
+            and table is not None
+            and self.breaker.allow()
+        ):
+            try:
+                resolver = keystream_async(table, kidx, counters)
+                if resolver is not None:
+                    rows = resolver()
+                    self.breaker.record_success()
+                    self.device_batches += 1
+                    self.device_blocks += 2 * total
+                    self._maybe_oracle(table, kidx, counters, rows)
+            except Exception:
+                _log.exception("recrypt re-seal dispatch failed; host path")
+                self.device_errors += 1
+                self.breaker.record_failure("reseal")
+                rows = None
+        if rows is None:
+            from .ops.recrypt import host_keystream
+
+            assert table is not None  # caller resolved both kids from it
+            self.host_blocks += 2 * total
+            rows = host_keystream(table, kidx, counters)
+        for s, (i, _old_nonce, ct, off, n) in enumerate(spans):
+            if n == 0:
+                out[i] = fresh[s].tobytes()
+                self.resealed += 1
+                continue
+            c = np.frombuffer(ct, dtype=np.uint8)
+            ks_old = rows[off : off + n].reshape(-1)[: len(ct)]
+            ks_new = rows[total + off : total + off + n].reshape(-1)[: len(ct)]
+            out[i] = fresh[s].tobytes() + (c ^ ks_old ^ ks_new).tobytes()
+            self.resealed += 1
+        return out
+
+    def note_rekey(self, tenant: str) -> None:
+        """Account one completed rotation and lazily register the
+        per-tenant epoch gauge (mqtt_tpu_recrypt_epoch)."""
+        self.rekeys += 1
+        r = self._registry
+        if r is not None and tenant not in self._epoch_metered:
+            self._epoch_metered.add(tenant)
+            r.gauge(
+                "mqtt_tpu_recrypt_epoch",
+                "Current re-key epoch per tenant (0 = never rotated)",
+                fn=lambda t=tenant: self.keys.current_epoch(t),
+                tenant=tenant,
+            )
+
     # -- client-side helpers (tests, embedders, bench) ---------------------
 
     def seal_with_key(
@@ -884,6 +1153,9 @@ class RecryptEngine:
             "oracle_mismatches": self.oracle_mismatches,
             "no_key_drops": self.no_key_drops,
             "malformed": self.malformed,
+            "rekeys": self.rekeys,
+            "resealed": self.resealed,
+            "stale_epoch_drops": self.stale_epoch_drops,
             "breaker_state": self.breaker.state,
         }
 
@@ -903,6 +1175,9 @@ class RecryptEngine:
             ("mqtt_tpu_recrypt_oracle_mismatches_total", "oracle_mismatches"),
             ("mqtt_tpu_recrypt_no_key_drops_total", "no_key_drops"),
             ("mqtt_tpu_recrypt_malformed_total", "malformed"),
+            ("mqtt_tpu_recrypt_epoch_rekeys_total", "rekeys"),
+            ("mqtt_tpu_recrypt_epoch_resealed_total", "resealed"),
+            ("mqtt_tpu_recrypt_epoch_stale_drops_total", "stale_epoch_drops"),
         ):
             registry.counter(
                 name,

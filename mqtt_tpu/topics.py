@@ -111,12 +111,26 @@ def _ns_local0(key: str) -> str:
 PREDICATE_NUMERIC_OPS = ("GT", "GTE", "LT", "LTE", "EQ", "NE")
 #: ops that aggregate a numeric payload feature over a message window
 PREDICATE_AGG_OPS = ("MEAN", "MAX", "MIN")
-#: every recognized predicate op (CONTAINS is the one payload-bytes op)
-PREDICATE_OPS = PREDICATE_NUMERIC_OPS + ("CONTAINS",) + PREDICATE_AGG_OPS
+#: every recognized simple predicate op (CONTAINS and EQS are the
+#: payload-bytes/string ops; compounds AND/OR are parsed separately)
+PREDICATE_OPS = (
+    PREDICATE_NUMERIC_OPS + ("CONTAINS", "EQS") + PREDICATE_AGG_OPS
+)
+#: compound ops combining SIMPLE predicates: ``$AND{$GT{t:20}$LT{t:30}}``
+PREDICATE_COMPOUND_OPS = ("AND", "OR")
 
 _PREDICATE_RE = re.compile(
     r"^(?P<base>.*?)\$(?P<op>" + "|".join(PREDICATE_OPS) + r")\{(?P<arg>[^{}]*)\}$",
     re.DOTALL,
+)
+# one SIMPLE predicate token, anchored at the string start — the unit
+# the compound-argument scanner consumes
+_PREDICATE_TOKEN_RE = re.compile(
+    r"^\$(?P<op>" + "|".join(PREDICATE_OPS) + r")\{(?P<arg>[^{}]*)\}",
+    re.DOTALL,
+)
+_COMPOUND_RE = re.compile(
+    r"^(?P<base>.*?)\$(?P<op>AND|OR)\{(?P<arg>.*)\}$", re.DOTALL
 )
 
 
@@ -126,6 +140,11 @@ def _predicate_arg_ok(op: str, arg: str) -> bool:
     extension can never reject a filter plain MQTT would accept)."""
     if op == "CONTAINS":
         return len(arg) > 0
+    if op == "EQS":
+        # string equality ``field:literal``; an empty field means "the
+        # whole payload as the string"
+        _field, sep, _literal = arg.partition(":")
+        return bool(sep)
     field_part, _, num = arg.rpartition(":")
     if op in PREDICATE_AGG_OPS:
         try:
@@ -140,6 +159,25 @@ def _predicate_arg_ok(op: str, arg: str) -> bool:
     # (field_part may be empty: "whole payload as the number")
 
 
+def split_predicate_tokens(arg: str) -> tuple:
+    """Scan a compound argument into its simple ``$OP{...}`` member
+    tokens. Returns the token tuple, or () when the argument is not a
+    well-formed run of >= 2 valid simple predicates (compounds of one
+    are just that predicate; spell it plainly)."""
+    tokens = []
+    rest = arg
+    while rest:
+        m = _PREDICATE_TOKEN_RE.match(rest)
+        if m is None or not _predicate_arg_ok(m.group("op"), m.group("arg")):
+            return ()
+        if m.group("op") in PREDICATE_AGG_OPS:
+            # stateful windows have no boolean verdict to combine
+            return ()
+        tokens.append(m.group(0))
+        rest = rest[len(m.group(0)):]
+    return tuple(tokens) if len(tokens) >= 2 else ()
+
+
 def split_predicate_suffix(filter: str) -> tuple[str, str]:
     """Split a trailing MQTT+ predicate off a subscription filter.
 
@@ -148,7 +186,15 @@ def split_predicate_suffix(filter: str) -> tuple[str, str]:
     predicate). Only a syntactically valid suffix is split — anything
     else is a literal filter, so pre-MQTT+ behavior is bit-identical. A
     bare predicate (``$CONTAINS{alarm}``) means "every topic": the base
-    widens to ``#``."""
+    widens to ``#``.
+
+    Compounds (``$AND{...}``/``$OR{...}`` over simple predicates) are
+    matched FIRST — their argument contains nested braces, which the
+    simple-token grammar deliberately excludes."""
+    m = _COMPOUND_RE.match(filter)
+    if m is not None and split_predicate_tokens(m.group("arg")):
+        base = m.group("base") or "#"
+        return base, filter[len(m.group("base")):]
     m = _PREDICATE_RE.match(filter)
     if m is None:
         return filter, ""

@@ -896,3 +896,156 @@ class TestSuspectWindowKnob:
         opts = Options(cluster_suspect_window_s=-5.0)
         opts.ensure_defaults()
         assert opts.cluster_suspect_window_s == 0.0
+
+
+class TestStringAndCompoundPredicates:
+    """The $EQS / $AND / $OR grammar extension (ISSUE 20 satellite):
+    string equality rides the host-computed verdict bitmask like
+    CONTAINS; compounds intern their members as ordinary (device-
+    eligible) rules and combine the child bits host-side."""
+
+    def test_eqs_suffix_splits_and_compiles(self):
+        from mqtt_tpu.predicates import OP_EQS
+
+        assert split_predicate_suffix("cfg/mode$EQS{mode:active}") == (
+            "cfg/mode",
+            "$EQS{mode:active}",
+        )
+        spec = compile_suffix("$EQS{mode:active}")
+        assert spec.op == OP_EQS
+        assert spec.field == "mode" and spec.text == b"active"
+        # empty field: whole payload as the string
+        spec = compile_suffix("$EQS{:go}")
+        assert spec.field == "" and spec.text == b"go"
+
+    def test_compound_suffix_splits_and_compiles(self):
+        from mqtt_tpu.predicates import OP_AND, OP_GT, OP_LT, OP_OR
+
+        base, suffix = split_predicate_suffix("$AND{$GT{t:1.0}$LT{t:5.0}}")
+        assert (base, suffix) == ("#", "$AND{$GT{t:1.0}$LT{t:5.0}}")
+        spec = compile_suffix(suffix)
+        assert spec.op == OP_AND and spec.is_compound
+        assert [c.op for c in spec.children] == [OP_GT, OP_LT]
+        base, suffix = split_predicate_suffix(
+            "a/b$OR{$EQS{m:on}$CONTAINS{hot}}"
+        )
+        assert base == "a/b"
+        assert compile_suffix(suffix).op == OP_OR
+
+    def test_malformed_forms_stay_literal_filters(self):
+        for literal in (
+            "a/b$EQS{noseparator}",  # no field:literal colon
+            "a/b$AND{$GT{t:1.0}}",  # compound of one: spell it plainly
+            "a/b$AND{$MEAN{t:5}$GT{t:1.0}}",  # agg member has no verdict
+            "a/b$AND{$GT{t:1.0}junk}",  # trailing junk in the argument
+            "a/b$AND{}",  # empty compound
+        ):
+            assert split_predicate_suffix(literal) == (literal, ""), literal
+
+    def test_eqs_host_semantics(self):
+        from mqtt_tpu.predicates import eval_equals
+
+        spec = compile_suffix("$EQS{mode:active}")
+        assert eval_rule_host(spec, b'{"mode": "active"}')
+        assert not eval_rule_host(spec, b'{"mode": "idle"}')
+        # skip-to-pass: missing / non-string field, non-JSON payload
+        assert eval_rule_host(spec, b'{"other": 1}')
+        assert eval_rule_host(spec, b'{"mode": 7}')
+        assert eval_rule_host(spec, b"not json")
+        # whole-payload equality has no skip: bytes match or they don't
+        whole = compile_suffix("$EQS{:go}")
+        assert eval_rule_host(whole, b"go")
+        assert not eval_rule_host(whole, b"stop")
+        assert eval_equals(b'{"a.b": "x"}', "a.b", b"x")
+
+    def test_compound_host_semantics(self):
+        land = compile_suffix("$AND{$GT{t:1.0}$LT{t:5.0}}")
+        assert eval_rule_host(land, b'{"t": 3}')
+        assert not eval_rule_host(land, b'{"t": 9}')
+        lor = compile_suffix("$OR{$GT{t:5.0}$CONTAINS{hot}}")
+        assert eval_rule_host(lor, b'{"t": 1, "s": "hot"}')
+        assert eval_rule_host(lor, b'{"t": 9}')
+        assert not eval_rule_host(lor, b'{"t": 1}')
+
+    def test_engine_interns_members_and_releases_refcounted(self):
+        eng = PredicateEngine(oracle_sample=0)
+        compound = "$AND{$GT{v:1.0}$EQS{m:on}}"
+        rule = eng.register(compound)
+        assert rule.children == ("$GT{v:1.0}", "$EQS{m:on}")
+        assert not rule.device  # the compound row itself never on device
+        assert eng._rules["$GT{v:1.0}"].device  # ...but its members are
+        eng.register("$GT{v:1.0}")  # an independent plain subscription
+        eng.release((compound,))
+        assert compound not in eng._rules
+        assert eng._rules["$GT{v:1.0}"].refs == 1  # member ref dropped
+        assert "$EQS{m:on}" not in eng._rules
+        eng.release(("$GT{v:1.0}",))
+        assert not eng._rules
+
+    def test_apply_filters_through_compound_and_eqs(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$AND{$GT{v:5.0}$EQS{mode:run}}")
+        eng.register("$EQS{mode:run}")
+
+        def subs():  # apply() consumes its per-publish copy in place
+            return _subs_with(
+                (
+                    "both",
+                    Subscription(
+                        filter="t",
+                        predicates=("$AND{$GT{v:5.0}$EQS{mode:run}}",),
+                    ),
+                ),
+                (
+                    "str",
+                    Subscription(filter="t", predicates=("$EQS{mode:run}",)),
+                ),
+            )
+
+        out, _ = eng.apply(subs(), b'{"v": 3.0, "mode": "run"}')
+        assert set(out.subscriptions) == {"str"}  # AND fails on v
+        out, _ = eng.apply(subs(), b'{"v": 9.0, "mode": "run"}')
+        assert set(out.subscriptions) == {"both", "str"}
+        out, _ = eng.apply(subs(), b'{"v": 9.0, "mode": "walk"}')
+        assert set(out.subscriptions) == set()
+
+    def test_eqs_device_vs_host_differential(self):
+        import numpy as np
+
+        eng = PredicateEngine(oracle_sample=0)
+        suffixes = [
+            "$EQS{mode:active}",
+            "$EQS{mode:idle}",
+            "$EQS{:go}",
+            "$CONTAINS{go}",  # shares the verdict bit space with EQS
+            "$GT{v:2.0}",
+        ]
+        for s in suffixes:
+            eng.register(s)
+        payloads = [
+            b'{"mode": "active", "v": 3}',
+            b'{"mode": "idle"}',
+            b"go",
+            b'{"mode": 5, "v": 1}',
+            b"not json",
+        ]
+        feats = [eng.features_for(p) for p in payloads]
+        resolved = eng.eval_batch_async(feats)
+        assert resolved is not None
+        eng.attach_rows(feats, resolved())
+        for p, f in zip(payloads, feats):
+            assert f.device_row is not None
+            for s in suffixes:
+                rule = eng._rules[s]
+                bit = bool(
+                    (f.device_row[rule.idx >> 5] >> np.uint32(rule.idx & 31))
+                    & 1
+                )
+                assert bit == eval_rule_host(rule.spec, p), (s, p)
+
+    def test_gauges_count_equals_bits(self):
+        eng = PredicateEngine(oracle_sample=0)
+        eng.register("$EQS{a:x}")
+        eng.register("$CONTAINS{y}")
+        g = eng.gauges()
+        assert g["equals"] == 1 and g["contains"] == 1

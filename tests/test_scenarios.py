@@ -1,0 +1,468 @@
+"""Scenario lab (ISSUE 20): the delivery-oracle math, the SLO gate's
+breach behavior against injected failures, catalog sanity, one cheap
+scenario end-to-end — and the QoS2 exactly-once regression suite the
+``qos2_fanout`` scenario's kill -9 leg motivated, including the named
+regression for the ``process_pubrec`` durable-window persistence fix.
+"""
+
+import asyncio
+import shutil
+import tempfile
+
+import pytest
+
+from mqtt_tpu import Options
+from mqtt_tpu.hooks.storage.logkv import LogKVOptions, LogKVStore
+from mqtt_tpu.packets import (
+    PUBCOMP,
+    PUBLISH,
+    PUBREC,
+    PUBREL,
+    FixedHeader,
+    Packet,
+    encode_packet,
+)
+from mqtt_tpu.scenarios import (
+    SCENARIOS,
+    DeliveryOracle,
+    ScenarioBroker,
+    ScenarioClient,
+    ScenarioGate,
+    run_scenario,
+    scenario_names,
+)
+from mqtt_tpu.slo import parse_objectives
+from mqtt_tpu.telemetry import Telemetry
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+# -- oracle math -------------------------------------------------------------
+
+
+class TestDeliveryOracle:
+    def test_clean_run_settles_zero_gaps_zero_dups(self):
+        o = DeliveryOracle("t")
+        for k in ("a", "b", "c"):
+            o.expect(k)
+            o.deliver(k)
+        s = o.summary()
+        assert s == {
+            "expected": 3,
+            "delivered": 3,
+            "gaps": 0,
+            "duplicates": 0,
+            "faults": 0,
+        }
+        assert o.complete()
+
+    def test_gap_duplicate_and_unexpected_accounting(self):
+        o = DeliveryOracle("t")
+        o.expect("arrives")
+        o.expect("lost")
+        o.deliver("arrives")
+        o.deliver("arrives")  # repeat of an expected key: 1 duplicate
+        o.deliver("leak")  # nobody expected it: also budget spend
+        o.fault(2)
+        s = o.summary()
+        assert s["gaps"] == 1
+        assert s["duplicates"] == 2  # 1 repeat + 1 unexpected
+        assert s["delivered"] == 3
+        assert s["faults"] == 2
+        assert not o.complete()
+
+    def test_settle_publishes_labeled_counters(self):
+        tel = Telemetry()
+        o = DeliveryOracle("mytest")
+        o.expect("k")
+        o.deliver("k")
+        o.settle(tel.registry)
+        text = tel.registry.exposition()
+        assert (
+            'mqtt_tpu_scenario_expected_total{scenario="mytest"} 1' in text
+        )
+        assert (
+            'mqtt_tpu_scenario_delivered_total{scenario="mytest"} 1' in text
+        )
+        assert 'mqtt_tpu_scenario_gaps_total{scenario="mytest"} 0' in text
+
+
+# -- the SLO gate ------------------------------------------------------------
+
+
+OBJ = (
+    "scenario_gap ratio < 0.1% over 5s",
+    "scenario_dup ratio < 0.1% over 5s",
+)
+
+
+class TestScenarioGate:
+    def test_clean_oracle_passes(self):
+        tel = Telemetry()
+        gate = ScenarioGate(tel, OBJ)
+        o = DeliveryOracle("clean")
+        for i in range(100):
+            o.expect(i)
+            o.deliver(i)
+        o.settle(tel.registry)
+        ok, rows = gate.verdict()
+        assert ok
+        assert len(rows) == 2
+
+    def test_injected_gaps_breach(self):
+        tel = Telemetry()
+        gate = ScenarioGate(tel, OBJ)
+        o = DeliveryOracle("gappy")
+        for i in range(100):
+            o.expect(i)
+            if i % 10:  # 10% of expected deliveries never arrive
+                o.deliver(i)
+        o.settle(tel.registry)
+        ok, rows = gate.verdict()
+        assert not ok
+        breached = {r["spec"] for r in rows if r["breached"]}
+        assert "scenario_gap ratio < 0.1% over 5s" in breached
+
+    def test_injected_duplicates_breach(self):
+        tel = Telemetry()
+        gate = ScenarioGate(tel, OBJ)
+        o = DeliveryOracle("dupey")
+        for i in range(100):
+            o.expect(i)
+            o.deliver(i)
+        for i in range(5):
+            o.deliver(i)  # 5% exactly-once violations
+        o.settle(tel.registry)
+        ok, rows = gate.verdict()
+        assert not ok
+        breached = {r["spec"] for r in rows if r["breached"]}
+        assert "scenario_dup ratio < 0.1% over 5s" in breached
+
+
+# -- catalog sanity ----------------------------------------------------------
+
+
+class TestCatalog:
+    def test_matrix_covers_the_issue_and_seeds_are_unique(self):
+        assert len(SCENARIOS) >= 6
+        for required in (
+            "payload_sweep",
+            "qos2_fanout",
+            "mixed_fleet",
+            "will_storm",
+            "bridge_federation",
+            "tenant_rekey",
+        ):
+            assert required in SCENARIOS
+        seeds = [s.seed for s in SCENARIOS.values()]
+        assert len(set(seeds)) == len(seeds)
+        for name, spec in SCENARIOS.items():
+            assert spec.name == name
+
+    def test_every_objective_parses(self):
+        for spec in SCENARIOS.values():
+            objs = parse_objectives(list(spec.objectives))
+            assert objs, spec.name
+
+    def test_smoke_subset_is_proper_and_nonempty(self):
+        smoke = scenario_names(smoke_only=True)
+        assert smoke
+        assert set(smoke) < set(scenario_names())
+
+
+# -- one scenario end-to-end -------------------------------------------------
+
+
+class TestScenarioEndToEnd:
+    def test_payload_sweep_runs_green(self):
+        r = run_scenario("payload_sweep")
+        assert r["passed"], r["failures"]
+        assert r["oracle"]["gaps"] == 0
+        assert r["oracle"]["duplicates"] == 0
+        assert r["oracle"]["delivered"] == r["oracle"]["expected"] > 0
+        assert r["slo"]["passed"]
+        assert r["metrics"]["recrypt_fanouts"] > 0
+
+    def test_seed_override_is_reported(self):
+        # reseeding must be visible in the result doc (reproducibility
+        # contract: the doc + seed is enough to replay the run)
+        r = run_scenario("mixed_fleet", seed=4242)
+        assert r["seed"] == 4242
+        assert r["passed"], r["failures"]
+
+    @pytest.mark.slow
+    def test_full_matrix_is_green(self):
+        from mqtt_tpu.scenarios import run_matrix
+
+        results = run_matrix(scenario_names())
+        failed = [r["scenario"] for r in results if not r["passed"]]
+        assert not failed, failed
+
+
+# -- QoS2 exactly-once regression suite --------------------------------------
+
+
+class TestQoS2ExactlyOnce:
+    """The named regressions behind the ``qos2_fanout`` scenario: the
+    cross-shard ack cycle, session-present resume semantics, and the
+    durable PUBLISH -> PUBREL window transition whose absence re-sent
+    already-PUBREC'd messages across a kill -9 ([MQTT-4.3.3-6])."""
+
+    def test_cross_shard_pubrec_pubrel_pubcomp_cycle(self):
+        async def drill():
+            b = await ScenarioBroker(
+                Options(inline_client=False, loop_shards=2)
+            ).start()
+            got: list[tuple[str, str]] = []
+            subs = []
+            try:
+                for i in range(4):
+                    c = ScenarioClient(b.port, f"x-{i}")
+                    await c.connect()
+                    c.on_publish = (
+                        lambda t, p, pk, cid=c.cid: got.append((cid, bytes(p).decode()))
+                    )
+                    await c.subscribe("x/t", qos=2)
+                    subs.append(c)
+                pub = ScenarioClient(b.port, "x-pub")
+                await pub.connect()
+                subs.append(pub)
+                for seq in range(3):
+                    await pub.publish("x/t", f"m{seq}".encode(), qos=2)
+                for _ in range(200):
+                    if len(got) >= 12 and b.total_inflight() == 0:
+                        break
+                    await asyncio.sleep(0.02)
+                assert sorted(got) == sorted(
+                    (f"x-{i}", f"m{s}") for i in range(4) for s in range(3)
+                )
+                assert b.total_inflight() == 0
+            finally:
+                for c in subs:
+                    await c.close()
+                await b.stop()
+
+        run(drill())
+
+    def test_reconnect_session_present_resends_pubrel_not_publish(self):
+        """A receiver that PUBREC'd then dropped must resume with the
+        broker re-sending PUBREL — a repeat PUBLISH would be delivered
+        twice ([MQTT-4.3.3-6]). In-memory sessions: the inflight window
+        itself was flipped to a PUBREL packet by process_pubrec."""
+
+        async def drill():
+            b = await ScenarioBroker(Options(inline_client=False)).start()
+            publishes: list[bytes] = []
+            try:
+                c = ScenarioClient(b.port, "rp")
+                await c.connect(clean=False)
+                c.withhold_pubcomp = True
+                c.on_publish = lambda t, p, pk: publishes.append(bytes(p))
+                await c.subscribe("rp/t", qos=2)
+                pub = ScenarioClient(b.port, "rp-pub")
+                await pub.connect()
+                await pub.publish("rp/t", b"once", qos=2)
+                for _ in range(100):
+                    if c.pubrel_seen:
+                        break
+                    await asyncio.sleep(0.02)
+                assert c.pubrel_seen == {1}
+                c.abort()
+                await c.close()
+
+                c2 = ScenarioClient(b.port, "rp")
+                c2.on_publish = lambda t, p, pk: publishes.append(bytes(p))
+                present = await c2.connect(clean=False)
+                assert present
+                for _ in range(100):
+                    if c2.pubrel_seen and b.total_inflight() == 0:
+                        break
+                    await asyncio.sleep(0.02)
+                assert c2.pubrel_seen == {1}  # resumed at PUBREL...
+                assert publishes == [b"once"]  # ...not with a repeat
+                assert b.total_inflight() == 0
+                await c2.close()
+                await pub.close()
+            finally:
+                await b.stop()
+
+        run(drill())
+
+    def test_duplicate_publish_after_reconnect_is_suppressed(self):
+        """A sender that reconnects (session-present) before PUBREL and
+        re-sends the PUBLISH with DUP must get a fresh PUBREC and NO
+        second fan-out ([MQTT-4.3.3-10]): the open receiver window is
+        the dedup state."""
+
+        def raw_publish(c, pid, dup):
+            c.writer.write(
+                encode_packet(
+                    Packet(
+                        fixed_header=FixedHeader(type=PUBLISH, qos=2, dup=dup),
+                        protocol_version=4,
+                        topic_name="dup/t",
+                        packet_id=pid,
+                        payload=b"once",
+                    )
+                )
+            )
+
+        async def drill():
+            b = await ScenarioBroker(Options(inline_client=False)).start()
+            got: list[bytes] = []
+            try:
+                sub = ScenarioClient(b.port, "dup-sub")
+                await sub.connect()
+                sub.on_publish = lambda t, p, pk: got.append(bytes(p))
+                await sub.subscribe("dup/t", qos=2)
+
+                pub = ScenarioClient(b.port, "dup-pub")
+                await pub.connect(clean=False)
+                rec = pub._future(PUBREC, 7)
+                raw_publish(pub, 7, dup=False)  # ...but never PUBREL
+                await asyncio.wait_for(rec, 10)
+                await _wait(lambda: len(got) == 1)
+                pub.abort()
+                await pub.close()
+
+                pub2 = ScenarioClient(b.port, "dup-pub")
+                present = await pub2.connect(clean=False)
+                assert present
+                rec2 = pub2._future(PUBREC, 7)
+                raw_publish(pub2, 7, dup=True)  # the reconnect re-send
+                await asyncio.wait_for(rec2, 10)  # re-acknowledged...
+                await asyncio.sleep(0.2)
+                assert got == [b"once"]  # ...but never re-delivered
+                comp = pub2._future(PUBCOMP, 7)
+                pub2._send(PUBREL, 7, qos=1)
+                await asyncio.wait_for(comp, 10)
+                assert await _wait(lambda: b.total_inflight() == 0)
+                await pub2.close()
+                await sub.close()
+            finally:
+                await b.stop()
+
+        async def _wait(cond, timeout=10.0):
+            for _ in range(int(timeout / 0.02)):
+                if cond():
+                    return True
+                await asyncio.sleep(0.02)
+            return cond()
+
+        run(drill())
+
+    def test_pubrec_flips_the_durable_record_to_pubrel(self):
+        """THE regression for the process_pubrec persistence fix: once
+        PUBREC arrives, the stored inflight record must carry PUBREL —
+        before the fix it stayed PUBLISH and every crash-restore
+        re-delivered the message."""
+
+        async def drill(path):
+            b = ScenarioBroker(Options(inline_client=False))
+            store = LogKVStore()
+            b.server.add_hook(store, LogKVOptions(path=path, gc_interval=0))
+            await b.start()
+            try:
+                c = ScenarioClient(b.port, "dr")
+                await c.connect(clean=False)
+                c.withhold_pubcomp = True
+                await c.subscribe("dr/t", qos=2)
+                pub = ScenarioClient(b.port, "dr-pub")
+                await pub.connect()
+                await pub.publish("dr/t", b"x", qos=2)
+                for _ in range(100):
+                    if c.pubrel_seen:
+                        break
+                    await asyncio.sleep(0.02)
+                assert c.pubrel_seen
+                recs = [
+                    m
+                    for m in store.stored_inflight_messages()
+                    if m.client == "dr"
+                ]
+                assert len(recs) == 1
+                assert recs[0].fixed_header_type == PUBREL
+                assert recs[0].fixed_header_type != PUBLISH
+                await c.close()
+                await pub.close()
+            finally:
+                await b.stop()
+                store.stop()
+
+        tmp = tempfile.mkdtemp(prefix="q2-rec-")
+        try:
+            run(drill(tmp + "/kv"))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+    def test_kill9_mid_window_resume_is_exactly_once(self):
+        """Freeze a QoS2 session at the PUBREL stage, copy the store the
+        way kill -9 leaves it, boot a second broker life on the image:
+        the restored window must finish via PUBREL/PUBCOMP with zero
+        repeat PUBLISHes, through the batched inflight restore plane."""
+
+        async def drill(path, crash):
+            publishes: list[bytes] = []
+            b1 = ScenarioBroker(Options(inline_client=False))
+            store = LogKVStore()
+            b1.server.add_hook(store, LogKVOptions(path=path, gc_interval=0))
+            await b1.start()
+            try:
+                c = ScenarioClient(b1.port, "k9")
+                await c.connect(clean=False)
+                c.withhold_pubcomp = True
+                c.on_publish = lambda t, p, pk: publishes.append(bytes(p))
+                await c.subscribe("k9/t", qos=2)
+                pub = ScenarioClient(b1.port, "k9-pub")
+                await pub.connect()
+                for seq in range(3):
+                    await pub.publish("k9/t", f"v{seq}".encode(), qos=2)
+                for _ in range(100):
+                    if len(c.pubrel_seen) >= 3:
+                        break
+                    await asyncio.sleep(0.02)
+                assert len(c.pubrel_seen) == 3
+                assert len(publishes) == 3
+                store.sync()
+                shutil.copytree(path, crash)
+                await pub.close()
+            finally:
+                c.abort()
+                await c.close()
+                await b1.stop()
+                store.stop()
+
+            b2 = ScenarioBroker(Options(inline_client=False))
+            b2.server.add_hook(
+                LogKVStore(), LogKVOptions(path=crash, gc_interval=0)
+            )
+            await b2.start()  # serve() replays the image via read_store
+            try:
+                assert b2.server._durable["restored_inflight"] >= 3
+                c2 = ScenarioClient(b2.port, "k9")
+                c2.on_publish = lambda t, p, pk: publishes.append(bytes(p))
+                present = await c2.connect(clean=False)
+                assert present
+                for _ in range(200):
+                    if (
+                        len(c2.pubrel_seen) >= 3
+                        and b2.total_inflight() == 0
+                    ):
+                        break
+                    await asyncio.sleep(0.02)
+                assert len(c2.pubrel_seen) == 3
+                assert b2.total_inflight() == 0
+                # the exactly-once assertion: life 1 delivered all 3,
+                # life 2 must add NOTHING
+                assert sorted(publishes) == [b"v0", b"v1", b"v2"]
+                await c2.close()
+            finally:
+                await b2.stop()
+
+        tmp = tempfile.mkdtemp(prefix="q2-k9-")
+        try:
+            run(drill(tmp + "/kv", tmp + "/kv-crash"))
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)

@@ -1002,3 +1002,104 @@ class TestCrossWorker:
                 await h1.shutdown()
 
         run(scenario())
+
+
+class TestEpochRekey:
+    """The live re-key epoch machinery (ISSUE 20 tentpole): nonce
+    tagging, the stage -> activate -> retire lifecycle, and the atomic
+    fan-out (key ids, epoch) resolution. End-to-end (zero gaps, zero
+    old-key leaks under load) lives in the ``tenant_rekey`` scenario."""
+
+    K0 = bytes(range(16))
+    K1 = bytes(range(16, 32))
+
+    def test_nonce_tag_round_trip(self):
+        from mqtt_tpu.tenancy import (
+            EPOCH_NONCE_MAGIC,
+            epoch_tag_nonce,
+            nonce_epoch,
+        )
+
+        nonce = bytes(range(100, 112))
+        tagged = epoch_tag_nonce(nonce, 3)
+        assert len(tagged) == 12
+        assert tagged[0] == EPOCH_NONCE_MAGIC
+        assert nonce_epoch(tagged) == 3
+        assert tagged[3:] == nonce[3:]  # client uniqueness bytes survive
+        assert nonce_epoch(bytes(12)) is None  # untagged stays opaque
+        assert nonce_epoch(epoch_tag_nonce(nonce, 0)) == 0
+
+    def test_stage_activate_retire_lifecycle(self):
+        from mqtt_tpu.tenancy import KeyRegistry
+
+        ks = KeyRegistry()
+        kid0 = ks.set_key("acme", "sub", self.K0)
+        assert not ks.has_epochs("acme")
+        assert ks.current_epoch("acme") == 0
+
+        epoch = ks.stage_epoch("acme", {"sub": self.K1})
+        assert epoch == 1
+        assert ks.staged_epoch("acme") == 1
+        assert ks.has_epochs("acme")
+        # staged but NOT active: current lookups keep the old generation
+        assert ks.key_id("acme", "sub") == kid0
+        assert ks.current_epoch("acme") == 0
+
+        assert ks.activate_epoch("acme") == 1
+        kid1 = ks.key_id("acme", "sub")
+        assert kid1 != kid0
+        assert ks.current_epoch("acme") == 1
+        # the drain window: both generations stay addressable by tag
+        assert ks.kid_for_epoch("acme", "sub", 0) == kid0
+        assert ks.kid_for_epoch("acme", "sub", 1) == kid1
+
+        scrubbed = ks.retire_epoch("acme", 0)
+        assert scrubbed == 1
+        assert ks.kid_for_epoch("acme", "sub", 0) == -2  # stale
+        assert ks.kid_for_epoch("acme", "sub", 1) == kid1  # live untouched
+        assert not ks._round_keys[kid0].any()  # old key material zeroed
+
+    def test_activate_without_stage_is_noop(self):
+        from mqtt_tpu.tenancy import KeyRegistry
+
+        ks = KeyRegistry()
+        ks.set_key("t", "a", self.K0)
+        assert ks.activate_epoch("t") == -1
+        assert ks.current_epoch("t") == 0
+
+    def test_retire_never_takes_the_live_epoch(self):
+        from mqtt_tpu.tenancy import KeyRegistry
+
+        ks = KeyRegistry()
+        ks.set_key("t", "a", self.K0)
+        ks.stage_epoch("t", {"a": self.K1})
+        ks.activate_epoch("t")
+        ks.retire_epoch("t", 1)  # asks for the CURRENT epoch
+        # the floor clamps at the live generation: epoch 1 still serves
+        assert ks.kid_for_epoch("t", "a", 1) >= 0
+        assert ks.key_id("t", "a") >= 0
+
+    def test_epoch0_identity_resolvable_without_explicit_record(self):
+        from mqtt_tpu.tenancy import KeyRegistry
+
+        ks = KeyRegistry()
+        kid = ks.set_key("t", "a", self.K0)
+        # identities keyed before any rotation live at epoch 0 via _ids
+        assert ks.kid_for_epoch("t", "a", 0) == kid
+        assert ks.kid_for_epoch("t", "a", 7) == -1  # unknown generation
+        assert ks.kid_for_epoch("t", "ghost", 0) == -1
+
+    def test_key_ids_with_epoch_is_atomic_per_generation(self):
+        from mqtt_tpu.tenancy import KeyRegistry
+
+        ks = KeyRegistry()
+        ks.set_key("t", "a", self.K0)
+        ks.set_key("t", "b", self.K1)
+        ids, epoch = ks.key_ids_with_epoch("t", [("a",), ("b",), ("nope",)])
+        assert epoch == 0
+        assert ids[0] >= 0 and ids[1] >= 0 and ids[2] == -1
+        ks.stage_epoch("t", {"a": self.K1, "b": self.K0})
+        ks.activate_epoch("t")
+        ids2, epoch2 = ks.key_ids_with_epoch("t", [("a",), ("b",)])
+        assert epoch2 == 1
+        assert set(ids2).isdisjoint(ids[:2])  # new generation, new rows
